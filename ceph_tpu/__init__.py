@@ -1,0 +1,20 @@
+"""ceph_tpu — a TPU-native distributed object-storage framework with the
+capabilities of Ceph (reference: nexr/ceph 15.2.13).
+
+Layer map (mirrors SURVEY.md section 1):
+  ceph_tpu.utils    - runtime primitives: config, logging, perf counters
+  ceph_tpu.ops      - GF(2^w) math, coding matrices, codec engines (numpy,
+                      C++ native, JAX/TPU bit-plane matmul)
+  ceph_tpu.ec       - erasure-code interface, plugin registry, plugins
+                      (jerasure-compatible CPU reference, flagship `tpu`)
+  ceph_tpu.parallel - device-mesh sharding for batched codec calls
+  ceph_tpu.crush    - deterministic placement (CRUSH-style)
+  ceph_tpu.store    - local object stores (MemStore first)
+  ceph_tpu.msg      - async messenger + typed messages
+  ceph_tpu.osd      - storage daemon: PGs, EC/replicated backends
+  ceph_tpu.mon      - monitor: cluster maps, profiles, consensus
+  ceph_tpu.client   - librados-style client API + objecter
+  ceph_tpu.tools    - CLIs (rados-like, benchmark, vstart)
+"""
+
+__version__ = "0.1.0"

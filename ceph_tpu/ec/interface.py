@@ -1,0 +1,284 @@
+"""Erasure-code plugin interface and base class.
+
+Python-native equivalent of the reference's codec seam:
+`ErasureCodeInterface` (reference src/erasure-code/ErasureCodeInterface.h:170,
+~12 virtuals) and the `ErasureCode` default implementation (reference
+src/erasure-code/ErasureCode.cc).  Semantics reproduced behaviorally:
+
+* objects are padded so all k+m chunks are equal size
+  (ErasureCodeInterface.h:39-78 layout doc; encode_prepare at
+  ErasureCode.cc:151-186);
+* minimum_to_decode = "want if available, else first k available"
+  (ErasureCode.cc:103-120);
+* optional chunk remapping via the profile's ``mapping=`` key of D/c
+  characters (ErasureCode.cc:274-293);
+* profiles are plain string->string maps (ErasureCodeInterface.h:155).
+
+Chunks here are ``bytes`` / numpy uint8 arrays instead of bufferlists; the
+TPU plugin adds batched array entry points on top (ceph_tpu/ec/plugins/tpu.py).
+"""
+from __future__ import annotations
+
+import abc
+from typing import Dict, List, Mapping, MutableMapping, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+ErasureCodeProfile = MutableMapping[str, str]
+
+SIMD_ALIGN = 32  # reference ErasureCode.cc:42
+
+
+class ErasureCodeValidationError(ValueError):
+    """Raised when a profile fails validation (maps EINVAL returns)."""
+
+
+class ErasureCodeInterface(abc.ABC):
+    """Abstract codec API (reference ErasureCodeInterface.h:170)."""
+
+    @abc.abstractmethod
+    def init(self, profile: ErasureCodeProfile) -> None:
+        """Initialize from a profile; raises ErasureCodeValidationError on
+        bad parameters (reference :219)."""
+
+    @abc.abstractmethod
+    def get_chunk_count(self) -> int:
+        """k + m (reference :227)."""
+
+    @abc.abstractmethod
+    def get_data_chunk_count(self) -> int:
+        """k (reference :240)."""
+
+    def get_coding_chunk_count(self) -> int:
+        """m (reference :249)."""
+        return self.get_chunk_count() - self.get_data_chunk_count()
+
+    def get_sub_chunk_count(self) -> int:
+        """>1 only for array codes like CLAY (reference :259)."""
+        return 1
+
+    @abc.abstractmethod
+    def get_chunk_size(self, object_size: int) -> int:
+        """Chunk size for a given object size, including padding/alignment
+        (reference :278)."""
+
+    @abc.abstractmethod
+    def minimum_to_decode(self, want_to_read: Set[int],
+                          available: Set[int]
+                          ) -> Dict[int, List[Tuple[int, int]]]:
+        """chunk id -> [(subchunk offset, count)] needed (reference :297)."""
+
+    @abc.abstractmethod
+    def minimum_to_decode_with_cost(self, want_to_read: Set[int],
+                                    available: Mapping[int, int]) -> Set[int]:
+        """Cheapest chunk set given per-chunk retrieval costs (reference :326)."""
+
+    @abc.abstractmethod
+    def encode(self, want_to_encode: Set[int], data: bytes
+               ) -> Dict[int, bytes]:
+        """Pad + split + encode; returns the requested chunks (reference :365)."""
+
+    @abc.abstractmethod
+    def encode_chunks(self, want_to_encode: Set[int],
+                      encoded: Dict[int, np.ndarray]) -> None:
+        """In-place parity computation over pre-split chunks (reference :370)."""
+
+    @abc.abstractmethod
+    def decode(self, want_to_read: Set[int], chunks: Mapping[int, bytes],
+               chunk_size: int) -> Dict[int, bytes]:
+        """Reconstruct wanted chunks from available ones (reference :407)."""
+
+    @abc.abstractmethod
+    def get_chunk_mapping(self) -> List[int]:
+        """Remapped chunk order, empty if identity (reference :448)."""
+
+    @abc.abstractmethod
+    def decode_concat(self, chunks: Mapping[int, bytes]) -> bytes:
+        """Concatenated data chunks in mapped order (reference :460)."""
+
+    def create_rule(self, name: str, crush) -> int:
+        """Create a CRUSH rule for this codec (reference :212); implemented
+        by the base class against ceph_tpu.crush."""
+        raise NotImplementedError
+
+
+class ErasureCode(ErasureCodeInterface):
+    """Default implementation (reference ErasureCode.cc)."""
+
+    DEFAULT_RULE_ROOT = "default"
+    DEFAULT_RULE_FAILURE_DOMAIN = "host"
+
+    def __init__(self) -> None:
+        self.chunk_mapping: List[int] = []
+        self._profile: ErasureCodeProfile = {}
+        self.rule_root = self.DEFAULT_RULE_ROOT
+        self.rule_failure_domain = self.DEFAULT_RULE_FAILURE_DOMAIN
+        self.rule_device_class = ""
+
+    # -- profile plumbing --------------------------------------------------
+    def init(self, profile: ErasureCodeProfile) -> None:
+        self.rule_root = self.to_string("crush-root", profile,
+                                        self.DEFAULT_RULE_ROOT)
+        self.rule_failure_domain = self.to_string(
+            "crush-failure-domain", profile, self.DEFAULT_RULE_FAILURE_DOMAIN)
+        self.rule_device_class = self.to_string("crush-device-class",
+                                                profile, "")
+        self._profile = dict(profile)
+
+    def get_profile(self) -> ErasureCodeProfile:
+        return self._profile
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        self.to_mapping(profile)
+
+    def to_mapping(self, profile: ErasureCodeProfile) -> None:
+        """Parse ``mapping=DD_D...`` (D=data position) per ErasureCode.cc:274."""
+        if "mapping" in profile:
+            mapping = profile["mapping"]
+            data_positions = [i for i, c in enumerate(mapping) if c == "D"]
+            coding_positions = [i for i, c in enumerate(mapping) if c != "D"]
+            self.chunk_mapping = data_positions + coding_positions
+
+    @staticmethod
+    def to_int(name: str, profile: ErasureCodeProfile, default: str) -> int:
+        if name not in profile or profile[name] == "":
+            profile[name] = default
+        try:
+            return int(profile[name])
+        except ValueError:
+            raise ErasureCodeValidationError(
+                f"could not convert {name}={profile[name]!r} to int")
+
+    @staticmethod
+    def to_bool(name: str, profile: ErasureCodeProfile, default: str) -> bool:
+        if name not in profile or profile[name] == "":
+            profile[name] = default
+        return profile[name] in ("yes", "true")
+
+    @staticmethod
+    def to_string(name: str, profile: ErasureCodeProfile,
+                  default: str) -> str:
+        if name not in profile or profile[name] == "":
+            profile[name] = default
+        return profile[name]
+
+    @staticmethod
+    def sanity_check_k_m(k: int, m: int) -> None:
+        if k < 2:
+            raise ErasureCodeValidationError(f"k={k} must be >= 2")
+        if m < 1:
+            raise ErasureCodeValidationError(f"m={m} must be >= 1")
+
+    # -- chunk bookkeeping -------------------------------------------------
+    def chunk_index(self, i: int) -> int:
+        return self.chunk_mapping[i] if len(self.chunk_mapping) > i else i
+
+    def get_chunk_mapping(self) -> List[int]:
+        return self.chunk_mapping
+
+    # -- minimum_to_decode (reference ErasureCode.cc:103-149) -------------
+    def _minimum_to_decode(self, want_to_read: Set[int],
+                           available: Set[int]) -> Set[int]:
+        if want_to_read <= available:
+            return set(want_to_read)
+        k = self.get_data_chunk_count()
+        if len(available) < k:
+            raise IOError("not enough available chunks to decode")
+        return set(sorted(available)[:k])
+
+    def minimum_to_decode(self, want_to_read: Set[int],
+                          available: Set[int]
+                          ) -> Dict[int, List[Tuple[int, int]]]:
+        minimum = self._minimum_to_decode(want_to_read, available)
+        sub = [(0, self.get_sub_chunk_count())]
+        return {i: list(sub) for i in sorted(minimum)}
+
+    def minimum_to_decode_with_cost(self, want_to_read: Set[int],
+                                    available: Mapping[int, int]) -> Set[int]:
+        return self._minimum_to_decode(want_to_read, set(available))
+
+    # -- encode (reference ErasureCode.cc:151-204) ------------------------
+    def encode_prepare(self, raw: bytes) -> Dict[int, np.ndarray]:
+        k = self.get_data_chunk_count()
+        m = self.get_coding_chunk_count()
+        blocksize = self.get_chunk_size(len(raw))
+        if blocksize == 0:  # zero-length object: all chunks empty
+            return {self.chunk_index(i): np.zeros(0, dtype=np.uint8)
+                    for i in range(k + m)}
+        padded_chunks = k - len(raw) // blocksize
+        encoded: Dict[int, np.ndarray] = {}
+        buf = np.frombuffer(raw, dtype=np.uint8)
+        for i in range(k - padded_chunks):
+            encoded[self.chunk_index(i)] = \
+                buf[i * blocksize:(i + 1) * blocksize].copy()
+        if padded_chunks:
+            remainder = len(raw) - (k - padded_chunks) * blocksize
+            tail = np.zeros(blocksize, dtype=np.uint8)
+            tail[:remainder] = buf[(k - padded_chunks) * blocksize:]
+            encoded[self.chunk_index(k - padded_chunks)] = tail
+            for i in range(k - padded_chunks + 1, k):
+                encoded[self.chunk_index(i)] = np.zeros(blocksize,
+                                                        dtype=np.uint8)
+        for i in range(k, k + m):
+            encoded[self.chunk_index(i)] = np.zeros(blocksize, dtype=np.uint8)
+        return encoded
+
+    def encode(self, want_to_encode: Set[int], data: bytes
+               ) -> Dict[int, bytes]:
+        encoded = self.encode_prepare(data)
+        self.encode_chunks(want_to_encode, encoded)
+        return {i: encoded[i].tobytes()
+                for i in sorted(encoded) if i in want_to_encode}
+
+    # -- decode (reference ErasureCode.cc:212-255) ------------------------
+    def _decode(self, want_to_read: Set[int],
+                chunks: Mapping[int, np.ndarray]
+                ) -> Dict[int, np.ndarray]:
+        have = set(chunks)
+        if want_to_read <= have:
+            return {i: np.asarray(chunks[i]) for i in want_to_read}
+        k = self.get_data_chunk_count()
+        m = self.get_coding_chunk_count()
+        if not chunks:
+            raise IOError("no chunks to decode from")
+        blocksize = len(next(iter(chunks.values())))
+        decoded: Dict[int, np.ndarray] = {}
+        for i in range(k + m):
+            if i in chunks:
+                decoded[i] = np.array(np.frombuffer(
+                    np.asarray(chunks[i]).tobytes(), dtype=np.uint8))
+            else:
+                decoded[i] = np.zeros(blocksize, dtype=np.uint8)
+        self.decode_chunks(want_to_read, {i: np.asarray(chunks[i])
+                                          for i in chunks}, decoded)
+        return {i: decoded[i] for i in want_to_read}
+
+    def decode(self, want_to_read: Set[int], chunks: Mapping[int, bytes],
+               chunk_size: int = 0) -> Dict[int, bytes]:
+        arrays = {i: np.frombuffer(c, dtype=np.uint8)
+                  for i, c in chunks.items()}
+        out = self._decode(set(want_to_read), arrays)
+        return {i: v.tobytes() for i, v in out.items()}
+
+    def decode_chunks(self, want_to_read: Set[int],
+                      chunks: Mapping[int, np.ndarray],
+                      decoded: Dict[int, np.ndarray]) -> None:
+        raise NotImplementedError("decode_chunks not implemented")
+
+    def decode_concat(self, chunks: Mapping[int, bytes]) -> bytes:
+        want = {self.chunk_index(i)
+                for i in range(self.get_data_chunk_count())}
+        arrays = {i: np.frombuffer(c, dtype=np.uint8)
+                  for i, c in chunks.items()}
+        decoded = self._decode(want, arrays)
+        return b"".join(
+            decoded[self.chunk_index(i)].tobytes()
+            for i in range(self.get_data_chunk_count()))
+
+    # -- CRUSH integration (reference ErasureCode.cc:64-83) ---------------
+    def create_rule(self, name: str, crush) -> int:
+        ruleid = crush.add_simple_rule(
+            name, self.rule_root, self.rule_failure_domain,
+            self.rule_device_class, "indep", pool_type="erasure")
+        crush.set_rule_mask_max_size(ruleid, self.get_chunk_count())
+        return ruleid

@@ -1,0 +1,3 @@
+"""Built-in erasure-code plugins, loaded on demand by the registry
+(ceph_tpu/ec/registry.py) the way the reference dlopens libec_<name>.so
+(reference src/erasure-code/ErasureCodePlugin.cc:124-182)."""

@@ -1,0 +1,365 @@
+"""CPU reference codec plugin, drop-in equivalent of the reference's
+default "jerasure" plugin (reference
+src/erasure-code/jerasure/ErasureCodeJerasure.{h,cc} +
+ErasureCodePluginJerasure.cc) with the same seven techniques and the same
+profile/chunk-size semantics.  GF kernels are our own
+(ceph_tpu/ops/{gf,matrix,engine}.py) since the reference's jerasure /
+gf-complete submodules are vendored externals.
+
+This plugin is the bit-exactness oracle for the TPU plugin
+(ceph_tpu/ec/plugins/tpu.py): both build identical coding matrices, so
+chunks must match byte-for-byte.
+
+Techniques (dispatch mirrors ErasureCodePluginJerasure.cc:34-71):
+  reed_sol_van   - RS Vandermonde, GF(2^w) matrix, w in {8,16,32}
+  reed_sol_r6_op - RAID-6 (m=2), P=XOR / Q=powers-of-2 matrix
+  cauchy_orig    - Cauchy bitmatrix, packet layout
+  cauchy_good    - ones-minimized Cauchy bitmatrix, packet layout
+  liberation     - m=2 bitmatrix code, w prime (see note in class docstring)
+  blaum_roth     - m=2 bitmatrix code, w+1 prime
+  liber8tion     - m=2 bitmatrix code, w=8
+"""
+from __future__ import annotations
+
+from typing import Dict, Mapping, Set
+
+import numpy as np
+
+from ...ops import matrix as mat
+from ...ops.engine import CodecCore
+from ..interface import (ErasureCode, ErasureCodeProfile,
+                         ErasureCodeValidationError)
+from ..registry import ErasureCodePlugin
+
+LARGEST_VECTOR_WORDSIZE = 16  # reference ErasureCodeJerasure.cc:30
+
+
+def is_prime(value: int) -> bool:
+    if value < 2:
+        return False
+    f = 2
+    while f * f <= value:
+        if value % f == 0:
+            return False
+        f += 1
+    return True
+
+
+class ErasureCodeJerasure(ErasureCode):
+    """Base class (reference ErasureCodeJerasure.h:25-79)."""
+
+    DEFAULT_K = "2"
+    DEFAULT_M = "1"
+    DEFAULT_W = "8"
+
+    def __init__(self, technique: str):
+        super().__init__()
+        self.technique = technique
+        self.k = 0
+        self.m = 0
+        self.w = 0
+        self.per_chunk_alignment = False
+        self.core: CodecCore = None  # built by prepare()
+
+    # -- plumbing ---------------------------------------------------------
+    def init(self, profile: ErasureCodeProfile) -> None:
+        profile["technique"] = self.technique
+        self.parse(profile)
+        self.prepare()
+        super().init(profile)
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        self.k = self.to_int("k", profile, self.DEFAULT_K)
+        self.m = self.to_int("m", profile, self.DEFAULT_M)
+        self.w = self.to_int("w", profile, self.DEFAULT_W)
+        if self.chunk_mapping and len(self.chunk_mapping) != self.k + self.m:
+            nmapped = len(self.chunk_mapping)
+            self.chunk_mapping = []
+            raise ErasureCodeValidationError(
+                f"mapping maps {nmapped} chunks instead of "
+                f"the expected {self.k + self.m}")
+        self.sanity_check_k_m(self.k, self.m)
+
+    def prepare(self) -> None:
+        raise NotImplementedError
+
+    # -- interface --------------------------------------------------------
+    def get_chunk_count(self) -> int:
+        return self.k + self.m
+
+    def get_data_chunk_count(self) -> int:
+        return self.k
+
+    def get_alignment(self) -> int:
+        raise NotImplementedError
+
+    def get_chunk_size(self, object_size: int) -> int:
+        """Padding rules per reference ErasureCodeJerasure.cc:80-103."""
+        alignment = self.get_alignment()
+        if self.per_chunk_alignment:
+            chunk_size = object_size // self.k
+            if object_size % self.k:
+                chunk_size += 1
+            if alignment > chunk_size:
+                chunk_size = alignment
+            modulo = chunk_size % alignment
+            if modulo:
+                chunk_size += alignment - modulo
+            return chunk_size
+        tail = object_size % alignment
+        padded = object_size + (alignment - tail if tail else 0)
+        assert padded % self.k == 0
+        return padded // self.k
+
+    def encode_chunks(self, want_to_encode: Set[int],
+                      encoded: Dict[int, np.ndarray]) -> None:
+        """Data piece i lives at key chunk_index(i) (where encode_prepare
+        put it); parity for code position k+i goes to key chunk_index(k+i).
+        With the default identity mapping this is byte-identical to the
+        reference (ErasureCodeJerasure.cc:105-113)."""
+        data = np.stack([encoded[self.chunk_index(i)] for i in range(self.k)])
+        parity = self.core.encode(data)
+        for i in range(self.m):
+            encoded[self.chunk_index(self.k + i)][:] = parity[i]
+
+    def decode_chunks(self, want_to_read: Set[int],
+                      chunks: Mapping[int, np.ndarray],
+                      decoded: Dict[int, np.ndarray]) -> None:
+        if len(chunks) < self.k:
+            raise IOError("not enough chunks to decode")
+        # translate disk keys -> code positions for the codec math
+        pos_of_key = {self.chunk_index(p): p
+                      for p in range(self.k + self.m)}
+        present = {pos_of_key[i]: np.asarray(c) for i, c in chunks.items()}
+        blocksize = len(next(iter(present.values())))
+        rebuilt = self.core.decode_chunks(present, blocksize)
+        for pos, arr in rebuilt.items():
+            decoded[self.chunk_index(pos)][:] = arr
+
+
+class ReedSolomonVandermonde(ErasureCodeJerasure):
+    """reed_sol_van (reference ErasureCodeJerasure.cc:156-204)."""
+
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = "7", "3", "8"
+
+    def __init__(self, technique: str = "reed_sol_van"):
+        super().__init__(technique)
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        if self.w not in (8, 16, 32):
+            raise ErasureCodeValidationError(
+                f"ReedSolomonVandermonde: w={self.w} must be one of "
+                "{8, 16, 32}")
+        self.per_chunk_alignment = self.to_bool(
+            "jerasure-per-chunk-alignment", profile, "false")
+
+    def get_alignment(self) -> int:
+        if self.per_chunk_alignment:
+            return self.w * LARGEST_VECTOR_WORDSIZE
+        if (self.w * 4) % LARGEST_VECTOR_WORDSIZE:
+            return self.k * self.w * LARGEST_VECTOR_WORDSIZE
+        return self.k * self.w * 4
+
+    def prepare(self) -> None:
+        M = mat.reed_sol_vandermonde_coding_matrix(self.k, self.m, self.w)
+        self.core = CodecCore(self.k, self.m, self.w, coding_matrix=M,
+                              layout="byte")
+
+
+class ReedSolomonRAID6(ReedSolomonVandermonde):
+    """reed_sol_r6_op (reference ErasureCodeJerasure.cc:207-256)."""
+
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = "7", "2", "8"
+
+    def __init__(self):
+        super().__init__("reed_sol_r6_op")
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        ErasureCodeJerasure.parse(self, profile)
+        if self.m != 2:
+            raise ErasureCodeValidationError(
+                f"ReedSolomonRAID6: m={self.m} must be 2 for RAID6")
+        if self.w not in (8, 16, 32):
+            raise ErasureCodeValidationError(
+                f"ReedSolomonRAID6: w={self.w} must be one of {{8, 16, 32}}")
+
+    def prepare(self) -> None:
+        M = mat.reed_sol_r6_coding_matrix(self.k, self.w)
+        self.core = CodecCore(self.k, self.m, self.w, coding_matrix=M,
+                              layout="byte")
+
+
+class PacketizedBitmatrixTechnique(ErasureCodeJerasure):
+    """Shared base for the packet-layout bitmatrix techniques (cauchy /
+    liberation families; reference ErasureCodeJerasure.cc:259-316)."""
+
+    DEFAULT_PACKETSIZE = "2048"
+
+    def __init__(self, technique: str):
+        super().__init__(technique)
+        self.packetsize = 0
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        self.packetsize = self.to_int("packetsize", profile,
+                                      self.DEFAULT_PACKETSIZE)
+
+    def get_alignment(self) -> int:
+        if self.per_chunk_alignment:
+            alignment = self.w * self.packetsize
+            modulo = alignment % LARGEST_VECTOR_WORDSIZE
+            if modulo:
+                alignment += LARGEST_VECTOR_WORDSIZE - modulo
+            return alignment
+        if (self.w * self.packetsize * 4) % LARGEST_VECTOR_WORDSIZE:
+            return self.k * self.w * self.packetsize * LARGEST_VECTOR_WORDSIZE
+        return self.k * self.w * self.packetsize * 4
+
+    def _make_core(self, bitmatrix: np.ndarray) -> None:
+        self.core = CodecCore(self.k, self.m, self.w, bitmatrix=bitmatrix,
+                              layout="packet", packetsize=self.packetsize)
+
+
+class Cauchy(PacketizedBitmatrixTechnique):
+    """cauchy_orig / cauchy_good (reference ErasureCodeJerasure.cc:259-336)."""
+
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = "7", "3", "8"
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        self.per_chunk_alignment = self.to_bool(
+            "jerasure-per-chunk-alignment", profile, "false")
+
+    def _coding_matrix(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def prepare(self) -> None:
+        M = self._coding_matrix()
+        self._make_core(mat.matrix_to_bitmatrix(M, self.w))
+
+
+class CauchyOrig(Cauchy):
+    def __init__(self):
+        super().__init__("cauchy_orig")
+
+    def _coding_matrix(self) -> np.ndarray:
+        return mat.cauchy_original_coding_matrix(self.k, self.m, self.w)
+
+
+class CauchyGood(Cauchy):
+    def __init__(self):
+        super().__init__("cauchy_good")
+
+    def _coding_matrix(self) -> np.ndarray:
+        return mat.cauchy_good_coding_matrix(self.k, self.m, self.w)
+
+
+class Liberation(PacketizedBitmatrixTechnique):
+    """liberation (reference ErasureCodeJerasure.cc:339-454).
+
+    Parameter validation matches the reference exactly (m=2, w prime > 2,
+    k <= w, packetsize multiple of 4).  The coding bitmatrix is a
+    minimum-density MDS bitmatrix built from a Cauchy matrix over GF(2^w)
+    rather than jerasure's liberation construction (the liberation tables
+    live in the vendored submodule absent from the reference checkout), so
+    chunks are self-consistent within this framework but not byte-identical
+    to jerasure's liberation output."""
+
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = "2", "2", "7"
+
+    def __init__(self, technique: str = "liberation"):
+        super().__init__(technique)
+
+    def check_k(self) -> None:
+        if self.k > self.w:
+            raise ErasureCodeValidationError(
+                f"k={self.k} must be less than or equal to w={self.w}")
+
+    def check_w(self) -> None:
+        if self.w <= 2 or not is_prime(self.w):
+            raise ErasureCodeValidationError(
+                f"w={self.w} must be greater than two and be prime")
+
+    def check_packetsize(self) -> None:
+        if self.packetsize == 0:
+            raise ErasureCodeValidationError("packetsize must be set")
+        if self.packetsize % 4 != 0:
+            raise ErasureCodeValidationError(
+                f"packetsize={self.packetsize} must be a multiple of 4")
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        self.check_k()
+        self.check_w()
+        self.check_packetsize()
+
+    def prepare(self) -> None:
+        M = mat.cauchy_good_coding_matrix(self.k, self.m, self.w)
+        self._make_core(mat.matrix_to_bitmatrix(M, self.w))
+
+
+class BlaumRoth(Liberation):
+    """blaum_roth (reference ErasureCodeJerasure.cc:457-478): w+1 prime."""
+
+    def __init__(self):
+        super().__init__("blaum_roth")
+
+    def check_w(self) -> None:
+        # w=7 tolerated for backward compatibility (reference :459-472)
+        if self.w == 7:
+            return
+        if self.w <= 2 or not is_prime(self.w + 1):
+            raise ErasureCodeValidationError(
+                f"w={self.w} must be greater than two and w+1 must be prime")
+
+
+class Liber8tion(Liberation):
+    """liber8tion (reference ErasureCodeJerasure.cc:481-515): w=8, m=2."""
+
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = "2", "2", "8"
+
+    def __init__(self):
+        super().__init__("liber8tion")
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        PacketizedBitmatrixTechnique.parse(self, profile)
+        if self.m != 2:
+            raise ErasureCodeValidationError(
+                f"liber8tion: m={self.m} must be 2")
+        if self.w != 8:
+            raise ErasureCodeValidationError(
+                f"liber8tion: w={self.w} must be 8")
+        self.check_k()
+        if self.packetsize == 0:
+            raise ErasureCodeValidationError("packetsize must be set")
+
+
+TECHNIQUES = {
+    "reed_sol_van": ReedSolomonVandermonde,
+    "reed_sol_r6_op": ReedSolomonRAID6,
+    "cauchy_orig": CauchyOrig,
+    "cauchy_good": CauchyGood,
+    "liberation": Liberation,
+    "blaum_roth": BlaumRoth,
+    "liber8tion": Liber8tion,
+}
+
+
+class ErasureCodePluginJerasure(ErasureCodePlugin):
+    """Technique dispatch (reference ErasureCodePluginJerasure.cc:34-71)."""
+
+    def factory(self, profile: ErasureCodeProfile):
+        technique = profile.get("technique", "reed_sol_van")
+        cls = TECHNIQUES.get(technique)
+        if cls is None:
+            raise ErasureCodeValidationError(
+                f"technique={technique} is not a valid coding technique")
+        codec = cls()
+        codec.init(profile)
+        return codec
+
+
+def __erasure_code_init__(registry) -> None:
+    registry.add("jerasure", ErasureCodePluginJerasure())

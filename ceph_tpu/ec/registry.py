@@ -1,0 +1,112 @@
+"""Erasure-code plugin registry.
+
+Python-native equivalent of `ErasureCodePluginRegistry`
+(reference src/erasure-code/ErasureCodePlugin.h:45-79, .cc:90-200):
+singleton registry, load-on-demand by name, version checking, factory
+dispatch, and `preload()` of a comma-separated plugin list.  Where the
+reference dlopens ``libec_<name>.so`` and calls ``__erasure_code_init``
+(ErasureCodePlugin.cc:124-182), we import ``ceph_tpu.ec.plugins.<name>``
+and call its module-level ``__erasure_code_init__(registry)``; external
+plugins can be registered the same way via ``add()``.
+
+This registry is exactly where the flagship ``tpu`` plugin hooks in — the
+same seam the north star names (BASELINE.json), used unchanged by the OSD
+ECBackend (ceph_tpu/osd/ec_backend.py) and the monitor's profile
+validation (ceph_tpu/mon), mirroring reference osd/PGBackend.cc:555-591
+and mon/OSDMonitor.cc:7371-7392.
+"""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Dict, Optional
+
+from .interface import ErasureCodeInterface, ErasureCodeProfile
+
+VERSION = "1.0.0"  # plugin ABI version (reference checks CEPH_GIT_NICE_VER)
+
+
+class ErasureCodePlugin:
+    """A named factory of codec instances (reference ErasureCodePlugin.h:29)."""
+
+    version = VERSION
+
+    def factory(self, profile: ErasureCodeProfile) -> ErasureCodeInterface:
+        raise NotImplementedError
+
+
+class ErasureCodePluginRegistry:
+    _instance: Optional["ErasureCodePluginRegistry"] = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self) -> None:
+        self.lock = threading.Lock()
+        self.load_lock = threading.Lock()  # held across the whole load()
+        self.plugins: Dict[str, ErasureCodePlugin] = {}
+        self.disable_dlclose = False
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+            return cls._instance
+
+    def add(self, name: str, plugin: ErasureCodePlugin) -> None:
+        with self.lock:
+            if name in self.plugins:
+                raise KeyError(f"plugin {name} already registered")
+            self.plugins[name] = plugin
+
+    def remove(self, name: str) -> None:
+        with self.lock:
+            self.plugins.pop(name, None)
+
+    def get(self, name: str) -> Optional[ErasureCodePlugin]:
+        with self.lock:
+            return self.plugins.get(name)
+
+    def load(self, name: str) -> ErasureCodePlugin:
+        """Load-on-demand: import ceph_tpu.ec.plugins.<name> which must call
+        ``registry.add(name, plugin)`` from __erasure_code_init__.  The load
+        lock is held across check + registration, as the reference holds
+        its registry mutex (reference ErasureCodePlugin.cc:124-182)."""
+        with self.load_lock:
+            existing = self.get(name)
+            if existing is not None:
+                return existing
+            try:
+                mod = importlib.import_module(f"ceph_tpu.ec.plugins.{name}")
+            except ImportError as e:
+                raise KeyError(f"erasure-code plugin {name!r} not found: {e}")
+            entry = getattr(mod, "__erasure_code_init__", None)
+            if entry is None:
+                raise KeyError(
+                    f"plugin {name!r} has no __erasure_code_init__ entry point")
+            entry(self)
+            plugin = self.get(name)
+            if plugin is None:
+                raise KeyError(f"plugin {name!r} did not register itself")
+            if plugin.version != VERSION:
+                self.remove(name)
+                raise KeyError(
+                    f"plugin {name!r} version {plugin.version} != {VERSION}")
+            return plugin
+
+    def factory(self, name: str, profile: ErasureCodeProfile
+                ) -> ErasureCodeInterface:
+        """Get-or-load the plugin, then build and init a codec instance
+        (reference ErasureCodePlugin.cc:90-118)."""
+        plugin = self.get(name) or self.load(name)
+        instance = plugin.factory(dict(profile))
+        return instance
+
+    def preload(self, names: str) -> None:
+        """Preload a comma-separated plugin list (reference :184-200)."""
+        for name in filter(None, (n.strip() for n in names.split(","))):
+            if self.get(name) is None:
+                self.load(name)
+
+
+def instance() -> ErasureCodePluginRegistry:
+    return ErasureCodePluginRegistry.instance()

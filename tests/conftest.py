@@ -1,0 +1,16 @@
+"""Test fixtures.
+
+Forces JAX onto a virtual 8-device CPU mesh *before* jax is imported
+anywhere, so multi-chip sharding (ceph_tpu.parallel) is exercised without
+TPU hardware.  Benchmarks (bench.py) run in their own process and are not
+affected."""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,83 @@
+"""GF(2^w) field arithmetic tests (analog of the galois-layer checks the
+reference inherits from its vendored gf-complete test suite)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.ops.gf import GF, GF_POLY, gf
+
+
+@pytest.mark.parametrize("w", [4, 7, 8, 16])
+def test_field_axioms_random(w):
+    f = gf(w)
+    rng = np.random.default_rng(1234 + w)
+    for _ in range(200):
+        a, b, c = (int(x) for x in rng.integers(0, f.size, 3))
+        assert f.mul(a, b) == f.mul(b, a)
+        assert f.mul(a, f.mul(b, c)) == f.mul(f.mul(a, b), c)
+        # distributivity over xor (field addition)
+        assert f.mul(a, b ^ c) == f.mul(a, b) ^ f.mul(a, c)
+        if a:
+            assert f.mul(a, f.inv(a)) == 1
+        assert f.mul(a, 1) == a
+        assert f.mul(a, 0) == 0
+
+
+def test_known_values_w8():
+    f = gf(8)
+    # poly 0x11D: x^8 = x^4 + x^3 + x^2 + 1
+    assert f.mul(0x80, 2) == 0x1D
+    assert f.mul(2, 2) == 4
+    assert f.mul(3, 3) == 5  # (x+1)^2 = x^2+1
+    # Fermat: a^255 == 1
+    assert f.pow(7, 255) == 1
+
+
+@pytest.mark.parametrize("w", [4, 8, 16])
+def test_tables_match_slow_mul(w):
+    f = gf(w)
+    rng = np.random.default_rng(99)
+    for _ in range(100):
+        a, b = (int(x) for x in rng.integers(0, f.size, 2))
+        assert f.mul(a, b) == f._mul_slow(a, b)
+
+
+def test_w32_slow_path():
+    f = GF(32)
+    a, b = 0xDEADBEEF, 0x12345678
+    p = f._mul_slow(a, b)
+    assert 0 <= p < (1 << 32)
+    assert f._mul_slow(a, 1) == a
+    assert f._mul_slow(a, 2) ^ f._mul_slow(a, 3) == a  # distributivity
+    inv = f.inv(a)
+    assert f._mul_slow(a, inv) == 1
+
+
+def test_vectorized_mul_matches_scalar():
+    f = gf(8)
+    rng = np.random.default_rng(7)
+    a = rng.integers(0, 256, 64)
+    b = rng.integers(0, 256, 64)
+    va = np.asarray(f.mul(a, b))
+    for i in range(64):
+        assert va[i] == f.mul(int(a[i]), int(b[i]))
+
+
+def test_mat_invert_roundtrip():
+    f = gf(8)
+    rng = np.random.default_rng(5)
+    for _ in range(10):
+        while True:
+            A = rng.integers(0, 256, (5, 5))
+            try:
+                Ainv = f.mat_invert(A)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        prod = f.matmul(A, Ainv)
+        assert np.array_equal(prod, np.eye(5, dtype=np.int64))
+
+
+def test_all_polys_primitive():
+    for w in GF_POLY:
+        if w <= 16:
+            gf(w)  # raises if 2 doesn't generate the full group
