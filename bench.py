@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""North-star benchmark: EC encode throughput, TPU plugin vs the native
+CPU baseline (the stand-in for jerasure, whose SIMD kernels live in the
+reference's empty vendored submodules — see BASELINE.md).
+
+Reproduces the semantics of the reference's harness
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc:156-185: throughput
+= object bytes processed / seconds) for the BASELINE.json config
+"Reed-Solomon k=8 m=4, batched stripes", and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+
+where vs_baseline is the speedup of the TPU plugin over the native CPU
+kernel measured head-to-head on this host (target: >= 10x).
+
+Accounting is end-to-end: host buffers in, parity on host out — the same
+boundary the OSD write pipeline sees.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def time_fn(fn, min_iters=3, min_time=2.0):
+    fn()  # warmup / compile
+    t0 = time.perf_counter()
+    iters = 0
+    while True:
+        fn()
+        iters += 1
+        dt = time.perf_counter() - t0
+        if iters >= min_iters and dt >= min_time:
+            return dt / iters
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--m", type=int, default=4)
+    ap.add_argument("--batch", type=int, default=64,
+                    help="stripes per device call")
+    ap.add_argument("--stripe-mib", type=float, default=1.0,
+                    help="stripe unit (k chunks) size in MiB")
+    ap.add_argument("--workload", choices=["encode", "decode"],
+                    default="encode")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform (e.g. cpu) for debugging")
+    args = ap.parse_args()
+
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    from ceph_tpu.ec import registry as ecreg
+    from ceph_tpu.ops import native
+
+    k, m = args.k, args.m
+    L = int(args.stripe_mib * 2**20) // k
+    L = (L // 128) * 128
+    batch = args.batch
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (batch, k, L), dtype=np.uint8)
+    gib = data.nbytes / 2**30
+
+    reg = ecreg.instance()
+    profile = {"k": str(k), "m": str(m), "technique": "reed_sol_van"}
+    tpu = reg.factory("tpu", dict(profile))
+
+    if args.workload == "encode":
+        tpu_s = time_fn(lambda: tpu.encode_batch(data))
+    else:
+        parity = tpu.encode_batch(data)
+        present = {i: data[:, i] for i in range(2, k)}
+        present.update({k + i: parity[:, i] for i in range(m)})
+        tpu_s = time_fn(lambda: tpu.decode_batch(present, L))
+
+    # CPU baseline: native C++ kernel (SSSE3 split-table, jerasure-class);
+    # falls back to numpy if the toolchain is unavailable.
+    from ceph_tpu.ops.matrix import reed_sol_vandermonde_coding_matrix
+    M = reed_sol_vandermonde_coding_matrix(k, m, 8)
+    baseline_name = "native-c++"
+    try:
+        nb = native.NativeBackend()
+        cpu_fn = lambda: nb.apply_matrix(M, data, 8)  # noqa: E731
+    except RuntimeError:
+        from ceph_tpu.ops.engine import NumpyBackend
+        nb2 = NumpyBackend()
+        baseline_name = "numpy"
+        cpu_fn = lambda: nb2.apply_matrix(M, data, 8)  # noqa: E731
+    cpu_s = time_fn(cpu_fn, min_iters=2, min_time=1.0)
+
+    import jax
+    dev = jax.devices()[0].platform
+    value = gib / tpu_s
+    baseline = gib / cpu_s
+    print(json.dumps({
+        "metric": (f"EC {args.workload} GiB/s (plugin=tpu reed_sol_van "
+                   f"k={k} m={m}, {args.stripe_mib:g}MiB stripes x{batch}, "
+                   f"device={dev}, baseline={baseline_name} "
+                   f"{baseline:.2f} GiB/s)"),
+        "value": round(value, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(value / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

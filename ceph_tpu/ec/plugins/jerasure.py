@@ -84,6 +84,11 @@ class ErasureCodeJerasure(ErasureCode):
     def prepare(self) -> None:
         raise NotImplementedError
 
+    def make_backend(self):
+        """Codec execution backend; None = numpy CPU reference.  The tpu
+        plugin overrides this with the shared JAX backend."""
+        return None
+
     # -- interface --------------------------------------------------------
     def get_chunk_count(self) -> int:
         return self.k + self.m
@@ -165,7 +170,7 @@ class ReedSolomonVandermonde(ErasureCodeJerasure):
     def prepare(self) -> None:
         M = mat.reed_sol_vandermonde_coding_matrix(self.k, self.m, self.w)
         self.core = CodecCore(self.k, self.m, self.w, coding_matrix=M,
-                              layout="byte")
+                              layout="byte", backend=self.make_backend())
 
 
 class ReedSolomonRAID6(ReedSolomonVandermonde):
@@ -188,7 +193,7 @@ class ReedSolomonRAID6(ReedSolomonVandermonde):
     def prepare(self) -> None:
         M = mat.reed_sol_r6_coding_matrix(self.k, self.w)
         self.core = CodecCore(self.k, self.m, self.w, coding_matrix=M,
-                              layout="byte")
+                              layout="byte", backend=self.make_backend())
 
 
 class PacketizedBitmatrixTechnique(ErasureCodeJerasure):
@@ -219,7 +224,8 @@ class PacketizedBitmatrixTechnique(ErasureCodeJerasure):
 
     def _make_core(self, bitmatrix: np.ndarray) -> None:
         self.core = CodecCore(self.k, self.m, self.w, bitmatrix=bitmatrix,
-                              layout="packet", packetsize=self.packetsize)
+                              layout="packet", packetsize=self.packetsize,
+                              backend=self.make_backend())
 
 
 class Cauchy(PacketizedBitmatrixTechnique):

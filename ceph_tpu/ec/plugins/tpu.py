@@ -1,0 +1,124 @@
+"""The flagship `tpu` erasure-code plugin.
+
+Registers alongside the CPU plugins in the same registry — the seam named
+by the north star (BASELINE.json): a profile of
+``plugin=tpu technique=reed_sol_van k=8 m=4`` yields a codec whose
+encode_chunks/decode_chunks run as batched bit-plane GF matmuls on the
+MXU (ceph_tpu/ops/jax_engine.py), bit-exact with the CPU `jerasure`
+plugin because both build identical coding matrices.
+
+All seven jerasure-compatible techniques are supported; every one reduces
+to a binary matrix, so they all ride the same TPU kernel.  On hosts
+without a TPU (e.g. the monitor validating a profile, reference
+mon/OSDMonitor.cc:7371-7392) JAX falls back to its CPU backend — same
+results, no special-casing.
+
+Beyond the reference's synchronous per-stripe API, this plugin exposes
+the batched entry points the OSD write pipeline uses to amortize
+host->device transfers across the PG queue (SURVEY.md section 3.1
+"batching point"):
+
+    encode_batch(data[B, k, L])  -> parity[B, m, L]
+    decode_batch(present {id: [B, L]}, chunk_len) -> {id: [B, L]}
+"""
+from __future__ import annotations
+
+from typing import Dict, Mapping
+
+import numpy as np
+
+from ...ops.jax_engine import JaxBackend
+from ..interface import ErasureCodeProfile, ErasureCodeValidationError
+from ..registry import ErasureCodePlugin
+from . import jerasure as jr
+
+_SHARED_BACKEND: JaxBackend = None
+
+
+def shared_backend() -> JaxBackend:
+    """One backend per process so jit caches / device matrices are shared
+    across codec instances (each PG constructs its own codec, reference
+    osd/PGBackend.cc:555-591)."""
+    global _SHARED_BACKEND
+    if _SHARED_BACKEND is None:
+        _SHARED_BACKEND = JaxBackend()
+    return _SHARED_BACKEND
+
+
+class TpuCodecMixin:
+    """Overrides the backend and adds the batched API."""
+
+    def make_backend(self):
+        return shared_backend()
+
+    # -- batched entry points (the TPU value-add) -------------------------
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """uint8 [B, k, L] -> parity uint8 [B, m, L]; one device call for
+        the whole stripe batch."""
+        data = np.asarray(data, dtype=np.uint8)
+        if data.ndim != 3 or data.shape[1] != self.k:
+            raise ValueError(f"expected [batch, k={self.k}, L] input")
+        return self.core.encode_batch(data)
+
+    def decode_batch(self, present: Mapping[int, np.ndarray],
+                     chunk_len: int) -> Dict[int, np.ndarray]:
+        """Reconstruct all missing chunk ids for a batch: present maps
+        chunk id -> uint8 [B, L]."""
+        arrays = {i: np.asarray(c, dtype=np.uint8)
+                  for i, c in present.items()}
+        return self.core.decode_chunks(arrays, chunk_len)
+
+
+class TpuReedSolomonVandermonde(TpuCodecMixin, jr.ReedSolomonVandermonde):
+    DEFAULT_K, DEFAULT_M, DEFAULT_W = "8", "4", "8"  # north-star config
+
+
+class TpuReedSolomonRAID6(TpuCodecMixin, jr.ReedSolomonRAID6):
+    pass
+
+
+class TpuCauchyOrig(TpuCodecMixin, jr.CauchyOrig):
+    pass
+
+
+class TpuCauchyGood(TpuCodecMixin, jr.CauchyGood):
+    pass
+
+
+class TpuLiberation(TpuCodecMixin, jr.Liberation):
+    pass
+
+
+class TpuBlaumRoth(TpuCodecMixin, jr.BlaumRoth):
+    pass
+
+
+class TpuLiber8tion(TpuCodecMixin, jr.Liber8tion):
+    pass
+
+
+TECHNIQUES = {
+    "reed_sol_van": TpuReedSolomonVandermonde,
+    "reed_sol_r6_op": TpuReedSolomonRAID6,
+    "cauchy_orig": TpuCauchyOrig,
+    "cauchy_good": TpuCauchyGood,
+    "liberation": TpuLiberation,
+    "blaum_roth": TpuBlaumRoth,
+    "liber8tion": TpuLiber8tion,
+}
+
+
+class ErasureCodePluginTpu(ErasureCodePlugin):
+    def factory(self, profile: ErasureCodeProfile):
+        technique = profile.get("technique", "reed_sol_van")
+        cls = TECHNIQUES.get(technique)
+        if cls is None:
+            raise ErasureCodeValidationError(
+                f"technique={technique} is not a valid coding technique")
+        codec = cls()
+        codec.init(profile)
+        return codec
+
+
+def __erasure_code_init__(registry) -> None:
+    registry.add("tpu", ErasureCodePluginTpu())

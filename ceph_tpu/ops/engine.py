@@ -135,6 +135,7 @@ class NumpyBackend:
     """Bit-exact CPU reference backend."""
 
     name = "numpy"
+    supported_widths = None  # None = all widths
 
     def apply_matrix(self, M: np.ndarray, data: np.ndarray, w: int
                      ) -> np.ndarray:
@@ -210,17 +211,23 @@ class CodecCore:
     def _apply(self, B: np.ndarray, M: Optional[np.ndarray],
                data: np.ndarray) -> np.ndarray:
         if self.layout == "byte":
-            if M is not None and isinstance(self.backend, NumpyBackend):
+            widths = getattr(self.backend, "supported_widths", None)
+            if widths is not None and self.w not in widths:
+                return self._apply_bitmatrix_bytes(B, data)
+            if hasattr(self.backend, "apply_bitmatrix_bytes"):
+                return self.backend.apply_bitmatrix_bytes(B, data, self.w)
+            if M is not None:
                 return self.backend.apply_matrix(M, data, self.w)
             return self._apply_bitmatrix_bytes(B, data)
+        if hasattr(self.backend, "apply_packet_chunks"):
+            return self.backend.apply_packet_chunks(B, data, self.w,
+                                                    self.packetsize)
         pk = bytes_to_packets(data, self.w, self.packetsize)
         out = self.backend.apply_bitmatrix_packets(B, pk)
         return packets_to_bytes(out, self.w, self.packetsize)
 
     def _apply_bitmatrix_bytes(self, B: np.ndarray, data: np.ndarray
                                ) -> np.ndarray:
-        if hasattr(self.backend, "apply_bitmatrix_bytes"):
-            return self.backend.apply_bitmatrix_bytes(B, data, self.w)
         bits = bytes_to_bitplanes(data, self.w)
         out = np.matmul(B.astype(np.int64), bits.astype(np.int64)) & 1
         return bitplanes_to_bytes(out.astype(np.uint8), self.w)
@@ -237,7 +244,11 @@ class CodecCore:
         """Reconstruct every missing chunk id in 0..k+m-1.
 
         `present` maps chunk id -> uint8 array [..., L] (leading batch axes
-        allowed but must agree)."""
+        allowed but must agree); every chunk must be `chunk_len` long."""
+        for i, c in present.items():
+            if c.shape[-1] != chunk_len:
+                raise ValueError(
+                    f"chunk {i} length {c.shape[-1]} != {chunk_len}")
         n = self.k + self.m
         erased = [i for i in range(n) if i not in present]
         if not erased:

@@ -141,12 +141,13 @@ class GF:
             if out.ndim == 0:
                 return int(out)
             return out.astype(self.dtype)
-        # extended euclid via exponentiation: a^(2^w - 2)
+        # inverse via exponentiation: a^(2^w - 2)
+        if int(a) == 0:
+            raise ZeroDivisionError("GF inverse of 0")
         return self.pow(a, self.size - 2)
 
     def div(self, a, b):
-        return self.mul(a, self.inv(b)) if np.ndim(a) else (
-            0 if int(a) == 0 else self.mul(a, self.inv(b)))
+        return self.mul(a, self.inv(b))
 
     def pow(self, a, n: int):
         r = 1

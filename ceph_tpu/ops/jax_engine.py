@@ -1,0 +1,212 @@
+"""JAX/TPU codec engine: erasure codes as batched binary matmuls on the MXU.
+
+The TPU-native design (SURVEY.md section 7, "hard parts"): every GF(2^8)
+constant multiply is an 8x8 binary matrix over GF(2), so a k->m
+Reed-Solomon code becomes one (8m x 8k) 0/1 matrix M, and encoding a
+*batch* of stripes is a single int8 matmul
+
+    parity_bits[b, r, l] = (sum_c M[r, c] * data_bits[b, c, l]) mod 2
+
+which XLA tiles onto the MXU with int32 accumulation — exact, so chunks
+are bit-identical to the CPU reference (ceph_tpu/ops/engine.py).  The
+same kernel executes every codec family:
+
+* byte-domain GF(2^w) matrix codes (reed_sol_van/r6): contraction axis =
+  the w bits of each GF word (replaces jerasure_matrix_encode,
+  reference ErasureCodeJerasure.cc:162);
+* packet-domain bitmatrix codes (cauchy/liberation families):
+  contraction axis = the k*w packets per super-word (replaces
+  jerasure_schedule_encode, reference ErasureCodeJerasure.cc:265).
+
+Decode uses the same kernel with per-erasure-signature inverse rows,
+cached like ISA-L's decode-table LRU (reference
+isa/ErasureCodeIsaTableCache.cc).
+
+Shapes are bucketed (batch to the next power of two, length to a lane
+multiple) so the jit cache stays small while the OSD feeds variable-size
+stripe batches from the PG write queue.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Lane-friendly length quantum: last dim tiles of 128 on TPU.
+LENGTH_QUANTUM = 128
+
+
+def _bits_of_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """uint8[..., L] -> int8 bits [..., 8, L] (bit b of each byte)."""
+    shifts = jnp.arange(8, dtype=jnp.uint8).reshape((8,) + (1,) * 1)
+    bits = (x[..., None, :] >> shifts) & jnp.uint8(1)
+    return bits.astype(jnp.int8)
+
+
+def _bytes_of_bits(bits: jnp.ndarray) -> jnp.ndarray:
+    """int32/int8 bits [..., 8, L] -> uint8 [..., L]."""
+    weights = (jnp.uint8(1) << jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(bits.astype(jnp.uint8) * weights[..., :, None],
+                   axis=-2).astype(jnp.uint8)
+
+
+def _words_from_bytes(x: jnp.ndarray, wbytes: int) -> jnp.ndarray:
+    """uint8[..., L] -> uint{8*wbytes}[..., L/wbytes] little-endian,
+    built arithmetically (portable across backends)."""
+    if wbytes == 1:
+        return x
+    dt = {2: jnp.uint16, 4: jnp.uint32}[wbytes]
+    parts = [x[..., i::wbytes].astype(dt) << (8 * i) for i in range(wbytes)]
+    return functools.reduce(jnp.bitwise_or, parts)
+
+
+def _bytes_from_words(words: jnp.ndarray, wbytes: int) -> jnp.ndarray:
+    if wbytes == 1:
+        return words
+    parts = [((words >> (8 * i)) & 0xFF).astype(jnp.uint8)
+             for i in range(wbytes)]
+    stacked = jnp.stack(parts, axis=-1)  # [..., Lw, wbytes]
+    return stacked.reshape(stacked.shape[:-2] + (-1,))
+
+
+def _matmul_mod2(B: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
+    """B int8 [R, C] @ bits int8 [batch, C, L] -> int8 [batch, R, L] mod 2.
+    int8 x int8 -> int32 rides the MXU on TPU."""
+    out = jax.lax.dot_general(
+        B, bits,
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)  # [R, batch, L]
+    out = jnp.transpose(out, (1, 0, 2))
+    return (out & 1).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("w",), donate_argnums=())
+def _apply_byte_domain(B: jnp.ndarray, data: jnp.ndarray, w: int
+                       ) -> jnp.ndarray:
+    """data uint8 [batch, k, L] -> uint8 [batch, R/w, L] for a GF(2^w)
+    matrix code expanded to bit-planes."""
+    batch, k, L = data.shape
+    wbytes = max(1, w // 8)
+    words = _words_from_bytes(data, wbytes)  # [batch, k, Lw]
+    shifts = jnp.arange(w, dtype=words.dtype)
+    bits = (words[..., None, :] >> shifts[:, None]) & 1  # [batch, k, w, Lw]
+    bits = bits.astype(jnp.int8).reshape(batch, k * w, -1)
+    out_bits = _matmul_mod2(B, bits)  # [batch, R, Lw]
+    R = out_bits.shape[1]
+    m = R // w
+    out_bits = out_bits.reshape(batch, m, w, -1)
+    weights = (jnp.uint32(1) << jnp.arange(w, dtype=jnp.uint32))
+    out_words = jnp.sum(out_bits.astype(jnp.uint32) * weights[:, None],
+                        axis=-2)
+    dt = {8: jnp.uint8, 16: jnp.uint16, 32: jnp.uint32}[w]
+    return _bytes_from_words(out_words.astype(dt), wbytes)
+
+
+@functools.partial(jax.jit, static_argnames=("w", "packetsize"))
+def _apply_packet_domain(B: jnp.ndarray, data: jnp.ndarray, w: int,
+                         packetsize: int) -> jnp.ndarray:
+    """data uint8 [batch, k, L] -> uint8 [batch, R/w, L] for a packet-layout
+    bitmatrix code (L = nw * w * packetsize)."""
+    batch, k, L = data.shape
+    sw = w * packetsize
+    nw = L // sw
+    x = data.reshape(batch, k, nw, w, packetsize)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(batch * nw, k * w,
+                                                  packetsize)
+    bits = _bits_of_bytes(x)  # [batch*nw, k*w, 8, ps]
+    bits = jnp.transpose(bits, (0, 1, 3, 2)).reshape(batch * nw, k * w,
+                                                     packetsize * 8)
+    out = _matmul_mod2(B, bits)  # [batch*nw, R, ps*8]
+    R = out.shape[1]
+    out = out.reshape(batch * nw, R, packetsize, 8)
+    out = jnp.transpose(out, (0, 1, 3, 2))  # [.., R, 8, ps]
+    ob = _bytes_of_bits(out)  # [batch*nw, R, ps]
+    m = R // w
+    ob = ob.reshape(batch, nw, m, w, packetsize)
+    ob = jnp.transpose(ob, (0, 2, 1, 3, 4))
+    return ob.reshape(batch, m, L)
+
+
+def _round_up(x: int, q: int) -> int:
+    return ((x + q - 1) // q) * q
+
+
+def _bucket_batch(b: int) -> int:
+    if b <= 1:
+        return 1
+    return 1 << (b - 1).bit_length()
+
+
+class JaxBackend:
+    """Backend for CodecCore executing on the default JAX device (TPU when
+    present, CPU otherwise — the monitor-without-TPU fallback required by
+    SURVEY.md section 7)."""
+
+    name = "jax"
+
+    def __init__(self, bucket_shapes: bool = True):
+        self.bucket_shapes = bucket_shapes
+        self._dev_matrices: dict = {}
+
+    def _device_matrix(self, B: np.ndarray) -> jnp.ndarray:
+        key = (B.shape, B.tobytes())
+        hit = self._dev_matrices.get(key)
+        if hit is None:
+            hit = jnp.asarray(B, dtype=jnp.int8)
+            self._dev_matrices[key] = hit
+        return hit
+
+    def _padded(self, data: np.ndarray, quantum: int):
+        """Pad [batch, k, L] to bucketed [batch', k, L'] (zeros are
+        harmless: the code is GF-linear)."""
+        batch, k, L = data.shape
+        if not self.bucket_shapes:
+            return data, batch, L
+        bb = _bucket_batch(batch)
+        Lb = _round_up(L, quantum)
+        if bb == batch and Lb == L:
+            return data, batch, L
+        out = np.zeros((bb, k, Lb), dtype=np.uint8)
+        out[:batch, :, :L] = data
+        return out, batch, L
+
+    def apply_bitmatrix_bytes(self, B: np.ndarray, data: np.ndarray,
+                              w: int) -> np.ndarray:
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        lead = data.shape[:-2]
+        data = data.reshape((-1,) + data.shape[-2:])
+        wbytes = max(1, w // 8)
+        if data.shape[-1] % wbytes:
+            raise ValueError(
+                f"chunk length must be a multiple of {wbytes} for w={w}")
+        padded, batch, L = self._padded(data, LENGTH_QUANTUM * wbytes)
+        out = _apply_byte_domain(self._device_matrix(B),
+                                 jnp.asarray(padded), w)
+        out = np.asarray(out)[:batch, :, :L]
+        out = out.reshape(lead + out.shape[-2:])
+        return out[0] if squeeze else out
+
+    def apply_bitmatrix_packets(self, B: np.ndarray, pk: np.ndarray
+                                ) -> np.ndarray:
+        raise NotImplementedError(
+            "packet layout handled via apply_packet_chunks")
+
+    def apply_packet_chunks(self, B: np.ndarray, data: np.ndarray, w: int,
+                            packetsize: int) -> np.ndarray:
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        lead = data.shape[:-2]
+        data = data.reshape((-1,) + data.shape[-2:])
+        padded, batch, L = self._padded(data, w * packetsize)
+        out = _apply_packet_domain(self._device_matrix(B),
+                                   jnp.asarray(padded), w, packetsize)
+        out = np.asarray(out)[:batch, :, :L]
+        out = out.reshape(lead + out.shape[-2:])
+        return out[0] if squeeze else out

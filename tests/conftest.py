@@ -12,5 +12,17 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8").strip()
+# persistent compile cache: XLA compiles dominate test time on 1 core
+_cache = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "..", ".jax_cache")
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", _cache)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# The environment pre-sets JAX_PLATFORMS=axon (TPU tunnel) via sitecustomize,
+# which wins over env mutation here — override through the config API (safe:
+# backends initialize lazily, no test has touched a device yet).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
