@@ -152,6 +152,107 @@ def cauchy_good_coding_matrix(k: int, m: int, w: int) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# ISA-L-compatible constructions (reference isa/ErasureCodeIsa.cc:385-387)
+# ---------------------------------------------------------------------------
+
+def isa_rs_vandermonde_matrix(k: int, m: int) -> np.ndarray:
+    """ISA-L gf_gen_rs_matrix coding rows: row i = powers of 2^i
+    (row 0 all ones).  NOT MDS for large (k, m) — the reference clamps to
+    k<=32, m<=4 (isa/README)."""
+    f = gf(8)
+    M = np.zeros((m, k), dtype=np.int64)
+    gen = 1
+    for i in range(m):
+        p = 1
+        for j in range(k):
+            M[i, j] = p
+            p = f.mul(p, gen)
+        gen = f.mul(gen, 2)
+    return M
+
+
+def isa_cauchy_matrix(k: int, m: int) -> np.ndarray:
+    """ISA-L gf_gen_cauchy1_matrix coding rows: entry = 1/((k+i) XOR j)."""
+    f = gf(8)
+    M = np.zeros((m, k), dtype=np.int64)
+    for i in range(m):
+        for j in range(k):
+            M[i, j] = f.inv((k + i) ^ j)
+    return M
+
+
+# ---------------------------------------------------------------------------
+# SHEC construction (reference shec/ErasureCodeShec.cc:465-529)
+# ---------------------------------------------------------------------------
+
+def shec_recovery_efficiency(k: int, m1: int, m2: int, c1: int,
+                             c2: int) -> float:
+    """Recovery-efficiency estimator used to pick the best multi-SHEC
+    split (reference shec_calc_recovery_efficiency1)."""
+    if m1 < c1 or m2 < c2:
+        return -1.0
+    if (m1 == 0 and c1 != 0) or (m2 == 0 and c2 != 0):
+        return -1.0
+    r_eff_k = [10**8] * k
+    r_e1 = 0.0
+    for (mm, cc_) in ((m1, c1), (m2, c2)):
+        for rr in range(mm):
+            start = ((rr * k) // mm) % k
+            end = (((rr + cc_) * k) // mm) % k
+            cc = start
+            first = True
+            while first or cc != end:
+                first = False
+                r_eff_k[cc] = min(r_eff_k[cc],
+                                  ((rr + cc_) * k) // mm - (rr * k) // mm)
+                cc = (cc + 1) % k
+            r_e1 += ((rr + cc_) * k) // mm - (rr * k) // mm
+    r_e1 += sum(r_eff_k)
+    return r_e1 / (k + m1 + m2)
+
+
+def shec_coding_matrix(k: int, m: int, c: int, w: int,
+                       single: bool) -> np.ndarray:
+    """Shingled-EC matrix: Vandermonde rows with the complement of each
+    parity's shingle window zeroed.  `single` uses one parity group;
+    otherwise the (m1, c1) split minimizing the recovery-efficiency
+    estimator is chosen."""
+    if single:
+        m1, c1 = 0, 0
+    else:
+        best = None
+        for c1_ in range(c // 2 + 1):
+            for m1_ in range(m + 1):
+                c2_, m2_ = c - c1_, m - m1_
+                if m1_ < c1_ or m2_ < c2_:
+                    continue
+                if (m1_ == 0 and c1_ != 0) or (m2_ == 0 and c2_ != 0):
+                    continue
+                if (m1_ != 0 and c1_ == 0) or (m2_ != 0 and c2_ == 0):
+                    continue
+                r = shec_recovery_efficiency(k, m1_, m2_, c1_, c2_)
+                if best is None or r < best[0] - 1e-12:
+                    best = (r, c1_, m1_)
+        _, c1, m1 = best
+    m2, c2 = m - m1, c - c1
+
+    M = reed_sol_vandermonde_coding_matrix(k, m, w)
+    for rr in range(m1):
+        end = ((rr * k) // m1) % k
+        cc = (((rr + c1) * k) // m1) % k
+        while cc != end:
+            M[rr, cc] = 0
+            cc = (cc + 1) % k
+    for rr in range(m2):
+        end = ((rr * k) // m2) % k
+        cc = (((rr + c2) * k) // m2) % k
+        while cc != end:
+            M[m1 + rr, cc] = 0
+            cc = (cc + 1) % k
+    return M
+
+
+# ---------------------------------------------------------------------------
 # GF(2) bitmatrices — the universal TPU representation
 # ---------------------------------------------------------------------------
 
