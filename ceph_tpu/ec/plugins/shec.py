@@ -15,12 +15,13 @@ minimizing the recovery-efficiency estimator) and ``single``.
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Mapping, Optional, Set, Tuple
 
 import numpy as np
 
 from ...ops import matrix as mat
-from ...ops.engine import CodecCore, NumpyBackend
+from ...ops.engine import CodecCore
 from ...ops.gf import gf
 from ..interface import (ErasureCode, ErasureCodeProfile,
                          ErasureCodeValidationError)
@@ -39,7 +40,17 @@ class ErasureCodeShec(ErasureCode):
         self.w = 8
         self.matrix: np.ndarray = None
         self.core: CodecCore = None
-        self._decode_cache: Dict[tuple, tuple] = {}
+        # LRU-bounded per-codec cache of decode solutions, the moral
+        # equivalent of the reference's shared table cache
+        # (ErasureCodeShecTableCache.cc:277-283 evicts the LRU front)
+        self._decode_cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+    DECODE_CACHE_MAX = 2048
+
+    def _cache_put(self, key: tuple, value) -> None:
+        self._decode_cache[key] = value
+        if len(self._decode_cache) > self.DECODE_CACHE_MAX:
+            self._decode_cache.popitem(last=False)
 
     def make_backend(self):
         return None
@@ -141,6 +152,7 @@ class ErasureCodeShec(ErasureCode):
         inverse: dup x dup GF matrix mapping dm_rows values -> dm_cols."""
         key = (want_ids, avail_ids)
         if key in self._decode_cache:
+            self._decode_cache.move_to_end(key)
             return self._decode_cache[key]
         k, m = self.k, self.m
         f = gf(self.w)
@@ -200,7 +212,7 @@ class ErasureCodeShec(ErasureCode):
                 minp = len(parities)
 
         if mindup == k + 1:
-            self._decode_cache[key] = None
+            self._cache_put(key, None)
             return None
 
         minimum = set(best_rows)
@@ -219,7 +231,7 @@ class ErasureCodeShec(ErasureCode):
             inverse = f.mat_invert(A)
         result = (tuple(sorted(minimum)), tuple(best_rows),
                   tuple(best_cols), inverse)
-        self._decode_cache[key] = result
+        self._cache_put(key, result)
         return result
 
     def _system_matrix(self, rows: List[int], cols: List[int]) -> np.ndarray:
@@ -255,13 +267,17 @@ class ErasureCodeShec(ErasureCode):
         if res is None:
             raise IOError("cannot find recover matrix")
         _, dm_rows, dm_cols, inverse = res
-        backend = NumpyBackend()
+        backend = self.core.backend
         if inverse is not None and dm_cols:
-            b = np.stack([decoded[i] for i in dm_rows])
-            sol = backend.apply_matrix(inverse, b, self.w)
-            for ci, col in enumerate(dm_cols):
-                if col not in chunks:
-                    decoded[col][:] = sol[ci]
+            # only solve the rows for genuinely missing columns (the
+            # reference skips avail columns too, ErasureCodeShec.cc:795)
+            missing = [ci for ci, col in enumerate(dm_cols)
+                       if col not in chunks]
+            if missing:
+                b = np.stack([decoded[i] for i in dm_rows])
+                sol = backend.apply_matrix(inverse[missing], b, self.w)
+                for si, ci in enumerate(missing):
+                    decoded[dm_cols[ci]][:] = sol[si]
         # re-encode wanted erased parities from (now complete) data
         for i in range(m):
             if (k + i) in want_to_read and (k + i) not in chunks:

@@ -1,0 +1,65 @@
+"""Registry lifecycle tests, incl. deliberately broken plugins (mirrors
+reference src/test/erasure-code/TestErasureCodePlugin.cc)."""
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import registry as ecreg
+
+
+@pytest.fixture
+def registry():
+    return ecreg.instance()
+
+
+class TestRegistryLifecycle:
+    def test_load_unknown(self, registry):
+        with pytest.raises(KeyError):
+            registry.load("no_such_plugin_xyz")
+
+    def test_fail_to_initialize(self, registry):
+        with pytest.raises(RuntimeError):
+            registry.load("fail_to_initialize")
+        assert registry.get("fail_to_initialize") is None
+
+    def test_fail_to_register(self, registry):
+        with pytest.raises(KeyError):
+            registry.load("fail_to_register")
+
+    def test_missing_entry_point(self, registry):
+        with pytest.raises(KeyError, match="entry point"):
+            registry.load("missing_entry_point")
+
+    def test_missing_version(self, registry):
+        with pytest.raises(KeyError, match="version"):
+            registry.load("missing_version")
+        assert registry.get("missing_version") is None
+
+    def test_double_add_rejected(self, registry):
+        registry.load("example")
+        with pytest.raises(KeyError):
+            registry.add("example", registry.get("example"))
+
+    def test_preload(self, registry):
+        registry.preload("example, jerasure")
+        assert registry.get("example") is not None
+        assert registry.get("jerasure") is not None
+
+
+class TestExamplePlugin:
+    def test_round_trip(self, registry):
+        codec = registry.factory("example", {})
+        data = bytes(range(100)) * 3
+        encoded = codec.encode({0, 1, 2}, data)
+        parity = np.bitwise_xor(
+            np.frombuffer(encoded[0], dtype=np.uint8),
+            np.frombuffer(encoded[1], dtype=np.uint8)).tobytes()
+        assert encoded[2] == parity
+        for lost in (0, 1, 2):
+            avail = {i: encoded[i] for i in range(3) if i != lost}
+            out = codec.decode({lost}, avail, len(encoded[0]))
+            assert out[lost] == encoded[lost]
+
+    def test_minimum_with_cost(self, registry):
+        codec = registry.factory("example", {})
+        assert codec.minimum_to_decode_with_cost(
+            {0, 1}, {0: 1, 1: 9, 2: 2}) == {0, 2}
