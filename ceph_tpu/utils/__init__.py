@@ -1,1 +1,8 @@
-
+"""Runtime primitives: config, logging, perf counters, admin socket,
+op tracking (reference src/common/ — see each module's docstring)."""
+from .admin_socket import AdminSocket, admin_command  # noqa: F401
+from .config import Config, Option, default_config  # noqa: F401
+from .log import Dout, get_subsys_level, set_subsys_level  # noqa: F401
+from .optracker import OpTracker, TrackedOp  # noqa: F401
+from .perf import (PerfCounters, PerfCountersCollection,  # noqa: F401
+                   TimeScope)

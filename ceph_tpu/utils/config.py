@@ -1,0 +1,212 @@
+"""Typed option table + layered configuration.
+
+Python-native equivalent of the reference's config system (reference
+src/common/options.cc — 1,676 ``Option(...)`` rows; schema
+src/common/options.h; md_config_t in src/common/config.cc): a single
+table of typed, documented options with defaults and validation, values
+layered from (lowest to highest precedence) compiled defaults < config
+file < environment < command line < runtime overrides (the reference's
+monitor central config, mon/ConfigMonitor.cc), with change observers
+notified on runtime updates.
+
+Only the options the framework actually consumes are declared here —
+the table grows with the subsystems.  Unknown keys raise, as the
+reference's ``ceph config set`` does for unknown names.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+
+@dataclass
+class Option:
+    """One typed option (reference common/options.h Option struct)."""
+    name: str
+    type: type                      # int, float, bool, str
+    default: Any
+    level: str = LEVEL_ADVANCED
+    description: str = ""
+    min: Optional[float] = None
+    max: Optional[float] = None
+    enum_allowed: Tuple[str, ...] = ()
+    see_also: Tuple[str, ...] = ()
+
+    def validate(self, value: Any) -> Any:
+        if self.type is bool and isinstance(value, str):
+            if value.lower() in ("true", "yes", "1"):
+                value = True
+            elif value.lower() in ("false", "no", "0"):
+                value = False
+            else:
+                raise ValueError(f"{self.name}: not a boolean: {value!r}")
+        try:
+            value = self.type(value)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"{self.name}: cannot convert {value!r} to "
+                f"{self.type.__name__}")
+        if self.min is not None and value < self.min:
+            raise ValueError(f"{self.name}: {value} < min {self.min}")
+        if self.max is not None and value > self.max:
+            raise ValueError(f"{self.name}: {value} > max {self.max}")
+        if self.enum_allowed and value not in self.enum_allowed:
+            raise ValueError(
+                f"{self.name}: {value!r} not in {self.enum_allowed}")
+        return value
+
+
+def _opts() -> List[Option]:
+    """The option table (the subset of reference common/options.cc the
+    framework consumes; reference line refs inline)."""
+    return [
+        # -- erasure code (reference options.cc:564,2659,2665) -----------
+        Option("erasure_code_dir", str, "",
+               description="plugin search path override"),
+        Option("osd_erasure_code_plugins", str, "jerasure isa lrc shec tpu",
+               description="plugins to preload at daemon start"),
+        Option("osd_pool_default_erasure_code_profile", str,
+               "plugin=jerasure technique=reed_sol_van k=2 m=1",
+               description="default profile for new EC pools"),
+        # -- tpu codec batching (framework-specific) ----------------------
+        Option("ec_tpu_batch_stripes", int, 1024, min=1,
+               description="stripes gathered per device call"),
+        Option("ec_tpu_queue_window_us", int, 200, min=0,
+               description="max microseconds a stripe waits for a batch"),
+        Option("ec_tpu_fallback_cpu", bool, True,
+               description="CPU bit-plane path when no TPU is present "
+                           "(monitors validate profiles without devices)"),
+        # -- osd (reference options.cc:2869-2901,2478,3159) ---------------
+        Option("osd_op_num_shards", int, 5, min=1,
+               description="sharded op queue shard count"),
+        Option("osd_op_num_threads_per_shard", int, 1, min=1),
+        Option("osd_recovery_max_active", int, 3, min=1,
+               description="recovery ops in flight per OSD"),
+        Option("osd_max_backfills", int, 1, min=1),
+        Option("osd_recovery_sleep", float, 0.0, min=0.0),
+        Option("osd_heartbeat_interval", float, 1.0, min=0.05,
+               description="seconds between peer pings "
+                           "(reference default 6s, scaled down)"),
+        Option("osd_heartbeat_grace", float, 4.0, min=0.1,
+               description="seconds without reply before reporting "
+                           "(reference default 20s, scaled down)"),
+        Option("osd_pool_default_size", int, 3, min=1),
+        Option("osd_pool_default_min_size", int, 0, min=0),
+        Option("osd_pool_default_pg_num", int, 32, min=1),
+        Option("osd_scrub_interval", float, 0.0, min=0.0,
+               description="0 disables background scrub"),
+        Option("osd_recovery_chunk_size", int, 8 << 20, min=4096,
+               description="recovery read window bytes "
+                           "(reference osd_recovery_max_chunk)"),
+        # -- mon (reference options.cc mon_* ) ----------------------------
+        Option("mon_osd_reporter_subtree_level", str, "host",
+               description="failure reports must span this crush level"),
+        Option("mon_osd_min_down_reporters", int, 2, min=1),
+        Option("mon_tick_interval", float, 0.5, min=0.05),
+        Option("mon_osd_down_out_interval", float, 10.0, min=0.0,
+               description="seconds down before auto-out "
+                           "(reference default 600s, scaled down)"),
+        Option("paxos_propose_interval", float, 0.05, min=0.0),
+        # -- messenger (reference options.cc:1075 ms_*) --------------------
+        Option("ms_inject_socket_failures", int, 0, min=0,
+               description="one in N sends fails (fault injection)"),
+        Option("ms_connection_retry_interval", float, 0.2, min=0.01),
+        Option("ms_crc_data", bool, True),
+        # -- logging -------------------------------------------------------
+        Option("log_to_stderr", bool, False),
+        Option("log_file", str, ""),
+        Option("debug_default_level", int, 1, min=0, max=30),
+    ]
+
+
+class Config:
+    """Layered config values + observer notification (reference
+    common/config.cc md_config_t::set_val / apply_changes)."""
+
+    SOURCES = ("default", "file", "env", "cli", "runtime")
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._lock = threading.RLock()
+        self.schema: Dict[str, Option] = {o.name: o for o in _opts()}
+        self._values: Dict[str, Dict[str, Any]] = {
+            s: {} for s in self.SOURCES}
+        self._observers: Dict[str, List[Callable[[str, Any], None]]] = {}
+        for name, opt in self.schema.items():
+            self._values["default"][name] = opt.default
+        self._load_env()
+        for k, v in (overrides or {}).items():
+            self.set(k, v, source="cli")
+
+    def _load_env(self) -> None:
+        # CEPH_TPU_<OPTION_NAME_UPPER>=value
+        for name in self.schema:
+            env = os.environ.get("CEPH_TPU_" + name.upper())
+            if env is not None:
+                self._values["env"][name] = self.schema[name].validate(env)
+
+    # -- access ------------------------------------------------------------
+    def get(self, name: str) -> Any:
+        with self._lock:
+            if name not in self.schema:
+                raise KeyError(f"unknown option {name!r}")
+            for source in reversed(self.SOURCES):
+                if name in self._values[source]:
+                    return self._values[source][name]
+        raise AssertionError("unreachable: defaults always populated")
+
+    def __getitem__(self, name: str) -> Any:
+        return self.get(name)
+
+    def set(self, name: str, value: Any, source: str = "runtime") -> None:
+        with self._lock:
+            if name not in self.schema:
+                raise KeyError(f"unknown option {name!r}")
+            if source not in self.SOURCES:
+                raise ValueError(f"unknown source {source!r}")
+            old = self.get(name)
+            value = self.schema[name].validate(value)
+            self._values[source][name] = value
+            new = self.get(name)
+            observers = list(self._observers.get(name, ())) \
+                if new != old else []
+        for fn in observers:
+            fn(name, new)
+
+    def add_observer(self, name: str,
+                     fn: Callable[[str, Any], None]) -> None:
+        """Called with (name, new_value) after an effective change
+        (reference md_config_obs_t)."""
+        with self._lock:
+            if name not in self.schema:
+                raise KeyError(f"unknown option {name!r}")
+            self._observers.setdefault(name, []).append(fn)
+
+    def dump(self) -> Dict[str, Any]:
+        with self._lock:
+            return {name: self.get(name) for name in sorted(self.schema)}
+
+    def diff(self) -> Dict[str, Any]:
+        """Only options changed from their defaults (reference
+        `ceph config diff`)."""
+        with self._lock:
+            return {name: self.get(name) for name in sorted(self.schema)
+                    if self.get(name) != self.schema[name].default}
+
+
+_default: Optional[Config] = None
+_default_lock = threading.Lock()
+
+
+def default_config() -> Config:
+    """Process-wide config (the reference's g_ceph_context->_conf)."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = Config()
+        return _default

@@ -1,0 +1,159 @@
+"""CRUSH mapper/wrapper tests.
+
+Mirrors the reference's mapping invariants (reference
+src/test/osd/TestOSDMap.cc and src/test/crush/: determinism, failure-
+domain separation, per-position 'indep' hole stability for EC, class
+filtering, weight-proportional distribution)."""
+import collections
+
+import pytest
+
+from ceph_tpu.crush.mapper import CRUSH_ITEM_NONE, crush_hash32_2, crush_hash32_3
+from ceph_tpu.crush.wrapper import CrushWrapper, build_flat_map
+
+IN = 0x10000  # full weight
+
+
+def weights(n, out=()):
+    return [0 if i in out else IN for i in range(n)]
+
+
+class TestHash:
+    def test_deterministic(self):
+        assert crush_hash32_2(1, 2) == crush_hash32_2(1, 2)
+        assert crush_hash32_3(1, 2, 3) == crush_hash32_3(1, 2, 3)
+
+    def test_spread(self):
+        vals = {crush_hash32_2(x, 7) for x in range(1000)}
+        assert len(vals) > 990  # essentially no collisions
+
+
+class TestFirstn:
+    def test_deterministic_and_distinct(self):
+        crush = build_flat_map(10, osds_per_host=2)
+        rid = crush.add_simple_rule("r", "default", "host", mode="firstn")
+        for x in range(50):
+            out = crush.do_rule(rid, x, 3, weights(10))
+            assert out == crush.do_rule(rid, x, 3, weights(10))
+            assert len(out) == 3
+            assert len(set(out)) == 3
+            # failure domain: one osd per host
+            hosts = {o // 2 for o in out}
+            assert len(hosts) == 3
+
+    def test_out_osd_replaced(self):
+        crush = build_flat_map(10, osds_per_host=2)
+        rid = crush.add_simple_rule("r", "default", "host", mode="firstn")
+        for x in range(30):
+            base = crush.do_rule(rid, x, 3, weights(10))
+            victim = base[0]
+            out = crush.do_rule(rid, x, 3, weights(10, out={victim}))
+            assert victim not in out
+            assert len(set(out)) == 3
+            # firstn shifts survivors forward
+            assert out[:2] != [CRUSH_ITEM_NONE, CRUSH_ITEM_NONE]
+
+    def test_distribution_tracks_weight(self):
+        crush = build_flat_map(4, osds_per_host=1)
+        crush.adjust_item_weight(0, 2.0)  # osd.0 twice the weight
+        rid = crush.add_simple_rule("r", "default", "osd", mode="firstn")
+        counts = collections.Counter()
+        for x in range(4000):
+            counts[crush.do_rule(rid, x, 1, weights(4))[0]] += 1
+        # osd.0 should get ~2x the placements of the others
+        others = sum(counts[i] for i in (1, 2, 3)) / 3
+        assert counts[0] > 1.5 * others
+
+
+class TestIndep:
+    def test_holes_and_stability(self):
+        """The EC invariant (reference ecbackend.rst "Crush"): when an
+        OSD goes out, its position gets a hole or replacement but other
+        positions keep their shards."""
+        crush = build_flat_map(12, osds_per_host=2)
+        rid = crush.add_simple_rule("ec", "default", "host", mode="indep",
+                                    pool_type="erasure")
+        moved_total = positions = 0
+        for x in range(30):
+            base = crush.do_rule(rid, x, 4, weights(12))
+            assert len(base) == 4
+            assert CRUSH_ITEM_NONE not in base
+            victim = base[2]
+            out = crush.do_rule(rid, x, 4, weights(12, out={victim}))
+            assert out[0] == base[0] and out[1] == base[1] \
+                and out[3] == base[3], "untouched positions must be stable"
+            assert out[2] != victim
+            positions += 4
+            moved_total += sum(1 for a, b in zip(base, out) if a != b)
+        assert moved_total <= 30  # only the victim position remaps
+
+    def test_unsatisfiable_leaves_hole(self):
+        # 3 hosts, need 4 distinct hosts -> position 3 is a hole
+        crush = build_flat_map(3, osds_per_host=1)
+        rid = crush.add_simple_rule("ec", "default", "host", mode="indep")
+        out = crush.do_rule(rid, 1234, 4, weights(3))
+        assert len(out) == 4
+        assert out.count(CRUSH_ITEM_NONE) == 1
+        assert len({o for o in out if o != CRUSH_ITEM_NONE}) == 3
+
+
+class TestDeviceClasses:
+    def test_class_filtering(self):
+        crush = CrushWrapper()
+        crush.add_bucket("default", "root")
+        crush.add_bucket("h0", "host")
+        crush.insert_item(crush.name_ids["h0"], 0, "h0", "default")
+        for osd in range(6):
+            cls = "ssd" if osd % 2 == 0 else "hdd"
+            crush.insert_item(osd, 1.0, f"osd.{osd}", "h0",
+                              device_class=cls)
+        rid = crush.add_simple_rule("ssd_rule", "default", "osd",
+                                    device_class="ssd", mode="firstn")
+        for x in range(40):
+            out = crush.do_rule(rid, x, 2, weights(6))
+            assert all(o % 2 == 0 for o in out), f"non-ssd osd in {out}"
+
+    def test_shadow_invalidated_on_change(self):
+        crush = CrushWrapper()
+        crush.add_bucket("default", "root")
+        crush.add_bucket("h0", "host")
+        crush.insert_item(crush.name_ids["h0"], 0, "h0", "default")
+        crush.insert_item(0, 1.0, "osd.0", "h0", device_class="ssd")
+        rid = crush.add_simple_rule("r", "default", "osd",
+                                    device_class="ssd", mode="firstn")
+        assert crush.do_rule(rid, 1, 1, weights(1)) == [0]
+        # adding another ssd redistributes
+        crush.insert_item(1, 1.0, "osd.1", "h0", device_class="ssd")
+        seen = {crush.do_rule(rid, x, 1, weights(2))[0] for x in range(50)}
+        assert seen == {0, 1}
+
+
+class TestWrapper:
+    def test_rule_bookkeeping(self):
+        crush = build_flat_map(4)
+        rid = crush.add_simple_rule("r", "default", "host")
+        crush.set_rule_mask_max_size(rid, 6)
+        assert crush.rule_id("r") == rid
+        assert crush.map.rules[rid].max_size == 6
+        with pytest.raises(KeyError):
+            crush.add_simple_rule("r", "default", "host")
+
+    def test_dump(self):
+        crush = build_flat_map(2)
+        crush.add_simple_rule("r", "default", "host")
+        d = crush.dump()
+        assert len(d["devices"]) == 2
+        assert any(b["name"] == "default" for b in d["buckets"])
+        assert d["rules"][0]["name"] == "r"
+
+    def test_ec_create_rule_integration(self):
+        """ErasureCode.create_rule plugs into the wrapper (reference
+        ErasureCode.cc:64-83)."""
+        from ceph_tpu.ec import registry as ecreg
+        crush = build_flat_map(12, osds_per_host=2)
+        codec = ecreg.instance().factory("jerasure", {"k": "4", "m": "2"})
+        rid = codec.create_rule("ecpool_rule", crush)
+        assert crush.rule_max_size[rid] == 6
+        out = crush.do_rule(rid, 42, 6, weights(12))
+        assert len(out) == 6
+        assert CRUSH_ITEM_NONE not in out

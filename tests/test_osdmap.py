@@ -1,0 +1,145 @@
+"""OSDMap mapping invariant tests (reference src/test/osd/TestOSDMap.cc:
+stable object→PG→OSD pipeline, EC hole preservation vs replicated
+shift-left, incremental application)."""
+import pytest
+
+from ceph_tpu.crush.wrapper import build_flat_map
+from ceph_tpu.osd.osdmap import (Incremental, OSDMap, PGid, PGPool,
+                                 ceph_stable_mod, ceph_str_hash_rjenkins,
+                                 pg_num_mask)
+
+
+def make_map(n_osds=6, pg_num=32, ec=False, k=4, m=2):
+    osdmap = OSDMap()
+    crush = build_flat_map(n_osds, osds_per_host=2)
+    inc = Incremental(1)
+    inc.new_crush = crush
+    inc.new_max_osd = n_osds
+    for o in range(n_osds):
+        inc.new_up[o] = ("127.0.0.1", 7000 + o)
+    if ec:
+        rid = crush.add_simple_rule("ecrule", "default", "osd",
+                                    mode="indep", pool_type="erasure")
+        pool = PGPool(name="ecpool", pool_id=1, type="erasure",
+                      size=k + m, min_size=k, pg_num=pg_num,
+                      crush_rule=rid, erasure_code_profile="default",
+                      stripe_width=4096 * k)
+    else:
+        rid = crush.add_simple_rule("reprule", "default", "host",
+                                    mode="firstn")
+        pool = PGPool(name="rbd", pool_id=1, size=3, min_size=2,
+                      pg_num=pg_num, crush_rule=rid)
+    inc.new_pools[1] = pool
+    osdmap.apply_incremental(inc)
+    return osdmap
+
+
+class TestHashing:
+    def test_stable_mod_splitting(self):
+        # doubling pg_num moves at most half the inputs
+        for x in range(1000):
+            before = ceph_stable_mod(x, 8, 15)
+            after = ceph_stable_mod(x, 16, 15)
+            assert after in (before, before + 8)
+
+    def test_str_hash_deterministic(self):
+        assert ceph_str_hash_rjenkins(b"foo") == \
+            ceph_str_hash_rjenkins(b"foo")
+        assert ceph_str_hash_rjenkins(b"foo") != \
+            ceph_str_hash_rjenkins(b"bar")
+
+    def test_pg_num_mask(self):
+        assert pg_num_mask(8) == 7
+        assert pg_num_mask(12) == 15
+        assert pg_num_mask(1) == 0
+
+
+class TestMapping:
+    def test_object_to_pg_stable(self):
+        osdmap = make_map()
+        pg = osdmap.object_locator_to_pg("myobject", 1)
+        assert pg == osdmap.object_locator_to_pg("myobject", 1)
+        assert 0 <= pg.seed < 32
+
+    def test_pg_spread(self):
+        osdmap = make_map()
+        seeds = {osdmap.object_locator_to_pg(f"obj{i}", 1).seed
+                 for i in range(500)}
+        assert len(seeds) > 25  # most PGs hit
+
+    def test_replicated_up_acting(self):
+        osdmap = make_map()
+        for s in range(32):
+            up, prim, acting, _ = osdmap.pg_to_up_acting_osds(PGid(1, s))
+            assert len(up) == 3
+            assert prim == up[0]
+            assert len({o // 2 for o in up}) == 3  # one per host
+
+    def test_down_osd_filtered_replicated(self):
+        osdmap = make_map()
+        pg = PGid(1, 5)
+        up_before, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+        victim = up_before[0]
+        inc = Incremental(2)
+        inc.new_down.append(victim)
+        osdmap.apply_incremental(inc)
+        up_after, prim, _, _ = osdmap.pg_to_up_acting_osds(pg)
+        assert victim not in up_after
+        assert prim is not None
+
+    def test_ec_holes_preserved(self):
+        osdmap = make_map(ec=True)
+        pg = PGid(1, 3)
+        up_before, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+        assert len(up_before) == 6
+        victim = up_before[2]
+        inc = Incremental(2)
+        inc.new_down.append(victim)
+        osdmap.apply_incremental(inc)
+        up_after, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+        assert len(up_after) == 6, "EC up set keeps positional holes"
+        # the down osd's position becomes None (still mapped by crush
+        # until marked out, but not up)
+        assert up_after[2] is None or up_after[2] != victim
+        for i in (0, 1, 3, 4, 5):
+            assert up_after[i] == up_before[i], \
+                "other EC positions must not move on down"
+
+    def test_ec_out_remaps_position(self):
+        osdmap = make_map(ec=True)
+        pg = PGid(1, 3)
+        up_before, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+        victim = up_before[2]
+        inc = Incremental(2)
+        inc.new_down.append(victim)
+        inc.new_weight[victim] = 0  # marked out
+        osdmap.apply_incremental(inc)
+        up_after, _, _, _ = osdmap.pg_to_up_acting_osds(pg)
+        assert up_after[2] != victim
+        for i in (0, 1, 3, 4, 5):
+            assert up_after[i] == up_before[i]
+
+
+class TestIncremental:
+    def test_epoch_ordering(self):
+        osdmap = make_map()
+        with pytest.raises(AssertionError):
+            osdmap.apply_incremental(Incremental(5))
+
+    def test_pool_lifecycle(self):
+        osdmap = make_map()
+        inc = Incremental(2)
+        inc.new_pools[2] = PGPool(name="second", pool_id=2, pg_num=8)
+        osdmap.apply_incremental(inc)
+        assert osdmap.get_pool("second").pool_id == 2
+        inc = Incremental(3)
+        inc.old_pools.append(2)
+        osdmap.apply_incremental(inc)
+        assert osdmap.get_pool("second") is None
+
+    def test_dump(self):
+        osdmap = make_map()
+        d = osdmap.dump()
+        assert d["epoch"] == 1
+        assert len(d["osds"]) == 6
+        assert d["pools"][0]["name"] == "rbd"
