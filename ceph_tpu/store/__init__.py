@@ -1,1 +1,19 @@
+"""Local object stores (reference src/os/, src/kv/).
 
+- objectstore: the transactional ObjectStore seam + Transaction
+  (reference os/ObjectStore.h)
+- memstore: in-RAM test double (reference os/memstore/MemStore.cc)
+- filestore: persistent files + LogDB metadata + WAL journal
+  (the BlueStore seat)
+- kv: KeyValueDB abstraction, MemDB/LogDB backends (reference
+  src/kv/KeyValueDB.h)
+"""
+from .objectstore import COLL_META, GHObject, ObjectStat, ObjectStore, \
+    Transaction
+from .memstore import MemStore
+from .filestore import FileStore
+from .kv import KeyValueDB, LogDB, MemDB, WriteBatch
+
+__all__ = ["COLL_META", "GHObject", "ObjectStat", "ObjectStore",
+           "Transaction", "MemStore", "FileStore", "KeyValueDB",
+           "LogDB", "MemDB", "WriteBatch"]

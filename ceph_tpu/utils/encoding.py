@@ -1,0 +1,186 @@
+"""Wire/disk encoding primitives.
+
+Python-native equivalent of the reference's bufferlist encode/decode
+layer (reference src/include/encoding.h: little-endian fixed-width
+integers, length-prefixed strings/buffers, containers encoded as
+count + elements; versioned struct envelopes via ENCODE_START /
+DECODE_START with struct_v + compat_v + length so old decoders can
+skip unknown trailing fields).
+
+Used by the object-store Transaction encoding and the messenger's
+typed message payloads, so on-wire and on-disk formats share one
+codec — as in the reference, where both are bufferlists.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class DecodeError(ValueError):
+    """Malformed or truncated buffer (maps buffer::malformed_input)."""
+
+
+class Encoder:
+    """Append-only little-endian encoder (reference encode(..., bl))."""
+
+    def __init__(self) -> None:
+        self._parts: List[bytes] = []
+
+    # -- fixed-width integers ---------------------------------------------
+    def u8(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<B", v)); return self
+
+    def u16(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<H", v)); return self
+
+    def u32(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<I", v)); return self
+
+    def u64(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<Q", v)); return self
+
+    def i32(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<i", v)); return self
+
+    def i64(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<q", v)); return self
+
+    def f64(self, v: float) -> "Encoder":
+        self._parts.append(struct.pack("<d", v)); return self
+
+    def bool(self, v: bool) -> "Encoder":
+        return self.u8(1 if v else 0)
+
+    # -- length-prefixed payloads -----------------------------------------
+    def bytes(self, v: bytes) -> "Encoder":
+        """u32 length + raw bytes (reference encode(bufferlist))."""
+        self.u32(len(v))
+        self._parts.append(bytes(v))
+        return self
+
+    def str(self, v: str) -> "Encoder":
+        return self.bytes(v.encode("utf-8"))
+
+    def str_list(self, vs) -> "Encoder":
+        vs = list(vs)
+        self.u32(len(vs))
+        for v in vs:
+            self.str(v)
+        return self
+
+    def i64_list(self, vs) -> "Encoder":
+        vs = list(vs)
+        self.u32(len(vs))
+        for v in vs:
+            self.i64(v)
+        return self
+
+    def str_bytes_map(self, m: Dict[str, bytes]) -> "Encoder":
+        self.u32(len(m))
+        for k in sorted(m):
+            self.str(k).bytes(m[k])
+        return self
+
+    def str_str_map(self, m: Dict[str, str]) -> "Encoder":
+        self.u32(len(m))
+        for k in sorted(m):
+            self.str(k).str(m[k])
+        return self
+
+    # -- versioned envelope (ENCODE_START/ENCODE_FINISH) ------------------
+    def struct(self, struct_v: int, compat_v: int,
+               body: "Encoder") -> "Encoder":
+        payload = body.build()
+        self.u8(struct_v).u8(compat_v).u32(len(payload))
+        self._parts.append(payload)
+        return self
+
+    def build(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Decoder:
+    """Cursor-based decoder over one buffer (reference decode(..., bl))."""
+
+    def __init__(self, buf: bytes, pos: int = 0, end: Optional[int] = None):
+        self._buf = buf
+        self._pos = pos
+        self._end = len(buf) if end is None else end
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > self._end:
+            raise DecodeError(
+                f"truncated: need {n} bytes at {self._pos}, "
+                f"have {self._end - self._pos}")
+        v = self._buf[self._pos:self._pos + n]
+        self._pos += n
+        return v
+
+    def remaining(self) -> int:
+        return self._end - self._pos
+
+    # -- fixed-width integers ---------------------------------------------
+    def u8(self) -> int:
+        return struct.unpack("<B", self._take(1))[0]
+
+    def u16(self) -> int:
+        return struct.unpack("<H", self._take(2))[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def i32(self) -> int:
+        return struct.unpack("<i", self._take(4))[0]
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self._take(8))[0]
+
+    def f64(self) -> float:
+        return struct.unpack("<d", self._take(8))[0]
+
+    def bool(self) -> bool:
+        return self.u8() != 0
+
+    # -- length-prefixed payloads -----------------------------------------
+    def bytes(self) -> bytes:
+        return self._take(self.u32())
+
+    def str(self) -> str:
+        try:
+            return self.bytes().decode("utf-8")
+        except UnicodeDecodeError as e:
+            raise DecodeError(f"bad utf-8 string: {e}")
+
+    def str_list(self) -> List[str]:
+        return [self.str() for _ in range(self.u32())]
+
+    def i64_list(self) -> List[int]:
+        return [self.i64() for _ in range(self.u32())]
+
+    def str_bytes_map(self) -> Dict[str, bytes]:
+        return {self.str(): self.bytes() for _ in range(self.u32())}
+
+    def str_str_map(self) -> Dict[str, str]:
+        return {self.str(): self.str() for _ in range(self.u32())}
+
+    # -- versioned envelope (DECODE_START/DECODE_FINISH) ------------------
+    def struct(self, max_known_v: int) -> Tuple[int, "Decoder"]:
+        """-> (struct_v, sub-decoder bounded to the struct payload).
+        Skips trailing unknown bytes, as DECODE_FINISH does; raises if
+        the peer requires a newer decoder (compat_v > max_known_v)."""
+        struct_v = self.u8()
+        compat_v = self.u8()
+        length = self.u32()
+        if compat_v > max_known_v:
+            raise DecodeError(
+                f"struct compat_v {compat_v} > decoder version "
+                f"{max_known_v}")
+        if self._pos + length > self._end:
+            raise DecodeError("truncated struct payload")
+        sub = Decoder(self._buf, self._pos, self._pos + length)
+        self._pos += length
+        return struct_v, sub
