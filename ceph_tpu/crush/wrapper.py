@@ -226,6 +226,60 @@ class CrushWrapper:
         """reference crush_do_rule via OSDMap::_pg_to_raw_osds."""
         return self.map.do_rule(ruleno, x, result_max, osd_weights)
 
+    # -- wire form (reference CrushWrapper::encode/decode) ----------------
+    def to_wire_dict(self) -> Dict:
+        """Full-fidelity serialization (shadow buckets included) so the
+        monitor can ship the map in MOSDMap and clients rebuild an
+        identical mapper."""
+        return {
+            "types": {str(k): v for k, v in self.types.items()},
+            "max_devices": self.map.max_devices,
+            "buckets": [
+                {"id": b.id, "type": b.type, "alg": b.alg,
+                 "items": list(b.items), "weights": list(b.weights)}
+                for b in self.map.buckets.values()],
+            "bucket_names": {str(k): v
+                             for k, v in self.bucket_names.items()},
+            "name_ids": dict(self.name_ids),
+            "device_classes": {str(k): v
+                               for k, v in self.device_classes.items()},
+            "class_shadow": [[bid, cls, sid] for (bid, cls), sid
+                             in self._class_shadow.items()],
+            "rules": [
+                {"name": r.name, "steps": [list(s) for s in r.steps],
+                 "rule_type": r.rule_type,
+                 "max_size": getattr(r, "max_size", 0)}
+                for r in self.map.rules],
+            "rule_max_size": {str(k): v
+                              for k, v in self.rule_max_size.items()},
+        }
+
+    @classmethod
+    def from_wire_dict(cls, d: Dict) -> "CrushWrapper":
+        crush = cls()
+        crush.types = {int(k): v for k, v in d["types"].items()}
+        crush.map.max_devices = d["max_devices"]
+        for bd in d["buckets"]:
+            bucket = Bucket(bd["id"], bd["type"], bd["alg"],
+                            items=bd["items"], weights=bd["weights"])
+            crush.map.add_bucket(bucket)
+        crush.bucket_names = {int(k): v
+                              for k, v in d["bucket_names"].items()}
+        crush.name_ids = dict(d["name_ids"])
+        crush.device_classes = {int(k): v
+                                for k, v in d["device_classes"].items()}
+        crush._class_shadow = {(bid, cls): sid
+                               for bid, cls, sid in d["class_shadow"]}
+        for rd in d["rules"]:
+            rule = Rule(rd["name"], [tuple(s) for s in rd["steps"]],
+                        rd["rule_type"])
+            if rd.get("max_size"):
+                rule.max_size = rd["max_size"]
+            crush.map.rules.append(rule)
+        crush.rule_max_size = {int(k): v
+                               for k, v in d["rule_max_size"].items()}
+        return crush
+
     # -- dump (crushtool -d style) ----------------------------------------
     def dump(self) -> Dict:
         return {

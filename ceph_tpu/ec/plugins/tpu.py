@@ -68,6 +68,28 @@ class TpuCodecMixin:
                   for i, c in present.items()}
         return self.core.decode_chunks(arrays, chunk_len)
 
+    def encode_batch_async(self, data: np.ndarray):
+        """Non-blocking encode_batch: returns an AsyncBatch whose wait()
+        yields parity [B, m, L].  Submitting the next batch before
+        waiting overlaps transfers with MXU compute — the OSD write
+        pipeline's double-buffering entry point."""
+        data = np.asarray(data, dtype=np.uint8)
+        if data.ndim != 3 or data.shape[1] != self.k:
+            raise ValueError(f"expected [batch, k={self.k}, L] input")
+        return self.core.backend.apply_bitmatrix_bytes_async(
+            self.core.bitmatrix, data, self.w)
+
+    def stage_batch(self, data: np.ndarray):
+        """Transfer a stripe batch to device HBM ahead of encode."""
+        data = np.asarray(data, dtype=np.uint8)
+        return self.core.backend.stage(data, self.w)
+
+    def encode_batch_device(self, dev_data):
+        """Device-resident encode: device array in, device array out (no
+        host round trip) — the codec-kernel boundary."""
+        return self.core.backend.apply_bitmatrix_bytes_device(
+            self.core.bitmatrix, dev_data, self.w)
+
 
 class TpuReedSolomonVandermonde(TpuCodecMixin, jr.ReedSolomonVandermonde):
     DEFAULT_K, DEFAULT_M, DEFAULT_W = "8", "4", "8"  # north-star config

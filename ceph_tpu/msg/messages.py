@@ -1,0 +1,647 @@
+"""The typed message catalog.
+
+Python-native equivalents of the reference's per-message headers
+(reference src/messages/): the ~15 messages the OSD data path, the
+monitor control plane, heartbeats, and recovery need (SURVEY §7 step 6).
+Data-plane payloads (client ops, EC sub-ops, pushes) are tight binary
+via ceph_tpu.utils.encoding; low-rate control-plane structures (cluster
+maps, mon commands, PG log entries) ride as JSON blobs of their
+to_wire_dict forms, the framework's moral equivalent of the reference's
+versioned struct encodings.
+
+Message -> reference mapping:
+  MOSDOp/MOSDOpReply           messages/MOSDOp.h, MOSDOpReply.h
+  MOSDECSubOpWrite/...Reply    messages/MOSDECSubOpWrite.h (ECSubWrite)
+  MOSDECSubOpRead/...Reply     messages/MOSDECSubOpRead.h (ECSubRead)
+  MOSDRepOp/MOSDRepOpReply     messages/MOSDRepOp.h (replicated backend)
+  MOSDPGPush/MOSDPGPushReply   messages/MOSDPGPush.h (recovery PushOp)
+  MOSDPing                     messages/MOSDPing.h
+  MOSDMap                      messages/MOSDMap.h
+  MOSDBoot/MOSDFailure         messages/MOSDBoot.h, MOSDFailure.h
+  MMonCommand/MMonCommandAck   messages/MMonCommand.h, MMonCommandAck.h
+  MMonSubscribe                messages/MMonSubscribe.h
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..utils.encoding import Decoder, Encoder
+from .message import Message, register
+
+
+def _enc_json(obj) -> bytes:
+    return json.dumps(obj, separators=(",", ":")).encode()
+
+
+def _dec_json(buf: bytes):
+    return json.loads(buf.decode())
+
+
+# ---------------------------------------------------------------------------
+# transport control
+# ---------------------------------------------------------------------------
+
+@register
+class MAck(Message):
+    """Delivery ack: everything up to ``acked_seq`` arrived; the sender
+    trims its resend queue (reference ProtocolV1/V2 per-message ACK
+    tags).  Handled inside the messenger, never dispatched; not itself
+    seq-stamped or retained."""
+    TYPE = 1
+
+    def __init__(self, acked_seq: int = 0):
+        super().__init__()
+        self.acked_seq = acked_seq
+
+    def encode_payload(self) -> bytes:
+        return Encoder().u64(self.acked_seq).build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MAck":
+        return cls(acked_seq=Decoder(buf).u64())
+
+
+# ---------------------------------------------------------------------------
+# client ops
+# ---------------------------------------------------------------------------
+
+@dataclass
+class OSDOp:
+    """One sub-operation of a client op (reference OSDOp / the op codes
+    of PrimaryLogPG::do_osd_ops' switch, osd/PrimaryLogPG.cc:5737).
+    ``op`` is a name: write, writefull, read, stat, delete, truncate,
+    append, setxattr, getxattr, omap_set, omap_get, ..."""
+    op: str
+    offset: int = 0
+    length: int = 0
+    data: bytes = b""
+    name: str = ""          # xattr/omap key where applicable
+
+    def encode(self, e: Encoder) -> None:
+        e.str(self.op).u64(self.offset).u64(self.length)
+        e.bytes(self.data).str(self.name)
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "OSDOp":
+        return cls(op=d.str(), offset=d.u64(), length=d.u64(),
+                   data=d.bytes(), name=d.str())
+
+
+@register
+class MOSDOp(Message):
+    TYPE = 42  # reference CEPH_MSG_OSD_OP
+
+    def __init__(self, client: str = "", tid: int = 0, epoch: int = 0,
+                 pool: int = 0, oid: str = "",
+                 ops: Optional[List[OSDOp]] = None,
+                 pgid_seed: int = 0, flags: int = 0):
+        super().__init__()
+        self.client = client
+        self.tid = tid
+        self.epoch = epoch           # client's map epoch
+        self.pool = pool
+        self.oid = oid
+        self.ops = ops or []
+        self.pgid_seed = pgid_seed
+        self.flags = flags
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.client).u64(self.tid).u32(self.epoch)
+        e.i64(self.pool).str(self.oid).u32(self.pgid_seed)
+        e.u32(self.flags)
+        e.u32(len(self.ops))
+        for op in self.ops:
+            op.encode(e)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDOp":
+        d = Decoder(buf)
+        m = cls(client=d.str(), tid=d.u64(), epoch=d.u32(), pool=d.i64(),
+                oid=d.str(), pgid_seed=d.u32(), flags=d.u32())
+        m.ops = [OSDOp.decode(d) for _ in range(d.u32())]
+        return m
+
+
+@register
+class MOSDOpReply(Message):
+    TYPE = 43  # reference CEPH_MSG_OSD_OPREPLY
+
+    def __init__(self, tid: int = 0, result: int = 0, epoch: int = 0,
+                 out_data: Optional[List[bytes]] = None,
+                 extra: Optional[dict] = None):
+        super().__init__()
+        self.tid = tid
+        self.result = result         # 0 or -errno
+        self.epoch = epoch           # replier's map epoch
+        self.out_data = out_data or []
+        self.extra = extra or {}     # op-specific structured outputs
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.u64(self.tid).i32(self.result).u32(self.epoch)
+        e.u32(len(self.out_data))
+        for b in self.out_data:
+            e.bytes(b)
+        e.bytes(_enc_json(self.extra))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDOpReply":
+        d = Decoder(buf)
+        m = cls(tid=d.u64(), result=d.i32(), epoch=d.u32())
+        m.out_data = [d.bytes() for _ in range(d.u32())]
+        m.extra = _dec_json(d.bytes())
+        return m
+
+
+# ---------------------------------------------------------------------------
+# EC backend sub-ops (reference osd/ECMsgTypes.h)
+# ---------------------------------------------------------------------------
+
+@register
+class MOSDECSubOpWrite(Message):
+    """Primary -> shard: apply this shard's transaction (reference
+    ECSubWrite carried by messages/MOSDECSubOpWrite.h)."""
+    TYPE = 108
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, tid: int = 0, epoch: int = 0,
+                 txn: bytes = b"", log_entries: Optional[list] = None,
+                 at_version: Tuple[int, int] = (0, 0)):
+        super().__init__()
+        self.pgid = pgid             # str(PGid), shard-free
+        self.shard = shard           # destination shard position
+        self.from_osd = from_osd     # primary's osd id
+        self.tid = tid
+        self.epoch = epoch
+        self.txn = txn               # encoded store Transaction
+        self.log_entries = log_entries or []   # pg-log dicts
+        self.at_version = at_version
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u64(self.tid).u32(self.epoch).bytes(self.txn)
+        e.bytes(_enc_json(self.log_entries))
+        e.u32(self.at_version[0]).u64(self.at_version[1])
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDECSubOpWrite":
+        d = Decoder(buf)
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                tid=d.u64(), epoch=d.u32(), txn=d.bytes())
+        m.log_entries = _dec_json(d.bytes())
+        m.at_version = (d.u32(), d.u64())
+        return m
+
+
+@register
+class MOSDECSubOpWriteReply(Message):
+    TYPE = 109
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, tid: int = 0, epoch: int = 0,
+                 committed: bool = True, result: int = 0):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard           # replying shard
+        self.from_osd = from_osd
+        self.tid = tid
+        self.epoch = epoch
+        self.committed = committed
+        self.result = result
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u64(self.tid).u32(self.epoch).bool(self.committed)
+        e.i32(self.result)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDECSubOpWriteReply":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                   tid=d.u64(), epoch=d.u32(), committed=d.bool(),
+                   result=d.i32())
+
+
+@register
+class MOSDECSubOpRead(Message):
+    """Primary -> shard: read chunk extents (+ attrs) for reconstruction
+    or recovery (reference ECSubRead, messages/MOSDECSubOpRead.h:21)."""
+    TYPE = 110
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, tid: int = 0, epoch: int = 0,
+                 reads: Optional[List[Tuple[str, int, int]]] = None,
+                 attrs_to_read: Optional[List[str]] = None,
+                 for_recovery: bool = False):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard
+        self.from_osd = from_osd
+        self.tid = tid
+        self.epoch = epoch
+        self.reads = reads or []     # (oid, offset, length)
+        self.attrs_to_read = attrs_to_read or []
+        self.for_recovery = for_recovery
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u64(self.tid).u32(self.epoch)
+        e.u32(len(self.reads))
+        for oid, off, length in self.reads:
+            e.str(oid).u64(off).i64(length)
+        e.str_list(self.attrs_to_read)
+        e.bool(self.for_recovery)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDECSubOpRead":
+        d = Decoder(buf)
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                tid=d.u64(), epoch=d.u32())
+        m.reads = [(d.str(), d.u64(), d.i64()) for _ in range(d.u32())]
+        m.attrs_to_read = d.str_list()
+        m.for_recovery = d.bool()
+        return m
+
+
+@register
+class MOSDECSubOpReadReply(Message):
+    TYPE = 111
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, tid: int = 0, epoch: int = 0,
+                 buffers: Optional[List[Tuple[str, int, bytes]]] = None,
+                 attrs: Optional[List[Tuple[str, Dict[str, bytes]]]] = None,
+                 errors: Optional[List[Tuple[str, int]]] = None):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard           # replying shard position
+        self.from_osd = from_osd     # replying osd id
+        self.tid = tid
+        self.epoch = epoch
+        self.buffers = buffers or []   # (oid, offset, data)
+        self.attrs = attrs or []       # (oid, {attr: value})
+        self.errors = errors or []     # (oid, -errno)
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u64(self.tid).u32(self.epoch)
+        e.u32(len(self.buffers))
+        for oid, off, data in self.buffers:
+            e.str(oid).u64(off).bytes(data)
+        e.u32(len(self.attrs))
+        for oid, attrs in self.attrs:
+            e.str(oid).str_bytes_map(attrs)
+        e.u32(len(self.errors))
+        for oid, err in self.errors:
+            e.str(oid).i32(err)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDECSubOpReadReply":
+        d = Decoder(buf)
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                tid=d.u64(), epoch=d.u32())
+        m.buffers = [(d.str(), d.u64(), d.bytes())
+                     for _ in range(d.u32())]
+        m.attrs = [(d.str(), d.str_bytes_map()) for _ in range(d.u32())]
+        m.errors = [(d.str(), d.i32()) for _ in range(d.u32())]
+        return m
+
+
+# ---------------------------------------------------------------------------
+# replicated backend sub-ops (reference messages/MOSDRepOp.h)
+# ---------------------------------------------------------------------------
+
+@register
+class MOSDRepOp(Message):
+    TYPE = 112
+
+    def __init__(self, pgid: str = "", from_osd: int = -1, tid: int = 0,
+                 epoch: int = 0, txn: bytes = b"",
+                 log_entries: Optional[list] = None,
+                 at_version: Tuple[int, int] = (0, 0)):
+        super().__init__()
+        self.pgid = pgid
+        self.from_osd = from_osd
+        self.tid = tid
+        self.epoch = epoch
+        self.txn = txn
+        self.log_entries = log_entries or []
+        self.at_version = at_version
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.from_osd).u64(self.tid)
+        e.u32(self.epoch).bytes(self.txn)
+        e.bytes(_enc_json(self.log_entries))
+        e.u32(self.at_version[0]).u64(self.at_version[1])
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDRepOp":
+        d = Decoder(buf)
+        m = cls(pgid=d.str(), from_osd=d.i32(), tid=d.u64(),
+                epoch=d.u32(), txn=d.bytes())
+        m.log_entries = _dec_json(d.bytes())
+        m.at_version = (d.u32(), d.u64())
+        return m
+
+
+@register
+class MOSDRepOpReply(Message):
+    TYPE = 113
+
+    def __init__(self, pgid: str = "", from_osd: int = -1, tid: int = 0,
+                 epoch: int = 0, result: int = 0):
+        super().__init__()
+        self.pgid = pgid
+        self.from_osd = from_osd
+        self.tid = tid
+        self.epoch = epoch
+        self.result = result
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.from_osd).u64(self.tid)
+        e.u32(self.epoch).i32(self.result)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDRepOpReply":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), from_osd=d.i32(), tid=d.u64(),
+                   epoch=d.u32(), result=d.i32())
+
+
+# ---------------------------------------------------------------------------
+# recovery pushes (reference messages/MOSDPGPush.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PushOp:
+    """One object (or object chunk) being pushed to a shard that is
+    missing it (reference PushOp in osd/osd_types.h)."""
+    oid: str
+    data_offset: int = 0
+    data: bytes = b""
+    attrs: Dict[str, bytes] = field(default_factory=dict)
+    omap: Dict[str, bytes] = field(default_factory=dict)
+    complete: bool = True      # last chunk of the object
+    version: Tuple[int, int] = (0, 0)
+
+    def encode(self, e: Encoder) -> None:
+        e.str(self.oid).u64(self.data_offset).bytes(self.data)
+        e.str_bytes_map(self.attrs).str_bytes_map(self.omap)
+        e.bool(self.complete)
+        e.u32(self.version[0]).u64(self.version[1])
+
+    @classmethod
+    def decode(cls, d: Decoder) -> "PushOp":
+        return cls(oid=d.str(), data_offset=d.u64(), data=d.bytes(),
+                   attrs=d.str_bytes_map(), omap=d.str_bytes_map(),
+                   complete=d.bool(), version=(d.u32(), d.u64()))
+
+
+@register
+class MOSDPGPush(Message):
+    TYPE = 105  # reference MSG_OSD_PG_PUSH
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, epoch: int = 0,
+                 pushes: Optional[List[PushOp]] = None):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard
+        self.from_osd = from_osd
+        self.epoch = epoch
+        self.pushes = pushes or []
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u32(self.epoch).u32(len(self.pushes))
+        for p in self.pushes:
+            p.encode(e)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPGPush":
+        d = Decoder(buf)
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                epoch=d.u32())
+        m.pushes = [PushOp.decode(d) for _ in range(d.u32())]
+        return m
+
+
+@register
+class MOSDPGPushReply(Message):
+    TYPE = 106
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, epoch: int = 0,
+                 oids: Optional[List[str]] = None):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard
+        self.from_osd = from_osd
+        self.epoch = epoch
+        self.oids = oids or []
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u32(self.epoch).str_list(self.oids)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPGPushReply":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                   epoch=d.u32(), oids=d.str_list())
+
+
+# ---------------------------------------------------------------------------
+# heartbeat / maps / boot / failure (reference MOSDPing.h, MOSDMap.h, ...)
+# ---------------------------------------------------------------------------
+
+@register
+class MOSDPing(Message):
+    TYPE = 70
+    PING = 0
+    PING_REPLY = 1
+
+    def __init__(self, op: int = PING, from_osd: int = -1,
+                 epoch: int = 0, stamp: float = 0.0):
+        super().__init__()
+        self.op = op
+        self.from_osd = from_osd
+        self.epoch = epoch
+        self.stamp = stamp           # echoed for RTT accounting
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.u8(self.op).i32(self.from_osd).u32(self.epoch).f64(self.stamp)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPing":
+        d = Decoder(buf)
+        return cls(op=d.u8(), from_osd=d.i32(), epoch=d.u32(),
+                   stamp=d.f64())
+
+
+@register
+class MOSDMap(Message):
+    """Full maps keyed by epoch, JSON of OSDMap.to_wire_dict (the
+    reference ships encoded OSDMap + Incrementals; full maps keep the
+    control plane simple at these cluster sizes)."""
+    TYPE = 41  # reference CEPH_MSG_OSD_MAP
+
+    def __init__(self, maps: Optional[Dict[int, dict]] = None):
+        super().__init__()
+        self.maps = maps or {}       # epoch -> wire dict
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.u32(len(self.maps))
+        for epoch in sorted(self.maps):
+            e.u32(epoch).bytes(_enc_json(self.maps[epoch]))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDMap":
+        d = Decoder(buf)
+        m = cls()
+        for _ in range(d.u32()):
+            epoch = d.u32()
+            m.maps[epoch] = _dec_json(d.bytes())
+        return m
+
+
+@register
+class MOSDBoot(Message):
+    TYPE = 71
+
+    def __init__(self, osd: int = -1, addr: Tuple[str, int] = ("", 0)):
+        super().__init__()
+        self.osd = osd
+        self.addr = addr
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.i32(self.osd).str(self.addr[0]).u16(self.addr[1])
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDBoot":
+        d = Decoder(buf)
+        return cls(osd=d.i32(), addr=(d.str(), d.u16()))
+
+
+@register
+class MOSDFailure(Message):
+    TYPE = 72
+
+    def __init__(self, target_osd: int = -1, from_osd: int = -1,
+                 failed_for: float = 0.0, epoch: int = 0):
+        super().__init__()
+        self.target_osd = target_osd
+        self.from_osd = from_osd
+        self.failed_for = failed_for   # seconds without a ping reply
+        self.epoch = epoch
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.i32(self.target_osd).i32(self.from_osd)
+        e.f64(self.failed_for).u32(self.epoch)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDFailure":
+        d = Decoder(buf)
+        return cls(target_osd=d.i32(), from_osd=d.i32(),
+                   failed_for=d.f64(), epoch=d.u32())
+
+
+# ---------------------------------------------------------------------------
+# monitor control plane (reference MMonCommand.h, MMonSubscribe.h)
+# ---------------------------------------------------------------------------
+
+@register
+class MMonCommand(Message):
+    TYPE = 50
+
+    def __init__(self, tid: int = 0, cmd: Optional[dict] = None):
+        super().__init__()
+        self.tid = tid
+        self.cmd = cmd or {}         # {"prefix": "osd pool create", ...}
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.u64(self.tid).bytes(_enc_json(self.cmd))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MMonCommand":
+        d = Decoder(buf)
+        return cls(tid=d.u64(), cmd=_dec_json(d.bytes()))
+
+
+@register
+class MMonCommandAck(Message):
+    TYPE = 51
+
+    def __init__(self, tid: int = 0, retcode: int = 0, rs: str = "",
+                 out: Optional[dict] = None):
+        super().__init__()
+        self.tid = tid
+        self.retcode = retcode
+        self.rs = rs                 # human-readable status
+        self.out = out or {}         # structured output
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.u64(self.tid).i32(self.retcode).str(self.rs)
+        e.bytes(_enc_json(self.out))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MMonCommandAck":
+        d = Decoder(buf)
+        return cls(tid=d.u64(), retcode=d.i32(), rs=d.str(),
+                   out=_dec_json(d.bytes()))
+
+
+@register
+class MMonSubscribe(Message):
+    """Subscribe to map deliveries from this epoch on (reference
+    MMonSubscribe.h; deliveries arrive as MOSDMap)."""
+    TYPE = 52
+
+    def __init__(self, what: Optional[Dict[str, int]] = None):
+        super().__init__()
+        self.what = what or {}       # {"osdmap": start_epoch}
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.u32(len(self.what))
+        for name in sorted(self.what):
+            e.str(name).u32(self.what[name])
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MMonSubscribe":
+        d = Decoder(buf)
+        return cls(what={d.str(): d.u32() for _ in range(d.u32())})

@@ -1,0 +1,500 @@
+"""Async TCP messenger.
+
+Python-native equivalent of the reference's messenger layer (reference
+src/msg/Messenger.h, msg/async/AsyncMessenger.cc): entity-named
+endpoints exchanging typed messages over persistent connections, with
+
+* dispatcher fan-out (reference Dispatcher.h): ms_dispatch /
+  ms_handle_connect / ms_handle_reset;
+* lossless peer policy (reference Policy.h): the connecting side
+  reconnects with backoff, unacknowledged messages are resent, and
+  receivers drop duplicates by message seq — the reconnect/replace
+  semantics of ProtocolV2 (reference msg/async/ProtocolV2.cc) reduced
+  to a seq-exchange handshake;
+* lossy policy for clients: a dead connection just resets, the Objecter
+  layer resends ops itself (reference Objecter resend-on-reset);
+* CRC framing per message (ceph_tpu/msg/message.py);
+* socket fault injection via config ``ms_inject_socket_failures``
+  (reference common/options.cc:1075), the hook the thrash tests use.
+
+Threads: one acceptor per bound messenger, one reader + one writer per
+connection (the reference's event loops multiplex instead; thread-per-
+connection is idiomatic Python and the daemon counts here are small).
+"""
+from __future__ import annotations
+
+import random
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils.config import Config, default_config
+from ..utils.encoding import DecodeError
+from .message import (CRC_LEN, HEADER_LEN, Message, decode_frame_body,
+                      decode_frame_header, encode_frame)
+from .messages import MAck
+
+# ack cadence: trim the peer's resend queue at least this often
+ACK_EVERY_MSGS = 32
+ACK_EVERY_BYTES = 1 << 20
+
+BANNER_MAGIC = 0x43455032  # "CEP2"
+_BANNER = struct.Struct("<IQQ")  # magic, nonce, in_seq
+
+MAX_FRAME = 256 << 20
+
+
+class Dispatcher:
+    """Receiver interface (reference msg/Dispatcher.h)."""
+
+    def ms_dispatch(self, conn: "Connection", msg: Message) -> bool:
+        """Return True if the message was handled."""
+        return False
+
+    def ms_handle_connect(self, conn: "Connection") -> None:
+        pass
+
+    def ms_handle_reset(self, conn: "Connection") -> None:
+        """A lossy connection died, or a lossless one gave up."""
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _send_banner(sock: socket.socket, name: str, nonce: int,
+                 in_seq: int) -> None:
+    nb = name.encode()
+    sock.sendall(_BANNER.pack(BANNER_MAGIC, nonce, in_seq) +
+                 struct.pack("<H", len(nb)) + nb)
+
+
+def _recv_banner(sock: socket.socket) -> Tuple[str, int, int]:
+    magic, nonce, in_seq = _BANNER.unpack(
+        _read_exact(sock, _BANNER.size))
+    if magic != BANNER_MAGIC:
+        raise ConnectionError(f"bad banner magic {magic:#x}")
+    (nlen,) = struct.unpack("<H", _read_exact(sock, 2))
+    name = _read_exact(sock, nlen).decode()
+    return name, nonce, in_seq
+
+
+def _shutdown_close(sock: Optional[socket.socket]) -> None:
+    """shutdown() then close(): shutdown wakes any thread blocked in
+    recv/send on the socket (close alone does not on Linux)."""
+    if sock is None:
+        return
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
+    try:
+        sock.close()
+    except OSError:
+        pass
+
+
+class Connection:
+    """One logical session with a peer (reference msg/Connection.h).
+    Survives socket deaths when lossless: the session (seq counters,
+    unacked messages) lives here; sockets come and go.
+
+    One persistent reader and one persistent writer thread pump
+    whichever socket generation is current — sockets are replaced on
+    reconnect, threads are not (the reference's event-loop workers are
+    likewise long-lived while connections churn)."""
+
+    def __init__(self, msgr: "Messenger", peer_addr: Tuple[str, int],
+                 lossless: bool, connector: bool):
+        self.msgr = msgr
+        self.peer_addr = peer_addr
+        self.peer_name = ""            # known after handshake
+        self.lossless = lossless
+        self.connector = connector     # we dial; else we accepted
+        self.lock = threading.RLock()
+        self.send_cond = threading.Condition(self.lock)
+        self.out_q: deque = deque()    # Messages to send
+        self.unacked: deque = deque()  # sent, possibly undelivered
+        self.out_seq = 0
+        self.in_seq = 0
+        self.sock: Optional[socket.socket] = None
+        self.state = "connecting"      # connecting|open|closed
+        # socket generation: every attach bumps it; pump loops carry
+        # their generation so a stale pump can never mutate the session
+        # after a replace (reference ProtocolV2 connection race handling)
+        self.gen = 0
+        self._reconnecting = False     # at most one reconnect thread
+        self._pumps_started = False
+        self.peer_nonce: Optional[int] = None
+        self._recv_since_ack = 0
+        self._recv_bytes_since_ack = 0
+
+    # -- public API --------------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        with self.lock:
+            if self.state == "closed":
+                return                 # dropped, like the reference's
+                                       # sends on a closed lossy conn
+            self.out_q.append(msg)
+            self.send_cond.notify_all()
+
+    def mark_down(self) -> None:
+        """Tear down now; no reset callback (reference mark_down)."""
+        self._close(reset=False)
+
+    def is_connected(self) -> bool:
+        with self.lock:
+            return self.state == "open"
+
+    def __repr__(self) -> str:
+        return (f"<Connection to {self.peer_name or self.peer_addr} "
+                f"{self.state}>")
+
+    # -- internals ---------------------------------------------------------
+    def _attach(self, sock: socket.socket, peer_name: str,
+                peer_nonce: int, peer_in_seq: int) -> None:
+        """Socket ready (post-handshake): replace any live socket, trim
+        acked, requeue unacked, wake the pumps."""
+        with self.lock:
+            if self.state == "closed":
+                _shutdown_close(sock)
+                return
+            old, self.sock = self.sock, None
+            self.peer_name = peer_name
+            if self.peer_nonce is not None \
+                    and self.peer_nonce != peer_nonce:
+                # peer restarted (reincarnation, detected by nonce as
+                # the reference does): its seqs restart at 1, so our
+                # dedup floor must reset or we'd drop everything
+                self.in_seq = 0
+            self.peer_nonce = peer_nonce
+            # drop messages the peer already received
+            while self.unacked and self.unacked[0].seq <= peer_in_seq:
+                self.unacked.popleft()
+            # resend the rest ahead of new traffic
+            for msg in reversed(self.unacked):
+                self.out_q.appendleft(msg)
+            self.unacked.clear()
+            self.sock = sock
+            self.state = "open"
+            self.gen += 1
+            if not self._pumps_started:
+                self._pumps_started = True
+                threading.Thread(target=self._writer_main,
+                                 name=f"msgr-w-{peer_name}",
+                                 daemon=True).start()
+                threading.Thread(target=self._reader_main,
+                                 name=f"msgr-r-{peer_name}",
+                                 daemon=True).start()
+            self.send_cond.notify_all()
+        _shutdown_close(old)
+        for d in self.msgr.dispatchers:
+            d.ms_handle_connect(self)
+
+    def _socket_dead(self, sock: socket.socket, gen: int) -> None:
+        _shutdown_close(sock)
+        with self.lock:
+            if gen != self.gen or self.state != "open":
+                return                 # stale generation or already
+                                       # handled by the other pump
+            self.sock = None
+            if self.lossless and self.connector:
+                self.state = "connecting"
+                self._spawn_reconnect_locked()
+                return
+            if self.lossless:
+                # acceptor keeps session state and waits for the peer
+                # to redial (reference replace semantics)
+                self.state = "connecting"
+                return
+        self._close(reset=True)
+
+    def _spawn_reconnect_locked(self) -> None:
+        """Start the (single) reconnect thread; caller holds the lock."""
+        if self._reconnecting:
+            return
+        self._reconnecting = True
+        threading.Thread(target=self.msgr._reconnect, args=(self,),
+                         daemon=True).start()
+
+    def _close(self, reset: bool) -> None:
+        with self.lock:
+            if self.state == "closed":
+                return
+            self.state = "closed"
+            sock, self.sock = self.sock, None
+            self.send_cond.notify_all()
+        _shutdown_close(sock)
+        self.msgr._conn_closed(self)
+        if reset:
+            for d in self.msgr.dispatchers:
+                d.ms_handle_reset(self)
+
+    # -- pumps -------------------------------------------------------------
+    def _current_socket(self):
+        """Block until there's an open socket (or the session closes);
+        -> (sock, gen) or (None, 0)."""
+        with self.lock:
+            while self.state == "connecting" or \
+                    (self.state == "open" and self.sock is None):
+                self.send_cond.wait()
+            if self.state == "closed":
+                return None, 0
+            return self.sock, self.gen
+
+    def _writer_main(self) -> None:
+        while True:
+            sock, gen = self._current_socket()
+            if sock is None:
+                return
+            while True:
+                with self.lock:
+                    while (not self.out_q and gen == self.gen
+                           and self.state == "open"):
+                        self.send_cond.wait()
+                    if gen != self.gen or self.state != "open":
+                        break          # pick up the next generation
+                    msg = self.out_q.popleft()
+                    if msg.TYPE != MAck.TYPE:
+                        if msg.seq == 0:
+                            self.out_seq += 1
+                            msg.seq = self.out_seq
+                        if self.lossless:
+                            self.unacked.append(msg)
+                inject = self.msgr.conf["ms_inject_socket_failures"]
+                try:
+                    if inject and random.randrange(inject) == 0:
+                        raise ConnectionError("injected socket failure")
+                    sock.sendall(encode_frame(msg))
+                except (OSError, ConnectionError):
+                    self._socket_dead(sock, gen)
+                    break
+
+    def _reader_main(self) -> None:
+        while True:
+            sock, gen = self._current_socket()
+            if sock is None:
+                return
+            while True:
+                try:
+                    head = _read_exact(sock, HEADER_LEN)
+                    mtype, seq, plen = decode_frame_header(head)
+                    if plen > MAX_FRAME:
+                        raise DecodeError(f"oversized frame {plen}")
+                    payload = _read_exact(sock, plen)
+                    crc = _read_exact(sock, CRC_LEN)
+                    msg = decode_frame_body(mtype, seq, head, payload,
+                                            crc)
+                except (OSError, ConnectionError, DecodeError):
+                    # dead or corrupt stream: kill the socket; a
+                    # lossless session reconnects and resends
+                    self._socket_dead(sock, gen)
+                    break
+                with self.lock:
+                    if gen != self.gen or self.state != "open":
+                        break          # replaced under us: stop
+                                       # dispatching from a stale socket
+                    if msg.TYPE == MAck.TYPE:
+                        # transport control: trim the resend queue
+                        while self.unacked and \
+                                self.unacked[0].seq <= msg.acked_seq:
+                            self.unacked.popleft()
+                        continue
+                    if msg.seq <= self.in_seq:
+                        continue       # duplicate after reconnect
+                    self.in_seq = msg.seq
+                    ack = None
+                    if self.lossless:
+                        self._recv_since_ack += 1
+                        self._recv_bytes_since_ack += plen
+                        if (self._recv_since_ack >= ACK_EVERY_MSGS or
+                                self._recv_bytes_since_ack >=
+                                ACK_EVERY_BYTES):
+                            ack = MAck(acked_seq=self.in_seq)
+                            self._recv_since_ack = 0
+                            self._recv_bytes_since_ack = 0
+                    if ack is not None:
+                        self.out_q.append(ack)
+                        self.send_cond.notify_all()
+                msg.connection = self
+                self.msgr._dispatch(self, msg)
+
+
+class Messenger:
+    """Entity-named endpoint (reference Messenger::create).  ``name``
+    is "type.id" — osd.3, mon.0, client.17."""
+
+    def __init__(self, name: str, nonce: Optional[int] = None,
+                 conf: Optional[Config] = None):
+        self.name = name
+        self.nonce = nonce if nonce is not None \
+            else random.getrandbits(64)
+        self.conf = conf or default_config()
+        self.dispatchers: List[Dispatcher] = []
+        self.lock = threading.RLock()
+        self.listen_sock: Optional[socket.socket] = None
+        self.my_addr: Optional[Tuple[str, int]] = None
+        self.conns_by_name: Dict[str, Connection] = {}
+        self.conns: List[Connection] = []
+        self._accept_thread: Optional[threading.Thread] = None
+        self._stopping = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def bind(self, addr: Tuple[str, int] = ("127.0.0.1", 0)
+             ) -> Tuple[str, int]:
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        sock.bind(addr)
+        sock.listen(64)
+        self.listen_sock = sock
+        self.my_addr = sock.getsockname()
+        return self.my_addr
+
+    def start(self) -> None:
+        if self.listen_sock is not None:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, name=f"msgr-accept-{self.name}",
+                daemon=True)
+            self._accept_thread.start()
+
+    def shutdown(self) -> None:
+        with self.lock:
+            self._stopping = True
+            conns = list(self.conns)
+        if self.listen_sock:
+            # shutdown() wakes the acceptor blocked in accept(); bare
+            # close() would leak that thread
+            _shutdown_close(self.listen_sock)
+        for conn in conns:
+            conn.mark_down()
+
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    def is_stopping(self) -> bool:
+        with self.lock:
+            return self._stopping
+
+    # -- connect side ------------------------------------------------------
+    def connect_to(self, addr: Tuple[str, int],
+                   lossless: bool = True) -> Connection:
+        """Get (or create) the connection to the peer at ``addr``."""
+        addr = (addr[0], int(addr[1]))
+        with self.lock:
+            for conn in self.conns:
+                if conn.peer_addr == addr and conn.state != "closed":
+                    return conn
+            conn = Connection(self, addr, lossless, connector=True)
+            self.conns.append(conn)
+        with conn.lock:
+            conn._spawn_reconnect_locked()
+        return conn
+
+    def _reconnect(self, conn: Connection) -> None:
+        retry = self.conf["ms_connection_retry_interval"]
+        try:
+            while True:
+                with self.lock:
+                    if self._stopping:
+                        return
+                with conn.lock:
+                    if conn.state != "connecting":
+                        return
+                    in_seq = conn.in_seq
+                try:
+                    sock = socket.create_connection(conn.peer_addr,
+                                                    timeout=5.0)
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                    _send_banner(sock, self.name, self.nonce, in_seq)
+                    peer_name, peer_nonce, peer_in_seq = \
+                        _recv_banner(sock)
+                    sock.settimeout(None)
+                except (OSError, ConnectionError):
+                    if not conn.lossless:
+                        conn._close(reset=True)
+                        return
+                    time.sleep(retry)
+                    continue
+                with self.lock:
+                    self.conns_by_name[peer_name] = conn
+                conn._attach(sock, peer_name, peer_nonce, peer_in_seq)
+                return
+        finally:
+            stopping = self.is_stopping()   # msgr lock, before conn lock
+            with conn.lock:
+                conn._reconnecting = False
+                # a socket may have died while we were attaching; if the
+                # session needs another dial, restart
+                if conn.state == "connecting" and conn.connector \
+                        and conn.lossless and not stopping:
+                    conn._spawn_reconnect_locked()
+
+    # -- accept side -------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while True:
+            try:
+                sock, _ = self.listen_sock.accept()
+            except OSError:
+                return                 # shut down
+            threading.Thread(target=self._handle_accept, args=(sock,),
+                             daemon=True).start()
+
+    def _handle_accept(self, sock: socket.socket) -> None:
+        try:
+            sock.settimeout(5.0)
+            peer_name, peer_nonce, peer_in_seq = _recv_banner(sock)
+            with self.lock:
+                conn = self.conns_by_name.get(peer_name)
+                if conn is None or conn.state == "closed":
+                    conn = Connection(self, sock.getpeername(),
+                                      lossless=True, connector=False)
+                    self.conns.append(conn)
+                    self.conns_by_name[peer_name] = conn
+                # a restarted peer sends in_seq=0 with a fresh nonce;
+                # replying with the stale floor would make it drop our
+                # next sends, so advertise what matches its incarnation
+                if conn.peer_nonce is not None \
+                        and conn.peer_nonce != peer_nonce:
+                    in_seq = 0
+                else:
+                    in_seq = conn.in_seq
+            _send_banner(sock, self.name, self.nonce, in_seq)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)
+        except (OSError, ConnectionError, UnicodeDecodeError):
+            try:
+                sock.close()
+            except OSError:
+                pass
+            return
+        # _attach replaces (and closes) any old socket on the session
+        # (reference ProtocolV2 "replace" on reconnect)
+        conn._attach(sock, peer_name, peer_nonce, peer_in_seq)
+
+    # -- plumbing ----------------------------------------------------------
+    def _dispatch(self, conn: Connection, msg: Message) -> None:
+        for d in self.dispatchers:
+            try:
+                if d.ms_dispatch(conn, msg):
+                    return
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                return
+
+    def _conn_closed(self, conn: Connection) -> None:
+        with self.lock:
+            if conn in self.conns:
+                self.conns.remove(conn)
+            if self.conns_by_name.get(conn.peer_name) is conn:
+                del self.conns_by_name[conn.peer_name]

@@ -141,6 +141,24 @@ def _bucket_batch(b: int) -> int:
     return 1 << (b - 1).bit_length()
 
 
+class AsyncBatch:
+    """Handle to an in-flight batched encode: the device computation and
+    the device->host copy are both dispatched; wait() joins and returns
+    the trimmed host array.  Lets the OSD batching layer (and the bench)
+    overlap host->device staging, MXU compute, and device->host parity
+    fetch across consecutive stripe batches."""
+
+    def __init__(self, dev_out, batch: int, L: int, lead: tuple):
+        self._dev = dev_out
+        self._batch = batch
+        self._L = L
+        self._lead = lead
+
+    def wait(self) -> np.ndarray:
+        out = np.asarray(self._dev)[:self._batch, :, :self._L]
+        return out.reshape(self._lead + out.shape[-2:])
+
+
 class JaxBackend:
     """Backend for CodecCore executing on the default JAX device (TPU when
     present, CPU otherwise — the monitor-without-TPU fallback required by
@@ -191,6 +209,43 @@ class JaxBackend:
         out = np.asarray(out)[:batch, :, :L]
         out = out.reshape(lead + out.shape[-2:])
         return out[0] if squeeze else out
+
+    def apply_bitmatrix_bytes_async(self, B: np.ndarray, data: np.ndarray,
+                                    w: int) -> AsyncBatch:
+        """Non-blocking apply_bitmatrix_bytes: dispatches h2d staging, the
+        MXU matmul, and the parity d2h copy, returning a handle.  Calling
+        this for batch i+1 before AsyncBatch.wait() on batch i overlaps
+        transfers with compute (double buffering)."""
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        lead = data.shape[:-2] if not squeeze else ()
+        data = data.reshape((-1,) + data.shape[-2:])
+        wbytes = max(1, w // 8)
+        if data.shape[-1] % wbytes:
+            raise ValueError(
+                f"chunk length must be a multiple of {wbytes} for w={w}")
+        padded, batch, L = self._padded(data, LENGTH_QUANTUM * wbytes)
+        dev = jax.device_put(padded)
+        out = _apply_byte_domain(self._device_matrix(B), dev, w)
+        out.copy_to_host_async()
+        return AsyncBatch(out, batch, L, lead)
+
+    def apply_bitmatrix_bytes_device(self, B: np.ndarray, dev_data, w: int):
+        """Device-resident apply: input is already a device array (padded
+        to bucket shapes by the caller via stage()); output stays on
+        device.  This is the codec-kernel boundary — the analog of the
+        reference benchmark timing encode() over buffers in RAM
+        (reference test/erasure-code/ceph_erasure_code_benchmark.cc:251)."""
+        return _apply_byte_domain(self._device_matrix(B), dev_data, w)
+
+    def stage(self, data: np.ndarray, w: int):
+        """Pad + transfer a [batch, k, L] host array to the device."""
+        wbytes = max(1, w // 8)
+        padded, batch, L = self._padded(data, LENGTH_QUANTUM * wbytes)
+        dev = jax.device_put(padded)
+        dev.block_until_ready()
+        return dev, batch, L
 
     def apply_bitmatrix_packets(self, B: np.ndarray, pk: np.ndarray
                                 ) -> np.ndarray:

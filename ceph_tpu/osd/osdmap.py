@@ -276,6 +276,54 @@ class OSDMap:
     def clone(self) -> "OSDMap":
         return copy.deepcopy(self)
 
+    # -- wire form (reference OSDMap::encode/decode, shipped in MOSDMap) --
+    def to_wire_dict(self) -> Dict:
+        return {
+            "epoch": self.epoch,
+            "fsid": self.fsid,
+            "max_osd": self.max_osd,
+            "osds": {str(o): {"up": i.up, "weight": i.weight,
+                              "addr": list(i.addr) if i.addr else None,
+                              "up_from": i.up_from, "down_at": i.down_at}
+                     for o, i in self.osds.items()},
+            "pools": {str(p.pool_id): {
+                "name": p.name, "type": p.type, "size": p.size,
+                "min_size": p.min_size, "pg_num": p.pg_num,
+                "crush_rule": p.crush_rule,
+                "erasure_code_profile": p.erasure_code_profile,
+                "stripe_width": p.stripe_width,
+                "ec_overwrites": p.ec_overwrites}
+                for p in self.pools.values()},
+            "erasure_code_profiles": self.erasure_code_profiles,
+            "crush": self.crush.to_wire_dict(),
+        }
+
+    @classmethod
+    def from_wire_dict(cls, d: Dict) -> "OSDMap":
+        m = cls()
+        m.epoch = d["epoch"]
+        m.fsid = d["fsid"]
+        m.max_osd = d["max_osd"]
+        for o, i in d["osds"].items():
+            m.osds[int(o)] = OSDInfo(
+                up=i["up"], weight=i["weight"],
+                addr=tuple(i["addr"]) if i["addr"] else None,
+                up_from=i["up_from"], down_at=i["down_at"])
+        for pid, p in d["pools"].items():
+            pool = PGPool(name=p["name"], pool_id=int(pid), type=p["type"],
+                          size=p["size"], min_size=p["min_size"],
+                          pg_num=p["pg_num"], crush_rule=p["crush_rule"],
+                          erasure_code_profile=p["erasure_code_profile"],
+                          stripe_width=p["stripe_width"],
+                          ec_overwrites=p.get("ec_overwrites", False))
+            m.pools[int(pid)] = pool
+            m.pool_name_to_id[pool.name] = int(pid)
+            m._next_pool_id = max(m._next_pool_id, int(pid) + 1)
+        m.erasure_code_profiles = {
+            k: dict(v) for k, v in d["erasure_code_profiles"].items()}
+        m.crush = CrushWrapper.from_wire_dict(d["crush"])
+        return m
+
     # -- dump --------------------------------------------------------------
     def dump(self) -> Dict:
         return {
