@@ -42,7 +42,7 @@ ACK_EVERY_MSGS = 32
 ACK_EVERY_BYTES = 1 << 20
 
 BANNER_MAGIC = 0x43455032  # "CEP2"
-_BANNER = struct.Struct("<IQQ")  # magic, nonce, in_seq
+_BANNER = struct.Struct("<IQQB")  # magic, nonce, in_seq, lossless flag
 
 MAX_FRAME = 256 << 20
 
@@ -72,20 +72,21 @@ def _read_exact(sock: socket.socket, n: int) -> bytes:
 
 
 def _send_banner(sock: socket.socket, name: str, nonce: int,
-                 in_seq: int) -> None:
+                 in_seq: int, lossless: bool) -> None:
     nb = name.encode()
-    sock.sendall(_BANNER.pack(BANNER_MAGIC, nonce, in_seq) +
+    sock.sendall(_BANNER.pack(BANNER_MAGIC, nonce, in_seq,
+                              1 if lossless else 0) +
                  struct.pack("<H", len(nb)) + nb)
 
 
-def _recv_banner(sock: socket.socket) -> Tuple[str, int, int]:
-    magic, nonce, in_seq = _BANNER.unpack(
+def _recv_banner(sock: socket.socket) -> Tuple[str, int, int, bool]:
+    magic, nonce, in_seq, lossless = _BANNER.unpack(
         _read_exact(sock, _BANNER.size))
     if magic != BANNER_MAGIC:
         raise ConnectionError(f"bad banner magic {magic:#x}")
     (nlen,) = struct.unpack("<H", _read_exact(sock, 2))
     name = _read_exact(sock, nlen).decode()
-    return name, nonce, in_seq
+    return name, nonce, in_seq, bool(lossless)
 
 
 def _shutdown_close(sock: Optional[socket.socket]) -> None:
@@ -415,8 +416,9 @@ class Messenger:
                                                     timeout=5.0)
                     sock.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
-                    _send_banner(sock, self.name, self.nonce, in_seq)
-                    peer_name, peer_nonce, peer_in_seq = \
+                    _send_banner(sock, self.name, self.nonce, in_seq,
+                                 conn.lossless)
+                    peer_name, peer_nonce, peer_in_seq, _ = \
                         _recv_banner(sock)
                     sock.settimeout(None)
                 except (OSError, ConnectionError):
@@ -452,23 +454,37 @@ class Messenger:
     def _handle_accept(self, sock: socket.socket) -> None:
         try:
             sock.settimeout(5.0)
-            peer_name, peer_nonce, peer_in_seq = _recv_banner(sock)
+            peer_name, peer_nonce, peer_in_seq, peer_lossless = \
+                _recv_banner(sock)
             with self.lock:
-                conn = self.conns_by_name.get(peer_name)
-                if conn is None or conn.state == "closed":
+                if not peer_lossless:
+                    # lossy dialer: every dial is a fresh session (no
+                    # retained seq state, not registered by name) —
+                    # reusing a lossless session here would dedup-drop
+                    # the new dial's restarted seqs
                     conn = Connection(self, sock.getpeername(),
-                                      lossless=True, connector=False)
+                                      lossless=False, connector=False)
                     self.conns.append(conn)
-                    self.conns_by_name[peer_name] = conn
-                # a restarted peer sends in_seq=0 with a fresh nonce;
-                # replying with the stale floor would make it drop our
-                # next sends, so advertise what matches its incarnation
-                if conn.peer_nonce is not None \
-                        and conn.peer_nonce != peer_nonce:
                     in_seq = 0
                 else:
-                    in_seq = conn.in_seq
-            _send_banner(sock, self.name, self.nonce, in_seq)
+                    conn = self.conns_by_name.get(peer_name)
+                    if conn is None or conn.state == "closed" \
+                            or not conn.lossless:
+                        conn = Connection(self, sock.getpeername(),
+                                          lossless=True, connector=False)
+                        self.conns.append(conn)
+                        self.conns_by_name[peer_name] = conn
+                    # a restarted peer sends in_seq=0 with a fresh
+                    # nonce; replying with the stale floor would make
+                    # it drop our next sends, so advertise what matches
+                    # its incarnation
+                    if conn.peer_nonce is not None \
+                            and conn.peer_nonce != peer_nonce:
+                        in_seq = 0
+                    else:
+                        in_seq = conn.in_seq
+            _send_banner(sock, self.name, self.nonce, in_seq,
+                         peer_lossless)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(None)
         except (OSError, ConnectionError, UnicodeDecodeError):

@@ -1,0 +1,229 @@
+"""PG storage-strategy seam: PGBackend + the logical mutation type.
+
+Python-native equivalent of the reference's PGBackend (reference
+src/osd/PGBackend.{h,cc}): the abstract strategy a PG uses to make an
+object mutation durable across its acting set.  ``build_pg_backend``
+switches on pool type exactly like the reference (PGBackend.cc:555-591):
+replicated pools get ReplicatedBackend, erasure pools instantiate the
+codec through the plugin registry and get ECBackend.
+
+``Mutation`` is the framework's PGTransaction (reference
+osd/PGTransaction.h): a *logical* description of one object's change —
+data writes, delete, attr/omap updates — that each backend lowers to
+per-shard ObjectStore transactions its own way (EC encodes chunks,
+replication ships the whole thing).
+
+The backend talks to its hosting PG through the narrow ``PGHost``
+surface (the reference passes a Listener interface, PGBackend.h
+``Listener``): identity, acting set, store handles, message send, and
+log bookkeeping.  That seam is what lets the backends unit-test against
+a fake host with no OSD daemon (SURVEY.md §4 tier 1/2).
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..msg.message import Message
+from ..store.objectstore import GHObject, ObjectStore, Transaction
+from .pglog import Eversion, LogEntry
+
+# object_info xattr key (reference OI_ATTR "_")
+OI_ATTR = "_"
+
+
+@dataclass
+class Mutation:
+    """Logical single-object mutation (reference PGTransaction).
+
+    ``writes`` are (offset, data) byte extents; ``truncate`` runs after
+    writes when set; ``delete`` wipes the object; ``create`` asserts
+    non-existence.  ``attrs`` maps name -> value (None removes);
+    ``omap_set``/``omap_rm`` mutate the omap (replicated pools only —
+    the reference returns ENOTSUP for omap on EC pools).
+    """
+    writes: List[Tuple[int, bytes]] = field(default_factory=list)
+    truncate: Optional[int] = None
+    delete: bool = False
+    create: bool = False
+    attrs: Dict[str, Optional[bytes]] = field(default_factory=dict)
+    omap_set: Dict[str, bytes] = field(default_factory=dict)
+    omap_rm: List[str] = field(default_factory=list)
+    omap_clear: bool = False
+
+    def is_data_op(self) -> bool:
+        return bool(self.writes) or self.truncate is not None \
+            or self.delete
+
+    def append_only_at(self, size: int) -> bool:
+        """True if every write begins at or beyond current object size
+        (no RMW needed on an EC pool without overwrites)."""
+        pos = size
+        for off, data in self.writes:
+            if off < pos:
+                return False
+            pos = max(pos, off + len(data))
+        return True
+
+
+@dataclass
+class ObjectInfo:
+    """Per-object metadata xattr (reference object_info_t, OI_ATTR):
+    logical size + last mutating version; stored on every shard."""
+    size: int = 0
+    version: Eversion = (0, 0)
+
+    def encode(self) -> bytes:
+        import json
+        return json.dumps({"size": self.size,
+                           "version": list(self.version)}).encode()
+
+    @classmethod
+    def decode(cls, buf: bytes) -> "ObjectInfo":
+        import json
+        d = json.loads(buf.decode())
+        return cls(size=d["size"], version=tuple(d["version"]))
+
+
+class PGHost(abc.ABC):
+    """What a backend needs from its PG (reference PGBackend::Listener)."""
+
+    @property
+    @abc.abstractmethod
+    def whoami(self) -> int:
+        """This OSD's id."""
+
+    @property
+    @abc.abstractmethod
+    def pgid_str(self) -> str:
+        """str(PGid) — shard-free pg name used in sub-op messages."""
+
+    @property
+    @abc.abstractmethod
+    def own_shard(self) -> int:
+        """This OSD's shard position in the acting set (-1 replicated)."""
+
+    @property
+    @abc.abstractmethod
+    def store(self) -> ObjectStore:
+        ...
+
+    @property
+    def coll(self) -> str:
+        """This OSD's collection for the PG shard it holds."""
+        return self.coll_of(self.own_shard)
+
+    @abc.abstractmethod
+    def coll_of(self, shard: int) -> str:
+        """Collection name for a given shard position — str(SPGid);
+        identical naming on every OSD, so sub-op transactions built by
+        the primary apply verbatim on the target shard's store."""
+
+    @property
+    @abc.abstractmethod
+    def epoch(self) -> int:
+        """Current map epoch (stamped into sub-op messages)."""
+
+    @abc.abstractmethod
+    def acting_shards(self) -> List[Tuple[int, Optional[int]]]:
+        """[(shard, osd_id-or-None)] for the current acting set.  For
+        replicated pools shard is the index; osd None = hole."""
+
+    @abc.abstractmethod
+    def send_shard(self, osd: int, msg: Message) -> None:
+        """Ship a sub-op message to a peer OSD (cluster messenger)."""
+
+    @abc.abstractmethod
+    def prepare_log_txn(self, txn: Transaction,
+                        log_entries: List[dict]) -> None:
+        """Append the per-shard PG-log/info persistence ops for these
+        wire-form log entries into ``txn`` (pgmeta omap writes)."""
+
+    @abc.abstractmethod
+    def on_local_commit(self, fn: Callable[[], None]) -> None:
+        """Run ``fn`` under the PG lock after a local store commit
+        (completions re-enter the PG through its op queue)."""
+
+    def ec_profile(self) -> Dict[str, str]:
+        """The pool's erasure-code profile (EC pools only)."""
+        raise NotImplementedError
+
+
+class PGBackend(abc.ABC):
+    """Abstract storage strategy (reference PGBackend.h)."""
+
+    def __init__(self, host: PGHost):
+        self.host = host
+        self._next_tid = 0
+
+    def new_tid(self) -> int:
+        self._next_tid += 1
+        return self._next_tid
+
+    # -- primary-side API --------------------------------------------------
+    @abc.abstractmethod
+    def submit_transaction(self, oid: str, mutation: Mutation,
+                           at_version: Eversion,
+                           log_entries: List[LogEntry],
+                           on_all_commit: Callable[[int], None]) -> None:
+        """Make ``mutation`` durable on every acting shard; call
+        ``on_all_commit(0)`` (under the PG lock) once all shards
+        committed, or with -errno if the op cannot proceed (reference
+        submit_transaction, ECBackend.cc:1483 /
+        ReplicatedBackend::submit_transaction)."""
+
+    @abc.abstractmethod
+    def objects_read(self, oid: str, offset: int, length: int,
+                     cb: Callable[[int, bytes], None]) -> None:
+        """Read a logical extent; EC reconstructs from shards.  cb gets
+        (0, data) or (-errno, b"") (reference
+        objects_read_and_reconstruct, ECBackend.cc:2345)."""
+
+    @abc.abstractmethod
+    def recover_object(self, oid: str, version: Eversion,
+                       missing_on: List[Tuple[int, int]],
+                       cb: Callable[[int], None]) -> None:
+        """Rebuild ``oid`` on the (shard, osd) pairs missing it; cb(0)
+        when all pushes are acked (reference recover_object /
+        continue_recovery_op, ECBackend.cc:570-736)."""
+
+    # -- both-sides message entry -----------------------------------------
+    @abc.abstractmethod
+    def handle_message(self, msg: Message) -> bool:
+        """Dispatch a backend sub-op message; True if consumed
+        (reference PGBackend::handle_message)."""
+
+    @abc.abstractmethod
+    def on_change(self) -> None:
+        """Acting set changed (new interval): drop in-flight ops; the
+        clients will resend (reference on_change)."""
+
+    # -- local object metadata helpers ------------------------------------
+    def get_object_info(self, oid: str) -> Optional[ObjectInfo]:
+        obj = GHObject(oid, self.host.own_shard)
+        try:
+            return ObjectInfo.decode(
+                self.host.store.getattr(self.host.coll, obj, OI_ATTR))
+        except (FileNotFoundError, KeyError):
+            return None
+
+    def list_objects(self) -> List[str]:
+        return sorted({o.oid for o in
+                       self.host.store.collection_list(self.host.coll)})
+
+
+def build_pg_backend(host: PGHost, pool, ec_registry):
+    """reference PGBackend::build_pg_backend (PGBackend.cc:555-591):
+    replicated -> ReplicatedBackend; erasure -> registry factory for the
+    pool's profile + ECBackend with the pool's stripe_width."""
+    from ..osd.osdmap import POOL_TYPE_ERASURE
+    if pool.type == POOL_TYPE_ERASURE:
+        from .ecbackend import ECBackend
+        profile = dict(host.ec_profile())     # host supplies profile map
+        plugin = profile.pop("plugin", "jerasure")
+        ec_impl = ec_registry.factory(plugin, profile)
+        return ECBackend(host, ec_impl, pool.stripe_width,
+                         allows_overwrites=pool.ec_overwrites)
+    from .replicatedbackend import ReplicatedBackend
+    return ReplicatedBackend(host)

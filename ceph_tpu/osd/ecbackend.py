@@ -1,0 +1,683 @@
+"""Erasure-coded PG backend.
+
+Python-native equivalent of the reference's ECBackend (reference
+src/osd/ECBackend.{h,cc}, 2.6k LoC), the engine behind every EC pool:
+
+* **writes** run the reference's pipeline
+  ``waiting_state -> waiting_reads -> waiting_commit`` driven by
+  ``check_ops()`` (reference ECBackend.cc:2151-2156): a mutation whose
+  stripes are partially overwritten first gathers RMW reads
+  (``try_state_to_reads``, :1865), then encodes and fans out per-shard
+  sub-writes (``try_reads_to_commit``, :1939) — the **encode happens
+  here**, and is where this framework diverges TPU-first: the whole
+  aligned extent is encoded as ONE ``[nstripes, k, chunk]`` batch on
+  the MXU via ecutil.encode instead of the reference's per-stripe CPU
+  loop (ECUtil.cc:136-148);
+* **reads** reconstruct from the minimum shard set
+  (``objects_read_and_reconstruct`` -> ECSubRead fan-out ->
+  batched decode; reference ECBackend.cc:2345,1594,2287);
+* **recovery** reads k surviving shards, decodes the missing shards'
+  chunks in one batch and pushes with MOSDPGPush (reference
+  continue_recovery_op FSM IDLE->READING->WRITING, ECBackend.cc:
+  570-736); when the primary itself lacks the object its metadata is
+  first fetched from a surviving peer (the reference's pull path);
+* per-shard cumulative-CRC ``HashInfo`` xattrs maintained on appends
+  and consumed by deep scrub (reference ECBackend.cc:2475).
+
+Pools without ``ec_overwrites`` reject non-append writes, omap and
+truncate exactly like the reference (allows_ecoverwrites,
+osd/osd_types.h:1600; omap ENOTSUP per
+doc/dev/osd_internals/erasure_coding/ecbackend.rst) — enforced by the
+PG before submit.
+
+In-flight writes to the same object serialize through a per-object
+queue — the role the reference's ExtentCache plays for pipelined
+overlapping RMW (reference ExtentCache.h; ECBackend.cc:1891-1920).
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                            MOSDECSubOpWrite, MOSDECSubOpWriteReply,
+                            MOSDPGPush, MOSDPGPushReply, PushOp)
+from ..store.objectstore import GHObject, Transaction
+from . import ecutil
+from .backend import OI_ATTR, Mutation, ObjectInfo, PGBackend, PGHost
+from .pglog import Eversion, LogEntry
+
+
+class _WriteOp:
+    """One in-flight client write (reference ECBackend::Op)."""
+
+    def __init__(self, tid: int, oid: str, mutation: Mutation,
+                 at_version: Eversion, log_entries: List[LogEntry],
+                 on_all_commit: Callable[[int], None]):
+        self.tid = tid
+        self.oid = oid
+        self.mutation = mutation
+        self.at_version = at_version
+        self.log_entries = log_entries
+        self.on_all_commit = on_all_commit
+        self.to_read: Optional[Tuple[int, int]] = None   # aligned extent
+        self.read_data: bytes = b""
+        self.obj_info = None             # fetched once in _start_rmw
+        self.pending_commits: Set[int] = set()           # shards
+
+
+class _ReadOp:
+    """One in-flight reconstructing read (reference ECBackend::ReadOp)."""
+
+    def __init__(self, tid: int, oid: str, chunk_off: int,
+                 chunk_len: int, want_shards: Dict[int, int],
+                 cb: Callable[[Dict[int, bytes], Dict[int, int]], None],
+                 tried: Optional[Set[int]] = None):
+        self.tid = tid
+        self.oid = oid
+        self.chunk_off = chunk_off
+        self.chunk_len = chunk_len
+        self.want_shards = want_shards       # shard -> osd
+        self.received: Dict[int, bytes] = {}
+        self.errors: Dict[int, int] = {}
+        self.tried: Set[int] = tried or set(want_shards)
+        self.cb = cb                         # (shard->bytes, shard->err)
+
+
+class _RecoveryOp:
+    """reference ECBackend::RecoveryOp FSM state."""
+
+    def __init__(self, oid: str, version: Eversion,
+                 missing_on: List[Tuple[int, int]],
+                 cb: Callable[[int], None]):
+        self.oid = oid
+        self.version = version
+        self.missing_on = missing_on         # [(shard, osd)]
+        self.cb = cb
+        self.pending_pushes: Set[int] = set()
+
+
+class ECBackend(PGBackend):
+    def __init__(self, host: PGHost, ec_impl, stripe_width: int,
+                 allows_overwrites: bool = False):
+        super().__init__(host)
+        self.ec_impl = ec_impl
+        self.k = ec_impl.get_data_chunk_count()
+        self.m = ec_impl.get_coding_chunk_count()
+        self.sinfo = ecutil.StripeInfo(self.k, stripe_width)
+        self.allows_overwrites = allows_overwrites
+        # write pipeline queues (reference ECBackend.cc:2151)
+        self.waiting_commit: Dict[int, _WriteOp] = {}
+        self.in_flight_reads: Dict[int, _ReadOp] = {}
+        self.attr_fetches: Dict[int, Tuple] = {}    # tid -> (rec,)
+        self.recovery_ops: Dict[str, _RecoveryOp] = {}
+        # per-object serialization of pipelined writes (ExtentCache role)
+        self._obj_queue: Dict[str, deque] = {}
+
+    # ------------------------------------------------------------------
+    # write path (reference submit_transaction -> start_rmw -> check_ops)
+    # ------------------------------------------------------------------
+    def submit_transaction(self, oid: str, mutation: Mutation,
+                           at_version: Eversion,
+                           log_entries: List[LogEntry],
+                           on_all_commit: Callable[[int], None]) -> None:
+        if mutation.truncate is not None:
+            # EC truncate is unsupported (reference: requires
+            # ec_overwrites plus rollback machinery; not lowered here)
+            on_all_commit(-95)           # -EOPNOTSUPP
+            return
+        op = _WriteOp(self.new_tid(), oid, mutation, at_version,
+                      log_entries, on_all_commit)
+        q = self._obj_queue.setdefault(oid, deque())
+        q.append(op)
+        if len(q) == 1:
+            self._start_rmw(op)
+
+    def _start_rmw(self, op: _WriteOp) -> None:
+        """Compute the WritePlan (reference get_write_plan,
+        ECTransaction.h:40): which existing stripes must be read back
+        before this mutation can be encoded.  Runs when the op reaches
+        the head of the per-object queue, so object state (exclusive-
+        create check included) reflects all earlier queued writes."""
+        info = self.get_object_info(op.oid)
+        mut = op.mutation
+        if mut.create and info is not None:
+            op.on_all_commit(-17)        # -EEXIST: exclusive create
+            self._finish_write(op)
+            return
+        op.obj_info = info = info or ObjectInfo()
+        if mut.delete or not mut.writes:
+            self._reads_to_commit(op)
+            return
+        lo = min(off for off, _ in mut.writes)
+        hi = max(off + len(d) for off, d in mut.writes)
+        astart, alen = self.sinfo.offset_len_to_stripe_bounds(lo, hi - lo)
+        # existing bytes inside the affected aligned range that the new
+        # data does not fully cover must be read back (RMW)
+        existing_end = min(info.size, astart + alen)
+        if existing_end <= astart or \
+                self._fully_covers(mut.writes, astart, existing_end):
+            self._reads_to_commit(op)
+            return
+        op.to_read = (astart, existing_end - astart)
+        self.objects_read(
+            op.oid, astart, existing_end - astart,
+            lambda res, data: self._rmw_read_done(op, res, data))
+
+    @staticmethod
+    def _fully_covers(writes: List[Tuple[int, bytes]], lo: int,
+                      hi: int) -> bool:
+        """True if [lo,hi) is entirely covered by the write extents."""
+        if hi <= lo:
+            return True
+        spans = sorted((off, off + len(d)) for off, d in writes)
+        pos = lo
+        for s, e in spans:
+            if s > pos:
+                return False
+            pos = max(pos, e)
+            if pos >= hi:
+                return True
+        return pos >= hi
+
+    def _rmw_read_done(self, op: _WriteOp, res: int,
+                       data: bytes) -> None:
+        if res < 0:
+            # RMW source unreadable (shards down mid-pipeline): fail the
+            # op; the client will resend once the PG re-peers
+            op.on_all_commit(res)
+            self._finish_write(op)
+            return
+        op.read_data = data
+        self._reads_to_commit(op)
+
+    def _reads_to_commit(self, op: _WriteOp) -> None:
+        """Encode + fan out per-shard sub-writes (reference
+        try_reads_to_commit, ECBackend.cc:1939-2101)."""
+        shard_txns = self._generate_transactions(op)
+        wire_entries = [e.to_dict() for e in op.log_entries]
+        # populate pending_commits for the WHOLE acting set before any
+        # send: a fast commit reply must not find a half-filled set and
+        # declare the op done early
+        targets = [(shard, osd) for shard, osd in
+                   self.host.acting_shards() if osd is not None]
+        op.pending_commits = {shard for shard, _ in targets}
+        self.waiting_commit[op.tid] = op
+        local_txn: Optional[Transaction] = None
+        for shard, osd in targets:
+            txn = shard_txns.get(shard) or Transaction()
+            if osd == self.host.whoami:
+                local_txn = txn
+                continue
+            self.host.send_shard(osd, MOSDECSubOpWrite(
+                pgid=self.host.pgid_str, shard=shard,
+                from_osd=self.host.whoami, tid=op.tid,
+                epoch=self.host.epoch, txn=txn.encode(),
+                log_entries=wire_entries,
+                at_version=op.at_version))
+        if local_txn is not None:
+            # the primary's own shard goes through the same sub-write
+            # handler, local call (reference ECBackend.cc:2086-2092)
+            tid = op.tid
+            self._apply_sub_write(
+                self.host.own_shard, local_txn, wire_entries,
+                lambda: self._sub_write_committed(
+                    tid, self.host.own_shard))
+
+    def _generate_transactions(self, op: _WriteOp
+                               ) -> Dict[int, Transaction]:
+        """Lower the logical mutation to per-shard store transactions
+        (reference ECTransaction::generate_transactions ->
+        encode_and_write, ECTransaction.cc:97,28)."""
+        mut, oid = op.mutation, op.oid
+        txns: Dict[int, Transaction] = {
+            shard: Transaction()
+            for shard, osd in self.host.acting_shards()
+            if osd is not None}
+
+        def for_all(fn):
+            for shard, txn in txns.items():
+                fn(shard, txn, GHObject(oid, shard),
+                   self.host.coll_of(shard))
+
+        if mut.delete:
+            for_all(lambda s, t, o, c: t.remove(c, o))
+            return txns
+
+        info = op.obj_info or ObjectInfo()
+        new_size = info.size
+        for_all(lambda s, t, o, c: t.touch(c, o))
+
+        if mut.writes:
+            lo = min(off for off, _ in mut.writes)
+            hi = max(off + len(d) for off, d in mut.writes)
+            astart, alen = self.sinfo.offset_len_to_stripe_bounds(
+                lo, hi - lo)
+            buf = bytearray(alen)        # zero padding to stripe bounds
+            if op.read_data:
+                buf[0:len(op.read_data)] = op.read_data
+            for off, data in mut.writes:
+                buf[off - astart:off - astart + len(data)] = data
+            new_size = max(info.size, hi)
+            is_append = mut.append_only_at(info.size) and \
+                astart >= self.sinfo.logical_to_prev_stripe_offset(
+                    info.size)
+            # ★ the batched encode: one [nstripes, k, chunk] device call
+            chunks = ecutil.encode(self.sinfo, self.ec_impl, bytes(buf))
+            chunk_off = \
+                self.sinfo.aligned_logical_offset_to_chunk_offset(astart)
+            hinfo = self._update_hinfo(oid, chunks, chunk_off, is_append)
+            henc = hinfo.encode()
+            for shard, txn in txns.items():
+                obj = GHObject(oid, shard)
+                coll = self.host.coll_of(shard)
+                txn.write(coll, obj, chunk_off, chunks[shard])
+                txn.setattr(coll, obj, ecutil.HINFO_KEY, henc)
+
+        oi = ObjectInfo(size=new_size, version=op.at_version).encode()
+        for_all(lambda s, t, o, c: t.setattr(c, o, OI_ATTR, oi))
+        for name, value in mut.attrs.items():
+            if value is None:
+                for_all(lambda s, t, o, c, n=name:
+                        t.rmattr(c, o, "u_" + n))
+            else:
+                for_all(lambda s, t, o, c, n=name, v=value:
+                        t.setattr(c, o, "u_" + n, v))
+        return txns
+
+    def _update_hinfo(self, oid: str, chunks: Dict[int, bytes],
+                      chunk_off: int, is_append: bool) -> ecutil.HashInfo:
+        """Cumulative CRCs stay valid only for pure appends; any
+        overwrite clears them (the reference drops hinfo on
+        ec_overwrites pools)."""
+        obj = GHObject(oid, self.host.own_shard)
+        hinfo = None
+        try:
+            hinfo = ecutil.HashInfo.decode(self.host.store.getattr(
+                self.host.coll, obj, ecutil.HINFO_KEY))
+        except (FileNotFoundError, KeyError):
+            pass
+        if hinfo is None or len(hinfo.crcs) != self.k + self.m:
+            hinfo = ecutil.HashInfo(self.k + self.m)
+        if is_append and hinfo.total_chunk_size == chunk_off:
+            hinfo.append(chunk_off, chunks)
+        else:
+            hinfo.clear()               # overwrite: CRCs unknowable
+        return hinfo
+
+    def _apply_sub_write(self, shard: int, txn: Transaction,
+                         wire_entries: List[dict],
+                         on_commit: Callable[[], None]) -> None:
+        """Shard-side sub-write application (reference handle_sub_write,
+        ECBackend.cc:915-989): log entries + data in one transaction."""
+        self.host.prepare_log_txn(txn, wire_entries)
+        txn.register_on_commit(
+            lambda: self.host.on_local_commit(on_commit))
+        self.host.store.queue_transactions([txn])
+
+    def _sub_write_committed(self, tid: int, shard: int) -> None:
+        op = self.waiting_commit.get(tid)
+        if op is None:
+            return
+        op.pending_commits.discard(shard)
+        if not op.pending_commits:
+            del self.waiting_commit[tid]
+            # completion fires BEFORE the next queued write starts, so
+            # clients observe per-object commit order
+            op.on_all_commit(0)
+            self._finish_write(op)
+
+    def _finish_write(self, op: _WriteOp) -> None:
+        """Advance the per-object pipeline queue."""
+        q = self._obj_queue.get(op.oid)
+        if q and q[0] is op:
+            q.popleft()
+            if q:
+                self._start_rmw(q[0])
+            else:
+                del self._obj_queue[op.oid]
+
+    # ------------------------------------------------------------------
+    # read path (reference objects_read_and_reconstruct)
+    # ------------------------------------------------------------------
+    def objects_read(self, oid: str, offset: int, length: int,
+                     cb: Callable[[int, bytes], None]) -> None:
+        info = self.get_object_info(oid)
+        if info is None:
+            cb(-2, b"")                  # -ENOENT
+            return
+        if offset >= info.size or length == 0:
+            cb(0, b"")
+            return
+        length = min(length, info.size - offset)
+        astart, alen = self.sinfo.offset_len_to_stripe_bounds(
+            offset, length)
+        chunk_off = \
+            self.sinfo.aligned_logical_offset_to_chunk_offset(astart)
+        chunk_len = self.sinfo.aligned_logical_offset_to_chunk_offset(
+            astart + alen) - chunk_off
+
+        shards = self._min_read_shards(set(range(self.k)))
+        if shards is None:
+            cb(-5, b"")                  # -EIO: not enough shards up
+            return
+
+        def reads_done(received: Dict[int, bytes],
+                       errors: Dict[int, int]) -> None:
+            if errors or len(received) < len(shards):
+                cb(-5, b"")
+                return
+            try:
+                data = ecutil.decode_concat(self.sinfo, self.ec_impl,
+                                            received)
+            except Exception:
+                cb(-5, b"")
+                return
+            lo = offset - astart
+            cb(0, data[lo:lo + length])
+
+        self._start_read(oid, chunk_off, chunk_len, shards, reads_done)
+
+    def _min_read_shards(self, want: Set[int],
+                         exclude: Optional[Set[int]] = None
+                         ) -> Optional[Dict[int, int]]:
+        """Choose the minimum shard set for reconstruction (reference
+        get_min_avail_to_read_shards, ECBackend.cc:1594): the codec's
+        minimum_to_decode picks data shards when whole, parity fills
+        holes; LRC/SHEC/CLAY codecs pick their cheaper local sets."""
+        avail = {shard: osd for shard, osd in self.host.acting_shards()
+                 if osd is not None
+                 and not (exclude and shard in exclude)}
+        try:
+            need = self.ec_impl.minimum_to_decode(want, set(avail))
+        except IOError:
+            return None
+        return {shard: avail[shard] for shard in need}
+
+    def _start_read(self, oid: str, chunk_off: int, chunk_len: int,
+                    shards: Dict[int, int],
+                    cb: Callable[[Dict[int, bytes], Dict[int, int]],
+                                 None],
+                    tried: Optional[Set[int]] = None) -> None:
+        rop = _ReadOp(self.new_tid(), oid, chunk_off, chunk_len,
+                      dict(shards), cb, tried)
+        self.in_flight_reads[rop.tid] = rop
+        for shard, osd in shards.items():
+            if osd == self.host.whoami:
+                data, err = self._local_chunk_read(
+                    oid, shard, chunk_off, chunk_len)
+                self._read_piece(rop, shard, data, err)
+            else:
+                self.host.send_shard(osd, MOSDECSubOpRead(
+                    pgid=self.host.pgid_str, shard=shard,
+                    from_osd=self.host.whoami, tid=rop.tid,
+                    epoch=self.host.epoch,
+                    reads=[(oid, chunk_off, chunk_len)]))
+
+    def _local_chunk_read(self, oid: str, shard: int, off: int,
+                          length: int) -> Tuple[bytes, int]:
+        try:
+            data = self.host.store.read(
+                self.host.coll_of(shard), GHObject(oid, shard), off,
+                length)
+        except FileNotFoundError:
+            return b"", -2
+        if len(data) < length:
+            # shards are never legitimately short (every write pads to
+            # stripe bounds): a short read means truncation/corruption,
+            # so error out and let reconstruction use parity instead
+            return b"", -5
+        return data, 0
+
+    def _read_piece(self, rop: _ReadOp, shard: int, data: bytes,
+                    err: int) -> None:
+        if rop.tid not in self.in_flight_reads:
+            return
+        if err < 0:
+            rop.errors[shard] = err
+        else:
+            rop.received[shard] = data
+        if len(rop.received) + len(rop.errors) < len(rop.want_shards):
+            return
+        del self.in_flight_reads[rop.tid]
+        if rop.errors:
+            # retry over shards not yet tried (reference
+            # send_all_remaining_reads on error, ECBackend.cc:2400)
+            retry = self._min_read_shards(set(range(self.k)),
+                                          exclude=rop.tried)
+            # allow reusing successfully-read shards from this attempt
+            if retry is None:
+                reuse = {s: o for s, o in self.host.acting_shards()
+                         if o is not None
+                         and (s in rop.received
+                              or s not in rop.tried)}
+                try:
+                    need = self.ec_impl.minimum_to_decode(
+                        set(range(self.k)), set(reuse))
+                    retry = {s: reuse[s] for s in need}
+                except IOError:
+                    retry = None
+            if retry is not None:
+                self._start_read(rop.oid, rop.chunk_off, rop.chunk_len,
+                                 retry, rop.cb,
+                                 tried=rop.tried | set(retry))
+                return
+        rop.cb(rop.received, rop.errors)
+
+    # ------------------------------------------------------------------
+    # recovery (reference continue_recovery_op FSM)
+    # ------------------------------------------------------------------
+    def recover_object(self, oid: str, version: Eversion,
+                       missing_on: List[Tuple[int, int]],
+                       cb: Callable[[int], None]) -> None:
+        if oid in self.recovery_ops:
+            cb(-16)                      # -EBUSY
+            return
+        rec = _RecoveryOp(oid, version, missing_on, cb)
+        self.recovery_ops[oid] = rec
+        info = self.get_object_info(oid)
+        if info is not None:
+            obj = GHObject(oid, self.host.own_shard)
+            try:
+                attrs = self.host.store.getattrs(self.host.coll, obj)
+            except FileNotFoundError:
+                attrs = {}
+            self._recover_with_info(rec, info, attrs)
+            return
+        # primary's own shard lacks the object: fetch metadata from a
+        # surviving peer first (the reference's pull path)
+        missing_shards = {s for s, _ in missing_on}
+        peers = [(s, o) for s, o in self.host.acting_shards()
+                 if o is not None and o != self.host.whoami
+                 and s not in missing_shards]
+        if not peers:
+            del self.recovery_ops[oid]
+            cb(-5)
+            return
+        shard, osd = peers[0]
+        tid = self.new_tid()
+        self.attr_fetches[tid] = (rec,)
+        # attrs_to_read carries object names (reference ECSubRead
+        # attrs_to_read is a set of hobjects)
+        self.host.send_shard(osd, MOSDECSubOpRead(
+            pgid=self.host.pgid_str, shard=shard,
+            from_osd=self.host.whoami, tid=tid, epoch=self.host.epoch,
+            reads=[], attrs_to_read=[oid], for_recovery=True))
+
+    def _attr_fetch_done(self, rec: _RecoveryOp,
+                         attrs: Dict[str, bytes]) -> None:
+        if rec.oid not in self.recovery_ops:
+            return
+        if OI_ATTR not in attrs:
+            del self.recovery_ops[rec.oid]
+            rec.cb(-2)
+            return
+        self._recover_with_info(rec, ObjectInfo.decode(attrs[OI_ATTR]),
+                                attrs)
+
+    def _recover_with_info(self, rec: _RecoveryOp, info: ObjectInfo,
+                           attrs: Dict[str, bytes]) -> None:
+        """READING state: gather k shards, decode missing (reference
+        handle_recovery_read_complete, ECBackend.cc:414-481)."""
+        oid = rec.oid
+        shard_len = self.sinfo.object_size_to_shard_size(info.size)
+        missing_shards = {s for s, _ in rec.missing_on}
+        if shard_len == 0:
+            self._push_recovered(
+                rec, attrs, {s: b"" for s in missing_shards})
+            return
+        shards = self._min_read_shards(set(missing_shards),
+                                       exclude=missing_shards)
+        if shards is None:
+            self.recovery_ops.pop(oid, None)
+            rec.cb(-5)
+            return
+
+        def reads_done(received: Dict[int, bytes],
+                       errors: Dict[int, int]) -> None:
+            if rec.oid not in self.recovery_ops:
+                return
+            if errors or len(received) < len(shards):
+                self.recovery_ops.pop(oid, None)
+                rec.cb(-5)
+                return
+            try:
+                dec = ecutil.decode(self.sinfo, self.ec_impl, received,
+                                    set(missing_shards))
+            except Exception:
+                self.recovery_ops.pop(oid, None)
+                rec.cb(-5)
+                return
+            self._push_recovered(rec, attrs, dec)
+
+        self._start_read(oid, 0, shard_len, shards, reads_done)
+
+    def _push_recovered(self, rec: _RecoveryOp, attrs: Dict[str, bytes],
+                        dec: Dict[int, bytes]) -> None:
+        """WRITING state: push decoded chunks + attrs to missing shards
+        (reference ECBackend.cc:634+)."""
+        for shard, osd in rec.missing_on:
+            rec.pending_pushes.add(shard)
+        for shard, osd in rec.missing_on:
+            push = PushOp(oid=rec.oid, data_offset=0,
+                          data=dec.get(shard, b""),
+                          attrs=dict(attrs), complete=True,
+                          version=rec.version)
+            if osd == self.host.whoami:
+                self._apply_push(shard, push,
+                                 lambda s=shard: self._push_acked(
+                                     rec.oid, s))
+            else:
+                self.host.send_shard(osd, MOSDPGPush(
+                    pgid=self.host.pgid_str, shard=shard,
+                    from_osd=self.host.whoami, epoch=self.host.epoch,
+                    pushes=[push]))
+
+    def _apply_push(self, shard: int, push: PushOp,
+                    on_commit: Callable[[], None]) -> None:
+        """Shard-side recovery write (reference handle_recovery_push)."""
+        coll = self.host.coll_of(shard)
+        obj = GHObject(push.oid, shard)
+        txn = Transaction()
+        # remove-then-recreate: a stale local copy must not leak attrs
+        # the authoritative copy no longer has
+        txn.remove(coll, obj)
+        txn.touch(coll, obj)
+        if push.data:
+            txn.write(coll, obj, push.data_offset, push.data)
+        if push.attrs:
+            txn.setattrs(coll, obj, push.attrs)
+        txn.register_on_commit(
+            lambda: self.host.on_local_commit(on_commit))
+        self.host.store.queue_transactions([txn])
+
+    def _push_acked(self, oid: str, shard: int) -> None:
+        rec = self.recovery_ops.get(oid)
+        if rec is None:
+            return
+        rec.pending_pushes.discard(shard)
+        if not rec.pending_pushes:
+            del self.recovery_ops[oid]
+            rec.cb(0)
+
+    # ------------------------------------------------------------------
+    # message dispatch (both roles)
+    # ------------------------------------------------------------------
+    def handle_message(self, msg) -> bool:
+        if isinstance(msg, MOSDECSubOpWrite):
+            txn = Transaction.decode(msg.txn)
+            self._apply_sub_write(
+                msg.shard, txn, msg.log_entries,
+                lambda: self.host.send_shard(
+                    msg.from_osd, MOSDECSubOpWriteReply(
+                        pgid=self.host.pgid_str, shard=msg.shard,
+                        from_osd=self.host.whoami, tid=msg.tid,
+                        epoch=self.host.epoch)))
+            return True
+        if isinstance(msg, MOSDECSubOpWriteReply):
+            self._sub_write_committed(msg.tid, msg.shard)
+            return True
+        if isinstance(msg, MOSDECSubOpRead):
+            self._handle_sub_read(msg)
+            return True
+        if isinstance(msg, MOSDECSubOpReadReply):
+            if msg.tid in self.attr_fetches:
+                (rec,) = self.attr_fetches.pop(msg.tid)
+                attrs = dict(msg.attrs[0][1]) if msg.attrs else {}
+                self._attr_fetch_done(rec, attrs)
+                return True
+            rop = self.in_flight_reads.get(msg.tid)
+            if rop is None:
+                return True
+            for oid, err in msg.errors:
+                self._read_piece(rop, msg.shard, b"", err)
+            for oid, off, data in msg.buffers:
+                self._read_piece(rop, msg.shard, data, 0)
+            return True
+        if isinstance(msg, MOSDPGPush):
+            for push in msg.pushes:
+                self._apply_push(
+                    msg.shard, push,
+                    lambda p=push: self.host.send_shard(
+                        msg.from_osd, MOSDPGPushReply(
+                            pgid=self.host.pgid_str, shard=msg.shard,
+                            from_osd=self.host.whoami,
+                            epoch=self.host.epoch, oids=[p.oid])))
+            return True
+        if isinstance(msg, MOSDPGPushReply):
+            for oid in msg.oids:
+                self._push_acked(oid, msg.shard)
+            return True
+        return False
+
+    def _handle_sub_read(self, msg: MOSDECSubOpRead) -> None:
+        """Shard-side chunk read (reference handle_sub_read,
+        ECBackend.cc:991)."""
+        reply = MOSDECSubOpReadReply(
+            pgid=self.host.pgid_str, shard=msg.shard,
+            from_osd=self.host.whoami, tid=msg.tid,
+            epoch=self.host.epoch)
+        for oid, off, length in msg.reads:
+            data, err = self._local_chunk_read(oid, msg.shard, off,
+                                               length)
+            if err < 0:
+                reply.errors.append((oid, err))
+            else:
+                reply.buffers.append((oid, off, data))
+        for oid in msg.attrs_to_read:
+            try:
+                attrs = self.host.store.getattrs(
+                    self.host.coll_of(msg.shard), GHObject(oid, msg.shard))
+                reply.attrs.append((oid, attrs))
+            except FileNotFoundError:
+                reply.errors.append((oid, -2))
+        self.host.send_shard(msg.from_osd, reply)
+
+    def on_change(self) -> None:
+        """New interval: drop every in-flight op (reference on_change);
+        clients resend against the new acting set."""
+        self.waiting_commit.clear()
+        self.in_flight_reads.clear()
+        self.attr_fetches.clear()
+        self.recovery_ops.clear()
+        self._obj_queue.clear()

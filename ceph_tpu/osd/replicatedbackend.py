@@ -1,0 +1,241 @@
+"""Replicated PG backend.
+
+Python-native equivalent of the reference's ReplicatedBackend
+(reference src/osd/ReplicatedBackend.{h,cc}, 2.4k LoC), the EC
+backend's twin for ``TYPE_REPLICATED`` pools: the primary lowers the
+logical mutation to ONE store transaction, applies it locally and ships
+the identical transaction to every replica inside an MOSDRepOp
+(reference ReplicatedBackend::submit_transaction -> issue_op); commit
+replies gather into on_all_commit.  Reads are plain local reads on the
+primary; recovery pushes the whole object (data + attrs + omap) with
+MOSDPGPush (reference prep_push / handle_push).
+
+Replicated pools support the full mutation vocabulary including omap
+and truncate (contrast ECBackend's restrictions).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..msg.messages import (MOSDPGPush, MOSDPGPushReply, MOSDRepOp,
+                            MOSDRepOpReply, PushOp)
+from ..store.objectstore import GHObject, Transaction
+from .backend import OI_ATTR, Mutation, ObjectInfo, PGBackend, PGHost
+from .pglog import Eversion, LogEntry
+
+
+class _RepOp:
+    def __init__(self, tid: int, on_all_commit: Callable[[int], None]):
+        self.tid = tid
+        self.on_all_commit = on_all_commit
+        self.pending: Set[int] = set()       # osd ids awaiting commit
+
+
+class _RecOp:
+    def __init__(self, oid: str, cb: Callable[[int], None]):
+        self.oid = oid
+        self.cb = cb
+        self.pending: Set[int] = set()
+
+
+class ReplicatedBackend(PGBackend):
+    def __init__(self, host: PGHost):
+        super().__init__(host)
+        self.in_flight: Dict[int, _RepOp] = {}
+        self.recovery_ops: Dict[str, _RecOp] = {}
+
+    # ------------------------------------------------------------------
+    # write path
+    # ------------------------------------------------------------------
+    def submit_transaction(self, oid: str, mutation: Mutation,
+                           at_version: Eversion,
+                           log_entries: List[LogEntry],
+                           on_all_commit: Callable[[int], None]) -> None:
+        # object info read once; stores apply mutations synchronously at
+        # queue time, so this reflects every previously submitted op
+        info = self.get_object_info(oid)
+        if mutation.create and info is not None:
+            on_all_commit(-17)           # -EEXIST: exclusive create
+            return
+        txn = self._lower(oid, mutation, at_version, info)
+        wire_entries = [e.to_dict() for e in log_entries]
+        op = _RepOp(self.new_tid(), on_all_commit)
+        self.in_flight[op.tid] = op
+        replicas = [(s, o) for s, o in self.host.acting_shards()
+                    if o is not None]
+        for shard, osd in replicas:
+            op.pending.add(osd)
+        enc = txn.encode()
+        for shard, osd in replicas:
+            if osd == self.host.whoami:
+                continue
+            self.host.send_shard(osd, MOSDRepOp(
+                pgid=self.host.pgid_str, from_osd=self.host.whoami,
+                tid=op.tid, epoch=self.host.epoch, txn=enc,
+                log_entries=wire_entries, at_version=at_version))
+        tid = op.tid
+        self._apply_local(txn, wire_entries,
+                          lambda: self._committed(tid, self.host.whoami))
+
+    def _lower(self, oid: str, mut: Mutation, at_version: Eversion,
+               info: Optional[ObjectInfo]) -> Transaction:
+        """Logical mutation -> one store transaction, applied identically
+        on every replica (collection names match on all OSDs)."""
+        coll = self.host.coll
+        obj = GHObject(oid, -1)
+        txn = Transaction()
+        if mut.delete:
+            txn.remove(coll, obj)
+            return txn
+        info = info or ObjectInfo()
+        new_size = info.size
+        txn.touch(coll, obj)
+        for off, data in mut.writes:
+            txn.write(coll, obj, off, data)
+            new_size = max(new_size, off + len(data))
+        if mut.truncate is not None:
+            txn.truncate(coll, obj, mut.truncate)
+            new_size = mut.truncate
+        txn.setattr(coll, obj, OI_ATTR,
+                    ObjectInfo(size=new_size,
+                               version=at_version).encode())
+        for name, value in mut.attrs.items():
+            if value is None:
+                txn.rmattr(coll, obj, "u_" + name)
+            else:
+                txn.setattr(coll, obj, "u_" + name, value)
+        if mut.omap_clear:
+            txn.omap_clear(coll, obj)
+        if mut.omap_set:
+            txn.omap_setkeys(coll, obj, mut.omap_set)
+        if mut.omap_rm:
+            txn.omap_rmkeys(coll, obj, mut.omap_rm)
+        return txn
+
+    def _apply_local(self, txn: Transaction, wire_entries: List[dict],
+                     on_commit: Callable[[], None]) -> None:
+        self.host.prepare_log_txn(txn, wire_entries)
+        txn.register_on_commit(
+            lambda: self.host.on_local_commit(on_commit))
+        self.host.store.queue_transactions([txn])
+
+    def _committed(self, tid: int, osd: int) -> None:
+        op = self.in_flight.get(tid)
+        if op is None:
+            return
+        op.pending.discard(osd)
+        if not op.pending:
+            del self.in_flight[tid]
+            op.on_all_commit(0)
+
+    # ------------------------------------------------------------------
+    # read path: local, the primary holds a full copy
+    # ------------------------------------------------------------------
+    def objects_read(self, oid: str, offset: int, length: int,
+                     cb: Callable[[int, bytes], None]) -> None:
+        obj = GHObject(oid, -1)
+        try:
+            data = self.host.store.read(self.host.coll, obj, offset,
+                                        length)
+        except FileNotFoundError:
+            cb(-2, b"")
+            return
+        cb(0, data)
+
+    # ------------------------------------------------------------------
+    # recovery: push the full object
+    # ------------------------------------------------------------------
+    def recover_object(self, oid: str, version: Eversion,
+                       missing_on: List[Tuple[int, int]],
+                       cb: Callable[[int], None]) -> None:
+        if oid in self.recovery_ops:
+            cb(-16)
+            return
+        obj = GHObject(oid, -1)
+        try:
+            data = self.host.store.read(self.host.coll, obj)
+            attrs = self.host.store.getattrs(self.host.coll, obj)
+            omap = self.host.store.omap_get(self.host.coll, obj)
+        except FileNotFoundError:
+            cb(-2)
+            return
+        rec = _RecOp(oid, cb)
+        self.recovery_ops[oid] = rec
+        targets = [(s, o) for s, o in missing_on
+                   if o is not None and o != self.host.whoami]
+        if not targets:
+            del self.recovery_ops[oid]
+            cb(0)
+            return
+        for shard, osd in targets:
+            rec.pending.add(osd)
+        for shard, osd in targets:
+            self.host.send_shard(osd, MOSDPGPush(
+                pgid=self.host.pgid_str, shard=shard,
+                from_osd=self.host.whoami, epoch=self.host.epoch,
+                pushes=[PushOp(oid=oid, data=data, attrs=attrs,
+                               omap=omap, version=version)]))
+
+    def _apply_push(self, push: PushOp,
+                    on_commit: Callable[[], None]) -> None:
+        coll = self.host.coll
+        obj = GHObject(push.oid, -1)
+        txn = Transaction()
+        # remove-then-recreate so stale attrs/omap don't survive
+        txn.remove(coll, obj)
+        txn.touch(coll, obj)
+        if push.data:
+            txn.write(coll, obj, 0, push.data)
+        if push.attrs:
+            txn.setattrs(coll, obj, push.attrs)
+        if push.omap:
+            txn.omap_setkeys(coll, obj, push.omap)
+        txn.register_on_commit(
+            lambda: self.host.on_local_commit(on_commit))
+        self.host.store.queue_transactions([txn])
+
+    def _push_acked(self, oid: str, osd: int) -> None:
+        rec = self.recovery_ops.get(oid)
+        if rec is None:
+            return
+        rec.pending.discard(osd)
+        if not rec.pending:
+            del self.recovery_ops[oid]
+            rec.cb(0)
+
+    # ------------------------------------------------------------------
+    # message dispatch
+    # ------------------------------------------------------------------
+    def handle_message(self, msg) -> bool:
+        if isinstance(msg, MOSDRepOp):
+            txn = Transaction.decode(msg.txn)
+            self._apply_local(
+                txn, msg.log_entries,
+                lambda: self.host.send_shard(
+                    msg.from_osd, MOSDRepOpReply(
+                        pgid=self.host.pgid_str,
+                        from_osd=self.host.whoami, tid=msg.tid,
+                        epoch=self.host.epoch)))
+            return True
+        if isinstance(msg, MOSDRepOpReply):
+            self._committed(msg.tid, msg.from_osd)
+            return True
+        if isinstance(msg, MOSDPGPush):
+            for push in msg.pushes:
+                self._apply_push(
+                    push,
+                    lambda p=push: self.host.send_shard(
+                        msg.from_osd, MOSDPGPushReply(
+                            pgid=self.host.pgid_str, shard=msg.shard,
+                            from_osd=self.host.whoami,
+                            epoch=self.host.epoch, oids=[p.oid])))
+            return True
+        if isinstance(msg, MOSDPGPushReply):
+            for oid in msg.oids:
+                self._push_acked(oid, msg.from_osd)
+            return True
+        return False
+
+    def on_change(self) -> None:
+        self.in_flight.clear()
+        self.recovery_ops.clear()
