@@ -576,6 +576,142 @@ class MOSDFailure(Message):
 
 
 # ---------------------------------------------------------------------------
+# peering (reference MOSDPGQuery.h, MOSDPGNotify.h, MOSDPGLog.h)
+# ---------------------------------------------------------------------------
+
+@register
+class MOSDPGQuery(Message):
+    """Primary -> acting member: report your PG info + log (reference
+    messages/MOSDPGQuery.h; the payload the reference splits across
+    pg_query_t variants is collapsed to one full-info query)."""
+    TYPE = 80
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, epoch: int = 0):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard           # queried shard position
+        self.from_osd = from_osd
+        self.epoch = epoch
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u32(self.epoch)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPGQuery":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                   epoch=d.u32())
+
+
+@register
+class MOSDPGNotify(Message):
+    """Acting member -> primary: my info + full (bounded) log
+    (reference messages/MOSDPGNotify.h; ships the whole in-memory log
+    instead of the reference's incremental slices — it is bounded at
+    PGLog.max_entries)."""
+    TYPE = 81
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, epoch: int = 0,
+                 log: Optional[dict] = None):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard           # replying shard position
+        self.from_osd = from_osd
+        self.epoch = epoch
+        self.log = log or {}         # PGLog.to_dict()
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u32(self.epoch).bytes(_enc_json(self.log))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPGNotify":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                   epoch=d.u32(), log=_dec_json(d.bytes()))
+
+
+@register
+class MOSDPGLog(Message):
+    """Primary -> acting member: activation with the authoritative log
+    (reference messages/MOSDPGLog.h): either the catch-up entries past
+    the member's head, or ``backfill`` objects (oid -> version) when
+    the log no longer reaches back far enough."""
+    TYPE = 82
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, epoch: int = 0,
+                 last_update: Tuple[int, int] = (0, 0),
+                 entries: Optional[list] = None,
+                 backfill: Optional[Dict[str, list]] = None):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard           # destination shard position
+        self.from_osd = from_osd
+        self.epoch = epoch
+        self.last_update = last_update
+        self.entries = entries or []         # LogEntry.to_dict()s
+        self.backfill = backfill             # None = log-based
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u32(self.epoch)
+        e.u32(self.last_update[0]).u64(self.last_update[1])
+        e.bytes(_enc_json(self.entries))
+        e.bool(self.backfill is not None)
+        if self.backfill is not None:
+            e.bytes(_enc_json(self.backfill))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPGLog":
+        d = Decoder(buf)
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                epoch=d.u32())
+        m.last_update = (d.u32(), d.u64())
+        m.entries = _dec_json(d.bytes())
+        if d.bool():
+            m.backfill = _dec_json(d.bytes())
+        return m
+
+
+@register
+class MPGStats(Message):
+    """OSD -> mon: per-PG health stats from the PGs this OSD leads
+    (reference messages/MPGStats.h / pg_stat_t), aggregated by the
+    monitor into cluster health ("active+clean" gating
+    wait_for_clean)."""
+    TYPE = 83
+
+    def __init__(self, from_osd: int = -1, epoch: int = 0,
+                 pg_stats: Optional[Dict[str, dict]] = None):
+        super().__init__()
+        self.from_osd = from_osd
+        self.epoch = epoch
+        self.pg_stats = pg_stats or {}   # pgid -> stat dict
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.i32(self.from_osd).u32(self.epoch)
+        e.bytes(_enc_json(self.pg_stats))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MPGStats":
+        d = Decoder(buf)
+        return cls(from_osd=d.i32(), epoch=d.u32(),
+                   pg_stats=_dec_json(d.bytes()))
+
+
+# ---------------------------------------------------------------------------
 # monitor control plane (reference MMonCommand.h, MMonSubscribe.h)
 # ---------------------------------------------------------------------------
 

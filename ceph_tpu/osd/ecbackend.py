@@ -30,9 +30,12 @@ osd/osd_types.h:1600; omap ENOTSUP per
 doc/dev/osd_internals/erasure_coding/ecbackend.rst) — enforced by the
 PG before submit.
 
-In-flight writes to the same object serialize through a per-object
-queue — the role the reference's ExtentCache plays for pipelined
-overlapping RMW (reference ExtentCache.h; ECBackend.cc:1891-1920).
+Writes serialize through a strictly FIFO per-PG pipeline, exactly like
+the reference's in-order 3-queue state machine (ECBackend.cc:2151):
+sub-writes — and with them PG-log entries — always apply in submission
+order, which keeps every shard's log monotonic.  (The reference's
+ExtentCache lets overlapping RMW pipeline deeper; here the pipeline
+depth is 1, trading a little latency for simplicity.)
 """
 from __future__ import annotations
 
@@ -111,8 +114,8 @@ class ECBackend(PGBackend):
         self.in_flight_reads: Dict[int, _ReadOp] = {}
         self.attr_fetches: Dict[int, Tuple] = {}    # tid -> (rec,)
         self.recovery_ops: Dict[str, _RecoveryOp] = {}
-        # per-object serialization of pipelined writes (ExtentCache role)
-        self._obj_queue: Dict[str, deque] = {}
+        # FIFO write pipeline: ops commit in submission order
+        self._pipeline: deque = deque()
 
     # ------------------------------------------------------------------
     # write path (reference submit_transaction -> start_rmw -> check_ops)
@@ -121,16 +124,10 @@ class ECBackend(PGBackend):
                            at_version: Eversion,
                            log_entries: List[LogEntry],
                            on_all_commit: Callable[[int], None]) -> None:
-        if mutation.truncate is not None:
-            # EC truncate is unsupported (reference: requires
-            # ec_overwrites plus rollback machinery; not lowered here)
-            on_all_commit(-95)           # -EOPNOTSUPP
-            return
         op = _WriteOp(self.new_tid(), oid, mutation, at_version,
                       log_entries, on_all_commit)
-        q = self._obj_queue.setdefault(oid, deque())
-        q.append(op)
-        if len(q) == 1:
+        self._pipeline.append(op)
+        if len(self._pipeline) == 1:
             self._start_rmw(op)
 
     def _start_rmw(self, op: _WriteOp) -> None:
@@ -153,8 +150,11 @@ class ECBackend(PGBackend):
         hi = max(off + len(d) for off, d in mut.writes)
         astart, alen = self.sinfo.offset_len_to_stripe_bounds(lo, hi - lo)
         # existing bytes inside the affected aligned range that the new
-        # data does not fully cover must be read back (RMW)
+        # data does not fully cover must be read back (RMW); bytes the
+        # accompanying truncate will discard don't count (writefull)
         existing_end = min(info.size, astart + alen)
+        if mut.truncate is not None:
+            existing_end = min(existing_end, max(lo, mut.truncate))
         if existing_end <= astart or \
                 self._fully_covers(mut.writes, astart, existing_end):
             self._reads_to_commit(op)
@@ -274,6 +274,21 @@ class ECBackend(PGBackend):
                 txn.write(coll, obj, chunk_off, chunks[shard])
                 txn.setattr(coll, obj, ecutil.HINFO_KEY, henc)
 
+        if mut.truncate is not None:
+            # logical truncate: shards trim to the per-shard size; any
+            # stale bytes inside the final partial stripe stay hidden
+            # behind ObjectInfo.size (reads trim, RMW re-encodes whole
+            # stripes from the logical content)
+            new_size = mut.truncate
+            shard_sz = self.sinfo.object_size_to_shard_size(new_size)
+            for_all(lambda s, t, o, c: t.truncate(c, o, shard_sz))
+            if not mut.writes:
+                # pure truncate invalidates cumulative CRCs (the
+                # write path above already refreshed/cleared them)
+                cleared = ecutil.HashInfo(self.k + self.m).encode()
+                for_all(lambda s, t, o, c:
+                        t.setattr(c, o, ecutil.HINFO_KEY, cleared))
+
         oi = ObjectInfo(size=new_size, version=op.at_version).encode()
         for_all(lambda s, t, o, c: t.setattr(c, o, OI_ATTR, oi))
         for name, value in mut.attrs.items():
@@ -328,14 +343,11 @@ class ECBackend(PGBackend):
             self._finish_write(op)
 
     def _finish_write(self, op: _WriteOp) -> None:
-        """Advance the per-object pipeline queue."""
-        q = self._obj_queue.get(op.oid)
-        if q and q[0] is op:
-            q.popleft()
-            if q:
-                self._start_rmw(q[0])
-            else:
-                del self._obj_queue[op.oid]
+        """Advance the FIFO pipeline."""
+        if self._pipeline and self._pipeline[0] is op:
+            self._pipeline.popleft()
+            if self._pipeline:
+                self._start_rmw(self._pipeline[0])
 
     # ------------------------------------------------------------------
     # read path (reference objects_read_and_reconstruct)
@@ -680,4 +692,4 @@ class ECBackend(PGBackend):
         self.in_flight_reads.clear()
         self.attr_fetches.clear()
         self.recovery_ops.clear()
-        self._obj_queue.clear()
+        self._pipeline.clear()

@@ -1,0 +1,744 @@
+"""Placement-group state machine: op execution, peering, recovery.
+
+Python-native equivalent of the reference's PG / PrimaryLogPG /
+PeeringState stack (reference src/osd/PG.cc, PrimaryLogPG.cc 15.5k LoC,
+PeeringState.{h,cc} boost::statechart) reduced to the states the
+framework drives:
+
+* **op execution** (primary): ``do_request`` -> ``do_op`` -> the op
+  switch (reference PrimaryLogPG::do_osd_ops' giant switch, :5737) —
+  write-class ops lower to one logical ``Mutation`` and go through
+  ``backend.submit_transaction`` with a PG-log entry (reference
+  issue_repop, :10650); read-class ops run against the backend
+  (EC reads reconstruct asynchronously);
+* **peering** (reference PeeringState): on every map interval change
+  the primary Queries the acting set, members Notify with their
+  bounded full log, the primary picks the authoritative log (best
+  last_update), adopts it if behind, computes per-shard missing sets
+  and Activates everyone with catch-up entries — or a ``backfill``
+  object list when a shard's log no longer overlaps (reference
+  GetInfo/GetLog/GetMissing/Activate collapsed to one round trip);
+* **recovery** (primary): ``start_recovery_ops(budget)`` drains the
+  union of missing sets through ``backend.recover_object`` (reference
+  PrimaryLogPG::start_recovery_ops / recover_primary + recover_
+  replicas), prioritizing objects client ops are blocked on
+  (``waiting_for_degraded``, the reference's wait_for_degraded_object);
+* EC pools reject omap and truncate unless ``ec_overwrites``
+  (reference pg_pool_t::allows_ecoverwrites, osd_types.h:1600).
+
+Degraded writes block until the object recovers, as the reference does
+(PrimaryLogPG::wait_for_degraded_object), keeping all acting shards
+write-consistent.
+
+Locking: one RLock per PG serializes every entry point (the
+reference's PG lock); store-commit callbacks re-enter through
+``on_local_commit`` which takes the lock.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from ..msg.messages import (MOSDOp, MOSDOpReply, MOSDPGLog, MOSDPGNotify,
+                            MOSDPGQuery, OSDOp)
+from ..store.objectstore import GHObject, Transaction
+from .backend import OI_ATTR, Mutation, ObjectInfo, build_pg_backend
+from .ecbackend import ECBackend
+from .osdmap import OSDMap, PGPool, PGid, POOL_TYPE_ERASURE
+from .pglog import (DELETE, MODIFY, Eversion, LogEntry, MissingSet,
+                    PGLog)
+
+PGMETA_OID = "_pgmeta"          # reference pgmeta_oid
+LOG_KEY_PREFIX = "log."
+INFO_KEY = "info"
+
+STATE_INACTIVE = "inactive"
+STATE_PEERING = "peering"
+STATE_ACTIVE = "active"
+
+WRITE_OPS = {"write", "writefull", "append", "create", "delete",
+             "truncate", "setxattr", "rmxattr", "omap_set", "omap_rm",
+             "omap_clear"}
+READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
+            "pgls"}
+
+
+class PG:
+    """One placement group as hosted by one OSD (primary or replica
+    shard).  ``service`` is the hosting OSD's service surface (see
+    osd.OSDService): whoami, conf, store, send_osd, get_osdmap."""
+
+    def __init__(self, service, pgid: PGid, pool: PGPool):
+        self.service = service
+        self.pgid = pgid
+        self.pool = pool
+        self.lock = threading.RLock()
+        self.state = STATE_INACTIVE
+        self.up: List[Optional[int]] = []
+        self.acting: List[Optional[int]] = []
+        self.primary_osd: Optional[int] = None
+        self.interval_start = 0          # epoch of last acting change
+        self.log = PGLog()
+        self.missing = MissingSet()      # objects THIS shard lacks
+        self.peer_missing: Dict[int, MissingSet] = {}
+        self._peer_notifies: Dict[int, dict] = {}
+        self.waiting_for_active: deque = deque()
+        self.waiting_for_degraded: Dict[str, deque] = {}
+        # per-object write serialization at the PG level so an append's
+        # offset (computed here against ObjectInfo.size) can't go stale
+        # behind an in-flight write to the same object
+        self.inflight_writes: Set[str] = set()
+        self.waiting_for_obj: Dict[str, deque] = {}
+        self._last_assigned: Eversion = (0, 0)
+        self.recovering: Set[str] = set()
+        self.backend = build_pg_backend(self, pool, service.ec_registry)
+        self._ensure_collections()
+        self._load_pgmeta()
+
+    # ------------------------------------------------------------------
+    # PGHost surface (consumed by the backend)
+    # ------------------------------------------------------------------
+    @property
+    def whoami(self) -> int:
+        return self.service.whoami
+
+    @property
+    def pgid_str(self) -> str:
+        return str(self.pgid)
+
+    @property
+    def own_shard(self) -> int:
+        if not self.pool.is_erasure():
+            return -1
+        for i, osd in enumerate(self.acting):
+            if osd == self.whoami:
+                return i
+        return -1
+
+    @property
+    def store(self):
+        return self.service.store
+
+    @property
+    def epoch(self) -> int:
+        return self.service.get_osdmap().epoch
+
+    def coll_of(self, shard: int) -> str:
+        if shard < 0:
+            return str(self.pgid)
+        return f"{self.pgid}s{shard}"
+
+    @property
+    def coll(self) -> str:
+        return self.coll_of(self.own_shard)
+
+    def acting_shards(self) -> List[Tuple[int, Optional[int]]]:
+        return list(enumerate(self.acting))
+
+    def send_shard(self, osd: int, msg) -> None:
+        self.service.send_osd(osd, msg)
+
+    def prepare_log_txn(self, txn: Transaction,
+                        log_entries: List[dict]) -> None:
+        """Persist log entries + info into the pgmeta object's omap in
+        the same transaction as the data (reference: pgmeta omap)."""
+        for e in log_entries:
+            entry = LogEntry.from_dict(e)
+            if entry.version > self.log.last_update:
+                self.log.add(entry)
+        self._append_pgmeta_ops(txn)
+
+    def on_local_commit(self, fn: Callable[[], None]) -> None:
+        with self.lock:
+            fn()
+
+    def ec_profile(self) -> Dict[str, str]:
+        prof = self.service.get_osdmap().erasure_code_profiles.get(
+            self.pool.erasure_code_profile)
+        return dict(prof or {"plugin": "jerasure", "k": "2", "m": "1"})
+
+    # ------------------------------------------------------------------
+    # persistence
+    # ------------------------------------------------------------------
+    def _meta_obj(self) -> GHObject:
+        return GHObject(PGMETA_OID, self.own_shard)
+
+    def _ensure_collections(self) -> None:
+        """Create this OSD's collection(s) for the PG.  EC shards are
+        positional so the collection is created lazily per interval;
+        all possible shard collections are created up front so a
+        position change never races transaction application."""
+        txn = Transaction()
+        made = False
+        if self.pool.is_erasure():
+            for s in range(self.pool.size):
+                if not self.store.collection_exists(self.coll_of(s)):
+                    txn.create_collection(self.coll_of(s))
+                    made = True
+        else:
+            if not self.store.collection_exists(self.coll_of(-1)):
+                txn.create_collection(self.coll_of(-1))
+                made = True
+        if made:
+            self.store.queue_transactions([txn])
+
+    def _append_pgmeta_ops(self, txn: Transaction) -> None:
+        kvs = {INFO_KEY: self.log.encode()}
+        txn.omap_setkeys(self.coll, self._meta_obj(), kvs)
+
+    def _persist_pgmeta(self) -> None:
+        txn = Transaction()
+        self._append_pgmeta_ops(txn)
+        self.store.queue_transactions([txn])
+
+    def _load_pgmeta(self) -> None:
+        """Restart is resume (reference OSD::init loads PGs from disk):
+        the log (and through it last_update) comes back from omap."""
+        for s in ([self.own_shard] if not self.pool.is_erasure()
+                  else range(self.pool.size)):
+            coll = self.coll_of(s if self.pool.is_erasure() else -1)
+            obj = GHObject(PGMETA_OID, s if self.pool.is_erasure() else -1)
+            try:
+                data = self.store.omap_get(coll, obj).get(INFO_KEY)
+            except FileNotFoundError:
+                continue
+            if data:
+                log = PGLog.decode(data)
+                if log.last_update > self.log.last_update:
+                    self.log = log
+
+    # ------------------------------------------------------------------
+    # map / interval handling (reference PG::handle_advance_map)
+    # ------------------------------------------------------------------
+    def advance_map(self, osdmap: OSDMap) -> None:
+        with self.lock:
+            pool = osdmap.get_pool(self.pgid.pool)
+            if pool is None:
+                return
+            self.pool = pool
+            up, up_p, acting, acting_p = \
+                osdmap.pg_to_up_acting_osds(self.pgid)
+            if acting == self.acting and self.state != STATE_INACTIVE:
+                return                   # same interval
+            self.up, self.acting = up, acting
+            self.primary_osd = acting_p
+            self.interval_start = osdmap.epoch
+            self.backend.on_change()
+            self._peer_notifies.clear()
+            self.peer_missing.clear()
+            self.recovering.clear()
+            self.missing = MissingSet()
+            self.waiting_for_degraded.clear()
+            if self.whoami not in [o for o in acting if o is not None]:
+                self.state = STATE_INACTIVE
+                return
+            self.state = STATE_PEERING
+            if self.is_primary():
+                self._start_peering()
+
+    def is_primary(self) -> bool:
+        return self.primary_osd == self.whoami
+
+    def _other_members(self) -> List[Tuple[int, int]]:
+        return [(s, o) for s, o in enumerate(self.acting)
+                if o is not None and o != self.whoami]
+
+    def _start_peering(self) -> None:
+        """Query every other acting member (reference GetInfo)."""
+        others = self._other_members()
+        if not others:
+            self._activate()
+            return
+        for shard, osd in others:
+            self.service.send_osd(osd, MOSDPGQuery(
+                pgid=str(self.pgid), shard=shard,
+                from_osd=self.whoami, epoch=self.epoch))
+
+    # -- peering message handlers --------------------------------------
+    def handle_pg_query(self, msg: MOSDPGQuery) -> None:
+        with self.lock:
+            self.service.send_osd(msg.from_osd, MOSDPGNotify(
+                pgid=str(self.pgid), shard=msg.shard,
+                from_osd=self.whoami, epoch=self.epoch,
+                log=self.log.to_dict()))
+
+    def handle_pg_notify(self, msg: MOSDPGNotify) -> None:
+        with self.lock:
+            if not self.is_primary() or self.state != STATE_PEERING:
+                return
+            self._peer_notifies[msg.shard] = msg.log
+            wanted = {s for s, _ in self._other_members()}
+            if wanted <= set(self._peer_notifies):
+                self._choose_and_activate()
+
+    def _choose_and_activate(self) -> None:
+        """Pick the authoritative log; adopt it if a peer is ahead
+        (reference GetLog); then activate (reference Activate)."""
+        best_shard, best_head = None, self.log.last_update
+        for shard, logd in self._peer_notifies.items():
+            head = tuple(logd["last_update"])
+            if head > best_head:
+                best_shard, best_head = shard, head
+        if best_shard is not None:
+            peer = PGLog.from_dict(self._peer_notifies[best_shard])
+            self.log.merge_authoritative(
+                peer.entries, peer.last_update,
+                lambda oid, need, have: self.missing.add(oid, need,
+                                                         have),
+                lambda oid, prior: self._roll_back_local(oid, prior))
+            self._persist_pgmeta()
+        self._activate()
+
+    def _roll_back_local(self, oid: str, prior: Eversion) -> None:
+        """Divergent local entry: drop our copy and re-recover it at the
+        authoritative version (log-based rollback stand-in; reference
+        EC rollback uses per-op rollback info, ecbackend.rst:10-27)."""
+        obj = GHObject(oid, self.own_shard)
+        if self.store.exists(self.coll, obj):
+            txn = Transaction()
+            txn.remove(self.coll, obj)
+            self.store.queue_transactions([txn])
+        if prior > (0, 0):
+            self.missing.add(oid, prior, None)
+
+    def _authoritative_objects(self) -> Dict[str, Eversion]:
+        """oid -> version of every live object the primary knows:
+        on-disk objects (their OI) overlaid with in-log versions."""
+        out: Dict[str, Eversion] = {}
+        for oid in self.backend.list_objects():
+            if oid == PGMETA_OID:
+                continue
+            oi = self.backend.get_object_info(oid)
+            if oi is not None:
+                out[oid] = oi.version
+        out.update(self.log.object_versions())
+        for oid, (need, _) in list(self.missing.items.items()):
+            out[oid] = need
+        return out
+
+    def _activate(self) -> None:
+        """Primary side: compute per-peer missing, send activation,
+        go active (reference PeeringState::Activate)."""
+        auth_objects = None
+        for shard, logd in self._peer_notifies.items():
+            peer_head = tuple(logd["last_update"])
+            entries = self.log.entries_since(peer_head)
+            osd = self.acting[shard]
+            if entries is None:
+                # no log overlap: backfill everything
+                if auth_objects is None:
+                    auth_objects = self._authoritative_objects()
+                ms = MissingSet()
+                for oid, ver in auth_objects.items():
+                    ms.add(oid, ver, None)
+                self.peer_missing[shard] = ms
+                self.service.send_osd(osd, MOSDPGLog(
+                    pgid=str(self.pgid), shard=shard,
+                    from_osd=self.whoami, epoch=self.epoch,
+                    last_update=self.log.last_update,
+                    backfill={oid: list(ver) for oid, ver
+                              in auth_objects.items()}))
+            else:
+                ms = MissingSet()
+                known: Dict[str, Eversion] = {}
+                for e in entries:
+                    if e.is_error():
+                        continue
+                    if e.is_delete():
+                        ms.rm(e.oid)
+                    else:
+                        ms.add(e.oid, e.version, known.get(e.oid))
+                    known[e.oid] = e.version
+                self.peer_missing[shard] = ms
+                self.service.send_osd(osd, MOSDPGLog(
+                    pgid=str(self.pgid), shard=shard,
+                    from_osd=self.whoami, epoch=self.epoch,
+                    last_update=self.log.last_update,
+                    entries=[e.to_dict() for e in entries]))
+        self.state = STATE_ACTIVE
+        self._peer_notifies.clear()
+        self._requeue_waiting()
+        self.service.pg_activated(self)
+
+    def handle_pg_log(self, msg: MOSDPGLog) -> None:
+        """Replica side: adopt the authoritative log and go active
+        (reference PG::RecoveryState::ReplicaActive)."""
+        with self.lock:
+            if msg.backfill is not None:
+                # authoritative object set: drop extras, note that the
+                # primary will push everything (stale copies get
+                # overwritten by pushes)
+                auth = {oid: tuple(v) for oid, v in msg.backfill.items()}
+                for oid in self.backend.list_objects():
+                    if oid == PGMETA_OID:
+                        continue
+                    if oid not in auth:
+                        obj = GHObject(oid, self.own_shard)
+                        txn = Transaction()
+                        txn.remove(self.coll, obj)
+                        self.store.queue_transactions([txn])
+                self.log = PGLog.from_dict(
+                    {"last_update": list(msg.last_update),
+                     "tail": list(msg.last_update), "entries": []})
+            else:
+                entries = [LogEntry.from_dict(e) for e in msg.entries]
+                self.log.merge_authoritative(
+                    entries, msg.last_update,
+                    lambda oid, need, have: self.missing.add(oid, need,
+                                                             have),
+                    lambda oid, prior: self._roll_back_local(oid,
+                                                             prior))
+                # apply deletes that happened while we were away
+                for e in entries:
+                    if e.is_delete():
+                        obj = GHObject(e.oid, self.own_shard)
+                        if self.store.exists(self.coll, obj):
+                            txn = Transaction()
+                            txn.remove(self.coll, obj)
+                            self.store.queue_transactions([txn])
+                        self.missing.rm(e.oid)
+            self._persist_pgmeta()
+            self.state = STATE_ACTIVE
+            self._requeue_waiting()
+
+    def _requeue_waiting(self) -> None:
+        while self.waiting_for_active:
+            msg, conn = self.waiting_for_active.popleft()
+            self._do_op(msg, conn)
+
+    # ------------------------------------------------------------------
+    # client op execution (reference do_request -> do_op -> do_osd_ops)
+    # ------------------------------------------------------------------
+    def do_request(self, msg: MOSDOp, conn) -> None:
+        with self.lock:
+            if not self.is_primary():
+                # client raced a map change: reply with our epoch so it
+                # refreshes and resends (reference resend-on-new-map)
+                self._reply(conn, msg, -108, [])   # -ESHUTDOWN marker
+                return
+            if self.state != STATE_ACTIVE:
+                self.waiting_for_active.append((msg, conn))
+                return
+            self._do_op(msg, conn)
+
+    def _is_degraded(self, oid: str) -> bool:
+        if self.missing.is_missing(oid):
+            return True
+        return any(ms.is_missing(oid)
+                   for s, ms in self.peer_missing.items()
+                   if self.acting[s] is not None)
+
+    def _do_op(self, msg: MOSDOp, conn) -> None:
+        has_write = any(op.op in WRITE_OPS for op in msg.ops)
+        oid = msg.oid
+        if has_write and self._is_degraded(oid):
+            # block until recovered (reference wait_for_degraded_object)
+            self.waiting_for_degraded.setdefault(oid, deque()).append(
+                (msg, conn))
+            self.service.kick_recovery(self)
+            return
+        if has_write:
+            if oid in self.inflight_writes:
+                self.waiting_for_obj.setdefault(oid, deque()).append(
+                    (msg, conn))
+                return
+            self._do_write(msg, conn)
+        else:
+            self._do_reads(msg, conn)
+
+    def _next_version(self) -> Eversion:
+        """Monotonic even while earlier writes are still in the async
+        pipeline (log.last_update only advances at local apply)."""
+        v = max(self._last_assigned[1], self.log.last_update[1]) + 1
+        self._last_assigned = (self.epoch, v)
+        return self._last_assigned
+
+    def _do_write(self, msg: MOSDOp, conn) -> None:
+        mut = Mutation()
+        err = 0
+        ec = self.pool.is_erasure()
+        full_replace = any(op.op == "writefull" for op in msg.ops)
+        info = self.backend.get_object_info(msg.oid)
+        cur_size = info.size if info else 0
+        for op in msg.ops:
+            o = op.op
+            if o == "write":
+                mut.writes.append((op.offset, op.data))
+            elif o == "writefull":
+                mut.writes.append((0, op.data))
+                mut.truncate = len(op.data)
+            elif o == "append":
+                mut.writes.append((cur_size, op.data))
+                cur_size += len(op.data)
+            elif o == "create":
+                mut.create = True
+            elif o == "delete":
+                mut.delete = True
+            elif o == "truncate":
+                if ec and not self.pool.ec_overwrites:
+                    err = -95
+                    break
+                mut.truncate = op.offset
+            elif o == "setxattr":
+                mut.attrs[op.name] = op.data
+            elif o == "rmxattr":
+                mut.attrs[op.name] = None
+            elif o in ("omap_set", "omap_rm", "omap_clear"):
+                if ec:
+                    err = -95            # ENOTSUP on EC pools
+                    break
+                if o == "omap_set":
+                    mut.omap_set[op.name] = op.data
+                elif o == "omap_rm":
+                    mut.omap_rm.append(op.name)
+                else:
+                    mut.omap_clear = True
+            elif o in READ_OPS:
+                err = -22                # no mixed read/write ops
+                break
+            else:
+                err = -95
+                break
+        if ec and not self.pool.ec_overwrites and not mut.delete \
+                and not full_replace \
+                and not mut.append_only_at(info.size if info else 0):
+            err = -95                    # overwrite needs ec_overwrites
+        if err:
+            self._reply(conn, msg, err, [])
+            return
+        version = self._next_version()
+        entry = LogEntry(DELETE if mut.delete else MODIFY, msg.oid,
+                         version,
+                         prior_version=(info.version if info
+                                        else (0, 0)))
+        self.inflight_writes.add(msg.oid)
+        self.backend.submit_transaction(
+            msg.oid, mut, version, [entry],
+            lambda res: self._op_committed(msg, conn, res))
+
+    def _op_committed(self, msg: MOSDOp, conn, res: int) -> None:
+        self.inflight_writes.discard(msg.oid)
+        self._reply(conn, msg, res, [])
+        q = self.waiting_for_obj.get(msg.oid)
+        if q:
+            nmsg, nconn = q.popleft()
+            if not q:
+                del self.waiting_for_obj[msg.oid]
+            self._do_op(nmsg, nconn)
+
+    def _do_reads(self, msg: MOSDOp, conn) -> None:
+        out_data: List[bytes] = [b""] * len(msg.ops)
+        extra: Dict = {}
+
+        def finish(res: int) -> None:
+            self._reply(conn, msg, res, out_data, extra)
+
+        def run(i: int) -> None:
+            if i >= len(msg.ops):
+                finish(0)
+                return
+            op = msg.ops[i]
+            o = op.op
+            if o == "read":
+                def cb(res: int, data: bytes, i=i) -> None:
+                    if res < 0:
+                        finish(res)
+                    else:
+                        out_data[i] = data
+                        run(i + 1)
+                length = op.length if op.length else (1 << 62)
+                self.backend.objects_read(msg.oid, op.offset, length, cb)
+                return
+            if o == "stat":
+                info = self.backend.get_object_info(msg.oid)
+                if info is None:
+                    finish(-2)
+                    return
+                extra["size"] = info.size
+                extra["version"] = list(info.version)
+            elif o == "getxattr":
+                try:
+                    out_data[i] = self.store.getattr(
+                        self.coll, GHObject(msg.oid, self.own_shard),
+                        "u_" + op.name)
+                except (FileNotFoundError, KeyError):
+                    finish(-61)          # -ENODATA
+                    return
+            elif o == "getxattrs":
+                try:
+                    attrs = self.store.getattrs(
+                        self.coll, GHObject(msg.oid, self.own_shard))
+                except FileNotFoundError:
+                    finish(-2)
+                    return
+                extra["xattrs"] = {k[2:]: v.decode("latin1")
+                                   for k, v in attrs.items()
+                                   if k.startswith("u_")}
+            elif o == "omap_get":
+                if self.pool.is_erasure():
+                    finish(-95)
+                    return
+                try:
+                    omap = self.store.omap_get(
+                        self.coll, GHObject(msg.oid, self.own_shard))
+                except FileNotFoundError:
+                    finish(-2)
+                    return
+                extra["omap"] = {k: v.decode("latin1")
+                                 for k, v in omap.items()}
+            elif o == "pgls":
+                objs = []
+                for oid in self.backend.list_objects():
+                    if oid == PGMETA_OID:
+                        continue
+                    objs.append(oid)
+                for oid, (need, _) in self.missing.items.items():
+                    if oid not in objs:
+                        objs.append(oid)
+                extra["objects"] = sorted(objs)
+            else:
+                finish(-95)
+                return
+            run(i + 1)
+
+        run(0)
+
+    def _reply(self, conn, msg: MOSDOp, result: int,
+               out_data: List[bytes], extra: Optional[Dict] = None
+               ) -> None:
+        reply = MOSDOpReply(tid=msg.tid, result=result,
+                            epoch=self.epoch, out_data=list(out_data),
+                            extra=extra or {})
+        conn.send_message(reply)
+
+    # ------------------------------------------------------------------
+    # recovery driving (reference start_recovery_ops)
+    # ------------------------------------------------------------------
+    def missing_objects(self) -> Dict[str, Eversion]:
+        """Union of all shards' missing (primary view)."""
+        out: Dict[str, Eversion] = {}
+        for oid, (need, _) in self.missing.items.items():
+            out[oid] = max(out.get(oid, (0, 0)), need)
+        for s, ms in self.peer_missing.items():
+            if self.acting[s] is None:
+                continue
+            for oid, (need, _) in ms.items.items():
+                out[oid] = max(out.get(oid, (0, 0)), need)
+        return out
+
+    def num_missing(self) -> int:
+        return len(self.missing_objects())
+
+    def is_clean(self) -> bool:
+        with self.lock:
+            if self.state != STATE_ACTIVE:
+                return False
+            if self.is_primary() and self.num_missing() > 0:
+                return False
+            return None not in self.acting and \
+                len(self.acting) >= self.pool.min_size
+
+    def start_recovery_ops(self, budget: int) -> int:
+        """Launch up to ``budget`` object recoveries; -> ops started."""
+        with self.lock:
+            if not self.is_primary() or self.state != STATE_ACTIVE:
+                return 0
+            started = 0
+            # blocked client ops first (reference recovery priorities)
+            queue = list(self.waiting_for_degraded)
+            queue += [oid for oid in self.missing_objects()
+                      if oid not in queue]
+            for oid in queue:
+                if started >= budget:
+                    break
+                if oid in self.recovering:
+                    continue
+                targets = self._missing_targets(oid)
+                if not targets:
+                    continue
+                version = self.missing_objects().get(oid)
+                if version is None:
+                    continue
+                self.recovering.add(oid)
+                entry_exists = not self._is_deleted_in_log(oid)
+                if not entry_exists:
+                    self._recover_delete(oid, targets)
+                    started += 1
+                    continue
+                self.backend.recover_object(
+                    oid, version, targets,
+                    lambda res, oid=oid: self._on_recovered(oid, res))
+                started += 1
+            return started
+
+    def _is_deleted_in_log(self, oid: str) -> bool:
+        for e in reversed(self.log.entries):
+            if e.oid == oid:
+                return e.is_delete()
+        return False
+
+    def _recover_delete(self, oid: str,
+                        targets: List[Tuple[int, int]]) -> None:
+        """The authoritative version of ``oid`` is a delete: remove it
+        wherever it lingers (no push needed)."""
+        for shard, osd in targets:
+            if osd == self.whoami:
+                obj = GHObject(oid, self.own_shard)
+                if self.store.exists(self.coll, obj):
+                    txn = Transaction()
+                    txn.remove(self.coll, obj)
+                    self.store.queue_transactions([txn])
+        self._on_recovered(oid, 0)
+
+    def _missing_targets(self, oid: str) -> List[Tuple[int, int]]:
+        targets: List[Tuple[int, int]] = []
+        if self.missing.is_missing(oid):
+            targets.append((self.own_shard, self.whoami))
+        for s, ms in self.peer_missing.items():
+            osd = self.acting[s] if s < len(self.acting) else None
+            if osd is not None and ms.is_missing(oid):
+                targets.append((s, osd))
+        return targets
+
+    def _on_recovered(self, oid: str, res: int) -> None:
+        with self.lock:
+            self.recovering.discard(oid)
+            if res == 0:
+                need = self.missing_objects().get(oid, (1 << 30, 0))
+                self.missing.got(oid, need)
+                for ms in self.peer_missing.values():
+                    ms.got(oid, need)
+            waiting = self.waiting_for_degraded.pop(oid, None)
+            if waiting:
+                for m, c in waiting:
+                    self._do_op(m, c)
+            self.service.kick_recovery(self)
+
+    # ------------------------------------------------------------------
+    # stats / scrub
+    # ------------------------------------------------------------------
+    def get_stats(self) -> Dict:
+        with self.lock:
+            states = [self.state]
+            if self.state == STATE_ACTIVE:
+                if self.is_primary() and self.num_missing() > 0:
+                    states.append("recovering")
+                elif None in self.acting or \
+                        len([o for o in self.acting
+                             if o is not None]) < self.pool.size:
+                    states.append("degraded")
+                else:
+                    states.append("clean")
+            n_objects = len([o for o in self.backend.list_objects()
+                             if o != PGMETA_OID])
+            return {
+                "state": "+".join(states),
+                "last_update": list(self.log.last_update),
+                "num_objects": n_objects,
+                "num_missing": (self.num_missing()
+                                if self.is_primary() else 0),
+                "acting": [o if o is not None else -1
+                           for o in self.acting],
+                "up": [o if o is not None else -1 for o in self.up],
+            }

@@ -294,17 +294,26 @@ def test_ec_recovery_onto_primary(ec_cluster):
     assert res2 == 0 and out == data
 
 
-def test_ec_truncate_and_exclusive_create_rejected(ec_cluster):
+def test_ec_writefull_replace_and_exclusive_create(ec_cluster):
     primary = ec_cluster.hosts[0].backend
     assert _write(primary, "excl", b"a" * 256, (1, 1)) == 0
+    # writefull lowering: write + truncate replaces the object whole
+    done = threading.Event()
     res = []
     primary.submit_transaction(
-        "excl", Mutation(truncate=10), (1, 2), [], res.append)
-    assert res == [-95]                       # EOPNOTSUPP
+        "excl", Mutation(writes=[(0, b"b" * 100)], truncate=100),
+        (1, 2), [], lambda r: (res.append(r), done.set()))
+    _wait(done)
+    assert res == [0]
+    r, out = _read(primary, "excl", 0, 1000)
+    assert r == 0 and out == b"b" * 100       # old tail gone
+    # exclusive create on an existing object -> EEXIST
+    done2 = threading.Event()
     primary.submit_transaction(
-        "excl", Mutation(create=True, writes=[(0, b"b" * 256)]),
-        (1, 3), [], res.append)
-    assert res == [-95, -17]                  # EEXIST
+        "excl", Mutation(create=True, writes=[(0, b"c" * 256)]),
+        (1, 3), [], lambda r: (res.append(r), done2.set()))
+    _wait(done2)
+    assert res == [0, -17]
 
 
 def test_ec_short_shard_treated_as_error(ec_cluster):
