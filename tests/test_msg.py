@@ -60,6 +60,15 @@ def sample_messages():
         M.MOSDMap(maps={3: {"epoch": 3}, 4: {"epoch": 4}}),
         M.MOSDBoot(osd=2, addr=("127.0.0.1", 7001)),
         M.MOSDFailure(target_osd=1, from_osd=0, failed_for=4.5, epoch=8),
+        M.MOSDPGQuery(pgid="1.3", shard=2, from_osd=0, epoch=11),
+        M.MOSDPGNotify(pgid="1.3", shard=2, from_osd=4, epoch=11,
+                       log={"head": [11, 7], "entries": []}),
+        M.MOSDPGLog(pgid="1.3", shard=2, from_osd=0, epoch=11,
+                    last_update=(11, 7),
+                    entries=[{"op": "modify", "oid": "o"}],
+                    backfill={"o2": [10, 1]}),
+        M.MPGStats(from_osd=4, epoch=11,
+                   pg_stats={"1.3": {"state": "active+clean"}}),
         M.MMonCommand(tid=1, cmd={"prefix": "osd pool create",
                                   "pool": "ec"}),
         M.MMonCommandAck(tid=1, retcode=0, rs="created",
