@@ -1,0 +1,394 @@
+"""Client stack: Objecter op engine + a librados-style API.
+
+Python-native equivalents of the reference's client layers:
+
+* **Objecter** (reference src/osdc/Objecter.cc 5.3k LoC): op
+  submission with map-based targeting (``op_submit`` :2263 ->
+  ``_calc_target`` :2766 — object -> PG via rjenkins+stable_mod ->
+  acting primary via CRUSH), resend on every map change that moves the
+  target or on connection reset, and completion matching by tid.
+  Connections to OSDs are lossy: a dead socket just resets and the
+  Objecter resends (reference Objecter resend-on-reset policy,
+  msg/Policy.h lossy client).
+* **Rados / IoCtx** (reference src/librados/ RadosClient + IoCtxImpl):
+  cluster handle bound to a monitor (map subscription + commands), and
+  per-pool IO contexts exposing the synchronous object API the tools
+  and tests drive: write/write_full/append/read/remove/stat/
+  getxattr/setxattr/omap/list_objects (reference
+  librados/IoCtxImpl.cc:595-672 routing into the Objecter).
+
+Async forms return ``Completion`` handles (reference aio_*); the sync
+forms wrap them.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..mon.client import MonClient
+from ..msg.messages import MOSDOp, MOSDOpReply, OSDOp
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..osd.osdmap import OSDMap, PGid
+from ..utils.config import Config, default_config
+from ..utils.log import Dout
+
+# reply code the OSD uses for "wrong primary / stale map, refresh and
+# resend" (reference: the client resends on a newer map rather than on
+# an errno, but a sentinel keeps the framework's reply path explicit)
+EAGAIN_WRONG_PRIMARY = -108
+
+
+class RadosError(OSError):
+    pass
+
+
+class Completion:
+    """One in-flight op (reference librados AioCompletion)."""
+
+    def __init__(self, objecter: "Objecter", tid: int):
+        self._objecter = objecter
+        self.tid = tid
+        self._ev = threading.Event()
+        self.result: Optional[int] = None
+        self.reply: Optional[MOSDOpReply] = None
+
+    def _complete(self, reply: MOSDOpReply) -> None:
+        self.reply = reply
+        self.result = reply.result
+        self._ev.set()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        if not self._ev.wait(timeout):
+            raise TimeoutError(f"op tid={self.tid} timed out")
+        return self.result
+
+    def is_complete(self) -> bool:
+        return self._ev.is_set()
+
+
+class _InflightOp:
+    def __init__(self, tid: int, pool: int, oid: str,
+                 ops: List[OSDOp], completion: Completion,
+                 pgid_seed: Optional[int] = None):
+        self.tid = tid
+        self.pool = pool
+        self.oid = oid
+        self.ops = ops
+        self.completion = completion
+        self.pgid_seed = pgid_seed     # explicit PG target (pgls)
+        self.target_osd: Optional[int] = None
+        self.sent_epoch = 0
+
+
+class Objecter(Dispatcher):
+    """Client op engine (reference osdc/Objecter.cc)."""
+
+    def __init__(self, msgr: Messenger, monc: MonClient,
+                 conf: Optional[Config] = None):
+        self.msgr = msgr
+        self.monc = monc
+        self.conf = conf or default_config()
+        self.log = Dout("client", f"objecter({msgr.name}) ")
+        self.lock = threading.RLock()
+        self.osdmap = OSDMap()
+        self.map_ready = threading.Event()
+        self._next_tid = 0
+        self.inflight: Dict[int, _InflightOp] = {}
+        self._osd_conns: Dict[int, Connection] = {}
+        msgr.add_dispatcher(self)
+
+    # ------------------------------------------------------------------
+    # map intake (MonClient delivers via handle_osdmap)
+    # ------------------------------------------------------------------
+    def handle_osdmap(self, wire: dict) -> None:
+        newmap = OSDMap.from_wire_dict(wire)
+        with self.lock:
+            if newmap.epoch <= self.osdmap.epoch:
+                return
+            self.osdmap = newmap
+            resend = list(self.inflight.values())
+        self.map_ready.set()
+        # resend ops whose target moved (reference _scan_requests /
+        # need_resend on every new map)
+        for op in resend:
+            target = self._target_of(op)
+            if target != op.target_osd:
+                self._send_op(op)
+
+    # ------------------------------------------------------------------
+    # op submission (reference op_submit :2263)
+    # ------------------------------------------------------------------
+    def submit(self, pool: int, oid: str, ops: List[OSDOp],
+               pgid_seed: Optional[int] = None) -> Completion:
+        with self.lock:
+            self._next_tid += 1
+            tid = self._next_tid
+            completion = Completion(self, tid)
+            op = _InflightOp(tid, pool, oid, ops, completion,
+                             pgid_seed=pgid_seed)
+            self.inflight[tid] = op
+        self._send_op(op)
+        return completion
+
+    def _pgid_of(self, osdmap: OSDMap, op: _InflightOp) -> PGid:
+        if op.pgid_seed is not None:
+            return PGid(op.pool, op.pgid_seed)
+        return osdmap.object_locator_to_pg(op.oid, op.pool)
+
+    def _target_of(self, op: _InflightOp) -> Optional[int]:
+        with self.lock:
+            osdmap = self.osdmap
+        if op.pool not in osdmap.pools:
+            return None
+        pgid = self._pgid_of(osdmap, op)
+        _, _, _, primary = osdmap.pg_to_up_acting_osds(pgid)
+        return primary
+
+    def _send_op(self, op: _InflightOp) -> None:
+        with self.lock:
+            osdmap = self.osdmap
+        if op.pool not in osdmap.pools:
+            self._fail_op(op, -2)        # pool gone: ENOENT
+            return
+        pgid = self._pgid_of(osdmap, op)
+        _, _, _, primary = osdmap.pg_to_up_acting_osds(pgid)
+        op.target_osd = primary
+        op.sent_epoch = osdmap.epoch
+        if primary is None:
+            # no primary (pool below min_size): hold until a new map
+            # (reference: op waits on PG to go active)
+            self.log.dout(10, f"tid {op.tid}: no primary for "
+                          f"{pgid}, waiting for map")
+            return
+        addr = osdmap.get_addr(primary)
+        if addr is None:
+            return
+        conn = self.msgr.connect_to(addr, lossless=False)
+        with self.lock:
+            self._osd_conns[primary] = conn
+        conn.send_message(MOSDOp(
+            client=self.msgr.name, tid=op.tid, epoch=osdmap.epoch,
+            pool=op.pool, oid=op.oid, ops=op.ops,
+            pgid_seed=pgid.seed))
+
+    def _fail_op(self, op: _InflightOp, result: int) -> None:
+        with self.lock:
+            self.inflight.pop(op.tid, None)
+        op.completion._complete(MOSDOpReply(tid=op.tid, result=result))
+
+    # ------------------------------------------------------------------
+    # replies + resets
+    # ------------------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if not isinstance(msg, MOSDOpReply):
+            return False
+        with self.lock:
+            op = self.inflight.get(msg.tid)
+        if op is None:
+            return True                  # late duplicate
+        if msg.result == EAGAIN_WRONG_PRIMARY:
+            # stale targeting: refresh the map and resend (reference
+            # resend-on-new-map); retry after the map catches up
+            self.monc.subscribe_osdmap(msg.epoch)
+            threading.Timer(0.05, self._send_op, args=(op,)).start()
+            return True
+        with self.lock:
+            self.inflight.pop(msg.tid, None)
+        op.completion._complete(msg)
+        return True
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        """Lossy OSD session died: resend everything targeted at it
+        (reference Objecter::ms_handle_reset)."""
+        with self.lock:
+            dead = [osd for osd, c in self._osd_conns.items()
+                    if c is conn]
+            for osd in dead:
+                del self._osd_conns[osd]
+            resend = [op for op in self.inflight.values()
+                      if op.target_osd in dead]
+        for op in resend:
+            # the target may be freshly down; refresh then resend
+            threading.Timer(0.1, self._send_op, args=(op,)).start()
+
+    def wait_for_map(self, timeout: float = 10.0) -> None:
+        if not self.map_ready.wait(timeout):
+            raise RadosError("no osdmap from monitor")
+
+
+class IoCtx:
+    """Per-pool IO handle (reference librados::IoCtx / IoCtxImpl)."""
+
+    def __init__(self, rados: "Rados", pool_id: int, pool_name: str):
+        self.rados = rados
+        self.pool_id = pool_id
+        self.pool_name = pool_name
+
+    # -- internals ---------------------------------------------------------
+    def _obj_op(self, oid: str, ops: List[OSDOp],
+                timeout: Optional[float] = None) -> MOSDOpReply:
+        timeout = timeout or self.rados.op_timeout
+        c = self.rados.objecter.submit(self.pool_id, oid, ops)
+        res = c.wait(timeout)
+        if res < 0:
+            raise RadosError(-res, f"{ops[0].op} {oid!r}: {res}")
+        return c.reply
+
+    # -- write class -------------------------------------------------------
+    def write_full(self, oid: str, data: bytes) -> None:
+        self._obj_op(oid, [OSDOp("writefull", data=data)])
+
+    def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        self._obj_op(oid, [OSDOp("write", offset=offset, data=data)])
+
+    def append(self, oid: str, data: bytes) -> None:
+        self._obj_op(oid, [OSDOp("append", data=data)])
+
+    def remove(self, oid: str) -> None:
+        self._obj_op(oid, [OSDOp("delete")])
+
+    def truncate(self, oid: str, size: int) -> None:
+        self._obj_op(oid, [OSDOp("truncate", offset=size)])
+
+    def create(self, oid: str) -> None:
+        self._obj_op(oid, [OSDOp("create")])
+
+    def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        self._obj_op(oid, [OSDOp("setxattr", name=name, data=value)])
+
+    def rmxattr(self, oid: str, name: str) -> None:
+        self._obj_op(oid, [OSDOp("rmxattr", name=name)])
+
+    def omap_set(self, oid: str, kvs: Dict[str, bytes]) -> None:
+        ops = [OSDOp("omap_set", name=k, data=v)
+               for k, v in kvs.items()]
+        self._obj_op(oid, ops)
+
+    def omap_rm_keys(self, oid: str, keys: List[str]) -> None:
+        self._obj_op(oid, [OSDOp("omap_rm", name=k) for k in keys])
+
+    # -- read class --------------------------------------------------------
+    def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
+        reply = self._obj_op(
+            oid, [OSDOp("read", offset=offset, length=length)])
+        return reply.out_data[0]
+
+    def stat(self, oid: str) -> Tuple[int, Tuple[int, int]]:
+        """-> (size, version)."""
+        reply = self._obj_op(oid, [OSDOp("stat")])
+        return reply.extra["size"], tuple(reply.extra["version"])
+
+    def getxattr(self, oid: str, name: str) -> bytes:
+        reply = self._obj_op(oid, [OSDOp("getxattr", name=name)])
+        return reply.out_data[0]
+
+    def getxattrs(self, oid: str) -> Dict[str, bytes]:
+        reply = self._obj_op(oid, [OSDOp("getxattrs")])
+        return {k: v.encode("latin1")
+                for k, v in reply.extra["xattrs"].items()}
+
+    def omap_get(self, oid: str) -> Dict[str, bytes]:
+        reply = self._obj_op(oid, [OSDOp("omap_get")])
+        return {k: v.encode("latin1")
+                for k, v in reply.extra["omap"].items()}
+
+    def list_objects(self) -> List[str]:
+        """Pool listing = pgls across every PG (reference
+        librados nobjects_begin -> per-PG pgls)."""
+        with self.rados.objecter.lock:
+            osdmap = self.rados.objecter.osdmap
+        pool = osdmap.pools.get(self.pool_id)
+        if pool is None:
+            raise RadosError(2, "pool is gone")
+        out: List[str] = []
+        for pgid in osdmap.pgs_for_pool(self.pool_id):
+            c = self.rados.objecter.submit(
+                self.pool_id, f".pgls.{pgid.seed}", [OSDOp("pgls")],
+                pgid_seed=pgid.seed)
+            res = c.wait(self.rados.op_timeout)
+            if res < 0:
+                raise RadosError(-res, f"pgls {pgid}: {res}")
+            out.extend(c.reply.extra.get("objects", []))
+        return sorted(set(out))
+
+    # -- async forms (reference aio_*) -------------------------------------
+    def aio_write_full(self, oid: str, data: bytes) -> Completion:
+        return self.rados.objecter.submit(
+            self.pool_id, oid, [OSDOp("writefull", data=data)])
+
+    def aio_read(self, oid: str, length: int = 0,
+                 offset: int = 0) -> Completion:
+        return self.rados.objecter.submit(
+            self.pool_id, oid,
+            [OSDOp("read", offset=offset, length=length)])
+
+
+class Rados:
+    """Cluster handle (reference librados::Rados / RadosClient)."""
+
+    _next_client = 0
+    _client_lock = threading.Lock()
+
+    def __init__(self, mon_addr: Tuple[str, int],
+                 conf: Optional[Config] = None,
+                 op_timeout: float = 30.0):
+        with Rados._client_lock:
+            Rados._next_client += 1
+            n = Rados._next_client
+        self.conf = conf or default_config()
+        self.op_timeout = op_timeout
+        self.msgr = Messenger(f"client.{n}", conf=self.conf)
+        self.monc = MonClient(self.msgr, mon_addr,
+                              map_cb=self._on_map)
+        self.objecter = Objecter(self.msgr, self.monc, self.conf)
+
+    def _on_map(self, wire: dict) -> None:
+        self.objecter.handle_osdmap(wire)
+
+    # ------------------------------------------------------------------
+    def connect(self, timeout: float = 10.0) -> "Rados":
+        self.msgr.start()
+        self.monc.subscribe_osdmap()
+        self.objecter.wait_for_map(timeout)
+        return self
+
+    def shutdown(self) -> None:
+        self.msgr.shutdown()
+
+    def __enter__(self) -> "Rados":
+        return self.connect()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
+
+    # ------------------------------------------------------------------
+    def mon_command(self, cmd: dict, timeout: float = 30.0
+                    ) -> Tuple[int, str, dict]:
+        return self.monc.command(cmd, timeout)
+
+    def open_ioctx(self, pool_name: str) -> IoCtx:
+        with self.objecter.lock:
+            pool = self.objecter.osdmap.get_pool(pool_name)
+        if pool is None:
+            # the pool may be newer than our map: refresh once
+            self.monc.subscribe_osdmap(self.objecter.osdmap.epoch + 1)
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                with self.objecter.lock:
+                    pool = self.objecter.osdmap.get_pool(pool_name)
+                if pool is not None:
+                    break
+                time.sleep(0.05)
+        if pool is None:
+            raise RadosError(2, f"no pool {pool_name!r}")
+        return IoCtx(self, pool.pool_id, pool_name)
+
+    def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.objecter.lock:
+                if self.objecter.osdmap.epoch >= epoch:
+                    return
+            time.sleep(0.02)
+        raise RadosError(110, f"epoch {epoch} not reached")

@@ -1,0 +1,216 @@
+"""In-process development cluster — the framework's vstart.sh.
+
+Python-native equivalent of the reference's dev-cluster fixtures:
+``src/vstart.sh`` (mon+mgr+osd from a build tree) and the standalone
+test helpers ``qa/standalone/ceph-helpers.sh`` (run_mon :447, run_osd
+:631, wait_for_clean :1579, kill/revive via ceph_manager.py
+:2748,:2790).  Daemons run as threads in one process, talking over real
+loopback TCP through the messenger — the same wire path a multi-host
+deployment uses, so thrash tests exercise real reconnect/resend
+machinery.
+
+``data_dir=None`` backs OSDs with MemStore (reference tier-2 fake
+backend; a *graceful* stop/start keeps the store object so restart is
+resume); a path gives every daemon a FileStore/LogDB directory so
+kill -9-style restarts recover from disk.  ``kill_osd`` with MemStore
+discards the store — the "disk died" scenario that forces a full
+rebuild from surviving shards (the BASELINE.json rebuild config).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .mon.monitor import Monitor
+from .client.rados import Rados, RadosError
+from .osd.osd import OSD
+from .store.filestore import FileStore
+from .store.memstore import MemStore
+from .store.objectstore import ObjectStore
+from .utils.config import Config
+
+
+def test_config(**overrides) -> Config:
+    """Timing scaled for single-host tests (the reference's vstart
+    likewise shrinks heartbeat/grace)."""
+    base = {
+        "osd_heartbeat_interval": 0.25,
+        "osd_heartbeat_grace": 1.5,
+        "mon_tick_interval": 0.2,
+        "mon_osd_down_out_interval": 3.0,
+        "osd_pool_default_pg_num": 8,
+    }
+    base.update(overrides)
+    return Config(base)
+
+
+class Cluster:
+    """mon.0 + N OSDs in one process (reference vstart.sh)."""
+
+    def __init__(self, n_osds: int = 3,
+                 data_dir: Optional[str] = None,
+                 conf: Optional[Config] = None):
+        self.n_osds = n_osds
+        self.data_dir = data_dir
+        self.conf = conf or test_config()
+        self.mon: Optional[Monitor] = None
+        self.osds: Dict[int, Optional[OSD]] = {}
+        self.stores: Dict[int, ObjectStore] = {}
+        self._clients: List[Rados] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def mon_addr(self) -> Tuple[str, int]:
+        return self.mon.my_addr
+
+    def _make_store(self, osd_id: int) -> ObjectStore:
+        if self.data_dir is None:
+            store = MemStore()
+            store.mkfs()
+        else:
+            path = os.path.join(self.data_dir, f"osd.{osd_id}")
+            store = FileStore(path)
+            if not os.path.exists(os.path.join(path, "meta.kv")):
+                store.mkfs()
+        return store
+
+    def start(self) -> "Cluster":
+        mon_path = ""
+        if self.data_dir is not None:
+            mon_path = os.path.join(self.data_dir, "mon.0")
+            os.makedirs(mon_path, exist_ok=True)
+        self.mon = Monitor(data_path=mon_path, conf=self.conf)
+        self.mon.start()
+        for i in range(self.n_osds):
+            self.start_osd(i)
+        return self
+
+    def start_osd(self, osd_id: int) -> OSD:
+        store = self.stores.get(osd_id)
+        if store is None:
+            store = self._make_store(osd_id)
+            self.stores[osd_id] = store
+        store.mount()
+        osd = OSD(osd_id, store, self.mon_addr, conf=self.conf)
+        osd.start()
+        self.osds[osd_id] = osd
+        return osd
+
+    def stop(self) -> None:
+        for client in self._clients:
+            client.shutdown()
+        self._clients.clear()
+        for osd in self.osds.values():
+            if osd is not None:
+                osd.shutdown()
+        self.osds = {i: None for i in self.osds}
+        if self.mon is not None:
+            self.mon.shutdown()
+            self.mon = None
+
+    def __enter__(self) -> "Cluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # ------------------------------------------------------------------
+    # fault injection (reference qa/tasks/ceph_manager.py kill_osd
+    # :2748 / revive_osd :2790)
+    # ------------------------------------------------------------------
+    def kill_osd(self, osd_id: int, lose_data: bool = False) -> None:
+        """Stop an OSD.  ``lose_data`` discards its store — the dead-
+        disk scenario: revive comes back empty and must backfill."""
+        osd = self.osds.get(osd_id)
+        if osd is not None:
+            osd.shutdown()
+            self.osds[osd_id] = None
+        if lose_data:
+            store = self.stores.pop(osd_id, None)
+            if store is not None and self.data_dir is not None:
+                shutil.rmtree(os.path.join(self.data_dir,
+                                           f"osd.{osd_id}"),
+                              ignore_errors=True)
+
+    def revive_osd(self, osd_id: int) -> OSD:
+        return self.start_osd(osd_id)
+
+    # ------------------------------------------------------------------
+    # admin conveniences (reference ceph CLI paths)
+    # ------------------------------------------------------------------
+    def rados(self, timeout: float = 10.0) -> Rados:
+        client = Rados(self.mon_addr, conf=self.conf).connect(timeout)
+        self._clients.append(client)
+        return client
+
+    def mon_command(self, cmd: dict) -> Tuple[int, str, dict]:
+        with Rados(self.mon_addr, conf=self.conf) as r:
+            return r.mon_command(cmd)
+
+    def create_ec_profile(self, name: str, **kv) -> None:
+        profile = [f"{k.replace('_', '-') if k.startswith('crush') else k}"
+                   f"={v}" for k, v in kv.items()]
+        ret, rs, _ = self.mon_command({
+            "prefix": "osd erasure-code-profile set", "name": name,
+            "profile": profile})
+        if ret != 0:
+            raise RadosError(-ret, rs)
+
+    def create_pool(self, name: str, pool_type: str = "replicated",
+                    pg_num: Optional[int] = None, **kw) -> int:
+        cmd = {"prefix": "osd pool create", "pool": name,
+               "pool_type": pool_type}
+        if pg_num is not None:
+            cmd["pg_num"] = pg_num
+        cmd.update(kw)
+        ret, rs, out = self.mon_command(cmd)
+        if ret != 0:
+            raise RadosError(-ret, rs)
+        return out.get("pool_id", -1)
+
+    # ------------------------------------------------------------------
+    # health polling (reference ceph-helpers.sh wait_for_clean :1579)
+    # ------------------------------------------------------------------
+    def health(self) -> dict:
+        ret, rs, out = self.mon_command({"prefix": "health"})
+        if ret != 0:
+            raise RadosError(-ret, rs)
+        return out
+
+    def wait_for_clean(self, timeout: float = 30.0) -> float:
+        """Block until every PG reports active+clean; -> seconds it
+        took (the rebuild-time metric of BASELINE.json config 5)."""
+        t0 = time.monotonic()
+        deadline = t0 + timeout
+        while time.monotonic() < deadline:
+            h = self.health()
+            if h.get("all_clean"):
+                return time.monotonic() - t0
+            time.sleep(0.1)
+        raise TimeoutError(
+            f"cluster not clean after {timeout}s: {self.health()}")
+
+    def wait_for_osd_up(self, osd_id: int, timeout: float = 10.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ret, _, out = self.mon_command({"prefix": "osd dump"})
+            if ret == 0:
+                for o in out.get("osds", []):
+                    if o["osd"] == osd_id and o["up"]:
+                        return
+            time.sleep(0.1)
+        raise TimeoutError(f"osd.{osd_id} not up after {timeout}s")
+
+    def wait_for_osd_down(self, osd_id: int,
+                          timeout: float = 15.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            ret, _, out = self.mon_command({"prefix": "osd dump"})
+            if ret == 0:
+                for o in out.get("osds", []):
+                    if o["osd"] == osd_id and not o["up"]:
+                        return
+            time.sleep(0.1)
+        raise TimeoutError(f"osd.{osd_id} still up after {timeout}s")

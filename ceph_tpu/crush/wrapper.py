@@ -116,6 +116,22 @@ class CrushWrapper:
                 self._adjust_ancestor_weights(pname)
         self._invalidate_shadows()
 
+    def ancestor_of(self, name: str, type_name: str) -> str:
+        """Name of the ``type_name``-level ancestor containing ``name``
+        (reference CrushWrapper::get_parent_of_type, used by the
+        monitor's reporter-subtree failure heuristic)."""
+        want = self.type_id(type_name)
+        cur = self.name_ids[name]
+        while True:
+            if cur < 0 and self.map.buckets[cur].type == want:
+                return self.bucket_names[cur]
+            parent = next((b.id for b in self.map.buckets.values()
+                           if cur in b.items and b.id not in
+                           self._class_shadow.values()), None)
+            if parent is None:
+                raise KeyError(f"no {type_name} ancestor of {name}")
+            cur = parent
+
     def _adjust_ancestor_weights(self, name: str) -> None:
         bid = self.name_ids[name]
         new_weight = self.map.buckets[bid].weight

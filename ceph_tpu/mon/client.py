@@ -1,0 +1,125 @@
+"""MonClient — every daemon's and client's line to the monitor.
+
+Python-native equivalent of the reference's MonClient (reference
+src/mon/MonClient.{h,cc}): maintains the session to the monitor,
+subscribes to map streams (reference MMonSubscribe / sub_want), runs
+synchronous CLI-style commands (reference MonCommand + tid matching),
+and carries the OSD-side control traffic — boot, failure reports, PG
+stats (reference OSD::_send_boot, send_failures, MPGStats).
+
+Map delivery: incoming MOSDMap frames invoke ``map_cb`` outside the
+client lock; consumers (OSD daemon, Objecter) re-enter their own
+locking from there.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
+                            MOSDBoot, MOSDFailure, MOSDMap, MPGStats)
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..utils.log import Dout
+
+
+class CommandTimeout(Exception):
+    pass
+
+
+class MonClient(Dispatcher):
+    """One session to the monitor (reference mon/MonClient.h).  The
+    hosting entity passes its own messenger; the monclient owns only
+    the mon connection."""
+
+    def __init__(self, msgr: Messenger, mon_addr: Tuple[str, int],
+                 map_cb: Optional[Callable[[dict], None]] = None):
+        self.msgr = msgr
+        self.mon_addr = mon_addr
+        self.map_cb = map_cb
+        self.log = Dout("mon", f"monc({msgr.name}) ")
+        self.lock = threading.RLock()
+        self.conn: Optional[Connection] = None
+        self._next_tid = 0
+        self._cmd_events: Dict[int, threading.Event] = {}
+        self._cmd_acks: Dict[int, MMonCommandAck] = {}
+        self._latest_epoch = 0
+        msgr.add_dispatcher(self)
+
+    # ------------------------------------------------------------------
+    def connect(self) -> None:
+        with self.lock:
+            if self.conn is None or not self.conn.is_connected():
+                self.conn = self.msgr.connect_to(self.mon_addr,
+                                                 lossless=True)
+
+    def _mon_conn(self) -> Connection:
+        self.connect()
+        return self.conn
+
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMonCommandAck):
+            with self.lock:
+                ev = self._cmd_events.get(msg.tid)
+                if ev is not None:
+                    self._cmd_acks[msg.tid] = msg
+                    ev.set()
+            return True
+        if isinstance(msg, MOSDMap) and conn is self.conn:
+            best = None
+            with self.lock:
+                for epoch in sorted(msg.maps):
+                    if epoch > self._latest_epoch:
+                        self._latest_epoch = epoch
+                        best = msg.maps[epoch]
+            if best is not None and self.map_cb is not None:
+                self.map_cb(best)
+            return True
+        return False
+
+    # ------------------------------------------------------------------
+    # subscriptions (reference MonClient::sub_want + renew)
+    # ------------------------------------------------------------------
+    def subscribe_osdmap(self, since_epoch: int = 0) -> None:
+        self._mon_conn().send_message(
+            MMonSubscribe(what={"osdmap": since_epoch}))
+
+    # ------------------------------------------------------------------
+    # commands (reference MonClient::start_mon_command)
+    # ------------------------------------------------------------------
+    def command(self, cmd: dict, timeout: float = 30.0
+                ) -> Tuple[int, str, dict]:
+        """Synchronous monitor command; -> (retcode, status, out)."""
+        with self.lock:
+            self._next_tid += 1
+            tid = self._next_tid
+            ev = threading.Event()
+            self._cmd_events[tid] = ev
+        try:
+            self._mon_conn().send_message(MMonCommand(tid=tid, cmd=cmd))
+            if not ev.wait(timeout):
+                raise CommandTimeout(
+                    f"mon command {cmd.get('prefix')!r} timed out")
+            with self.lock:
+                ack = self._cmd_acks.pop(tid)
+            return ack.retcode, ack.rs, ack.out
+        finally:
+            with self.lock:
+                self._cmd_events.pop(tid, None)
+                self._cmd_acks.pop(tid, None)
+
+    # ------------------------------------------------------------------
+    # OSD control traffic
+    # ------------------------------------------------------------------
+    def send_boot(self, osd: int, addr: Tuple[str, int]) -> None:
+        self._mon_conn().send_message(MOSDBoot(osd=osd, addr=addr))
+
+    def report_failure(self, target_osd: int, from_osd: int,
+                       failed_for: float, epoch: int) -> None:
+        self._mon_conn().send_message(
+            MOSDFailure(target_osd=target_osd, from_osd=from_osd,
+                        failed_for=failed_for, epoch=epoch))
+
+    def send_pg_stats(self, from_osd: int, epoch: int,
+                      pg_stats: Dict[str, dict]) -> None:
+        self._mon_conn().send_message(
+            MPGStats(from_osd=from_osd, epoch=epoch, pg_stats=pg_stats))

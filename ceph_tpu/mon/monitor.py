@@ -1,0 +1,661 @@
+"""Monitor — the cluster's control plane and map authority.
+
+Python-native equivalent of the reference's monitor stack (reference
+src/mon/Monitor.cc, mon/OSDMonitor.cc 14.1k LoC, mon/MonitorDBStore.h)
+reduced to the single-monitor deployment the framework drives first
+(SURVEY.md §7 step 8: "single-mon first, Paxos quorum later"):
+
+* **map authority**: the one OSDMap lineage, advanced by applying
+  ``Incremental`` deltas (reference pending_inc + Paxos propose/commit;
+  here commit = persist to the MonitorDBStore then publish);
+* **MonitorDBStore**: every epoch's full map is persisted to a
+  key-value store (reference mon/MonitorDBStore.h:37 over RocksDB;
+  here ``store.kv``: LogDB on disk or MemDB), so a monitor restart
+  resumes the lineage — reference "mon data dir";
+* **command table** (reference mon/MonCommands.h + OSDMonitor
+  handlers): ``osd erasure-code-profile set`` validates the profile by
+  *instantiating the plugin* exactly like the reference
+  (mon/OSDMonitor.cc:7371-7392 get_erasure_code — so the monitor loads
+  the TPU plugin too, which must work without a TPU present);
+  ``osd pool create`` wires profile -> crush rule via the codec's
+  ``create_rule`` (reference OSDMonitor.cc:7216-7368);
+* **failure detection** (reference prepare_failure/check_failure,
+  mon/OSDMonitor.cc:3257,3172): OSDs report unresponsive peers with
+  MOSDFailure; once ``mon_osd_min_down_reporters`` distinct reporters
+  from distinct failure-domain subtrees (``mon_osd_reporter_subtree_
+  level``) agree, the target is marked down in a new epoch;
+* **down-out tick** (reference mon_osd_down_out_interval): OSDs down
+  longer than the interval are marked out (weight 0) so CRUSH remaps
+  and recovery rebuilds their data elsewhere;
+* **PG stat aggregation** (reference MgrStatMonitor/PGMap): primaries
+  report per-PG stats (MPGStats); ``status``/``health`` summarize them
+  — this is what ``wait_for_clean`` polls (reference
+  qa/standalone/ceph-helpers.sh:1579).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..crush.wrapper import CrushWrapper, weight_to_fixed
+from ..ec import registry as ec_registry
+from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
+                            MOSDBoot, MOSDFailure, MOSDMap, MPGStats)
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..osd.osdmap import (Incremental, OSDMap, PGPool,
+                          POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
+from ..store.kv import KeyValueDB, LogDB, MemDB, WriteBatch
+from ..utils.config import Config, default_config
+from ..utils.log import Dout
+
+DEFAULT_STRIPE_UNIT = 4096      # reference osd_pool_erasure_code_stripe_unit
+
+
+class MonitorDBStore:
+    """Persisted monitor state (reference mon/MonitorDBStore.h:37):
+    full OSDMap per epoch under ``osdmap.<epoch>``, plus the latest
+    committed epoch pointer — a monitor restart resumes from here."""
+
+    def __init__(self, path: str = ""):
+        self.db: KeyValueDB = LogDB(os.path.join(path, "mon.db")) \
+            if path else MemDB()
+        self.db.open()
+
+    def put_map(self, epoch: int, wire: dict) -> None:
+        batch = WriteBatch()
+        batch.set(f"osdmap.{epoch:010d}", json.dumps(wire).encode())
+        batch.set("osdmap.last", str(epoch).encode())
+        self.db.submit(batch, sync=True)
+
+    def last_epoch(self) -> int:
+        raw = self.db.get("osdmap.last")
+        return int(raw.decode()) if raw else 0
+
+    def get_map(self, epoch: int) -> Optional[dict]:
+        raw = self.db.get(f"osdmap.{epoch:010d}")
+        return json.loads(raw.decode()) if raw else None
+
+    def close(self) -> None:
+        self.db.close()
+
+
+class Monitor(Dispatcher):
+    """Single monitor daemon (reference mon/Monitor.cc)."""
+
+    def __init__(self, name: str = "mon.0", data_path: str = "",
+                 conf: Optional[Config] = None,
+                 addr: Tuple[str, int] = ("127.0.0.1", 0)):
+        self.name = name
+        self.conf = conf or default_config()
+        self.log = Dout("mon", f"{name} ")
+        self.lock = threading.RLock()
+        self.store = MonitorDBStore(data_path)
+        self.osdmap = OSDMap()
+        self.ec_registry = ec_registry.instance()
+        # subscribers: conn -> next epoch wanted (reference
+        # Session::sub_map / MMonSubscribe)
+        self.subs: Dict[Connection, int] = {}
+        # failure reports: target -> reporter -> (first_seen, failed_for)
+        self.failure_reports: Dict[int, Dict[int, Tuple[float, float]]] = {}
+        self.pg_stats: Dict[str, dict] = {}
+        self.pg_stats_from: Dict[str, int] = {}
+        self._booted_addr: Dict[int, Tuple[str, int]] = {}
+        self.msgr = Messenger(name, conf=self.conf)
+        self.my_addr = self.msgr.bind(addr)
+        self.msgr.add_dispatcher(self)
+        self._stop = threading.Event()
+        self._tick_thread: Optional[threading.Thread] = None
+        self._down_since: Dict[int, float] = {}
+        self._load_or_bootstrap()
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def _load_or_bootstrap(self) -> None:
+        last = self.store.last_epoch()
+        if last:
+            self.osdmap = OSDMap.from_wire_dict(self.store.get_map(last))
+            self.log.dout(1, f"resumed at osdmap e{last}")
+            return
+        # genesis map: crush root + the default replicated rule
+        # (reference OSDMonitor::create_initial)
+        inc = Incremental(1)
+        crush = CrushWrapper()
+        crush.add_bucket("default", "root")
+        crush.add_simple_rule("replicated_rule", "default", "host",
+                              mode="firstn", pool_type="replicated")
+        inc.new_crush = crush
+        self._commit(inc)
+
+    def start(self) -> None:
+        self.msgr.start()
+        self._tick_thread = threading.Thread(
+            target=self._tick_loop, name=f"{self.name}-tick", daemon=True)
+        self._tick_thread.start()
+        self.log.dout(1, f"listening on {self.my_addr}")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self.msgr.shutdown()
+        if self._tick_thread:
+            self._tick_thread.join(timeout=5)
+        self.store.close()
+
+    # ------------------------------------------------------------------
+    # map commit + publish (reference Paxos propose/commit -> publish)
+    # ------------------------------------------------------------------
+    def _commit(self, inc: Incremental) -> None:
+        """Caller need not hold the lock; commits serialize on it."""
+        with self.lock:
+            self.osdmap.apply_incremental(inc)
+            wire = self.osdmap.to_wire_dict()
+            self.store.put_map(self.osdmap.epoch, wire)
+            targets = [(conn, since) for conn, since in self.subs.items()
+                       if since <= self.osdmap.epoch]
+            for conn, _ in targets:
+                self.subs[conn] = self.osdmap.epoch + 1
+            epoch = self.osdmap.epoch
+        for conn, _ in targets:
+            conn.send_message(MOSDMap(maps={epoch: wire}))
+
+    def _pending(self) -> Incremental:
+        return Incremental(self.osdmap.epoch + 1)
+
+    # ------------------------------------------------------------------
+    # dispatch
+    # ------------------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMonSubscribe):
+            self._handle_subscribe(conn, msg)
+        elif isinstance(msg, MMonCommand):
+            self._handle_command(conn, msg)
+        elif isinstance(msg, MOSDBoot):
+            self._handle_boot(conn, msg)
+        elif isinstance(msg, MOSDFailure):
+            self._handle_failure(conn, msg)
+        elif isinstance(msg, MPGStats):
+            self._handle_pg_stats(conn, msg)
+        else:
+            return False
+        return True
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        with self.lock:
+            self.subs.pop(conn, None)
+
+    def _handle_subscribe(self, conn: Connection, msg: MMonSubscribe
+                          ) -> None:
+        want = msg.what.get("osdmap")
+        if want is None:
+            return
+        with self.lock:
+            epoch = self.osdmap.epoch
+            wire = self.osdmap.to_wire_dict() if epoch >= want else None
+            self.subs[conn] = epoch + 1
+        if wire is not None:
+            conn.send_message(MOSDMap(maps={epoch: wire}))
+
+    # ------------------------------------------------------------------
+    # OSD boot (reference OSDMonitor::prepare_boot)
+    # ------------------------------------------------------------------
+    def _handle_boot(self, conn: Connection, msg: MOSDBoot) -> None:
+        osd, addr = msg.osd, tuple(msg.addr)
+        with self.lock:
+            info = self.osdmap.osds.get(osd)
+            if info is not None and info.up and info.addr == addr:
+                return                   # duplicate boot
+            self._booted_addr[osd] = addr
+            inc = self._pending()
+            inc.new_up[osd] = addr
+            crush = self.osdmap.crush
+            if f"osd.{osd}" not in crush.name_ids:
+                # auto-create the crush item under a per-OSD host
+                # (vstart-style dev topology; reference `osd crush
+                # create-or-move` run by the OSD's init script)
+                crush = self._crush_clone()
+                host = f"host{osd}"
+                if host not in crush.name_ids:
+                    crush.add_bucket(host, "host")
+                    crush.insert_item(crush.name_ids[host], 0, host,
+                                      "default")
+                crush.insert_item(osd, 1.0, f"osd.{osd}", host)
+                inc.new_crush = crush
+            self._commit(inc)
+        self.log.dout(1, f"osd.{osd} booted at {addr}")
+
+    def _crush_clone(self) -> CrushWrapper:
+        return CrushWrapper.from_wire_dict(
+            self.osdmap.crush.to_wire_dict())
+
+    # ------------------------------------------------------------------
+    # failure reports (reference OSDMonitor::prepare_failure :3257)
+    # ------------------------------------------------------------------
+    def _reporter_subtree(self, osd: int) -> str:
+        """The failure-domain ancestor of a reporter (reference
+        mon_osd_reporter_subtree_level): two reports only count as
+        independent if they come from different subtrees."""
+        level = self.conf["mon_osd_reporter_subtree_level"]
+        crush = self.osdmap.crush
+        name = f"osd.{osd}"
+        try:
+            return crush.ancestor_of(name, level)
+        except (KeyError, AttributeError):
+            return name                  # no topology: every osd counts
+
+    def _handle_failure(self, conn: Connection, msg: MOSDFailure) -> None:
+        now = time.monotonic()
+        mark_down = False
+        with self.lock:
+            if not self.osdmap.is_up(msg.target_osd):
+                return
+            reports = self.failure_reports.setdefault(msg.target_osd, {})
+            reports[msg.from_osd] = (now, msg.failed_for)
+            subtrees = {self._reporter_subtree(r) for r in reports}
+            need = self.conf["mon_osd_min_down_reporters"]
+            up_others = sum(1 for o, i in self.osdmap.osds.items()
+                            if i.up and o != msg.target_osd)
+            need = min(need, max(up_others, 1))
+            if len(subtrees) >= need:
+                mark_down = True
+                del self.failure_reports[msg.target_osd]
+                inc = self._pending()
+                inc.new_down.append(msg.target_osd)
+                self._commit(inc)
+        if mark_down:
+            self.log.dout(1, f"marking osd.{msg.target_osd} down "
+                            f"({len(reports)} reporters)")
+
+    # ------------------------------------------------------------------
+    # pg stats (reference MgrStatMonitor; health for wait_for_clean)
+    # ------------------------------------------------------------------
+    def _handle_pg_stats(self, conn: Connection, msg: MPGStats) -> None:
+        with self.lock:
+            for pgid, stat in msg.pg_stats.items():
+                old = self.pg_stats.get(pgid)
+                if old is not None and old.get("_epoch", 0) > msg.epoch:
+                    continue             # stale reporter
+                stat = dict(stat)
+                stat["_epoch"] = msg.epoch
+                self.pg_stats[pgid] = stat
+                self.pg_stats_from[pgid] = msg.from_osd
+
+    def _health_summary_locked(self) -> dict:
+        expected = sum(p.pg_num for p in self.osdmap.pools.values())
+        states: Dict[str, int] = {}
+        known = 0
+        for pgid, stat in self.pg_stats.items():
+            pool = pgid.split(".", 1)[0]
+            if int(pool) not in self.osdmap.pools:
+                continue
+            # a stat predating the current map may describe a dead
+            # interval (e.g. "clean" from before an OSD died); count
+            # it as not-yet-reported so wait_for_clean blocks until
+            # the live primary reports at this epoch (the reference
+            # gates on pg_stat_t::reported_epoch the same way)
+            if stat.get("_epoch", 0) < self.osdmap.epoch:
+                continue
+            known += 1
+            states[stat.get("state", "unknown")] = \
+                states.get(stat.get("state", "unknown"), 0) + 1
+        clean = states.get("active+clean", 0)
+        degraded = sum(n for s, n in states.items() if "degraded" in s
+                       or "recovering" in s)
+        if expected == 0 or (known >= expected and clean == known):
+            status = "HEALTH_OK"
+        elif degraded or known < expected:
+            status = "HEALTH_WARN"
+        else:
+            status = "HEALTH_WARN"
+        return {"status": status, "num_pgs": expected,
+                "num_pgs_reported": known, "pg_states": states,
+                "all_clean": expected > 0 and known >= expected
+                and clean == known}
+
+    # ------------------------------------------------------------------
+    # tick: down->out aging (reference mon_osd_down_out_interval)
+    # ------------------------------------------------------------------
+    def _tick_loop(self) -> None:
+        interval = self.conf["mon_tick_interval"]
+        while not self._stop.wait(interval):
+            self._tick()
+
+    def _tick(self) -> None:
+        down_out = self.conf["mon_osd_down_out_interval"]
+        if down_out <= 0:
+            return
+        inc = None
+        with self.lock:
+            now_epoch = self.osdmap.epoch
+            for osd, info in self.osdmap.osds.items():
+                if info.up or info.weight == 0:
+                    continue
+                # age by epochs-as-time: down_at records the epoch; use
+                # wall time via _down_since bookkeeping instead
+                since = self._down_since.get(osd)
+                if since is None:
+                    self._down_since[osd] = time.monotonic()
+                elif time.monotonic() - since >= down_out:
+                    if inc is None:
+                        inc = self._pending()
+                    inc.new_weight[osd] = 0
+                    self.log.dout(1, f"osd.{osd} down > {down_out}s:"
+                                  f" marking out")
+            for osd in list(self._down_since):
+                info = self.osdmap.osds.get(osd)
+                if info is None or info.up:
+                    del self._down_since[osd]
+            if inc is not None:
+                self._commit(inc)
+
+    # ------------------------------------------------------------------
+    # commands (reference mon/MonCommands.h table + OSDMonitor handlers)
+    # ------------------------------------------------------------------
+    def _handle_command(self, conn: Connection, msg: MMonCommand) -> None:
+        cmd = msg.cmd
+        prefix = cmd.get("prefix", "")
+        handler = self.COMMANDS.get(prefix)
+        if handler is None:
+            ack = MMonCommandAck(tid=msg.tid, retcode=-22,
+                                 rs=f"unknown command {prefix!r}")
+        else:
+            try:
+                retcode, rs, out = handler(self, cmd)
+                ack = MMonCommandAck(tid=msg.tid, retcode=retcode, rs=rs,
+                                     out=out)
+            except Exception as e:       # command errors go to the CLI
+                ack = MMonCommandAck(tid=msg.tid, retcode=-22, rs=str(e))
+        conn.send_message(ack)
+
+    # -- erasure-code profiles (reference OSDMonitor.cc:10829,7492) ----
+    @staticmethod
+    def parse_profile(items: List[str]) -> Dict[str, str]:
+        """k=v list -> profile map (reference
+        parse_erasure_code_profile, OSDMonitor.cc:7492)."""
+        prof: Dict[str, str] = {}
+        for item in items:
+            if "=" not in item:
+                raise ValueError(f"profile entry {item!r} is not k=v")
+            key, val = item.split("=", 1)
+            prof[key.strip()] = val.strip()
+        return prof
+
+    def _cmd_profile_set(self, cmd: dict):
+        name = cmd["name"]
+        prof = self.parse_profile(cmd.get("profile", []))
+        prof.setdefault("plugin", "jerasure")
+        force = cmd.get("force", False)
+        with self.lock:
+            existing = self.osdmap.erasure_code_profiles.get(name)
+            if existing is not None and existing != prof and not force:
+                in_use = any(p.erasure_code_profile == name
+                             for p in self.osdmap.pools.values())
+                if in_use:
+                    return (-16, f"profile {name} is in use and differs; "
+                            f"--force to override", {})
+        # validate by instantiating the plugin, as the reference's
+        # monitor does (OSDMonitor.cc:7371-7392) — a bad k/m/technique
+        # fails here, before the profile ever reaches the map
+        try:
+            check = dict(prof)
+            plugin = check.pop("plugin")
+            self.ec_registry.factory(plugin, check)
+        except Exception as e:
+            return (-22, f"invalid profile: {e}", {})
+        with self.lock:
+            inc = self._pending()
+            inc.new_profiles[name] = prof
+            self._commit(inc)
+        return (0, f"profile {name} set", {})
+
+    def _cmd_profile_get(self, cmd: dict):
+        with self.lock:
+            prof = self.osdmap.erasure_code_profiles.get(cmd["name"])
+        if prof is None:
+            return (-2, f"no profile {cmd['name']}", {})
+        return (0, "", dict(prof))
+
+    def _cmd_profile_ls(self, cmd: dict):
+        with self.lock:
+            return (0, "", {"profiles":
+                            sorted(self.osdmap.erasure_code_profiles)})
+
+    def _cmd_profile_rm(self, cmd: dict):
+        name = cmd["name"]
+        with self.lock:
+            if any(p.erasure_code_profile == name
+                   for p in self.osdmap.pools.values()):
+                return (-16, f"profile {name} is in use", {})
+            if name not in self.osdmap.erasure_code_profiles:
+                return (0, "", {})
+            inc = self._pending()
+            inc.old_profiles.append(name)
+            self._commit(inc)
+        return (0, f"profile {name} removed", {})
+
+    # -- pools (reference OSDMonitor::prepare_new_pool :7216) -----------
+    def _cmd_pool_create(self, cmd: dict):
+        name = cmd["pool"]
+        pool_type = cmd.get("pool_type", POOL_TYPE_REPLICATED)
+        pg_num = int(cmd.get("pg_num",
+                             self.conf["osd_pool_default_pg_num"]))
+        with self.lock:
+            if self.osdmap.get_pool(name) is not None:
+                return (0, f"pool {name} exists", {})
+            pid = self.osdmap._next_pool_id
+        if pool_type == POOL_TYPE_ERASURE:
+            prof_name = cmd.get("erasure_code_profile", "default")
+            with self.lock:
+                prof = self.osdmap.erasure_code_profiles.get(prof_name)
+            if prof is None:
+                return (-2, f"no erasure profile {prof_name}", {})
+            check = dict(prof)
+            plugin = check.pop("plugin", "jerasure")
+            try:
+                ec = self.ec_registry.factory(plugin, check)
+            except Exception as e:
+                return (-22, f"profile {prof_name} invalid: {e}", {})
+            k = ec.get_data_chunk_count()
+            size = ec.get_chunk_count()
+            m = size - k
+            # reference: EC min_size = k + min(1, m) (can't serve
+            # writes below k shards; one spare before inactivity)
+            min_size = k + (1 if m >= 2 else 0)
+            stripe_unit = int(prof.get("stripe_unit",
+                                       DEFAULT_STRIPE_UNIT))
+            stripe_width = k * stripe_unit
+            rule_name = cmd.get("rule", f"ecrule_{prof_name}")
+            failure_domain = prof.get("crush-failure-domain", "host")
+            with self.lock:
+                crush = self._crush_clone()
+                try:
+                    rule_id = crush.rule_id(rule_name)
+                except KeyError:
+                    # reference ErasureCodeInterface::create_rule ->
+                    # add_simple_rule(..., "indep", TYPE_ERASURE)
+                    # (erasure-code/ErasureCode.cc:64-83)
+                    rule_id = crush.add_simple_rule(
+                        rule_name, prof.get("crush-root", "default"),
+                        failure_domain, mode="indep",
+                        pool_type="erasure")
+                pool = PGPool(name=name, pool_id=pid,
+                              type=POOL_TYPE_ERASURE, size=size,
+                              min_size=min_size, pg_num=pg_num,
+                              crush_rule=rule_id,
+                              erasure_code_profile=prof_name,
+                              stripe_width=stripe_width,
+                              ec_overwrites=False)
+                inc = self._pending()
+                inc.new_crush = crush
+                inc.new_pools[pid] = pool
+                self._commit(inc)
+        else:
+            size = int(cmd.get("size", self.conf["osd_pool_default_size"]))
+            min_size = int(cmd.get("min_size") or
+                           self.conf["osd_pool_default_min_size"] or
+                           max(1, size - size // 2))
+            with self.lock:
+                crush = self.osdmap.crush
+                try:
+                    rule_id = crush.rule_id(cmd.get("rule",
+                                                    "replicated_rule"))
+                except KeyError:
+                    return (-2, "no such crush rule", {})
+                pool = PGPool(name=name, pool_id=pid,
+                              type=POOL_TYPE_REPLICATED, size=size,
+                              min_size=min_size, pg_num=pg_num,
+                              crush_rule=rule_id)
+                inc = self._pending()
+                inc.new_pools[pid] = pool
+                self._commit(inc)
+        return (0, f"pool '{name}' created", {"pool_id": pid})
+
+    def _cmd_pool_set(self, cmd: dict):
+        """osd pool set <pool> <var> <val> (reference
+        OSDMonitor::prepare_command_pool_set); the variable the EC
+        tests rely on is allow_ec_overwrites."""
+        with self.lock:
+            pool = self.osdmap.get_pool(cmd["pool"])
+            if pool is None:
+                return (-2, f"no pool {cmd['pool']}", {})
+            var, val = cmd["var"], str(cmd.get("val", ""))
+            import copy as _copy
+            newpool = _copy.deepcopy(pool)
+            if var == "allow_ec_overwrites":
+                if not pool.is_erasure():
+                    return (-22, "pool is not erasure", {})
+                newpool.ec_overwrites = val.lower() in ("1", "true",
+                                                        "yes")
+            elif var == "size":
+                newpool.size = int(val)
+            elif var == "min_size":
+                newpool.min_size = int(val)
+            elif var == "pg_num":
+                newpool.pg_num = int(val)
+            else:
+                return (-22, f"unknown pool var {var}", {})
+            inc = self._pending()
+            inc.new_pools[pool.pool_id] = newpool
+            self._commit(inc)
+        return (0, "set", {})
+
+    def _cmd_pool_delete(self, cmd: dict):
+        with self.lock:
+            pool = self.osdmap.get_pool(cmd["pool"])
+            if pool is None:
+                return (-2, f"no pool {cmd['pool']}", {})
+            inc = self._pending()
+            inc.old_pools.append(pool.pool_id)
+            self._commit(inc)
+        return (0, f"pool {cmd['pool']} removed", {})
+
+    def _cmd_pool_ls(self, cmd: dict):
+        with self.lock:
+            return (0, "", {"pools": [p.name for p in
+                                      self.osdmap.pools.values()]})
+
+    # -- osd state (reference OSDMonitor out/in/down handlers) ----------
+    def _osd_ids(self, cmd: dict) -> List[int]:
+        ids = cmd.get("ids", [])
+        if isinstance(ids, (int, str)):
+            ids = [ids]
+        return [int(i) for i in ids]
+
+    def _cmd_osd_out(self, cmd: dict):
+        with self.lock:
+            inc = self._pending()
+            for osd in self._osd_ids(cmd):
+                inc.new_weight[osd] = 0
+            self._commit(inc)
+        return (0, "marked out", {})
+
+    def _cmd_osd_in(self, cmd: dict):
+        with self.lock:
+            inc = self._pending()
+            for osd in self._osd_ids(cmd):
+                inc.new_weight[osd] = 0x10000
+            self._commit(inc)
+        return (0, "marked in", {})
+
+    def _cmd_osd_down(self, cmd: dict):
+        with self.lock:
+            inc = self._pending()
+            for osd in self._osd_ids(cmd):
+                if self.osdmap.is_up(osd):
+                    inc.new_down.append(osd)
+            self._commit(inc)
+        return (0, "marked down", {})
+
+    def _cmd_osd_dump(self, cmd: dict):
+        with self.lock:
+            return (0, "", self.osdmap.dump())
+
+    def _cmd_osd_tree(self, cmd: dict):
+        with self.lock:
+            return (0, "", self.osdmap.crush.dump())
+
+    def _cmd_status(self, cmd: dict):
+        with self.lock:
+            health = self._health_summary_locked()
+            n_up = sum(1 for i in self.osdmap.osds.values() if i.up)
+            n_in = sum(1 for i in self.osdmap.osds.values()
+                       if i.weight > 0)
+            return (0, "", {
+                "health": health,
+                "osdmap": {"epoch": self.osdmap.epoch,
+                           "num_osds": len(self.osdmap.osds),
+                           "num_up_osds": n_up, "num_in_osds": n_in},
+                "pgmap": {"num_pgs": health["num_pgs"],
+                          "pgs_by_state": health["pg_states"]},
+            })
+
+    def _cmd_health(self, cmd: dict):
+        with self.lock:
+            return (0, "", self._health_summary_locked())
+
+    def _cmd_pg_stat(self, cmd: dict):
+        with self.lock:
+            return (0, "", {"pg_stats": dict(self.pg_stats)})
+
+    def _cmd_pg_dump(self, cmd: dict):
+        with self.lock:
+            return (0, "", {
+                "pg_stats": dict(self.pg_stats),
+                "reported_by": dict(self.pg_stats_from)})
+
+    def _cmd_config_set(self, cmd: dict):
+        try:
+            self.conf.set(cmd["name"], cmd["value"])
+        except (KeyError, ValueError) as e:
+            return (-22, str(e), {})
+        return (0, "", {})
+
+    def _cmd_config_get(self, cmd: dict):
+        try:
+            return (0, "", {"value": self.conf.get(cmd["name"])})
+        except KeyError as e:
+            return (-2, str(e), {})
+
+    COMMANDS = {
+        "osd erasure-code-profile set": _cmd_profile_set,
+        "osd erasure-code-profile get": _cmd_profile_get,
+        "osd erasure-code-profile ls": _cmd_profile_ls,
+        "osd erasure-code-profile rm": _cmd_profile_rm,
+        "osd pool create": _cmd_pool_create,
+        "osd pool set": _cmd_pool_set,
+        "osd pool delete": _cmd_pool_delete,
+        "osd pool ls": _cmd_pool_ls,
+        "osd out": _cmd_osd_out,
+        "osd in": _cmd_osd_in,
+        "osd down": _cmd_osd_down,
+        "osd dump": _cmd_osd_dump,
+        "osd tree": _cmd_osd_tree,
+        "status": _cmd_status,
+        "health": _cmd_health,
+        "pg stat": _cmd_pg_stat,
+        "pg dump": _cmd_pg_dump,
+        "config set": _cmd_config_set,
+        "config get": _cmd_config_get,
+    }

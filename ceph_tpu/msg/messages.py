@@ -446,6 +446,36 @@ class MOSDPGPush(Message):
 
 
 @register
+class MOSDPGPull(Message):
+    """Primary -> surviving replica: send me these objects — the
+    primary itself is missing them (reference MSG_OSD_PG_PULL,
+    messages/MOSDPGPull.h; the holder answers with MOSDPGPush)."""
+    TYPE = 107
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, epoch: int = 0,
+                 oids: Optional[List[str]] = None):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard           # the holder's shard position
+        self.from_osd = from_osd
+        self.epoch = epoch
+        self.oids = oids or []
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.shard).i32(self.from_osd)
+        e.u32(self.epoch).str_list(self.oids)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPGPull":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                   epoch=d.u32(), oids=d.str_list())
+
+
+@register
 class MOSDPGPushReply(Message):
     TYPE = 106
 
@@ -609,33 +639,41 @@ class MOSDPGQuery(Message):
 
 @register
 class MOSDPGNotify(Message):
-    """Acting member -> primary: my info + full (bounded) log
-    (reference messages/MOSDPGNotify.h; ships the whole in-memory log
-    instead of the reference's incremental slices — it is bounded at
-    PGLog.max_entries)."""
+    """Acting member -> primary: my info + full (bounded) log + my
+    persistent missing set (reference messages/MOSDPGNotify.h carries
+    pg_info_t; the missing set rides MOSDPGLog in the reference —
+    shipping it in the notify keeps peering one round trip).  The
+    missing set matters when a shard's *log* is current but its *data*
+    is not (log adopted, recovery interrupted by an interval change):
+    without it the primary would see no log delta and wrongly assume
+    the shard is whole."""
     TYPE = 81
 
     def __init__(self, pgid: str = "", shard: int = -1,
                  from_osd: int = -1, epoch: int = 0,
-                 log: Optional[dict] = None):
+                 log: Optional[dict] = None,
+                 missing: Optional[dict] = None):
         super().__init__()
         self.pgid = pgid
         self.shard = shard           # replying shard position
         self.from_osd = from_osd
         self.epoch = epoch
         self.log = log or {}         # PGLog.to_dict()
+        self.missing = missing or {}  # MissingSet.to_dict()
 
     def encode_payload(self) -> bytes:
         e = Encoder()
         e.str(self.pgid).i32(self.shard).i32(self.from_osd)
         e.u32(self.epoch).bytes(_enc_json(self.log))
+        e.bytes(_enc_json(self.missing))
         return e.build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDPGNotify":
         d = Decoder(buf)
         return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
-                   epoch=d.u32(), log=_dec_json(d.bytes()))
+                   epoch=d.u32(), log=_dec_json(d.bytes()),
+                   missing=_dec_json(d.bytes()))
 
 
 @register

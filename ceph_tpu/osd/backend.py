@@ -149,6 +149,12 @@ class PGHost(abc.ABC):
         """The pool's erasure-code profile (EC pools only)."""
         raise NotImplementedError
 
+    def note_object_recovered(self, oid: str, version) -> None:
+        """A recovery push for ``oid`` committed locally: drop it from
+        this shard's persistent missing set (reference
+        recover_got / pg_missing_t::got).  Default no-op for fake
+        hosts."""
+
 
 class PGBackend(abc.ABC):
     """Abstract storage strategy (reference PGBackend.h)."""

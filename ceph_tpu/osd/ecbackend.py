@@ -599,8 +599,12 @@ class ECBackend(PGBackend):
             txn.write(coll, obj, push.data_offset, push.data)
         if push.attrs:
             txn.setattrs(coll, obj, push.attrs)
+
+        def committed() -> None:
+            self.host.note_object_recovered(push.oid, push.version)
+            on_commit()
         txn.register_on_commit(
-            lambda: self.host.on_local_commit(on_commit))
+            lambda: self.host.on_local_commit(committed))
         self.host.store.queue_transactions([txn])
 
     def _push_acked(self, oid: str, shard: int) -> None:

@@ -1,0 +1,426 @@
+"""OSD daemon — hosts PGs, serves clients, heartbeats peers.
+
+Python-native equivalent of the reference's OSD/OSDService (reference
+src/osd/OSD.{h,cc} 10.8k LoC) reduced to the daemon duties the
+framework's PG/backend stack needs:
+
+* **boot** (reference OSD::init :3262 + _send_boot): mount the store,
+  subscribe to osdmaps, announce ourselves to the monitor (MOSDBoot);
+  restart is resume — PGs reload their logs from the store when the
+  first map arrives;
+* **map handling** (reference handle_osd_map :7753 +
+  handle_advance_map): every published epoch advances all hosted PGs;
+  PGs are instantiated on demand for any pool whose CRUSH mapping
+  places a shard here (reference load_pgs / handle_pg_create);
+* **op dispatch** (reference ms_fast_dispatch :7008 -> enqueue_op
+  :9612 -> op_shardedwq): client MOSDOps land in a sharded op queue
+  (``osd_op_num_shards`` × ``osd_op_num_threads_per_shard`` workers,
+  reference common/options.cc:2869-2901) hashed by PG so per-PG order
+  holds — **this queue is the TPU plugin's batching point** (SURVEY.md
+  §3.1): stripes from many in-flight ops on different PGs gather into
+  one device call; backend sub-ops fast-dispatch inline (reference
+  fast dispatch bypasses the queue for sub-ops);
+* **heartbeats + failure reports** (reference OSD.cc:5079-5632): ping
+  every up peer on an interval; a peer silent past
+  ``osd_heartbeat_grace`` is reported to the monitor (MOSDFailure),
+  which marks it down once enough distinct reporters agree;
+* **recovery driving** (reference start_recovery_ops + recovery wq):
+  a background thread drains primary PGs' missing sets through their
+  backends, ``osd_recovery_max_active`` object recoveries at a time;
+* **PG stats** (reference MPGStats tick): primaries report per-PG
+  state to the monitor, feeding ``status``/``wait_for_clean``.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..ec import registry as ec_registry
+from ..mon.client import MonClient
+from ..msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
+                            MOSDECSubOpWrite, MOSDECSubOpWriteReply,
+                            MOSDMap, MOSDOp, MOSDPGLog, MOSDPGNotify,
+                            MOSDPGPush, MOSDPGPushReply, MOSDPGQuery,
+                            MOSDPing, MOSDRepOp, MOSDRepOpReply)
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..store.objectstore import ObjectStore
+from ..utils.config import Config, default_config
+from ..utils.log import Dout
+from .osdmap import OSDMap, PGid
+from .pg import PG, STATE_ACTIVE, STATE_PEERING
+
+_BACKEND_MSGS = (MOSDECSubOpWrite, MOSDECSubOpWriteReply,
+                 MOSDECSubOpRead, MOSDECSubOpReadReply,
+                 MOSDRepOp, MOSDRepOpReply, MOSDPGPush, MOSDPGPushReply)
+_PEERING_MSGS = (MOSDPGQuery, MOSDPGNotify, MOSDPGLog)
+
+
+class OSDService:
+    """The narrow service surface PGs and backends consume (reference
+    OSDService in osd/OSD.h)."""
+
+    def __init__(self, osd: "OSD"):
+        self._osd = osd
+
+    @property
+    def whoami(self) -> int:
+        return self._osd.whoami
+
+    @property
+    def conf(self) -> Config:
+        return self._osd.conf
+
+    @property
+    def store(self) -> ObjectStore:
+        return self._osd.store
+
+    @property
+    def ec_registry(self):
+        return self._osd.ec_registry
+
+    def get_osdmap(self) -> OSDMap:
+        return self._osd.osdmap
+
+    def send_osd(self, osd: int, msg) -> None:
+        self._osd.send_osd(osd, msg)
+
+    def pg_activated(self, pg: PG) -> None:
+        self._osd.kick_recovery()
+
+    def kick_recovery(self, pg: Optional[PG] = None) -> None:
+        self._osd.kick_recovery()
+
+
+class OSD(Dispatcher):
+    """One object-storage daemon (reference ceph_osd.cc + OSD.cc)."""
+
+    def __init__(self, whoami: int, store: ObjectStore,
+                 mon_addr: Tuple[str, int],
+                 conf: Optional[Config] = None,
+                 addr: Tuple[str, int] = ("127.0.0.1", 0)):
+        self.whoami = whoami
+        self.store = store
+        self.conf = conf or default_config()
+        self.log = Dout("osd", f"osd.{whoami} ")
+        self.ec_registry = ec_registry.instance()
+        self.osdmap = OSDMap()
+        self.map_lock = threading.RLock()
+        self.pgs: Dict[PGid, PG] = {}
+        self.pg_lock = threading.RLock()
+        self.service = OSDService(self)
+        self.msgr = Messenger(f"osd.{whoami}", conf=self.conf)
+        self.my_addr = self.msgr.bind(addr)
+        self.msgr.add_dispatcher(self)
+        self.monc = MonClient(self.msgr, mon_addr,
+                              map_cb=self._on_map_published)
+        # sharded op queue (reference op_shardedwq, OSD.h:1287)
+        self._n_shards = self.conf["osd_op_num_shards"]
+        self._shard_queues: List[queue.Queue] = [
+            queue.Queue() for _ in range(self._n_shards)]
+        self._workers: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._recovery_kick = threading.Event()
+        # heartbeat state: peer -> last reply time (reference
+        # HeartbeatInfo, OSD.h)
+        self._hb_last_rx: Dict[int, float] = {}
+        self._hb_reported: Dict[int, float] = {}
+        self._threads: List[threading.Thread] = []
+
+    # ------------------------------------------------------------------
+    # lifecycle (reference OSD::init)
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self.msgr.start()
+        for shard in range(self._n_shards):
+            for t in range(self.conf["osd_op_num_threads_per_shard"]):
+                w = threading.Thread(
+                    target=self._op_worker, args=(shard,),
+                    name=f"osd{self.whoami}-op-{shard}.{t}", daemon=True)
+                w.start()
+                self._workers.append(w)
+        for target, name in ((self._recovery_loop, "recovery"),
+                             (self._heartbeat_loop, "hb"),
+                             (self._tick_loop, "tick")):
+            t = threading.Thread(target=target,
+                                 name=f"osd{self.whoami}-{name}",
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+        self.monc.subscribe_osdmap()
+        self.monc.send_boot(self.whoami, self.my_addr)
+        self.log.dout(1, f"booted, addr {self.my_addr}")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._recovery_kick.set()
+        for q in self._shard_queues:
+            q.put(None)
+        self.msgr.shutdown()
+        for t in self._workers + self._threads:
+            t.join(timeout=5)
+        try:
+            self.store.umount()
+        except Exception:
+            pass
+
+    # ------------------------------------------------------------------
+    # map handling (reference handle_osd_map :7753)
+    # ------------------------------------------------------------------
+    def _on_map_published(self, wire: dict) -> None:
+        newmap = OSDMap.from_wire_dict(wire)
+        with self.map_lock:
+            if newmap.epoch <= self.osdmap.epoch:
+                return
+            self.osdmap = newmap
+        self._advance_pgs(newmap)
+        # if the monitor thinks we're down (e.g. spurious failure
+        # reports) but we're alive, re-boot (reference OSD re-sends
+        # MOSDBoot when marked down while up)
+        info = newmap.osds.get(self.whoami)
+        if info is not None and not info.up and not self._stop.is_set():
+            self.monc.send_boot(self.whoami, self.my_addr)
+
+    def _advance_pgs(self, osdmap: OSDMap) -> None:
+        """Instantiate PGs mapped here and advance every hosted PG
+        (reference consume_map / handle_pg_create)."""
+        for pool_id in list(osdmap.pools):
+            for pgid in osdmap.pgs_for_pool(pool_id):
+                _, _, acting, _ = osdmap.pg_to_up_acting_osds(pgid)
+                if self.whoami in [o for o in acting if o is not None]:
+                    self._ensure_pg(pgid, osdmap)
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            pg.advance_map(osdmap)
+
+    def _ensure_pg(self, pgid: PGid, osdmap: OSDMap) -> Optional[PG]:
+        with self.pg_lock:
+            pg = self.pgs.get(pgid)
+            if pg is not None:
+                return pg
+            pool = osdmap.get_pool(pgid.pool)
+            if pool is None:
+                return None
+            pg = PG(self.service, pgid, pool)
+            self.pgs[pgid] = pg
+            return pg
+
+    def _lookup_pg(self, pgid: PGid, create: bool = True
+                   ) -> Optional[PG]:
+        with self.pg_lock:
+            pg = self.pgs.get(pgid)
+        if pg is not None:
+            return pg
+        if not create:
+            return None
+        # message raced our map: create if the current map places this
+        # PG here (reference wait-for-map + create semantics)
+        with self.map_lock:
+            osdmap = self.osdmap
+        if pgid.pool not in osdmap.pools:
+            return None
+        _, _, acting, _ = osdmap.pg_to_up_acting_osds(pgid)
+        if self.whoami not in [o for o in acting if o is not None]:
+            return None
+        pg = self._ensure_pg(pgid, osdmap)
+        if pg is not None:
+            pg.advance_map(osdmap)
+        return pg
+
+    # ------------------------------------------------------------------
+    # dispatch (reference ms_fast_dispatch :7008)
+    # ------------------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MOSDOp):
+            self._enqueue_op(conn, msg)
+            return True
+        if isinstance(msg, _BACKEND_MSGS):
+            pgid = PGid.parse(msg.pgid)
+            pg = self._lookup_pg(pgid)
+            if pg is not None:
+                with pg.lock:
+                    pg.backend.handle_message(msg)
+            return True
+        if isinstance(msg, _PEERING_MSGS):
+            pgid = PGid.parse(msg.pgid)
+            pg = self._lookup_pg(pgid)
+            if pg is None:
+                return True
+            if isinstance(msg, MOSDPGQuery):
+                pg.handle_pg_query(msg)
+            elif isinstance(msg, MOSDPGNotify):
+                pg.handle_pg_notify(msg)
+            else:
+                pg.handle_pg_log(msg)
+            return True
+        if isinstance(msg, MOSDPing):
+            self._handle_ping(conn, msg)
+            return True
+        return False        # MOSDMap etc. fall through to the MonClient
+
+    # -- sharded op queue (reference enqueue_op/dequeue_op) -------------
+    def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
+        pgid = PGid(msg.pool, msg.pgid_seed)
+        shard = hash(pgid) % self._n_shards
+        self._shard_queues[shard].put((conn, msg))
+
+    def _op_worker(self, shard: int) -> None:
+        q = self._shard_queues[shard]
+        while True:
+            item = q.get()
+            if item is None:
+                return
+            conn, msg = item
+            pgid = PGid(msg.pool, msg.pgid_seed)
+            pg = self._lookup_pg(pgid)
+            if pg is None:
+                # not our PG: tell the client to refresh its map
+                from ..msg.messages import MOSDOpReply
+                conn.send_message(MOSDOpReply(
+                    tid=msg.tid, result=-108, epoch=self.osdmap.epoch))
+                continue
+            try:
+                pg.do_request(msg, conn)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    # ------------------------------------------------------------------
+    # peer messaging
+    # ------------------------------------------------------------------
+    def send_osd(self, osd: int, msg) -> None:
+        if osd == self.whoami:
+            # local delivery loops through dispatch (the reference
+            # short-circuits local sub-ops similarly)
+            self.ms_dispatch(None, msg)
+            return
+        with self.map_lock:
+            addr = self.osdmap.get_addr(osd)
+        if addr is None:
+            self.log.dout(10, f"no addr for osd.{osd}, dropping "
+                          f"{type(msg).__name__}")
+            return
+        self.msgr.connect_to(addr, lossless=True).send_message(msg)
+
+    # ------------------------------------------------------------------
+    # heartbeats (reference OSD.cc:5079-5632)
+    # ------------------------------------------------------------------
+    def _hb_peers(self) -> List[int]:
+        with self.map_lock:
+            return [o for o, info in self.osdmap.osds.items()
+                    if info.up and o != self.whoami]
+
+    def _handle_ping(self, conn: Connection, msg: MOSDPing) -> None:
+        if msg.op == MOSDPing.PING:
+            self.send_osd(msg.from_osd, MOSDPing(
+                op=MOSDPing.PING_REPLY, from_osd=self.whoami,
+                epoch=self.osdmap.epoch, stamp=msg.stamp))
+        else:
+            self._hb_last_rx[msg.from_osd] = time.monotonic()
+
+    def _heartbeat_loop(self) -> None:
+        interval = self.conf["osd_heartbeat_interval"]
+        while not self._stop.wait(interval):
+            grace = self.conf["osd_heartbeat_grace"]
+            now = time.monotonic()
+            for peer in self._hb_peers():
+                last = self._hb_last_rx.get(peer)
+                if last is None:
+                    self._hb_last_rx[peer] = now   # grace starts now
+                elif now - last > grace:
+                    reported = self._hb_reported.get(peer, 0)
+                    if now - reported > grace:
+                        self._hb_reported[peer] = now
+                        self.log.dout(1, f"osd.{peer} silent "
+                                      f"{now - last:.1f}s, reporting")
+                        try:
+                            self.monc.report_failure(
+                                peer, self.whoami, now - last,
+                                self.osdmap.epoch)
+                        except Exception:
+                            pass
+                self.send_osd(peer, MOSDPing(
+                    op=MOSDPing.PING, from_osd=self.whoami,
+                    epoch=self.osdmap.epoch, stamp=now))
+            # forget peers no longer up (map took them out)
+            up = set(self._hb_peers())
+            for peer in list(self._hb_last_rx):
+                if peer not in up:
+                    self._hb_last_rx.pop(peer, None)
+                    self._hb_reported.pop(peer, None)
+
+    # ------------------------------------------------------------------
+    # recovery (reference start_recovery_ops + recovery_wq)
+    # ------------------------------------------------------------------
+    def kick_recovery(self) -> None:
+        self._recovery_kick.set()
+
+    def _recovery_loop(self) -> None:
+        max_active = self.conf["osd_recovery_max_active"]
+        sleep = self.conf["osd_recovery_sleep"]
+        while not self._stop.is_set():
+            self._recovery_kick.wait(timeout=0.2)
+            self._recovery_kick.clear()
+            if self._stop.is_set():
+                return
+            with self.pg_lock:
+                pgs = list(self.pgs.values())
+            for pg in pgs:
+                if self._stop.is_set():
+                    return
+                try:
+                    started = pg.start_recovery_ops(max_active)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+                    started = 0
+                if started and sleep:
+                    time.sleep(sleep)
+
+    # ------------------------------------------------------------------
+    # tick: pg stats + stuck-peering retry
+    # ------------------------------------------------------------------
+    def _tick_loop(self) -> None:
+        interval = self.conf["mon_tick_interval"]
+        while not self._stop.wait(interval):
+            self._send_pg_stats()
+            self._retry_stuck_peering()
+
+    def _send_pg_stats(self) -> None:
+        stats: Dict[str, dict] = {}
+        with self.pg_lock:
+            pgs = list(self.pgs.items())
+        for pgid, pg in pgs:
+            if pg.is_primary():
+                try:
+                    stats[str(pgid)] = pg.get_stats()
+                except Exception:
+                    pass
+        if stats:
+            try:
+                self.monc.send_pg_stats(self.whoami, self.osdmap.epoch,
+                                        stats)
+            except Exception:
+                pass
+
+    def _retry_stuck_peering(self) -> None:
+        """A peering Query or recovery sub-op can race a peer's map
+        (messages for PGs it can't place yet are dropped); the primary
+        re-queries / re-runs recovery until everyone answers (the
+        reference's peering statechart retries via map-epoch events)."""
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        kick = False
+        for pg in pgs:
+            with pg.lock:
+                if pg.is_primary() and pg.state == STATE_PEERING:
+                    pg._start_peering()
+                if pg.is_primary() and pg.requeue_stale_recovery():
+                    kick = True
+                if pg.is_primary() and pg.state == STATE_ACTIVE \
+                        and pg.num_missing() > 0:
+                    kick = True          # belt-and-braces recovery kick
+        if kick:
+            self.kick_recovery()

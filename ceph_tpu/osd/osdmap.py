@@ -154,7 +154,8 @@ class Incremental:
         self.new_weight: Dict[int, int] = {}            # osd -> 16.16
         self.new_pools: Dict[int, PGPool] = {}
         self.old_pools: List[int] = []
-        self.new_profiles: Dict[int, dict] = {}
+        self.new_profiles: Dict[str, dict] = {}
+        self.old_profiles: List[str] = []
         self.new_crush: Optional[CrushWrapper] = None
         self.new_max_osd: Optional[int] = None
 
@@ -271,6 +272,8 @@ class OSDMap:
                 self.pool_name_to_id.pop(pool.name, None)
         for name, profile in inc.new_profiles.items():
             self.erasure_code_profiles[name] = dict(profile)
+        for name in inc.old_profiles:
+            self.erasure_code_profiles.pop(name, None)
         self.epoch = inc.epoch
 
     def clone(self) -> "OSDMap":

@@ -37,6 +37,7 @@ reference's PG lock); store-commit callbacks re-enter through
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -52,6 +53,8 @@ from .pglog import (DELETE, MODIFY, Eversion, LogEntry, MissingSet,
 PGMETA_OID = "_pgmeta"          # reference pgmeta_oid
 LOG_KEY_PREFIX = "log."
 INFO_KEY = "info"
+MISSING_KEY = "missing"         # persisted pg_missing_t (reference
+                                # PGLog write_log_and_missing)
 
 STATE_INACTIVE = "inactive"
 STATE_PEERING = "peering"
@@ -90,8 +93,15 @@ class PG:
         # behind an in-flight write to the same object
         self.inflight_writes: Set[str] = set()
         self.waiting_for_obj: Dict[str, deque] = {}
+        # every client op this PG currently holds, by reqid; on an
+        # interval change they all bounce back to the client for
+        # re-targeting (reference on_change requeue + client resend)
+        self._client_ops: Dict[Tuple[str, int], Tuple] = {}
         self._last_assigned: Eversion = (0, 0)
-        self.recovering: Set[str] = set()
+        # oid -> start time; recovery sub-ops can be dropped by peers
+        # that raced a map epoch, so stale entries are requeued by the
+        # OSD tick (the reference retries via peering-event machinery)
+        self.recovering: Dict[str, float] = {}
         self.backend = build_pg_backend(self, pool, service.ec_registry)
         self._ensure_collections()
         self._load_pgmeta()
@@ -158,6 +168,14 @@ class PG:
             self.pool.erasure_code_profile)
         return dict(prof or {"plugin": "jerasure", "k": "2", "m": "1"})
 
+    def note_object_recovered(self, oid: str, version) -> None:
+        """A recovery push committed on THIS shard: durable missing-set
+        update (reference recover_got)."""
+        with self.lock:
+            if self.missing.is_missing(oid):
+                self.missing.got(oid, tuple(version))
+                self._persist_pgmeta()
+
     # ------------------------------------------------------------------
     # persistence
     # ------------------------------------------------------------------
@@ -184,29 +202,44 @@ class PG:
             self.store.queue_transactions([txn])
 
     def _append_pgmeta_ops(self, txn: Transaction) -> None:
-        kvs = {INFO_KEY: self.log.encode()}
+        import json as _json
+        kvs = {INFO_KEY: self.log.encode(),
+               MISSING_KEY: _json.dumps(
+                   self.missing.to_dict()).encode()}
         txn.omap_setkeys(self.coll, self._meta_obj(), kvs)
 
     def _persist_pgmeta(self) -> None:
+        if self.pool.is_erasure() and self.own_shard < 0:
+            return    # not in the acting set (late completion after an
+                      # interval change): no home shard to persist to
         txn = Transaction()
         self._append_pgmeta_ops(txn)
         self.store.queue_transactions([txn])
 
     def _load_pgmeta(self) -> None:
         """Restart is resume (reference OSD::init loads PGs from disk):
-        the log (and through it last_update) comes back from omap."""
+        the log (and through it last_update) and the persistent missing
+        set come back from omap — a shard that adopted log entries but
+        never finished recovering them must still know it lacks the
+        data (reference PGLog::read_log_and_missing)."""
+        import json as _json
         for s in ([self.own_shard] if not self.pool.is_erasure()
                   else range(self.pool.size)):
             coll = self.coll_of(s if self.pool.is_erasure() else -1)
             obj = GHObject(PGMETA_OID, s if self.pool.is_erasure() else -1)
             try:
-                data = self.store.omap_get(coll, obj).get(INFO_KEY)
+                omap = self.store.omap_get(coll, obj)
             except FileNotFoundError:
                 continue
+            data = omap.get(INFO_KEY)
             if data:
                 log = PGLog.decode(data)
                 if log.last_update > self.log.last_update:
                     self.log = log
+                    raw = omap.get(MISSING_KEY)
+                    if raw:
+                        self.missing = MissingSet.from_dict(
+                            _json.loads(raw.decode()))
 
     # ------------------------------------------------------------------
     # map / interval handling (reference PG::handle_advance_map)
@@ -228,8 +261,28 @@ class PG:
             self._peer_notifies.clear()
             self.peer_missing.clear()
             self.recovering.clear()
-            self.missing = MissingSet()
+            # NOTE: self.missing survives the interval change — it is
+            # persistent state ("I adopted log entries whose data I do
+            # not have"), not peering scratch.  Clearing it here would
+            # let a data-less shard with a current log masquerade as
+            # whole after re-peering (reference pg_missing_t is
+            # likewise durable, PGLog write_log_and_missing).
             self.waiting_for_degraded.clear()
+            # bounce every held client op: the backend just dropped its
+            # sub-ops; the client re-targets against the new map and
+            # resends, reqid dedup suppressing re-application of
+            # anything that already committed (reference: requeue_ops
+            # on interval change + osd_reqid_t dup detection)
+            held = list(self._client_ops.values())
+            self._client_ops.clear()
+            self.waiting_for_active.clear()
+            self.waiting_for_obj.clear()
+            self.inflight_writes.clear()
+            for m, conn in held:
+                if conn is not None:
+                    reply = MOSDOpReply(tid=m.tid, result=-108,
+                                        epoch=osdmap.epoch)
+                    conn.send_message(reply)
             if self.whoami not in [o for o in acting if o is not None]:
                 self.state = STATE_INACTIVE
                 return
@@ -261,13 +314,15 @@ class PG:
             self.service.send_osd(msg.from_osd, MOSDPGNotify(
                 pgid=str(self.pgid), shard=msg.shard,
                 from_osd=self.whoami, epoch=self.epoch,
-                log=self.log.to_dict()))
+                log=self.log.to_dict(),
+                missing=self.missing.to_dict()))
 
     def handle_pg_notify(self, msg: MOSDPGNotify) -> None:
         with self.lock:
             if not self.is_primary() or self.state != STATE_PEERING:
                 return
-            self._peer_notifies[msg.shard] = msg.log
+            self._peer_notifies[msg.shard] = {"log": msg.log,
+                                              "missing": msg.missing}
             wanted = {s for s, _ in self._other_members()}
             if wanted <= set(self._peer_notifies):
                 self._choose_and_activate()
@@ -276,17 +331,22 @@ class PG:
         """Pick the authoritative log; adopt it if a peer is ahead
         (reference GetLog); then activate (reference Activate)."""
         best_shard, best_head = None, self.log.last_update
-        for shard, logd in self._peer_notifies.items():
-            head = tuple(logd["last_update"])
+        for shard, nd in self._peer_notifies.items():
+            head = tuple(nd["log"]["last_update"])
             if head > best_head:
                 best_shard, best_head = shard, head
         if best_shard is not None:
-            peer = PGLog.from_dict(self._peer_notifies[best_shard])
+            peer = PGLog.from_dict(self._peer_notifies[best_shard]["log"])
             self.log.merge_authoritative(
                 peer.entries, peer.last_update,
                 lambda oid, need, have: self.missing.add(oid, need,
                                                          have),
                 lambda oid, prior: self._roll_back_local(oid, prior))
+            # the authoritative shard may itself lack data for entries
+            # it logged (its own persistent missing): those objects are
+            # missing everywhere we can't prove otherwise — but for
+            # *us* only if we don't have them; our own missing already
+            # reflects our state, so nothing more to adopt here.
             self._persist_pgmeta()
         self._activate()
 
@@ -319,17 +379,19 @@ class PG:
 
     def _activate(self) -> None:
         """Primary side: compute per-peer missing, send activation,
-        go active (reference PeeringState::Activate)."""
+        go active (reference PeeringState::Activate).  A peer's
+        missing = its self-reported persistent missing (log current,
+        data absent) ∪ the log delta we're about to send it."""
         auth_objects = None
-        for shard, logd in self._peer_notifies.items():
-            peer_head = tuple(logd["last_update"])
+        for shard, nd in self._peer_notifies.items():
+            peer_head = tuple(nd["log"]["last_update"])
             entries = self.log.entries_since(peer_head)
             osd = self.acting[shard]
+            ms = MissingSet.from_dict(nd.get("missing", {}))
             if entries is None:
                 # no log overlap: backfill everything
                 if auth_objects is None:
                     auth_objects = self._authoritative_objects()
-                ms = MissingSet()
                 for oid, ver in auth_objects.items():
                     ms.add(oid, ver, None)
                 self.peer_missing[shard] = ms
@@ -340,7 +402,6 @@ class PG:
                     backfill={oid: list(ver) for oid, ver
                               in auth_objects.items()}))
             else:
-                ms = MissingSet()
                 known: Dict[str, Eversion] = {}
                 for e in entries:
                     if e.is_error():
@@ -365,11 +426,17 @@ class PG:
         """Replica side: adopt the authoritative log and go active
         (reference PG::RecoveryState::ReplicaActive)."""
         with self.lock:
+            if self.pool.is_erasure() and self.own_shard < 0:
+                # our map hasn't placed us in this PG yet (activation
+                # raced the map); drop — the primary's stuck-peering
+                # retry re-sends once our map catches up
+                return
             if msg.backfill is not None:
                 # authoritative object set: drop extras, note that the
                 # primary will push everything (stale copies get
                 # overwritten by pushes)
                 auth = {oid: tuple(v) for oid, v in msg.backfill.items()}
+                local: Dict[str, Eversion] = {}
                 for oid in self.backend.list_objects():
                     if oid == PGMETA_OID:
                         continue
@@ -378,9 +445,20 @@ class PG:
                         txn = Transaction()
                         txn.remove(self.coll, obj)
                         self.store.queue_transactions([txn])
+                    else:
+                        oi = self.backend.get_object_info(oid)
+                        if oi is not None:
+                            local[oid] = oi.version
                 self.log = PGLog.from_dict(
                     {"last_update": list(msg.last_update),
                      "tail": list(msg.last_update), "entries": []})
+                # durable missing: the log head we just adopted claims
+                # objects our store lacks — record that, or an interval
+                # change would strand them (see advance_map note)
+                self.missing = MissingSet()
+                for oid, ver in auth.items():
+                    if local.get(oid) != ver:
+                        self.missing.add(oid, ver, local.get(oid))
             else:
                 entries = [LogEntry.from_dict(e) for e in msg.entries]
                 self.log.merge_authoritative(
@@ -417,6 +495,7 @@ class PG:
                 # refreshes and resends (reference resend-on-new-map)
                 self._reply(conn, msg, -108, [])   # -ESHUTDOWN marker
                 return
+            self._client_ops[(msg.client, msg.tid)] = (msg, conn)
             if self.state != STATE_ACTIVE:
                 self.waiting_for_active.append((msg, conn))
                 return
@@ -445,6 +524,13 @@ class PG:
                 return
             self._do_write(msg, conn)
         else:
+            if self.missing.is_missing(oid):
+                # the primary's own copy is unreadable until recovery
+                # (reference wait_for_unreadable_object)
+                self.waiting_for_degraded.setdefault(
+                    oid, deque()).append((msg, conn))
+                self.service.kick_recovery(self)
+                return
             self._do_reads(msg, conn)
 
     def _next_version(self) -> Eversion:
@@ -455,6 +541,11 @@ class PG:
         return self._last_assigned
 
     def _do_write(self, msg: MOSDOp, conn) -> None:
+        # dup detection: a resend of an already-committed op must not
+        # re-apply (reference PGLog dup handling / already_complete)
+        if self.log.has_reqid(msg.client, msg.tid) is not None:
+            self._reply(conn, msg, 0, [])
+            return
         mut = Mutation()
         err = 0
         ec = self.pool.is_erasure()
@@ -511,7 +602,8 @@ class PG:
         entry = LogEntry(DELETE if mut.delete else MODIFY, msg.oid,
                          version,
                          prior_version=(info.version if info
-                                        else (0, 0)))
+                                        else (0, 0)),
+                         reqid=(msg.client, msg.tid))
         self.inflight_writes.add(msg.oid)
         self.backend.submit_transaction(
             msg.oid, mut, version, [entry],
@@ -607,6 +699,9 @@ class PG:
     def _reply(self, conn, msg: MOSDOp, result: int,
                out_data: List[bytes], extra: Optional[Dict] = None
                ) -> None:
+        self._client_ops.pop((msg.client, msg.tid), None)
+        if conn is None:
+            return
         reply = MOSDOpReply(tid=msg.tid, result=result,
                             epoch=self.epoch, out_data=list(out_data),
                             extra=extra or {})
@@ -660,7 +755,7 @@ class PG:
                 version = self.missing_objects().get(oid)
                 if version is None:
                     continue
-                self.recovering.add(oid)
+                self.recovering[oid] = time.monotonic()
                 entry_exists = not self._is_deleted_in_log(oid)
                 if not entry_exists:
                     self._recover_delete(oid, targets)
@@ -701,12 +796,28 @@ class PG:
                 targets.append((s, osd))
         return targets
 
+    def requeue_stale_recovery(self, timeout: float = 2.0) -> bool:
+        """Abandon recovery ops stuck past ``timeout`` (lost sub-op,
+        peer raced a map) so the next recovery pass retries them."""
+        with self.lock:
+            now = time.monotonic()
+            stale = [oid for oid, t0 in self.recovering.items()
+                     if now - t0 > timeout]
+            for oid in stale:
+                del self.recovering[oid]
+                ops = getattr(self.backend, "recovery_ops", None)
+                if ops is not None:
+                    ops.pop(oid, None)
+            return bool(stale)
+
     def _on_recovered(self, oid: str, res: int) -> None:
         with self.lock:
-            self.recovering.discard(oid)
+            self.recovering.pop(oid, None)
             if res == 0:
                 need = self.missing_objects().get(oid, (1 << 30, 0))
-                self.missing.got(oid, need)
+                if self.missing.is_missing(oid):
+                    self.missing.got(oid, need)
+                    self._persist_pgmeta()
                 for ms in self.peer_missing.values():
                     ms.got(oid, need)
             waiting = self.waiting_for_degraded.pop(oid, None)

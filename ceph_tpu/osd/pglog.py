@@ -37,22 +37,32 @@ ERROR = "error"                     # logged failed op (reference ERROR)
 
 @dataclass
 class LogEntry:
-    """reference pg_log_entry_t (osd/osd_types.h)."""
+    """reference pg_log_entry_t (osd/osd_types.h).  ``reqid`` is the
+    originating client op id (client name, client tid) — the dup-
+    detection key (reference osd_reqid_t / pg_log_dup_t): a client
+    resending after an interval change must not re-apply a mutation
+    that already committed."""
     op: str
     oid: str
     version: Eversion
     prior_version: Eversion = EVERSION_ZERO
+    reqid: Optional[Tuple[str, int]] = None
 
     def to_dict(self) -> dict:
-        return {"op": self.op, "oid": self.oid,
-                "version": list(self.version),
-                "prior_version": list(self.prior_version)}
+        d = {"op": self.op, "oid": self.oid,
+             "version": list(self.version),
+             "prior_version": list(self.prior_version)}
+        if self.reqid is not None:
+            d["reqid"] = [self.reqid[0], self.reqid[1]]
+        return d
 
     @classmethod
     def from_dict(cls, d: dict) -> "LogEntry":
+        r = d.get("reqid")
         return cls(op=d["op"], oid=d["oid"],
                    version=tuple(d["version"]),
-                   prior_version=tuple(d["prior_version"]))
+                   prior_version=tuple(d["prior_version"]),
+                   reqid=(r[0], int(r[1])) if r else None)
 
     def is_delete(self) -> bool:
         return self.op == DELETE
@@ -114,6 +124,8 @@ class PGLog:
         self.last_update: Eversion = EVERSION_ZERO
         self.tail: Eversion = EVERSION_ZERO   # versions <= tail trimmed
         self.max_entries = max_entries
+        # dup detection (reference pg_log_dup_t index)
+        self.reqids: Dict[Tuple[str, int], Eversion] = {}
 
     # -- write path -------------------------------------------------------
     def add(self, entry: LogEntry) -> None:
@@ -121,11 +133,21 @@ class PGLog:
             f"log entry {entry.version} <= head {self.last_update}"
         self.entries.append(entry)
         self.last_update = entry.version
+        if entry.reqid is not None:
+            self.reqids[entry.reqid] = entry.version
         self._trim()
+
+    def has_reqid(self, client: str, tid: int) -> Optional[Eversion]:
+        """Version of an already-applied client op, or None (reference
+        PGLog::get_request dup detection)."""
+        return self.reqids.get((client, tid))
 
     def _trim(self) -> None:
         if len(self.entries) > self.max_entries:
             cut = len(self.entries) - self.max_entries
+            for e in self.entries[:cut]:
+                if e.reqid is not None:
+                    self.reqids.pop(e.reqid, None)
             self.tail = self.entries[cut - 1].version
             self.entries = self.entries[cut:]
 
@@ -160,6 +182,9 @@ class PGLog:
         divergent = [e for e in self.entries if e.version > auth_head]
         self.entries = [e for e in self.entries
                         if e.version <= auth_head]
+        for e in divergent:
+            if e.reqid is not None:
+                self.reqids.pop(e.reqid, None)
         if self.last_update > auth_head:
             self.last_update = auth_head
         seen_divergent = set()
@@ -180,6 +205,8 @@ class PGLog:
             if not e.is_error():
                 mark_missing(e.oid, e.version, applied.get(e.oid))
             self.entries.append(e)
+            if e.reqid is not None:
+                self.reqids[e.reqid] = e.version
             self.last_update = e.version
         self._trim()
 
@@ -208,6 +235,9 @@ class PGLog:
         log.last_update = tuple(d["last_update"])
         log.tail = tuple(d["tail"])
         log.entries = [LogEntry.from_dict(e) for e in d["entries"]]
+        for e in log.entries:
+            if e.reqid is not None:
+                log.reqids[e.reqid] = e.version
         return log
 
     def encode(self) -> bytes:

@@ -17,8 +17,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
-from ..msg.messages import (MOSDPGPush, MOSDPGPushReply, MOSDRepOp,
-                            MOSDRepOpReply, PushOp)
+from ..msg.messages import (MOSDPGPull, MOSDPGPush, MOSDPGPushReply,
+                            MOSDRepOp, MOSDRepOpReply, PushOp)
 from ..store.objectstore import GHObject, Transaction
 from .backend import OI_ATTR, Mutation, ObjectInfo, PGBackend, PGHost
 from .pglog import Eversion, LogEntry
@@ -36,6 +36,8 @@ class _RecOp:
         self.oid = oid
         self.cb = cb
         self.pending: Set[int] = set()
+        self.version: Eversion = (0, 0)
+        self.push_after_pull: List[Tuple[int, int]] = []
 
 
 class ReplicatedBackend(PGBackend):
@@ -151,21 +153,45 @@ class ReplicatedBackend(PGBackend):
         if oid in self.recovery_ops:
             cb(-16)
             return
+        rec = _RecOp(oid, cb)
+        rec.version = version
         obj = GHObject(oid, -1)
         try:
             data = self.host.store.read(self.host.coll, obj)
             attrs = self.host.store.getattrs(self.host.coll, obj)
             omap = self.host.store.omap_get(self.host.coll, obj)
         except FileNotFoundError:
-            cb(-2)
+            # the primary itself lacks the object: pull it from a
+            # surviving holder first (reference prep_object_replica_
+            # pushes -> recover_primary pull path, MOSDPGPull)
+            missing_osds = {o for _, o in missing_on}
+            holders = [(s, o) for s, o in self.host.acting_shards()
+                       if o is not None and o != self.host.whoami
+                       and o not in missing_osds]
+            if not holders:
+                cb(-5)                   # nobody has it
+                return
+            self.recovery_ops[oid] = rec
+            rec.push_after_pull = [
+                (s, o) for s, o in missing_on
+                if o is not None and o != self.host.whoami]
+            shard, osd = holders[0]
+            self.host.send_shard(osd, MOSDPGPull(
+                pgid=self.host.pgid_str, shard=shard,
+                from_osd=self.host.whoami, epoch=self.host.epoch,
+                oids=[oid]))
             return
-        rec = _RecOp(oid, cb)
         self.recovery_ops[oid] = rec
-        targets = [(s, o) for s, o in missing_on
-                   if o is not None and o != self.host.whoami]
+        self._push_to(rec, data, attrs, omap,
+                      [(s, o) for s, o in missing_on
+                       if o is not None and o != self.host.whoami])
+
+    def _push_to(self, rec: _RecOp, data: bytes,
+                 attrs: Dict[str, bytes], omap: Dict[str, bytes],
+                 targets: List[Tuple[int, int]]) -> None:
         if not targets:
-            del self.recovery_ops[oid]
-            cb(0)
+            self.recovery_ops.pop(rec.oid, None)
+            rec.cb(0)
             return
         for shard, osd in targets:
             rec.pending.add(osd)
@@ -173,8 +199,17 @@ class ReplicatedBackend(PGBackend):
             self.host.send_shard(osd, MOSDPGPush(
                 pgid=self.host.pgid_str, shard=shard,
                 from_osd=self.host.whoami, epoch=self.host.epoch,
-                pushes=[PushOp(oid=oid, data=data, attrs=attrs,
-                               omap=omap, version=version)]))
+                pushes=[PushOp(oid=rec.oid, data=data, attrs=attrs,
+                               omap=omap, version=rec.version)]))
+
+    def _pulled(self, push: PushOp) -> None:
+        """A pull answer landed and committed locally: forward the
+        object to the remaining missing replicas."""
+        rec = self.recovery_ops.get(push.oid)
+        if rec is None:
+            return
+        self._push_to(rec, push.data, dict(push.attrs),
+                      dict(push.omap), rec.push_after_pull)
 
     def _apply_push(self, push: PushOp,
                     on_commit: Callable[[], None]) -> None:
@@ -190,8 +225,12 @@ class ReplicatedBackend(PGBackend):
             txn.setattrs(coll, obj, push.attrs)
         if push.omap:
             txn.omap_setkeys(coll, obj, push.omap)
+
+        def committed() -> None:
+            self.host.note_object_recovered(push.oid, push.version)
+            on_commit()
         txn.register_on_commit(
-            lambda: self.host.on_local_commit(on_commit))
+            lambda: self.host.on_local_commit(committed))
         self.host.store.queue_transactions([txn])
 
     def _push_acked(self, oid: str, osd: int) -> None:
@@ -222,17 +261,43 @@ class ReplicatedBackend(PGBackend):
             return True
         if isinstance(msg, MOSDPGPush):
             for push in msg.pushes:
-                self._apply_push(
-                    push,
-                    lambda p=push: self.host.send_shard(
-                        msg.from_osd, MOSDPGPushReply(
-                            pgid=self.host.pgid_str, shard=msg.shard,
-                            from_osd=self.host.whoami,
-                            epoch=self.host.epoch, oids=[p.oid])))
+                rec = self.recovery_ops.get(push.oid)
+                if rec is not None and not rec.pending:
+                    # answer to our pull: apply locally, then fan out
+                    self._apply_push(
+                        push, lambda p=push: self._pulled(p))
+                else:
+                    self._apply_push(
+                        push,
+                        lambda p=push: self.host.send_shard(
+                            msg.from_osd, MOSDPGPushReply(
+                                pgid=self.host.pgid_str,
+                                shard=msg.shard,
+                                from_osd=self.host.whoami,
+                                epoch=self.host.epoch, oids=[p.oid])))
             return True
         if isinstance(msg, MOSDPGPushReply):
             for oid in msg.oids:
                 self._push_acked(oid, msg.from_osd)
+            return True
+        if isinstance(msg, MOSDPGPull):
+            for oid in msg.oids:
+                obj = GHObject(oid, -1)
+                try:
+                    data = self.host.store.read(self.host.coll, obj)
+                    attrs = self.host.store.getattrs(self.host.coll,
+                                                     obj)
+                    omap = self.host.store.omap_get(self.host.coll,
+                                                    obj)
+                    info = self.get_object_info(oid)
+                    ver = info.version if info else (0, 0)
+                except FileNotFoundError:
+                    continue             # puller retries elsewhere
+                self.host.send_shard(msg.from_osd, MOSDPGPush(
+                    pgid=self.host.pgid_str, shard=msg.shard,
+                    from_osd=self.host.whoami, epoch=self.host.epoch,
+                    pushes=[PushOp(oid=oid, data=data, attrs=attrs,
+                                   omap=omap, version=ver)]))
             return True
         return False
 

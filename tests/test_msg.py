@@ -55,6 +55,8 @@ def sample_messages():
                                       version=(7, 3))]),
         M.MOSDPGPushReply(pgid="1.0", shard=2, from_osd=2, epoch=7,
                           oids=["x"]),
+        M.MOSDPGPull(pgid="1.0", shard=1, from_osd=0, epoch=7,
+                     oids=["x", "y"]),
         M.MOSDPing(op=M.MOSDPing.PING_REPLY, from_osd=3, epoch=2,
                    stamp=123.5),
         M.MOSDMap(maps={3: {"epoch": 3}, 4: {"epoch": 4}}),
@@ -62,7 +64,8 @@ def sample_messages():
         M.MOSDFailure(target_osd=1, from_osd=0, failed_for=4.5, epoch=8),
         M.MOSDPGQuery(pgid="1.3", shard=2, from_osd=0, epoch=11),
         M.MOSDPGNotify(pgid="1.3", shard=2, from_osd=4, epoch=11,
-                       log={"head": [11, 7], "entries": []}),
+                       log={"head": [11, 7], "entries": []},
+                       missing={"o": {"need": [11, 7], "have": None}}),
         M.MOSDPGLog(pgid="1.3", shard=2, from_osd=0, epoch=11,
                     last_update=(11, 7),
                     entries=[{"op": "modify", "oid": "o"}],
