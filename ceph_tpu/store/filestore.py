@@ -406,6 +406,11 @@ class FileStore(ObjectStore):
             if not os.path.exists(path):
                 return b""
             with open(path, "rb") as fh:
+                # clamp to EOF: callers pass huge sentinels for
+                # "whole object" and fh.read preallocates the buffer
+                size = os.fstat(fh.fileno()).st_size
+                if length is None or offset + length > size:
+                    length = max(0, size - offset)
                 fh.seek(offset)
                 return fh.read(length)
 

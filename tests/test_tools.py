@@ -1,0 +1,309 @@
+"""CLI tool-suite tests (reference src/ceph.in, src/tools/rados,
+crushtool, osdmaptool, ceph-objectstore-tool, ceph-erasure-code-tool,
+ceph_erasure_code_benchmark).
+
+Live-cluster tools run against one module-scoped in-process Cluster over
+real loopback TCP — the same wire path a separate-process deployment
+uses — so these double as control-plane integration tests."""
+import json
+import os
+
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.tools import (ceph_cli, crushtool, ec_benchmark, ec_tool,
+                            objectstore_tool, osdmaptool, rados_cli)
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def mon(cluster):
+    host, port = cluster.mon_addr
+    return f"{host}:{port}"
+
+
+def run_ceph(mon, *words, fmt="json"):
+    return ceph_cli.main(["-m", mon, "--format", fmt, *words])
+
+
+# ---------------------------------------------------------------- ceph
+
+
+def test_ceph_status_health(mon, capsys):
+    assert run_ceph(mon, "status") == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["osdmap"]["num_up_osds"] == 3
+
+    assert run_ceph(mon, "health") == 0
+    assert "num_pgs" in json.loads(capsys.readouterr().out)
+
+
+def test_ceph_profile_and_pool_lifecycle(mon, capsys):
+    assert run_ceph(mon, "osd", "erasure-code-profile", "set", "cliprof",
+                    "plugin=jerasure", "k=2", "m=1") == 0
+    capsys.readouterr()
+    assert run_ceph(mon, "osd", "erasure-code-profile", "get",
+                    "cliprof") == 0
+    prof = json.loads(capsys.readouterr().out)
+    assert prof["k"] == "2" and prof["plugin"] == "jerasure"
+
+    assert run_ceph(mon, "osd", "erasure-code-profile", "ls") == 0
+    assert "cliprof" in json.loads(capsys.readouterr().out)["profiles"]
+
+    assert run_ceph(mon, "osd", "pool", "create", "cliec", "8", "erasure",
+                    "cliprof") == 0
+    capsys.readouterr()
+    assert run_ceph(mon, "osd", "pool", "ls") == 0
+    assert "cliec" in json.loads(capsys.readouterr().out)["pools"]
+
+    # profile in use: rm must refuse (reference OSDMonitor in-use check)
+    assert run_ceph(mon, "osd", "erasure-code-profile", "rm",
+                    "cliprof") == 1
+    capsys.readouterr()
+
+    assert run_ceph(mon, "osd", "pool", "delete", "cliec") == 0
+    capsys.readouterr()
+
+
+def test_ceph_osd_out_in_dump(mon, capsys):
+    assert run_ceph(mon, "osd", "out", "2") == 0
+    capsys.readouterr()
+    assert run_ceph(mon, "osd", "dump") == 0
+    dump = json.loads(capsys.readouterr().out)
+    info = {o["osd"]: o for o in dump["osds"]}
+    assert info[2]["weight"] == 0
+    assert run_ceph(mon, "osd", "in", "2") == 0
+    capsys.readouterr()
+    assert run_ceph(mon, "osd", "tree") == 0
+    capsys.readouterr()
+
+
+def test_ceph_unknown_command(mon):
+    with pytest.raises(SystemExit):
+        run_ceph(mon, "bogus", "verb")
+
+
+def test_ceph_options_after_command_words(mon, capsys):
+    """Options may follow the command words (ceph pg dump --format
+    json) — REMAINDER-style swallowing is a bug."""
+    assert ceph_cli.main(["-m", mon, "pg", "dump", "--format",
+                          "json"]) == 0
+    json.loads(capsys.readouterr().out)
+    assert ceph_cli.main(["-m", mon, "-s", "--format", "json"]) == 0
+    assert "osdmap" in json.loads(capsys.readouterr().out)
+    # --force after the profile entries must be an option, not a k=v
+    assert ceph_cli.main(["-m", mon, "osd", "erasure-code-profile",
+                          "set", "cliprof2", "plugin=jerasure", "k=2",
+                          "m=1", "--force", "--format", "json"]) == 0
+    capsys.readouterr()
+
+
+def test_ceph_truncated_commands_give_usage(mon):
+    for words in (["osd", "erasure-code-profile", "get"],
+                  ["osd", "erasure-code-profile", "rm"],
+                  ["osd", "pool", "delete"],
+                  ["config", "set", "onlyname"],
+                  ["config", "get"]):
+        with pytest.raises(SystemExit):
+            run_ceph(mon, *words)
+
+
+# --------------------------------------------------------------- rados
+
+
+@pytest.fixture(scope="module")
+def datapool(cluster, mon):
+    run_ceph(mon, "osd", "pool", "create", "clidata", "8", "replicated")
+    return "clidata"
+
+
+def test_rados_put_get_roundtrip(mon, datapool, tmp_path, capsys):
+    src = tmp_path / "in.bin"
+    src.write_bytes(os.urandom(70000))
+    dst = tmp_path / "out.bin"
+    assert rados_cli.main(["-m", mon, "-p", datapool, "put", "obj1",
+                           str(src)]) == 0
+    assert rados_cli.main(["-m", mon, "-p", datapool, "get", "obj1",
+                           str(dst)]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+
+    assert rados_cli.main(["-m", mon, "-p", datapool, "ls"]) == 0
+    assert "obj1" in capsys.readouterr().out.split()
+
+    assert rados_cli.main(["-m", mon, "-p", datapool, "stat", "obj1"]) == 0
+    assert "size 70000" in capsys.readouterr().out
+
+    assert rados_cli.main(["-m", mon, "-p", datapool, "setxattr", "obj1",
+                           "user.k", "v1"]) == 0
+    assert rados_cli.main(["-m", mon, "-p", datapool, "getxattr", "obj1",
+                           "user.k"]) == 0
+    assert capsys.readouterr().out.strip() == "v1"
+
+    assert rados_cli.main(["-m", mon, "-p", datapool, "rm", "obj1"]) == 0
+
+
+def test_rados_bench_write_then_seq(mon, datapool, capsys):
+    argv = ["-m", mon, "-p", datapool, "bench", "1", "write",
+            "-b", str(64 << 10), "-t", "4", "--no-cleanup",
+            "--format", "json"]
+    assert rados_cli.main(argv) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["total_ops"] > 0 and summary["errors"] == 0
+    assert summary["bandwidth_mb_sec"] > 0
+
+    argv = ["-m", mon, "-p", datapool, "bench", "1", "seq",
+            "--format", "json"]
+    assert rados_cli.main(argv) == 0
+    rd = json.loads(capsys.readouterr().out)
+    assert rd["total_ops"] >= summary["total_ops"]  # full pass
+    assert rd["errors"] == 0
+
+
+# ----------------------------------------------- erasure-code offline
+
+
+def test_ec_tool_roundtrip(tmp_path, capsys):
+    f = tmp_path / "payload"
+    f.write_bytes(os.urandom(12345))
+    prof = "plugin=jerasure,k=4,m=2"
+    assert ec_tool.main(["encode", prof, "4096", "all", str(f)]) == 0
+    capsys.readouterr()
+    # lose two chunks, decode from the rest
+    os.unlink(f"{f}.0")
+    os.unlink(f"{f}.5")
+    assert ec_tool.main(["decode", prof, "4096", "all", str(f)]) == 0
+    assert (tmp_path / "payload.decoded").read_bytes()[:12345] == \
+        f.read_bytes()
+
+
+def test_ec_tool_plugin_exists_and_chunk_size(capsys):
+    assert ec_tool.main(["test-plugin-exists", "tpu"]) == 0
+    capsys.readouterr()
+    assert ec_tool.main(["test-plugin-exists", "nope-such"]) == 1
+    capsys.readouterr()
+    assert ec_tool.main(["calc-chunk-size", "plugin=jerasure,k=2,m=1",
+                         "4096"]) == 0
+    assert int(capsys.readouterr().out) >= 2048
+
+
+def test_ec_benchmark_output_format(capsys):
+    assert ec_benchmark.main(["-p", "jerasure", "-P", "k=2,m=1",
+                              "-S", str(64 << 10), "-i", "2",
+                              "-w", "encode"]) == 0
+    secs, kib = capsys.readouterr().out.split("\t")
+    assert float(secs) > 0 and int(kib) == 2 * 64
+    assert ec_benchmark.main(["-p", "jerasure", "-P", "k=2,m=1",
+                              "-S", str(64 << 10), "-i", "3",
+                              "-w", "decode", "-e", "1",
+                              "--erasures-generation",
+                              "exhaustive"]) == 0
+    secs, kib = capsys.readouterr().out.split("\t")
+    assert float(secs) > 0 and int(kib) == 3 * 64
+
+
+def test_ec_benchmark_over_erasure_is_usage_error():
+    with pytest.raises(SystemExit):
+        ec_benchmark.main(["-p", "jerasure", "-P", "k=2,m=1",
+                           "-S", "4096", "-w", "decode", "-e", "4",
+                           "--erasures-generation", "exhaustive"])
+
+
+# -------------------------------------------------- crush/osdmap tools
+
+
+def test_crushtool_build_and_test(tmp_path, capsys):
+    mapfn = str(tmp_path / "crush.json")
+    assert crushtool.main(["--build", "--num-osds", "8", "-o", mapfn,
+                           "host", "straw2", "2", "rack", "straw2",
+                           "0"]) == 0
+    capsys.readouterr()
+    assert crushtool.main(["--test", "-i", mapfn, "--rule", "0",
+                           "--num-rep", "2", "--min-x", "0", "--max-x",
+                           "255", "--show-utilization"]) == 0
+    out = capsys.readouterr().out
+    assert "device" in out
+    assert crushtool.main(["-d", mapfn]) == 0
+    dump = json.loads(capsys.readouterr().out)
+    assert len([b for b in dump.get("buckets", [])]) >= 4
+
+
+def test_osdmaptool_create_print_test(tmp_path, capsys):
+    mapfn = str(tmp_path / "osdmap.json")
+    assert osdmaptool.main(["--createsimple", "6",
+                            "--with-default-pool", "-o", mapfn]) == 0
+    capsys.readouterr()
+    assert osdmaptool.main(["--print", mapfn]) == 0
+    dump = json.loads(capsys.readouterr().out)
+    assert dump["epoch"] >= 2
+    assert osdmaptool.main(["--test-map-pgs", "--pool", "1", mapfn]) == 0
+    assert "total pgs 64" in capsys.readouterr().out
+    assert osdmaptool.main(["--test-map-object", "foo", "--pool", "1",
+                            mapfn]) == 0
+    assert "-> up" in capsys.readouterr().out
+
+
+# ---------------------------------------------- objectstore offline
+
+
+def test_objectstore_tool(tmp_path, capsys):
+    ddir = str(tmp_path / "cl")
+    with Cluster(n_osds=2, data_dir=ddir) as c:
+        c.create_pool("ostp", "replicated", size=2)
+        r = c.rados()
+        io = r.open_ioctx("ostp")
+        io.write_full("ostobj", b"ostool-payload")
+        io.setxattr("ostobj", "user.a", b"xv")
+        c.wait_for_clean(20)
+    # cluster stopped: examine osd.0's store offline
+    path = os.path.join(ddir, "osd.0")
+    assert objectstore_tool.main(["--data-path", path, "--op",
+                                  "list"]) == 0
+    lines = [json.loads(ln) for ln in
+             capsys.readouterr().out.splitlines()]
+    target = [(c0, o) for c0, o in lines if "ostobj" in o]
+    assert target, f"ostobj not found in {lines}"
+    coll, objname = target[0]
+    assert objectstore_tool.main(["--data-path", path, coll, objname,
+                                  "get-bytes"]) == 0
+    assert b"ostool-payload" in capsys.readouterr().out.encode()
+    assert objectstore_tool.main(["--data-path", path, coll, objname,
+                                  "dump"]) == 0
+    dump = json.loads(capsys.readouterr().out)
+    assert dump["size"] == len(b"ostool-payload")
+    assert objectstore_tool.main(["--data-path", path, "--op",
+                                  "fsck"]) == 0
+    capsys.readouterr()
+
+
+def test_objectstore_tool_ec_shard_objects(tmp_path, capsys):
+    """EC shard objects print as 'name(sN)' in --op list; that exact
+    string must be accepted back for per-object commands."""
+    ddir = str(tmp_path / "cle")
+    with Cluster(n_osds=3, data_dir=ddir) as c:
+        c.create_ec_profile("ostprof", plugin="jerasure", k="2", m="1")
+        c.create_pool("ostec", "erasure", erasure_code_profile="ostprof")
+        io = c.rados().open_ioctx("ostec")
+        io.write_full("shardobj", b"z" * 8192)
+        c.wait_for_clean(20)
+    found = False
+    for osd in range(3):
+        path = os.path.join(ddir, f"osd.{osd}")
+        assert objectstore_tool.main(["--data-path", path, "--op",
+                                      "list"]) == 0
+        lines = [json.loads(ln) for ln in
+                 capsys.readouterr().out.splitlines()]
+        for coll, objname in lines:
+            if "shardobj" in objname and "(s" in objname:
+                assert objectstore_tool.main(
+                    ["--data-path", path, coll, objname, "dump"]) == 0
+                dump = json.loads(capsys.readouterr().out)
+                assert dump["size"] > 0
+                found = True
+    assert found, "no EC shard objects listed"
