@@ -43,9 +43,10 @@ from typing import Dict, List, Optional, Tuple
 from ..crush.wrapper import CrushWrapper, weight_to_fixed
 from ..ec import registry as ec_registry
 from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
-                            MOSDBoot, MOSDFailure, MOSDMap, MPGStats)
+                            MOSDBoot, MOSDFailure, MOSDMap, MOSDScrub,
+                            MPGStats)
 from ..msg.messenger import Connection, Dispatcher, Messenger
-from ..osd.osdmap import (Incremental, OSDMap, PGPool,
+from ..osd.osdmap import (Incremental, OSDMap, PGid, PGPool,
                           POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
 from ..store.kv import KeyValueDB, LogDB, MemDB, WriteBatch
 from ..utils.config import Config, default_config
@@ -98,6 +99,7 @@ class Monitor(Dispatcher):
         # subscribers: conn -> next epoch wanted (reference
         # Session::sub_map / MMonSubscribe)
         self.subs: Dict[Connection, int] = {}
+        self.osd_conns: Dict[int, Connection] = {}   # osd -> mon session
         # failure reports: target -> reporter -> (first_seen, failed_for)
         self.failure_reports: Dict[int, Dict[int, Tuple[float, float]]] = {}
         self.pg_stats: Dict[str, dict] = {}
@@ -185,6 +187,9 @@ class Monitor(Dispatcher):
     def ms_handle_reset(self, conn: Connection) -> None:
         with self.lock:
             self.subs.pop(conn, None)
+            for osd, c in list(self.osd_conns.items()):
+                if c is conn:
+                    del self.osd_conns[osd]
 
     def _handle_subscribe(self, conn: Connection, msg: MMonSubscribe
                           ) -> None:
@@ -204,6 +209,12 @@ class Monitor(Dispatcher):
     def _handle_boot(self, conn: Connection, msg: MOSDBoot) -> None:
         osd, addr = msg.osd, tuple(msg.addr)
         with self.lock:
+            # remember the OSD's own mon session: mon->OSD commands
+            # (scrub etc.) ride it back, since dialing the OSD fresh
+            # would collide with its MonClient session (the reference
+            # likewise sends MOSDScrub down the OSD's mon connection)
+            if conn is not None:
+                self.osd_conns[osd] = conn
             info = self.osdmap.osds.get(osd)
             if info is not None and info.up and info.addr == addr:
                 return                   # duplicate boot
@@ -286,10 +297,12 @@ class Monitor(Dispatcher):
         expected = sum(p.pg_num for p in self.osdmap.pools.values())
         states: Dict[str, int] = {}
         known = 0
+        scrub_errors = 0
         for pgid, stat in self.pg_stats.items():
             pool = pgid.split(".", 1)[0]
             if int(pool) not in self.osdmap.pools:
                 continue
+            scrub_errors += stat.get("num_scrub_errors", 0)
             # a stat predating the current map may describe a dead
             # interval (e.g. "clean" from before an OSD died); count
             # it as not-yet-reported so wait_for_clean blocks until
@@ -303,7 +316,12 @@ class Monitor(Dispatcher):
         clean = states.get("active+clean", 0)
         degraded = sum(n for s, n in states.items() if "degraded" in s
                        or "recovering" in s)
-        if expected == 0 or (known >= expected and clean == known):
+        inconsistent = sum(n for s, n in states.items()
+                           if "inconsistent" in s)
+        if inconsistent or scrub_errors:
+            # reference: PG_DAMAGED / OSD_SCRUB_ERRORS => HEALTH_ERR
+            status = "HEALTH_ERR"
+        elif expected == 0 or (known >= expected and clean == known):
             status = "HEALTH_OK"
         elif degraded or known < expected:
             status = "HEALTH_WARN"
@@ -311,6 +329,7 @@ class Monitor(Dispatcher):
             status = "HEALTH_WARN"
         return {"status": status, "num_pgs": expected,
                 "num_pgs_reported": known, "pg_states": states,
+                "num_scrub_errors": scrub_errors,
                 "all_clean": expected > 0 and known >= expected
                 and clean == known}
 
@@ -615,6 +634,42 @@ class Monitor(Dispatcher):
         with self.lock:
             return (0, "", self._health_summary_locked())
 
+    def _instruct_scrub(self, cmd: dict, deep: bool, repair: bool):
+        """'pg scrub|deep-scrub|repair <pgid>': forward MOSDScrub to
+        the PG's primary (reference MonCommands.h pg scrub ->
+        OSDMonitor sending MOSDScrub to the lead OSD)."""
+        try:
+            pgid = PGid.parse(cmd["pgid"])
+        except (KeyError, ValueError) as e:
+            return (-22, f"bad pgid: {e}", {})
+        with self.lock:
+            pool = self.osdmap.pools.get(pgid.pool)
+            if pool is None:
+                return (-2, f"no pool {pgid.pool}", {})
+            if pgid.seed >= pool.pg_num:
+                return (-2, f"pg {pgid} does not exist "
+                        f"(pool has {pool.pg_num} pgs)", {})
+            _, primary, _, _ = self.osdmap.pg_to_up_acting_osds(pgid)
+            conn = (self.osd_conns.get(primary)
+                    if primary is not None else None)
+        if primary is None or conn is None:
+            return (-11, f"pg {pgid} has no up primary", {})
+        conn.send_message(MOSDScrub(
+            pgid=str(pgid), deep=deep, repair=repair))
+        verb = ("repair" if repair else
+                "deep-scrub" if deep else "scrub")
+        return (0, f"instructing pg {pgid} on osd.{primary} to {verb}",
+                {})
+
+    def _cmd_pg_scrub(self, cmd: dict):
+        return self._instruct_scrub(cmd, deep=False, repair=False)
+
+    def _cmd_pg_deep_scrub(self, cmd: dict):
+        return self._instruct_scrub(cmd, deep=True, repair=False)
+
+    def _cmd_pg_repair(self, cmd: dict):
+        return self._instruct_scrub(cmd, deep=True, repair=True)
+
     def _cmd_pg_stat(self, cmd: dict):
         with self.lock:
             return (0, "", {"pg_stats": dict(self.pg_stats)})
@@ -656,6 +711,9 @@ class Monitor(Dispatcher):
         "health": _cmd_health,
         "pg stat": _cmd_pg_stat,
         "pg dump": _cmd_pg_dump,
+        "pg scrub": _cmd_pg_scrub,
+        "pg deep-scrub": _cmd_pg_deep_scrub,
+        "pg repair": _cmd_pg_repair,
         "config set": _cmd_config_set,
         "config get": _cmd_config_get,
     }

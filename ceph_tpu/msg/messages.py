@@ -750,6 +750,93 @@ class MPGStats(Message):
 
 
 # ---------------------------------------------------------------------------
+# scrub (reference messages/MOSDScrub.h, MOSDRepScrub.h, MOSDRepScrubMap.h)
+# ---------------------------------------------------------------------------
+
+@register
+class MOSDScrub(Message):
+    """mon/admin -> primary OSD: scrub this PG (reference
+    messages/MOSDScrub.h; triggered by 'ceph pg scrub|deep-scrub|
+    repair', mon/MonCommands.h)."""
+    TYPE = 90
+
+    def __init__(self, pgid: str = "", deep: bool = False,
+                 repair: bool = False):
+        super().__init__()
+        self.pgid = pgid
+        self.deep = deep
+        self.repair = repair
+
+    def encode_payload(self) -> bytes:
+        return (Encoder().str(self.pgid)
+                .u8(int(self.deep)).u8(int(self.repair)).build())
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDScrub":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), deep=bool(d.u8()), repair=bool(d.u8()))
+
+
+@register
+class MRepScrub(Message):
+    """Primary -> replica/shard: build and return your scrub map for
+    this PG (reference messages/MOSDRepScrub.h)."""
+    TYPE = 91
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, tid: int = 0, epoch: int = 0,
+                 deep: bool = False):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard
+        self.from_osd = from_osd
+        self.tid = tid
+        self.epoch = epoch
+        self.deep = deep
+
+    def encode_payload(self) -> bytes:
+        return (Encoder().str(self.pgid).i32(self.shard)
+                .i32(self.from_osd).u64(self.tid).u32(self.epoch)
+                .u8(int(self.deep)).build())
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MRepScrub":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                   tid=d.u64(), epoch=d.u32(), deep=bool(d.u8()))
+
+
+@register
+class MRepScrubMap(Message):
+    """Replica/shard -> primary: my scrub map (reference
+    messages/MOSDRepScrubMap.h; ScrubMap in osd/scrubber types).
+    ``scrub_map`` is oid -> {size, oi_version, data_crc, omap_crc,
+    attrs_crc, stored_crc, error}."""
+    TYPE = 92
+
+    def __init__(self, pgid: str = "", shard: int = -1,
+                 from_osd: int = -1, tid: int = 0,
+                 scrub_map: Optional[Dict[str, dict]] = None):
+        super().__init__()
+        self.pgid = pgid
+        self.shard = shard
+        self.from_osd = from_osd
+        self.tid = tid
+        self.scrub_map = scrub_map or {}
+
+    def encode_payload(self) -> bytes:
+        return (Encoder().str(self.pgid).i32(self.shard)
+                .i32(self.from_osd).u64(self.tid)
+                .bytes(_enc_json(self.scrub_map)).build())
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MRepScrubMap":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                   tid=d.u64(), scrub_map=_dec_json(d.bytes()))
+
+
+# ---------------------------------------------------------------------------
 # monitor control plane (reference MMonCommand.h, MMonSubscribe.h)
 # ---------------------------------------------------------------------------
 

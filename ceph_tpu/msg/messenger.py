@@ -456,6 +456,7 @@ class Messenger:
             sock.settimeout(5.0)
             peer_name, peer_nonce, peer_in_seq, peer_lossless = \
                 _recv_banner(sock)
+            stale = None
             with self.lock:
                 if not peer_lossless:
                     # lossy dialer: every dial is a fresh session (no
@@ -468,21 +469,28 @@ class Messenger:
                     in_seq = 0
                 else:
                     conn = self.conns_by_name.get(peer_name)
+                    if conn is not None and conn.peer_nonce is not None \
+                            and conn.peer_nonce != peer_nonce:
+                        # same name, different nonce: a NEW incarnation
+                        # of the peer (restarted process).  Reusing the
+                        # old session would replay its unacked queue —
+                        # stale replies delivered to a fresh peer — and
+                        # dedup-drop the new session's restarted seqs.
+                        # Retire it (reference ProtocolV2 treats
+                        # (addr, nonce) as the session identity).
+                        stale = conn
+                        conn = None
                     if conn is None or conn.state == "closed" \
                             or not conn.lossless:
                         conn = Connection(self, sock.getpeername(),
                                           lossless=True, connector=False)
                         self.conns.append(conn)
                         self.conns_by_name[peer_name] = conn
-                    # a restarted peer sends in_seq=0 with a fresh
-                    # nonce; replying with the stale floor would make
-                    # it drop our next sends, so advertise what matches
-                    # its incarnation
-                    if conn.peer_nonce is not None \
-                            and conn.peer_nonce != peer_nonce:
-                        in_seq = 0
-                    else:
-                        in_seq = conn.in_seq
+                    in_seq = conn.in_seq
+            if peer_lossless and stale is not None:
+                # outside the messenger lock: _close takes conn.lock
+                # and re-enters the messenger via _conn_closed
+                stale._close(reset=True)
             _send_banner(sock, self.name, self.nonce, in_seq,
                          peer_lossless)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)

@@ -205,6 +205,15 @@ class PGBackend(abc.ABC):
         """Acting set changed (new interval): drop in-flight ops; the
         clients will resend (reference on_change)."""
 
+    def build_scrub_map(self, deep: bool) -> Dict[str, dict]:
+        """Per-object consistency snapshot of this OSD's local shard
+        (reference ScrubMap built in PGBackend::be_scan_list +
+        be_deep_scrub): oid -> {size, oi_version, and under deep:
+        data_crc/omap_crc/attrs_crc (replicated,
+        ReplicatedBackend.cc:614) or shard data_crc vs the stored
+        HashInfo crc (EC, ECBackend.cc:2475)}."""
+        raise NotImplementedError
+
     # -- local object metadata helpers ------------------------------------
     def get_object_info(self, oid: str) -> Optional[ObjectInfo]:
         obj = GHObject(oid, self.host.own_shard)

@@ -439,6 +439,21 @@ class ECBackend(PGBackend):
             # stripe bounds): a short read means truncation/corruption,
             # so error out and let reconstruction use parity instead
             return b"", -5
+        if off == 0:
+            # whole-shard read: verify bytes against the HashInfo CRC
+            # so bit-rot surfaces as EIO and the read retries over
+            # other shards (reference handle_sub_read hinfo check,
+            # ECBackend.cc:1002-1048)
+            try:
+                hinfo = ecutil.HashInfo.decode(self.host.store.getattr(
+                    self.host.coll_of(shard), GHObject(oid, shard),
+                    ecutil.HINFO_KEY))
+            except (FileNotFoundError, KeyError, ValueError):
+                hinfo = None
+            if hinfo is not None and \
+                    hinfo.total_chunk_size == len(data) and \
+                    ecutil.chunk_crc(data) != hinfo.crcs[shard]:
+                return b"", -5
         return data, 0
 
     def _read_piece(self, rop: _ReadOp, shard: int, data: bytes,
@@ -688,6 +703,49 @@ class ECBackend(PGBackend):
             except FileNotFoundError:
                 reply.errors.append((oid, -2))
         self.host.send_shard(msg.from_osd, reply)
+
+    def build_scrub_map(self, deep: bool) -> Dict[str, dict]:
+        """Per-shard-object snapshot (reference ECBackend::be_deep_scrub,
+        ECBackend.cc:2475-2579): under deep, recompute this shard's CRC
+        from stored bytes and compare against the HashInfo xattr — no
+        decode on scrub.  ``hinfo_ok`` is None when the CRC is
+        unknowable (overwritten object cleared its cumulative CRCs)."""
+        out: Dict[str, dict] = {}
+        store = self.host.store
+        shard = self.host.own_shard
+        coll = self.host.coll
+        for obj in store.collection_list(coll):
+            if obj.oid.startswith("_pgmeta"):
+                continue
+            try:
+                st = store.stat(coll, obj)
+                entry: Dict[str, object] = {"size": st.size,
+                                            "shard": shard}
+                info = self.get_object_info(obj.oid)
+                entry["oi_version"] = list(info.version) if info else None
+                if info is not None:
+                    entry["expect_size"] = \
+                        self.sinfo.object_size_to_shard_size(info.size)
+                hinfo = None
+                try:
+                    hinfo = ecutil.HashInfo.decode(store.getattr(
+                        coll, obj, ecutil.HINFO_KEY))
+                except (FileNotFoundError, KeyError, ValueError):
+                    pass
+                if deep:
+                    data = store.read(coll, obj)
+                    entry["data_crc"] = ecutil.chunk_crc(data)
+                    if hinfo is not None and \
+                            hinfo.total_chunk_size == len(data):
+                        entry["stored_crc"] = hinfo.crcs[shard]
+                        entry["hinfo_ok"] = \
+                            hinfo.crcs[shard] == entry["data_crc"]
+                    else:
+                        entry["hinfo_ok"] = None    # CRC unknowable
+            except FileNotFoundError:
+                entry = {"error": "read_error", "shard": shard}
+            out[obj.oid] = entry
+        return out
 
     def on_change(self) -> None:
         """New interval: drop every in-flight op (reference on_change);

@@ -43,7 +43,8 @@ from ..msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                             MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                             MOSDMap, MOSDOp, MOSDPGLog, MOSDPGNotify,
                             MOSDPGPush, MOSDPGPushReply, MOSDPGQuery,
-                            MOSDPing, MOSDRepOp, MOSDRepOpReply)
+                            MOSDPing, MOSDRepOp, MOSDRepOpReply,
+                            MOSDScrub, MRepScrub, MRepScrubMap)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..store.objectstore import ObjectStore
 from ..utils.config import Config, default_config
@@ -255,6 +256,17 @@ class OSD(Dispatcher):
             else:
                 pg.handle_pg_log(msg)
             return True
+        if isinstance(msg, (MOSDScrub, MRepScrub, MRepScrubMap)):
+            pg = self._lookup_pg(PGid.parse(msg.pgid))
+            if pg is not None:
+                with pg.lock:
+                    if isinstance(msg, MOSDScrub):
+                        pg.scrubber.start(msg.deep, msg.repair)
+                    elif isinstance(msg, MRepScrub):
+                        pg.scrubber.handle_rep_scrub(msg)
+                    else:
+                        pg.scrubber.handle_rep_scrub_map(msg)
+            return True
         if isinstance(msg, MOSDPing):
             self._handle_ping(conn, msg)
             return True
@@ -387,6 +399,32 @@ class OSD(Dispatcher):
         while not self._stop.wait(interval):
             self._send_pg_stats()
             self._retry_stuck_peering()
+            self._maybe_schedule_scrub()
+
+    def _maybe_schedule_scrub(self) -> None:
+        """Periodic scrub scheduling (reference OSD::sched_scrub:
+        shallow every osd_scrub_interval, deep every
+        osd_deep_scrub_interval; 0 disables)."""
+        shallow = self.conf["osd_scrub_interval"]
+        deep_iv = self.conf["osd_deep_scrub_interval"]
+        now = time.time()
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            with pg.lock:
+                pg.scrubber.maybe_abort_stuck()
+        if shallow <= 0:
+            return
+        for pg in pgs:
+            with pg.lock:
+                if not pg.is_primary() or pg.state != STATE_ACTIVE \
+                        or pg.scrubber.active:
+                    continue
+                if now - pg.scrubber.last_scrub < shallow:
+                    continue
+                deep = deep_iv > 0 and \
+                    now - pg.scrubber.last_deep_scrub >= deep_iv
+                pg.scrubber.start(deep=deep, repair=False)
 
     def _send_pg_stats(self) -> None:
         stats: Dict[str, dict] = {}

@@ -103,6 +103,8 @@ class PG:
         # OSD tick (the reference retries via peering-event machinery)
         self.recovering: Dict[str, float] = {}
         self.backend = build_pg_backend(self, pool, service.ec_registry)
+        from .scrub import Scrubber
+        self.scrubber = Scrubber(self)
         self._ensure_collections()
         self._load_pgmeta()
 
@@ -258,6 +260,7 @@ class PG:
             self.primary_osd = acting_p
             self.interval_start = osdmap.epoch
             self.backend.on_change()
+            self.scrubber.reset()
             self._peer_notifies.clear()
             self.peer_missing.clear()
             self.recovering.clear()
@@ -786,6 +789,18 @@ class PG:
                     self.store.queue_transactions([txn])
         self._on_recovered(oid, 0)
 
+    def mark_shard_missing(self, oid: str, version: Eversion,
+                           shard: int, osd: int) -> None:
+        """Scrub repair found a bad copy: treat it as missing so the
+        recovery path rebuilds it (reference repair_object marking the
+        authoritative-divergent shard missing)."""
+        if osd == self.whoami:
+            self.missing.add(oid, version, None)
+            self._persist_pgmeta()
+        else:
+            ms = self.peer_missing.setdefault(shard, MissingSet())
+            ms.add(oid, version, None)
+
     def _missing_targets(self, oid: str) -> List[Tuple[int, int]]:
         targets: List[Tuple[int, int]] = []
         if self.missing.is_missing(oid):
@@ -841,6 +856,8 @@ class PG:
                     states.append("degraded")
                 else:
                     states.append("clean")
+            if self.scrubber.errors:
+                states.append("inconsistent")
             n_objects = len([o for o in self.backend.list_objects()
                              if o != PGMETA_OID])
             return {
@@ -852,4 +869,10 @@ class PG:
                 "acting": [o if o is not None else -1
                            for o in self.acting],
                 "up": [o if o is not None else -1 for o in self.up],
+                "num_scrub_errors": self.scrubber.errors,
+                "inconsistent": {
+                    oid: list(shards) for oid, shards in
+                    self.scrubber.inconsistent.items()},
+                "last_scrub": self.scrubber.last_scrub,
+                "last_deep_scrub": self.scrubber.last_deep_scrub,
             }

@@ -301,6 +301,41 @@ class ReplicatedBackend(PGBackend):
             return True
         return False
 
+    def build_scrub_map(self, deep: bool) -> Dict[str, dict]:
+        """Full-object snapshot (reference be_scan_list; deep CRCs per
+        ReplicatedBackend::be_deep_scrub, ReplicatedBackend.cc:614 —
+        whole-object data hash, omap hash, attr hash)."""
+        import zlib
+        out: Dict[str, dict] = {}
+        store = self.host.store
+        coll = self.host.coll
+        for obj in store.collection_list(coll):
+            if obj.oid.startswith("_pgmeta"):
+                continue
+            try:
+                st = store.stat(coll, obj)
+                entry: Dict[str, object] = {"size": st.size}
+                info = self.get_object_info(obj.oid)
+                entry["oi_version"] = list(info.version) if info else None
+                if deep:
+                    entry["data_crc"] = zlib.crc32(store.read(coll, obj))
+                    oc = 0
+                    omap = store.omap_get(coll, obj)
+                    for k in sorted(omap):
+                        oc = zlib.crc32(k.encode() + b"\0" + omap[k],
+                                        oc)
+                    entry["omap_crc"] = oc
+                    ac = 0
+                    attrs = store.getattrs(coll, obj)
+                    for k in sorted(attrs):
+                        ac = zlib.crc32(k.encode() + b"\0" + attrs[k],
+                                        ac)
+                    entry["attrs_crc"] = ac
+            except FileNotFoundError:
+                entry = {"error": "read_error"}
+            out[obj.oid] = entry
+        return out
+
     def on_change(self) -> None:
         self.in_flight.clear()
         self.recovery_ops.clear()

@@ -101,6 +101,9 @@ def _opts() -> List[Option]:
         Option("osd_pool_default_pg_num", int, 32, min=1),
         Option("osd_scrub_interval", float, 0.0, min=0.0,
                description="0 disables background scrub"),
+        Option("osd_deep_scrub_interval", float, 0.0, min=0.0,
+               description="deep-scrub cadence when background scrub "
+                           "is on (reference osd_deep_scrub_interval)"),
         Option("osd_recovery_chunk_size", int, 8 << 20, min=4096,
                description="recovery read window bytes "
                            "(reference osd_recovery_max_chunk)"),

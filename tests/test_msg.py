@@ -77,6 +77,12 @@ def sample_messages():
         M.MMonCommandAck(tid=1, retcode=0, rs="created",
                          out={"pool_id": 1}),
         M.MMonSubscribe(what={"osdmap": 5}),
+        M.MOSDScrub(pgid="1.4", deep=True, repair=False),
+        M.MRepScrub(pgid="1.4", shard=2, from_osd=0, tid=5, epoch=9,
+                    deep=True),
+        M.MRepScrubMap(pgid="1.4", shard=2, from_osd=1, tid=5,
+                       scrub_map={"obj": {"size": 512, "data_crc": 7,
+                                          "hinfo_ok": True}}),
     ]
 
 

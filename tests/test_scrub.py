@@ -1,0 +1,202 @@
+"""Scrub / repair / EIO tests over a live cluster.
+
+Reference analog: deep scrub comparing replica hashes
+(ReplicatedBackend::be_deep_scrub, ReplicatedBackend.cc:614) and EC
+shard CRCs vs HashInfo (ECBackend::be_deep_scrub, ECBackend.cc:2475);
+corruption handling per qa/standalone/erasure-code/test-erasure-eio.sh
+(corrupted shards surface as EIO, reads reconstruct from survivors,
+repair rebuilds the bad copy)."""
+import os
+import time
+
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.store.objectstore import Transaction
+
+
+
+@pytest.fixture
+def cl():
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        yield c
+
+
+def corrupt_object(cluster, oid, shard=None, skip_osd=None):
+    """Flip bytes of one stored copy of ``oid`` directly in an OSD's
+    store, under the daemon — simulated bit-rot (reference
+    test-erasure-eio.sh corrupting shard files on disk)."""
+    for osd_id, store in cluster.stores.items():
+        if osd_id == skip_osd:
+            continue
+        for coll in store.list_collections():
+            for obj in store.collection_list(coll):
+                if obj.oid != oid:
+                    continue
+                if shard is not None and obj.shard != shard:
+                    continue
+                st = store.stat(coll, obj)
+                if st.size == 0:
+                    continue
+                garbage = bytes((b ^ 0xFF) for b in
+                                store.read(coll, obj, 0, 64))
+                t = Transaction()
+                t.write(coll, obj, 0, garbage)
+                store.apply_transaction(t)
+                return osd_id, coll, obj
+    raise AssertionError(f"no copy of {oid} found to corrupt")
+
+
+def pg_stat_of(cluster, oid, pool_name):
+    ret, _, out = cluster.mon_command({"prefix": "pg dump"})
+    assert ret == 0
+    # find the pg holding oid: any pg stat listing it is fine; instead
+    # key by pgid computed client-side
+    r = cluster.rados()
+    io = r.open_ioctx(pool_name)
+    with r.objecter.lock:
+        pgid = r.objecter.osdmap.object_locator_to_pg(oid, io.pool_id)
+    return str(pgid), out["pg_stats"].get(str(pgid), {})
+
+
+def wait_scrub_errors(cluster, pgid, predicate, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ret, _, out = cluster.mon_command({"prefix": "pg dump"})
+        if ret == 0:
+            stat = out["pg_stats"].get(pgid, {})
+            if predicate(stat):
+                return stat
+        time.sleep(0.2)
+    raise TimeoutError(f"pg {pgid} never matched: last={stat}")
+
+
+def test_replicated_deep_scrub_detects_and_repairs(cl):
+    cl.create_pool("sp", "replicated", size=3)
+    io = cl.rados().open_ioctx("sp")
+    io.write_full("victim", os.urandom(8192))
+    good = io.read("victim")
+    cl.wait_for_clean(20)
+
+    pgid, _ = pg_stat_of(cl, "victim", "sp")
+    # corrupt one replica (not the primary: majority must out-vote it)
+    ret, _, out = cl.mon_command({"prefix": "pg dump"})
+    primary = out["pg_stats"][pgid]["acting"][0]
+    bad_osd, _, _ = corrupt_object(cl, "victim", skip_osd=primary)
+
+    # shallow scrub: size unchanged -> no error
+    ret, rs, _ = cl.mon_command({"prefix": "pg scrub", "pgid": pgid})
+    assert ret == 0, rs
+    time.sleep(1.0)
+    stat = wait_scrub_errors(cl, pgid,
+                             lambda s: s.get("last_scrub", 0) > 0)
+    assert stat.get("num_scrub_errors", 0) == 0
+
+    # deep scrub: CRC mismatch detected
+    ret, rs, _ = cl.mon_command({"prefix": "pg deep-scrub",
+                                 "pgid": pgid})
+    assert ret == 0, rs
+    stat = wait_scrub_errors(
+        cl, pgid, lambda s: s.get("num_scrub_errors", 0) > 0)
+    assert "victim" in stat["inconsistent"]
+    h = cl.health()
+    assert h["status"] == "HEALTH_ERR"
+
+    # repair: bad replica rebuilt from the authoritative majority
+    ret, rs, _ = cl.mon_command({"prefix": "pg repair", "pgid": pgid})
+    assert ret == 0, rs
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        ret, _, _ = cl.mon_command({"prefix": "pg deep-scrub",
+                                    "pgid": pgid})
+        ret, _, out = cl.mon_command({"prefix": "pg dump"})
+        stat = out["pg_stats"].get(pgid, {})
+        if stat.get("num_scrub_errors", 1) == 0 and \
+                stat.get("last_deep_scrub", 0) > 0 and \
+                stat.get("num_missing", 1) == 0:
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError(f"repair never converged: {stat}")
+    assert io.read("victim") == good
+    # the corrupted store copy itself must now hold good bytes
+    store = cl.stores[bad_osd]
+    for coll in store.list_collections():
+        for obj in store.collection_list(coll):
+            if obj.oid == "victim":
+                assert store.read(coll, obj) == good
+
+
+def test_ec_corrupt_shard_read_survives_and_repairs(cl):
+    """Bit-rot on a data shard: reads must reconstruct from parity
+    (hinfo CRC check -> EIO -> retry), deep scrub must localize the
+    bad shard, repair must rewrite it."""
+    cl.create_ec_profile("sep", plugin="jerasure", k="2", m="1")
+    cl.create_pool("sep1", "erasure", erasure_code_profile="sep")
+    io = cl.rados().open_ioctx("sep1")
+    payload = os.urandom(16384)
+    io.write_full("ecv", payload)
+    cl.wait_for_clean(20)
+
+    # corrupt data shard 0 wherever it lives
+    bad_osd, coll, obj = corrupt_object(cl, "ecv", shard=0)
+    assert obj.shard == 0
+
+    # client read still returns correct bytes via parity
+    assert io.read("ecv") == payload
+
+    pgid, _ = pg_stat_of(cl, "ecv", "sep1")
+    ret, rs, _ = cl.mon_command({"prefix": "pg deep-scrub",
+                                 "pgid": pgid})
+    assert ret == 0, rs
+    stat = wait_scrub_errors(
+        cl, pgid, lambda s: s.get("num_scrub_errors", 0) > 0)
+    assert stat["inconsistent"].get("ecv") == [0]
+
+    ret, rs, _ = cl.mon_command({"prefix": "pg repair", "pgid": pgid})
+    assert ret == 0, rs
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        cl.mon_command({"prefix": "pg deep-scrub", "pgid": pgid})
+        ret, _, out = cl.mon_command({"prefix": "pg dump"})
+        stat = out["pg_stats"].get(pgid, {})
+        if stat.get("num_scrub_errors", 1) == 0 and \
+                stat.get("num_missing", 1) == 0 and \
+                stat.get("last_deep_scrub", 0) > 0:
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError(f"EC repair never converged: {stat}")
+    # the shard object itself must be restored bit-exact
+    store = cl.stores[bad_osd]
+    data = store.read(coll, obj)
+    assert data[:64] != bytes((b ^ 0xFF) for b in data[:64])
+    assert io.read("ecv") == payload
+    cl.wait_for_clean(20)
+
+
+def test_periodic_background_scrub(tmp_path):
+    """osd_scrub_interval drives automatic scrubbing from the OSD tick
+    (reference OSD::sched_scrub)."""
+    from ceph_tpu.cluster import test_config
+    conf = test_config(osd_scrub_interval=0.5,
+                      osd_deep_scrub_interval=0.5)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("bg", "replicated", size=2)
+        io = c.rados().open_ioctx("bg")
+        io.write_full("auto", b"scrubme" * 100)
+        c.wait_for_clean(20)
+        deadline = time.monotonic() + 20
+        seen = False
+        while time.monotonic() < deadline and not seen:
+            ret, _, out = c.mon_command({"prefix": "pg dump"})
+            if ret == 0:
+                for stat in out["pg_stats"].values():
+                    if stat.get("last_deep_scrub", 0) > 0:
+                        seen = True
+            time.sleep(0.3)
+        assert seen, "background scrub never ran"
