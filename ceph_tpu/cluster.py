@@ -51,11 +51,15 @@ class Cluster:
 
     def __init__(self, n_osds: int = 3,
                  data_dir: Optional[str] = None,
-                 conf: Optional[Config] = None):
+                 conf: Optional[Config] = None,
+                 n_mons: int = 1):
         self.n_osds = n_osds
+        self.n_mons = n_mons
         self.data_dir = data_dir
         self.conf = conf or test_config()
         self.mon: Optional[Monitor] = None
+        self.mons: Dict[int, Optional[Monitor]] = {}
+        self._mon_addrs: List[Tuple[str, int]] = []
         self.osds: Dict[int, Optional[OSD]] = {}
         self.stores: Dict[int, ObjectStore] = {}
         self._clients: List[Rados] = []
@@ -76,16 +80,67 @@ class Cluster:
                 store.mkfs()
         return store
 
+    def _mon_path(self, rank: int) -> str:
+        if self.data_dir is None:
+            return ""
+        path = os.path.join(self.data_dir, f"mon.{rank}")
+        os.makedirs(path, exist_ok=True)
+        return path
+
     def start(self) -> "Cluster":
-        mon_path = ""
-        if self.data_dir is not None:
-            mon_path = os.path.join(self.data_dir, "mon.0")
-            os.makedirs(mon_path, exist_ok=True)
-        self.mon = Monitor(data_path=mon_path, conf=self.conf)
-        self.mon.start()
+        # construct every mon first (each binds its port), then share
+        # the monmap and start them (reference monmaptool --add before
+        # first boot)
+        for rank in range(self.n_mons):
+            self.mons[rank] = Monitor(name=f"mon.{rank}", rank=rank,
+                                      data_path=self._mon_path(rank),
+                                      conf=self.conf)
+        self._mon_addrs = [self.mons[r].my_addr
+                           for r in range(self.n_mons)]
+        for rank in range(self.n_mons):
+            self.mons[rank].set_monmap(self._mon_addrs)
+            self.mons[rank].start()
+        self.mon = self.mons[0]
+        if self.n_mons > 1:
+            self.wait_for_quorum()
         for i in range(self.n_osds):
             self.start_osd(i)
         return self
+
+    def wait_for_quorum(self, timeout: float = 15.0) -> int:
+        """Block until some live mon is leader; -> leader rank."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for mon in self.mons.values():
+                if mon is not None and mon.quorum.is_leader():
+                    return mon.rank
+            time.sleep(0.05)
+        raise TimeoutError("no mon leader elected")
+
+    def kill_mon(self, rank: int) -> None:
+        mon = self.mons.get(rank)
+        if mon is not None:
+            mon.shutdown()
+            self.mons[rank] = None
+            if self.mon is mon:
+                self.mon = next((m for m in self.mons.values()
+                                 if m is not None), None)
+
+    def revive_mon(self, rank: int) -> Monitor:
+        mon = Monitor(name=f"mon.{rank}", rank=rank,
+                      data_path=self._mon_path(rank), conf=self.conf)
+        # rebind moved the port: patch the live monmaps in place (the
+        # reference keeps mon addrs stable; our test mons bind port 0)
+        self._mon_addrs[rank] = mon.my_addr
+        mon.set_monmap(self._mon_addrs)
+        for other in self.mons.values():
+            if other is not None:
+                other.quorum.monmap[rank] = mon.my_addr
+        mon.start()
+        self.mons[rank] = mon
+        if self.mon is None:
+            self.mon = mon
+        return mon
 
     def start_osd(self, osd_id: int) -> OSD:
         store = self.stores.get(osd_id)
@@ -93,10 +148,18 @@ class Cluster:
             store = self._make_store(osd_id)
             self.stores[osd_id] = store
         store.mount()
-        osd = OSD(osd_id, store, self.mon_addr, conf=self.conf)
+        osd = OSD(osd_id, store, self.client_mon_addrs(),
+                  conf=self.conf)
         osd.start()
         self.osds[osd_id] = osd
         return osd
+
+    def client_mon_addrs(self):
+        """What clients/daemons dial: the single mon addr, or the full
+        monmap so MonClient can hunt."""
+        if self.n_mons == 1:
+            return self.mon_addr
+        return list(self._mon_addrs)
 
     def stop(self) -> None:
         for client in self._clients:
@@ -106,9 +169,11 @@ class Cluster:
             if osd is not None:
                 osd.shutdown()
         self.osds = {i: None for i in self.osds}
-        if self.mon is not None:
-            self.mon.shutdown()
-            self.mon = None
+        for rank, mon in list(self.mons.items()):
+            if mon is not None:
+                mon.shutdown()
+                self.mons[rank] = None
+        self.mon = None
 
     def __enter__(self) -> "Cluster":
         return self.start()
@@ -141,12 +206,13 @@ class Cluster:
     # admin conveniences (reference ceph CLI paths)
     # ------------------------------------------------------------------
     def rados(self, timeout: float = 10.0) -> Rados:
-        client = Rados(self.mon_addr, conf=self.conf).connect(timeout)
+        client = Rados(self.client_mon_addrs(),
+                       conf=self.conf).connect(timeout)
         self._clients.append(client)
         return client
 
     def mon_command(self, cmd: dict) -> Tuple[int, str, dict]:
-        with Rados(self.mon_addr, conf=self.conf) as r:
+        with Rados(self.client_mon_addrs(), conf=self.conf) as r:
             return r.mon_command(cmd)
 
     def create_ec_profile(self, name: str, **kv) -> None:

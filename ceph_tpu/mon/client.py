@@ -14,6 +14,7 @@ locking from there.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Dict, Optional, Tuple
 
 from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
@@ -31,10 +32,18 @@ class MonClient(Dispatcher):
     hosting entity passes its own messenger; the monclient owns only
     the mon connection."""
 
-    def __init__(self, msgr: Messenger, mon_addr: Tuple[str, int],
+    def __init__(self, msgr: Messenger, mon_addr,
                  map_cb: Optional[Callable[[dict], None]] = None):
+        """``mon_addr``: one (host, port) or a list of them (the
+        monmap).  With several, the client hunts: failed sessions
+        rotate to the next mon (reference MonClient::_reopen_session
+        hunting)."""
         self.msgr = msgr
-        self.mon_addr = mon_addr
+        if mon_addr and isinstance(mon_addr[0], (tuple, list)):
+            self.mon_addrs = [tuple(a) for a in mon_addr]
+        else:
+            self.mon_addrs = [tuple(mon_addr)]
+        self._addr_idx = 0
         self.map_cb = map_cb
         self.log = Dout("mon", f"monc({msgr.name}) ")
         self.lock = threading.RLock()
@@ -43,18 +52,66 @@ class MonClient(Dispatcher):
         self._cmd_events: Dict[int, threading.Event] = {}
         self._cmd_acks: Dict[int, MMonCommandAck] = {}
         self._latest_epoch = 0
+        self._sub_epoch: Optional[int] = None
         msgr.add_dispatcher(self)
+
+    @property
+    def mon_addr(self) -> Tuple[str, int]:
+        return self.mon_addrs[self._addr_idx % len(self.mon_addrs)]
 
     # ------------------------------------------------------------------
     def connect(self) -> None:
+        # lossy, like the reference's client->mon policy: a dead mon
+        # resets the session immediately so hunting can move on,
+        # instead of a lossless reconnect loop pinning us to a corpse
         with self.lock:
             if self.conn is None or not self.conn.is_connected():
                 self.conn = self.msgr.connect_to(self.mon_addr,
-                                                 lossless=True)
+                                                 lossless=False)
 
     def _mon_conn(self) -> Connection:
         self.connect()
         return self.conn
+
+    def _retarget(self, addr: Tuple[str, int]) -> None:
+        """Point the session at a specific mon (leader redirect or
+        hunting)."""
+        with self.lock:
+            addr = (addr[0], int(addr[1]))
+            if addr not in self.mon_addrs:
+                self.mon_addrs.append(addr)
+            self._addr_idx = self.mon_addrs.index(addr)
+            old, self.conn = self.conn, None
+        if old is not None:
+            old.mark_down()
+        self.connect()
+        with self.lock:
+            sub = self._sub_epoch
+        if sub is not None:
+            self.subscribe_osdmap(self._latest_epoch + 1)
+
+    def ms_handle_reset(self, conn: Connection) -> None:
+        """Session died (mon crashed): hunt to the next mon and renew
+        subscriptions (reference MonClient hunting)."""
+        with self.lock:
+            if conn is not self.conn or len(self.mon_addrs) == 1:
+                return
+            self._addr_idx = (self._addr_idx + 1) % len(self.mon_addrs)
+            self.conn = None
+            sub = self._sub_epoch
+        self.log.dout(1, f"mon session reset, hunting to "
+                      f"{self.mon_addr}")
+        # pace the hunt: with every mon down, back-to-back ECONNREFUSED
+        # resets would otherwise spin through the monmap at full speed
+        time.sleep(0.2)
+        if self.msgr.is_stopping():
+            return
+        try:
+            self.connect()
+            if sub is not None:
+                self.subscribe_osdmap(self._latest_epoch + 1)
+        except Exception:
+            pass
 
     def ms_dispatch(self, conn: Connection, msg) -> bool:
         if isinstance(msg, MMonCommandAck):
@@ -80,15 +137,55 @@ class MonClient(Dispatcher):
     # subscriptions (reference MonClient::sub_want + renew)
     # ------------------------------------------------------------------
     def subscribe_osdmap(self, since_epoch: int = 0) -> None:
+        with self.lock:
+            self._sub_epoch = since_epoch
         self._mon_conn().send_message(
             MMonSubscribe(what={"osdmap": since_epoch}))
 
     # ------------------------------------------------------------------
     # commands (reference MonClient::start_mon_command)
     # ------------------------------------------------------------------
+    REDIRECT_RETCODE = -301          # monitor.py REDIRECT_RETCODE
+
     def command(self, cmd: dict, timeout: float = 30.0
                 ) -> Tuple[int, str, dict]:
-        """Synchronous monitor command; -> (retcode, status, out)."""
+        """Synchronous monitor command; -> (retcode, status, out).
+        Follows peon->leader redirects and hunts to another mon on
+        timeout (reference MonClient resends commands on session
+        change; peon forwarding becomes an explicit redirect here)."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise CommandTimeout(
+                    f"mon command {cmd.get('prefix')!r} unresolved "
+                    f"within {timeout}s")
+            try:
+                ret, rs, out = self._command_once(
+                    cmd, min(5.0, max(0.5, remaining)))
+            except CommandTimeout:
+                if time.monotonic() >= deadline:
+                    raise
+                with self.lock:          # hunt to the next mon
+                    if len(self.mon_addrs) > 1:
+                        self._addr_idx = (self._addr_idx + 1) % \
+                            len(self.mon_addrs)
+                        old, self.conn = self.conn, None
+                    else:
+                        old = None
+                if old is not None:
+                    old.mark_down()
+                continue
+            if ret == self.REDIRECT_RETCODE and "leader" in out:
+                self._retarget(tuple(out["leader"]))
+                continue
+            if ret == -11 and "electing" in rs:
+                time.sleep(0.5)          # quorum forming: retry
+                continue
+            return ret, rs, out
+
+    def _command_once(self, cmd: dict, timeout: float
+                      ) -> Tuple[int, str, dict]:
         with self.lock:
             self._next_tid += 1
             tid = self._next_tid

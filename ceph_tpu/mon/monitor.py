@@ -42,9 +42,9 @@ from typing import Dict, List, Optional, Tuple
 
 from ..crush.wrapper import CrushWrapper, weight_to_fixed
 from ..ec import registry as ec_registry
-from ..msg.messages import (MMonCommand, MMonCommandAck, MMonSubscribe,
-                            MOSDBoot, MOSDFailure, MOSDMap, MOSDScrub,
-                            MPGStats)
+from ..msg.messages import (MMonCommand, MMonCommandAck, MMonMon,
+                            MMonSubscribe, MOSDBoot, MOSDFailure,
+                            MOSDMap, MOSDScrub, MPGStats)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..osd.osdmap import (Incremental, OSDMap, PGid, PGPool,
                           POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
@@ -53,6 +53,7 @@ from ..utils.config import Config, default_config
 from ..utils.log import Dout
 
 DEFAULT_STRIPE_UNIT = 4096      # reference osd_pool_erasure_code_stripe_unit
+REDIRECT_RETCODE = -301         # "ask the leader" (MonClient retries)
 
 
 class MonitorDBStore:
@@ -88,8 +89,10 @@ class Monitor(Dispatcher):
 
     def __init__(self, name: str = "mon.0", data_path: str = "",
                  conf: Optional[Config] = None,
-                 addr: Tuple[str, int] = ("127.0.0.1", 0)):
+                 addr: Tuple[str, int] = ("127.0.0.1", 0),
+                 rank: int = 0):
         self.name = name
+        self.rank = rank
         self.conf = conf or default_config()
         self.log = Dout("mon", f"{name} ")
         self.lock = threading.RLock()
@@ -111,7 +114,17 @@ class Monitor(Dispatcher):
         self._stop = threading.Event()
         self._tick_thread: Optional[threading.Thread] = None
         self._down_since: Dict[int, float] = {}
+        # single-mon monmap by default; multi-mon deployments call
+        # set_monmap with every mon's address before start()
+        from .paxos import QuorumService
+        self.quorum = QuorumService(self, rank, [self.my_addr])
         self._load_or_bootstrap()
+
+    def set_monmap(self, monmap: List[Tuple[str, int]]) -> None:
+        """Install the full monitor map (reference MonMap); must be
+        called on every mon before start() in multi-mon deployments."""
+        from .paxos import QuorumService
+        self.quorum = QuorumService(self, self.rank, monmap)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -138,6 +151,12 @@ class Monitor(Dispatcher):
             target=self._tick_loop, name=f"{self.name}-tick", daemon=True)
         self._tick_thread.start()
         self.log.dout(1, f"listening on {self.my_addr}")
+        if self.quorum.n_mons > 1:
+            self.quorum.start_election()
+
+    def on_quorum_formed(self) -> None:
+        """Called on the new leader after victory."""
+        self.log.dout(1, f"quorum formed: {sorted(self.quorum.quorum)}")
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -149,19 +168,49 @@ class Monitor(Dispatcher):
     # ------------------------------------------------------------------
     # map commit + publish (reference Paxos propose/commit -> publish)
     # ------------------------------------------------------------------
+    class NoQuorum(RuntimeError):
+        pass
+
     def _commit(self, inc: Incremental) -> None:
-        """Caller need not hold the lock; commits serialize on it."""
+        """Caller need not hold the lock; commits serialize on it.
+        Multi-mon: the new map is REPLICATED FIRST (paxos begin/accept
+        to a majority) and only then applied/persisted/published —
+        a minority leader cannot advance the map (reference
+        Paxos::begin gates commit on accepts)."""
         with self.lock:
-            self.osdmap.apply_incremental(inc)
-            wire = self.osdmap.to_wire_dict()
-            self.store.put_map(self.osdmap.epoch, wire)
+            candidate = self.osdmap.clone()
+            candidate.apply_incremental(inc)
+            wire = candidate.to_wire_dict()
+            epoch = candidate.epoch
+            if self.quorum.n_mons > 1:
+                if not self.quorum.is_leader():
+                    raise Monitor.NoQuorum("not the leader")
+                if not self.quorum.propose(epoch, wire):
+                    raise Monitor.NoQuorum(
+                        "no quorum majority, map change rejected")
+            self.osdmap = candidate
+            self.store.put_map(epoch, wire)
             targets = [(conn, since) for conn, since in self.subs.items()
-                       if since <= self.osdmap.epoch]
+                       if since <= epoch]
             for conn, _ in targets:
-                self.subs[conn] = self.osdmap.epoch + 1
-            epoch = self.osdmap.epoch
+                self.subs[conn] = epoch + 1
         for conn, _ in targets:
             conn.send_message(MOSDMap(maps={epoch: wire}))
+
+    def apply_replicated(self, version: int, wire: dict) -> None:
+        """Peon-side: install a map the leader replicated (paxos commit
+        or catch-up sync) and publish to this mon's subscribers."""
+        with self.lock:
+            if version <= self.osdmap.epoch:
+                return
+            self.osdmap = OSDMap.from_wire_dict(wire)
+            self.store.put_map(version, wire)
+            targets = [(conn, since) for conn, since in self.subs.items()
+                       if since <= version]
+            for conn, _ in targets:
+                self.subs[conn] = version + 1
+        for conn, _ in targets:
+            conn.send_message(MOSDMap(maps={version: wire}))
 
     def _pending(self) -> Incremental:
         return Incremental(self.osdmap.epoch + 1)
@@ -170,19 +219,54 @@ class Monitor(Dispatcher):
     # dispatch
     # ------------------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMonMon):
+            self.quorum.handle(msg)
+            return True
         if isinstance(msg, MMonSubscribe):
             self._handle_subscribe(conn, msg)
         elif isinstance(msg, MMonCommand):
             self._handle_command(conn, msg)
-        elif isinstance(msg, MOSDBoot):
-            self._handle_boot(conn, msg)
-        elif isinstance(msg, MOSDFailure):
-            self._handle_failure(conn, msg)
-        elif isinstance(msg, MPGStats):
-            self._handle_pg_stats(conn, msg)
+        elif isinstance(msg, (MOSDBoot, MOSDFailure, MPGStats)):
+            # map-mutating / aggregate reports belong to the leader; a
+            # peon relays (reference mons forward to the leader via
+            # MRoute/MForward)
+            if not self.quorum.is_leader():
+                self._forward_to_leader(msg)
+                if isinstance(msg, MOSDBoot):
+                    # still remember the direct session for scrub etc.
+                    self._note_osd_conn(conn, msg)
+                return True
+            try:
+                if isinstance(msg, MOSDBoot):
+                    self._handle_boot(conn, msg)
+                elif isinstance(msg, MOSDFailure):
+                    self._handle_failure(conn, msg)
+                else:
+                    self._handle_pg_stats(conn, msg)
+            except Monitor.NoQuorum:
+                pass                     # senders re-announce
         else:
             return False
         return True
+
+    def _forward_to_leader(self, msg) -> None:
+        addr = self.quorum.leader_addr()
+        if addr is None:
+            return                       # electing: sender retries
+        try:
+            msg.seq = 0                  # re-stamped on the relay conn
+            self.msgr.connect_to(
+                addr, peer_name=f"mon.{self.quorum.leader}"
+            ).send_message(msg)
+        except Exception:
+            pass
+
+    def _note_osd_conn(self, conn: Optional[Connection],
+                       msg: MOSDBoot) -> None:
+        if conn is not None and \
+                not conn.peer_name.startswith("mon."):
+            with self.lock:
+                self.osd_conns[msg.osd] = conn
 
     def ms_handle_reset(self, conn: Connection) -> None:
         with self.lock:
@@ -208,13 +292,13 @@ class Monitor(Dispatcher):
     # ------------------------------------------------------------------
     def _handle_boot(self, conn: Connection, msg: MOSDBoot) -> None:
         osd, addr = msg.osd, tuple(msg.addr)
+        # remember the OSD's own mon session: mon->OSD commands (scrub
+        # etc.) ride it back, since dialing the OSD fresh would collide
+        # with its MonClient session (the reference likewise sends
+        # MOSDScrub down the OSD's mon connection).  Forwarded boots
+        # arrive over a mon-mon conn, which is not an OSD session.
+        self._note_osd_conn(conn, msg)
         with self.lock:
-            # remember the OSD's own mon session: mon->OSD commands
-            # (scrub etc.) ride it back, since dialing the OSD fresh
-            # would collide with its MonClient session (the reference
-            # likewise sends MOSDScrub down the OSD's mon connection)
-            if conn is not None:
-                self.osd_conns[osd] = conn
             info = self.osdmap.osds.get(osd)
             if info is not None and info.up and info.addr == addr:
                 return                   # duplicate boot
@@ -339,9 +423,17 @@ class Monitor(Dispatcher):
     def _tick_loop(self) -> None:
         interval = self.conf["mon_tick_interval"]
         while not self._stop.wait(interval):
-            self._tick()
+            try:
+                self._tick()
+            except Monitor.NoQuorum:
+                pass                     # aging retries next tick
+            except Exception as e:
+                self.log.dout(1, f"tick failed: {e!r}")
 
     def _tick(self) -> None:
+        self.quorum.tick()
+        if not self.quorum.is_leader():
+            return                       # map aging is the leader's job
         down_out = self.conf["mon_osd_down_out_interval"]
         if down_out <= 0:
             return
@@ -372,9 +464,27 @@ class Monitor(Dispatcher):
     # ------------------------------------------------------------------
     # commands (reference mon/MonCommands.h table + OSDMonitor handlers)
     # ------------------------------------------------------------------
+    # commands a peon can serve from its own state/sessions
+    _LOCAL_COMMANDS = ("pg scrub", "pg deep-scrub", "pg repair")
+
     def _handle_command(self, conn: Connection, msg: MMonCommand) -> None:
         cmd = msg.cmd
         prefix = cmd.get("prefix", "")
+        if self.quorum.n_mons > 1 and not self.quorum.is_leader() \
+                and prefix not in self._LOCAL_COMMANDS:
+            # redirect to the leader (observable equivalent of the
+            # reference's MForward routing through the leader)
+            addr = self.quorum.leader_addr()
+            if addr is None:
+                ack = MMonCommandAck(tid=msg.tid, retcode=-11,
+                                     rs="quorum is electing, retry")
+            else:
+                ack = MMonCommandAck(
+                    tid=msg.tid, retcode=REDIRECT_RETCODE,
+                    rs=f"not leader; retry at mon.{self.quorum.leader}",
+                    out={"leader": list(addr)})
+            conn.send_message(ack)
+            return
         handler = self.COMMANDS.get(prefix)
         if handler is None:
             ack = MMonCommandAck(tid=msg.tid, retcode=-22,
@@ -384,6 +494,12 @@ class Monitor(Dispatcher):
                 retcode, rs, out = handler(self, cmd)
                 ack = MMonCommandAck(tid=msg.tid, retcode=retcode, rs=rs,
                                      out=out)
+            except Monitor.NoQuorum as e:
+                # -11 + "electing" is the retry signal MonClient
+                # already understands
+                ack = MMonCommandAck(tid=msg.tid, retcode=-11,
+                                     rs=f"quorum is electing, "
+                                        f"retry: {e}")
             except Exception as e:       # command errors go to the CLI
                 ack = MMonCommandAck(tid=msg.tid, retcode=-22, rs=str(e))
         conn.send_message(ack)
@@ -652,8 +768,19 @@ class Monitor(Dispatcher):
             _, primary, _, _ = self.osdmap.pg_to_up_acting_osds(pgid)
             conn = (self.osd_conns.get(primary)
                     if primary is not None else None)
-        if primary is None or conn is None:
+        if primary is None:
             return (-11, f"pg {pgid} has no up primary", {})
+        if conn is None:
+            # the primary's mon session lives on another mon (OSDs
+            # session to one mon each): bounce the client to the
+            # leader, the usual session holder
+            addr = self.quorum.leader_addr()
+            if not self.quorum.is_leader() and addr is not None:
+                return (REDIRECT_RETCODE,
+                        f"no session with osd.{primary} here; retry "
+                        f"at mon.{self.quorum.leader}",
+                        {"leader": list(addr)})
+            return (-11, f"no mon session with osd.{primary}", {})
         conn.send_message(MOSDScrub(
             pgid=str(pgid), deep=deep, repair=repair))
         verb = ("repair" if repair else

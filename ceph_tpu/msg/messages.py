@@ -836,6 +836,52 @@ class MRepScrubMap(Message):
                    tid=d.u64(), scrub_map=_dec_json(d.bytes()))
 
 
+@register
+class MMonMon(Message):
+    """Mon <-> mon quorum traffic (reference messages/MMonElection.h +
+    MMonPaxos.h collapsed into one op-tagged frame).  ``op`` is one of:
+    election ops ``propose``/``ack``/``victory``; paxos ops ``begin``/
+    ``accept``/``commit``/``lease``; catch-up ops ``sync_req``/``sync``.
+    ``value``/``maps`` carry full OSDMap wire dicts (low-rate control
+    plane, JSON like the mon command path)."""
+    TYPE = 93
+
+    def __init__(self, op: str = "", from_rank: int = -1,
+                 epoch: int = 0, version: int = 0,
+                 last_committed: int = 0,
+                 value: Optional[dict] = None,
+                 quorum: Optional[List[int]] = None,
+                 maps: Optional[Dict[int, dict]] = None):
+        super().__init__()
+        self.op = op
+        self.from_rank = from_rank
+        self.epoch = epoch                  # election epoch
+        self.version = version              # paxos version (map epoch)
+        self.last_committed = last_committed
+        self.value = value                  # proposed full-map wire dict
+        self.quorum = quorum or []
+        self.maps = maps or {}              # epoch -> wire dict (sync)
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.op).i32(self.from_rank).u32(self.epoch)
+        e.u32(self.version).u32(self.last_committed)
+        e.bytes(_enc_json(self.value))
+        e.i64_list(self.quorum)
+        e.bytes(_enc_json({str(k): v for k, v in self.maps.items()}))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MMonMon":
+        d = Decoder(buf)
+        out = cls(op=d.str(), from_rank=d.i32(), epoch=d.u32(),
+                  version=d.u32(), last_committed=d.u32())
+        out.value = _dec_json(d.bytes())
+        out.quorum = [int(x) for x in d.i64_list()]
+        out.maps = {int(k): v for k, v in _dec_json(d.bytes()).items()}
+        return out
+
+
 # ---------------------------------------------------------------------------
 # monitor control plane (reference MMonCommand.h, MMonSubscribe.h)
 # ---------------------------------------------------------------------------

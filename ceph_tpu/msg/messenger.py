@@ -136,6 +136,7 @@ class Connection:
         self._reconnecting = False     # at most one reconnect thread
         self._pumps_started = False
         self.peer_nonce: Optional[int] = None
+        self.intended_peer = ""        # who connect_to() meant to reach
         self._recv_since_ack = 0
         self._recv_bytes_since_ack = 0
 
@@ -387,14 +388,27 @@ class Messenger:
 
     # -- connect side ------------------------------------------------------
     def connect_to(self, addr: Tuple[str, int],
-                   lossless: bool = True) -> Connection:
-        """Get (or create) the connection to the peer at ``addr``."""
+                   lossless: bool = True,
+                   peer_name: str = "") -> Connection:
+        """Get (or create) the connection to the peer at ``addr``.
+
+        ``peer_name`` (when the caller knows who lives there, e.g.
+        "osd.3" / "mon.1") makes the session full-duplex: an already-
+        accepted connection FROM that peer is reused instead of
+        dialing a second, competing session — the accepted conn's
+        peer_addr is an ephemeral port, so the addr scan alone can
+        never find it (reference msgr keeps one session per entity)."""
         addr = (addr[0], int(addr[1]))
         with self.lock:
+            if peer_name:
+                conn = self.conns_by_name.get(peer_name)
+                if conn is not None and conn.state != "closed":
+                    return conn
             for conn in self.conns:
                 if conn.peer_addr == addr and conn.state != "closed":
                     return conn
             conn = Connection(self, addr, lossless, connector=True)
+            conn.intended_peer = peer_name
             self.conns.append(conn)
         with conn.lock:
             conn._spawn_reconnect_locked()
@@ -425,6 +439,28 @@ class Messenger:
                     if not conn.lossless:
                         conn._close(reset=True)
                         return
+                    # if this dial lost a connection race (the peer's
+                    # acceptor rejects us because ITS dial won), an
+                    # accepted session to the same peer exists: hand
+                    # our queued messages to it and retire this conn
+                    # instead of redialing forever
+                    if conn.intended_peer:
+                        with self.lock:
+                            winner = self.conns_by_name.get(
+                                conn.intended_peer)
+                        if winner is not None and winner is not conn \
+                                and winner.state == "open":
+                            with conn.lock:
+                                pending = list(conn.unacked) + \
+                                    [m for m in conn.out_q
+                                     if m.TYPE != MAck.TYPE]
+                                conn.unacked.clear()
+                                conn.out_q.clear()
+                            conn.mark_down()
+                            for m in pending:
+                                m.seq = 0
+                                winner.send_message(m)
+                            return
                     time.sleep(retry)
                     continue
                 with self.lock:
@@ -480,6 +516,19 @@ class Messenger:
                         # (addr, nonce) as the session identity).
                         stale = conn
                         conn = None
+                    elif conn is not None and conn.connector and \
+                            self.name < peer_name:
+                        # CONNECTION RACE: we dialed them while they
+                        # dialed us.  Without a deterministic winner
+                        # each attach keeps killing the other side's
+                        # socket in a loop.  Rule: the dial FROM the
+                        # lexicographically smaller name wins
+                        # (reference ProtocolV2 reuses existing vs
+                        # replace by address comparison) — ours does:
+                        # reject their dial; they adopt ours when our
+                        # banner lands on their acceptor.
+                        _shutdown_close(sock)
+                        return
                     if conn is None or conn.state == "closed" \
                             or not conn.lossless:
                         conn = Connection(self, sock.getpeername(),

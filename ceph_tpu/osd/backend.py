@@ -205,6 +205,12 @@ class PGBackend(abc.ABC):
         """Acting set changed (new interval): drop in-flight ops; the
         clients will resend (reference on_change)."""
 
+    def inflight_writes(self) -> int:
+        """Writes submitted but not yet fully committed — scrub waits
+        for zero before snapshotting (reference scrubber write
+        blocking)."""
+        return 0
+
     def build_scrub_map(self, deep: bool) -> Dict[str, dict]:
         """Per-object consistency snapshot of this OSD's local shard
         (reference ScrubMap built in PGBackend::be_scan_list +

@@ -704,6 +704,9 @@ class ECBackend(PGBackend):
                 reply.errors.append((oid, -2))
         self.host.send_shard(msg.from_osd, reply)
 
+    def inflight_writes(self) -> int:
+        return len(self._pipeline)
+
     def build_scrub_map(self, deep: bool) -> Dict[str, dict]:
         """Per-shard-object snapshot (reference ECBackend::be_deep_scrub,
         ECBackend.cc:2475-2579): under deep, recompute this shard's CRC

@@ -180,7 +180,7 @@ class OSD(Dispatcher):
         # reports) but we're alive, re-boot (reference OSD re-sends
         # MOSDBoot when marked down while up)
         info = newmap.osds.get(self.whoami)
-        if info is not None and not info.up and not self._stop.is_set():
+        if (info is None or not info.up) and not self._stop.is_set():
             self.monc.send_boot(self.whoami, self.my_addr)
 
     def _advance_pgs(self, osdmap: OSDMap) -> None:
@@ -314,7 +314,8 @@ class OSD(Dispatcher):
             self.log.dout(10, f"no addr for osd.{osd}, dropping "
                           f"{type(msg).__name__}")
             return
-        self.msgr.connect_to(addr, lossless=True).send_message(msg)
+        self.msgr.connect_to(addr, lossless=True,
+                             peer_name=f"osd.{osd}").send_message(msg)
 
     # ------------------------------------------------------------------
     # heartbeats (reference OSD.cc:5079-5632)
@@ -400,6 +401,22 @@ class OSD(Dispatcher):
             self._send_pg_stats()
             self._retry_stuck_peering()
             self._maybe_schedule_scrub()
+            self._maybe_reboot()
+
+    def _maybe_reboot(self) -> None:
+        """The boot can be lost to a mon election (commit rejected by
+        a dissolving quorum, or a lossy mon session dropping it):
+        keep re-announcing until the map shows us up (reference OSD
+        start_boot retry ticks)."""
+        with self.map_lock:
+            info = self.osdmap.osds.get(self.whoami)
+        if (info is None or not info.up or
+                tuple(info.addr or ()) != tuple(self.my_addr)) \
+                and not self._stop.is_set():
+            try:
+                self.monc.send_boot(self.whoami, self.my_addr)
+            except Exception:
+                pass
 
     def _maybe_schedule_scrub(self) -> None:
         """Periodic scrub scheduling (reference OSD::sched_scrub:
@@ -413,6 +430,7 @@ class OSD(Dispatcher):
         for pg in pgs:
             with pg.lock:
                 pg.scrubber.maybe_abort_stuck()
+                pg.scrubber.kick()       # drain-wait retries
         if shallow <= 0:
             return
         for pg in pgs:

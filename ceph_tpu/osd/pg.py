@@ -93,6 +93,7 @@ class PG:
         # behind an in-flight write to the same object
         self.inflight_writes: Set[str] = set()
         self.waiting_for_obj: Dict[str, deque] = {}
+        self.waiting_for_scrub: deque = deque()
         # every client op this PG currently holds, by reqid; on an
         # interval change they all bounce back to the client for
         # re-targeting (reference on_change requeue + client resend)
@@ -514,6 +515,12 @@ class PG:
     def _do_op(self, msg: MOSDOp, conn) -> None:
         has_write = any(op.op in WRITE_OPS for op in msg.ops)
         oid = msg.oid
+        if has_write and self.scrubber.write_blocked():
+            # scrub snapshots must describe one committed state; new
+            # writes wait for the round (reference write blocking on
+            # the scrubbed chunk)
+            self.waiting_for_scrub.append((msg, conn))
+            return
         if has_write and self._is_degraded(oid):
             # block until recovered (reference wait_for_degraded_object)
             self.waiting_for_degraded.setdefault(oid, deque()).append(
@@ -621,6 +628,8 @@ class PG:
             if not q:
                 del self.waiting_for_obj[msg.oid]
             self._do_op(nmsg, nconn)
+        # a scrub waiting for the write pipeline to drain may now run
+        self.scrubber.kick()
 
     def _do_reads(self, msg: MOSDOp, conn) -> None:
         out_data: List[bytes] = [b""] * len(msg.ops)
@@ -788,6 +797,12 @@ class PG:
                     txn.remove(self.coll, obj)
                     self.store.queue_transactions([txn])
         self._on_recovered(oid, 0)
+
+    def requeue_scrub_waiters(self) -> None:
+        waiters, self.waiting_for_scrub = \
+            self.waiting_for_scrub, deque()
+        for msg, conn in waiters:
+            self._do_op(msg, conn)
 
     def mark_shard_missing(self, oid: str, version: Eversion,
                            shard: int, osd: int) -> None:

@@ -301,6 +301,9 @@ class ReplicatedBackend(PGBackend):
             return True
         return False
 
+    def inflight_writes(self) -> int:
+        return len(self.in_flight)
+
     def build_scrub_map(self, deep: bool) -> Dict[str, dict]:
         """Full-object snapshot (reference be_scan_list; deep CRCs per
         ReplicatedBackend::be_deep_scrub, ReplicatedBackend.cc:614 —

@@ -21,8 +21,10 @@ Comparison rules:
 
 Scrub runs whole-PG in one pass (our PGs are test-scale; the
 reference chunks the object range with scrubber.start/end and blocks
-writes per chunk — here the PG lock over the compare gives the same
-exclusion)."""
+writes per chunk).  Write exclusion: new client writes queue on the
+primary for the duration of the round and the snapshot waits for the
+in-flight pipeline to drain (``kick``), so every shard's map
+describes the same committed state."""
 from __future__ import annotations
 
 import time
@@ -44,6 +46,7 @@ class Scrubber:
         self.deep = False
         self.repair = False
         self.tid = 0
+        self._collected = False
         self.waiting_on: Dict[int, int] = {}     # shard -> osd
         self.maps: Dict[int, Dict[str, dict]] = {}   # shard -> scrub map
         # results of the last completed scrub
@@ -74,6 +77,27 @@ class Scrubber:
         self.tid += 1
         self.maps = {}
         self.waiting_on = {}
+        self._collected = False
+        # snapshots must all describe the same committed state: new
+        # writes are blocked (write_blocked -> PG queues them) and the
+        # map collection waits until in-flight writes drain (the
+        # reference blocks writes on the scrubbed chunk range)
+        self.kick()
+        return True
+
+    def write_blocked(self) -> bool:
+        """Client writes queue while a scrub round is running."""
+        return self.active
+
+    def kick(self) -> None:
+        """Collect the maps once the write pipeline is empty (called
+        from start, write completions, and the OSD tick)."""
+        pg = self.pg
+        if not self.active or self._collected:
+            return
+        if pg.backend.inflight_writes() > 0:
+            return
+        self._collected = True
         # replicated PGs carry own_shard=-1 but appear in acting_shards
         # under their acting index — key the local map consistently so
         # compare/repair can resolve it back to an OSD
@@ -84,17 +108,16 @@ class Scrubber:
                     own = shard
                     break
         self._own_key = own
-        self.maps[own] = pg.backend.build_scrub_map(deep)
+        self.maps[own] = pg.backend.build_scrub_map(self.deep)
         for shard, osd in pg.acting_shards():
             if osd is None or osd == pg.whoami:
                 continue
             self.waiting_on[shard] = osd
             pg.send_shard(osd, MRepScrub(
                 pgid=pg.pgid_str, shard=shard, from_osd=pg.whoami,
-                tid=self.tid, epoch=pg.epoch, deep=deep))
+                tid=self.tid, epoch=pg.epoch, deep=self.deep))
         if not self.waiting_on:
             self._finish()
-        return True
 
     def reset(self) -> None:
         """Abort an in-flight round (interval change / peer loss);
@@ -102,6 +125,7 @@ class Scrubber:
         self.active = False
         self.waiting_on = {}
         self.maps = {}
+        self.pg.requeue_scrub_waiters()
 
     def maybe_abort_stuck(self, timeout: float = 30.0) -> bool:
         """A replica that died mid-round never sends its map; without
@@ -158,6 +182,7 @@ class Scrubber:
                          pg.pgid_str, self.errors, len(inconsistent))
         if self.repair and inconsistent:
             self._repair(inconsistent)
+        pg.requeue_scrub_waiters()
         pg.service.kick_recovery(pg)
 
     def _all_oids(self) -> List[str]:

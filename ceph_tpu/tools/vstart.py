@@ -24,6 +24,8 @@ def main(argv: List[str] = None) -> int:
     p = argparse.ArgumentParser(prog="vstart",
                                 description=__doc__.splitlines()[0])
     p.add_argument("-n", "--num-osds", type=int, default=3)
+    p.add_argument("--num-mons", type=int, default=1,
+                   help="monitor quorum size (paxos replication)")
     p.add_argument("-d", "--data-dir",
                    help="FileStore-backed daemons (default: MemStore)")
     p.add_argument("-e", "--ec-pool", action="store_true",
@@ -38,7 +40,8 @@ def main(argv: List[str] = None) -> int:
 
     from ..cluster import Cluster
 
-    cluster = Cluster(n_osds=ns.num_osds, data_dir=ns.data_dir)
+    cluster = Cluster(n_osds=ns.num_osds, data_dir=ns.data_dir,
+                      n_mons=ns.num_mons)
     cluster.start()
     host, port = cluster.mon_addr
     addr = f"{host}:{port}"
@@ -52,7 +55,8 @@ def main(argv: List[str] = None) -> int:
     if out_conf:
         with open(out_conf, "w") as f:
             f.write(addr + "\n")
-    print(f"vstart: {ns.num_osds} osds up, mon at {addr}")
+    print(f"vstart: {ns.num_osds} osds up, "
+          f"{ns.num_mons} mon(s), mon.0 at {addr}")
     print(f"export CEPH_TPU_MON={addr}")
     sys.stdout.flush()
 

@@ -112,6 +112,11 @@ def _opts() -> List[Option]:
                description="failure reports must span this crush level"),
         Option("mon_osd_min_down_reporters", int, 2, min=1),
         Option("mon_tick_interval", float, 0.5, min=0.05),
+        Option("mon_lease", float, 5.0, min=0.1,
+               description="leader lease seconds (reference mon_lease)"),
+        Option("mon_election_timeout", float, 2.0, min=0.1,
+               description="restart a stalled election after this "
+                           "(reference mon_election_timeout)"),
         Option("mon_osd_down_out_interval", float, 10.0, min=0.0,
                description="seconds down before auto-out "
                            "(reference default 600s, scaled down)"),

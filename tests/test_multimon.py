@@ -1,0 +1,147 @@
+"""Multi-monitor quorum tests.
+
+Reference analog: mon/Elector + mon/Paxos behavior driven by
+qa/standalone/mon/* (quorum formation, leader loss, peon redirect,
+no-quorum stalls, store-backed restart)."""
+import os
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.cluster import Cluster, test_config
+from ceph_tpu.mon.client import CommandTimeout
+
+
+def quorum_conf(**over):
+    # lease comfortably above tick so GIL stalls under pytest load
+    # don't fake leader death
+    return test_config(mon_lease=2.5, mon_election_timeout=1.0,
+                       mon_tick_interval=0.25, **over)
+
+
+@pytest.fixture
+def cl():
+    with Cluster(n_osds=2, n_mons=3, conf=quorum_conf()) as c:
+        c.wait_for_quorum()
+        for i in range(2):
+            c.wait_for_osd_up(i, 20)
+        yield c
+
+
+def test_quorum_forms_and_maps_replicate(cl):
+    leader = cl.wait_for_quorum()
+    assert cl.mons[leader].quorum.is_leader()
+    cl.create_pool("mm1", "replicated", size=2)
+    # commits reach every mon (paxos to the quorum, lease catch-up for
+    # any straggler outside it)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        epochs = {r: m.osdmap.epoch for r, m in cl.mons.items()}
+        if len(set(epochs.values())) == 1:
+            break
+        time.sleep(0.2)
+    else:
+        raise TimeoutError(f"mon epochs diverged: {epochs}")
+    names = {r: list(m.osdmap.pools)
+             for r, m in cl.mons.items()}
+    assert all(v == list(names.values())[0] for v in names.values())
+
+
+def test_commands_via_peon_redirect(cl):
+    leader = cl.wait_for_quorum()
+    peons = [r for r in cl.mons if r != leader]
+    # a client pointed ONLY at a peon must still mutate the map
+    # (peon answers with a leader redirect the client follows)
+    r = Rados(cl.mons[peons[0]].my_addr, conf=cl.conf).connect()
+    try:
+        ret, rs, _ = r.mon_command(
+            {"prefix": "osd pool create", "pool": "viapeon",
+             "pool_type": "replicated", "size": 2})
+        assert ret == 0, rs
+        ret, _, out = r.mon_command({"prefix": "osd pool ls"})
+        assert "viapeon" in out["pools"]
+    finally:
+        r.shutdown()
+
+
+def test_leader_failover(cl):
+    leader = cl.wait_for_quorum()
+    cl.create_pool("mmf", "replicated", size=2)
+    io = cl.rados().open_ioctx("mmf")
+    io.write_full("survivor", b"x" * 2048)
+
+    cl.kill_mon(leader)
+    new_leader = cl.wait_for_quorum(30)
+    assert new_leader != leader
+    # control plane and data plane keep working on a 2/3 quorum
+    ret, rs, _ = cl.mon_command({"prefix": "osd pool create",
+                                 "pool": "postfail",
+                                 "pool_type": "replicated", "size": 2})
+    assert ret == 0, rs
+    io2 = cl.rados().open_ioctx("mmf")
+    assert io2.read("survivor") == b"x" * 2048
+
+
+def test_no_quorum_blocks_mutations():
+    with Cluster(n_osds=1, n_mons=3, conf=quorum_conf()) as c:
+        leader = c.wait_for_quorum()
+        alive = [r for r in c.mons][0]
+        ranks = sorted(c.mons)
+        # kill two mons: majority gone, mutations must not commit
+        dead = [r for r in ranks if r != ranks[0]]
+        for r in dead:
+            c.kill_mon(r)
+        time.sleep(4.0)          # leases expire, election can't win
+        survivor = c.mons[ranks[0]]
+        # a minority mon must refuse (propose can't reach majority:
+        # either an explicit no-quorum error or, once it steps down,
+        # an "electing" stall ending in timeout)
+        from ceph_tpu.mon.client import MonClient
+        from ceph_tpu.msg.messenger import Messenger
+        m = Messenger("client.999", conf=c.conf)
+        m.start()
+        try:
+            ret, rs, _ = MonClient(m, survivor.my_addr).command(
+                {"prefix": "osd pool create", "pool": "nope",
+                 "pool_type": "replicated"}, timeout=8.0)
+            assert ret < 0, f"minority mon committed: {ret} {rs}"
+        except CommandTimeout:
+            pass
+        finally:
+            m.shutdown()
+        # revive one mon: quorum back, command succeeds
+        c.revive_mon(dead[0])
+        c.wait_for_quorum(30)
+        ret, rs, _ = c.mon_command({"prefix": "osd pool create",
+                                    "pool": "back",
+                                    "pool_type": "replicated",
+                                    "size": 1})
+        assert ret == 0, rs
+
+
+def test_mon_restart_resumes_from_store(tmp_path):
+    ddir = str(tmp_path / "mm")
+    with Cluster(n_osds=1, n_mons=3, data_dir=ddir,
+                 conf=quorum_conf()) as c:
+        c.wait_for_quorum()
+        c.create_pool("persist", "replicated", size=1)
+        target_epoch = c.mon.osdmap.epoch
+        victim = [r for r in c.mons
+                  if not c.mons[r].quorum.is_leader()][0]
+        c.kill_mon(victim)
+        c.create_pool("while-down", "replicated", size=1)
+        c.revive_mon(victim)
+        # revived mon resumes from its MonitorDBStore, then catches up
+        # the epochs it missed
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            m = c.mons[victim]
+            if m is not None and m.osdmap.epoch > target_epoch and \
+                    "while-down" in [p.name
+                                     for p in m.osdmap.pools.values()]:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError(
+                f"revived mon stuck at e{c.mons[victim].osdmap.epoch}")

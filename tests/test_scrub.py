@@ -177,6 +177,46 @@ def test_ec_corrupt_shard_read_survives_and_repairs(cl):
     cl.wait_for_clean(20)
 
 
+def test_scrub_concurrent_with_writes_no_false_errors(cl):
+    """Scrub must snapshot one committed state: writes racing the
+    round queue behind it instead of producing phantom mismatches
+    (reference write blocking on the scrubbed range)."""
+    cl.create_pool("cw", "replicated", size=3)
+    io = cl.rados().open_ioctx("cw")
+    io.write_full("hot", b"a" * 4096)
+    cl.wait_for_clean(20)
+    pgid, _ = pg_stat_of(cl, "hot", "cw")
+
+    import threading
+    stop = []
+    errors = []
+
+    def writer():
+        i = 0
+        while not stop:
+            try:
+                io.write_full("hot", bytes([i % 256]) * 4096)
+            except Exception as e:      # noqa: BLE001
+                errors.append(e)
+            i += 1
+
+    t = threading.Thread(target=writer)
+    t.start()
+    try:
+        for _ in range(4):
+            cl.mon_command({"prefix": "pg deep-scrub", "pgid": pgid})
+            time.sleep(0.8)
+    finally:
+        stop.append(1)
+        t.join()
+    assert not errors, errors
+    stat = wait_scrub_errors(cl, pgid,
+                             lambda s: s.get("last_deep_scrub", 0) > 0)
+    assert stat.get("num_scrub_errors", 0) == 0, stat
+    # writes queued behind scrub all landed
+    assert len(io.read("hot")) == 4096
+
+
 def test_periodic_background_scrub(tmp_path):
     """osd_scrub_interval drives automatic scrubbing from the OSD tick
     (reference OSD::sched_scrub)."""
