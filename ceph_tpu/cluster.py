@@ -37,7 +37,9 @@ def test_config(**overrides) -> Config:
     likewise shrinks heartbeat/grace)."""
     base = {
         "osd_heartbeat_interval": 0.25,
-        "osd_heartbeat_grace": 1.5,
+        # generous vs the 0.25s ping: single-core pytest runs starve
+        # threads for seconds; a tight grace fabricates OSD failures
+        "osd_heartbeat_grace": 3.0,
         "mon_tick_interval": 0.2,
         "mon_osd_down_out_interval": 3.0,
         "osd_pool_default_pg_num": 8,
@@ -52,9 +54,12 @@ class Cluster:
     def __init__(self, n_osds: int = 3,
                  data_dir: Optional[str] = None,
                  conf: Optional[Config] = None,
-                 n_mons: int = 1):
+                 n_mons: int = 1,
+                 with_mgr: bool = False):
         self.n_osds = n_osds
         self.n_mons = n_mons
+        self.with_mgr = with_mgr
+        self.mgr = None
         self.data_dir = data_dir
         self.conf = conf or test_config()
         self.mon: Optional[Monitor] = None
@@ -105,6 +110,10 @@ class Cluster:
             self.wait_for_quorum()
         for i in range(self.n_osds):
             self.start_osd(i)
+        if self.with_mgr:
+            from .mgr.manager import Manager
+            self.mgr = Manager(self.client_mon_addrs(),
+                               conf=self.conf).start()
         return self
 
     def wait_for_quorum(self, timeout: float = 15.0) -> int:
@@ -165,6 +174,9 @@ class Cluster:
         for client in self._clients:
             client.shutdown()
         self._clients.clear()
+        if self.mgr is not None:
+            self.mgr.shutdown()
+            self.mgr = None
         for osd in self.osds.values():
             if osd is not None:
                 osd.shutdown()

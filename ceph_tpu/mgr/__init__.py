@@ -1,0 +1,1 @@
+"""Manager daemon (reference src/mgr/ + src/pybind/mgr/, SURVEY §2.6)."""

@@ -1,0 +1,280 @@
+"""Manager daemon: telemetry aggregation + operator modules.
+
+Python-native equivalent of the reference's ceph-mgr (reference
+src/mgr/ 16.1k LoC C++ + src/pybind/mgr/ python modules):
+
+* **perf aggregation** (reference DaemonPerfCounters / MMgrReport):
+  the reference has daemons push counter deltas to the mgr; here the
+  mgr PULLS — every ``mgr_tick_interval`` it sends ``MCommand("perf
+  dump")`` to each up OSD (discovered from the osdmap) and keeps the
+  latest snapshot per daemon.  Pull avoids needing a MgrMap for
+  daemon->mgr discovery while producing the same aggregate.
+* **prometheus exporter** (reference src/pybind/mgr/prometheus/):
+  an HTTP endpoint serving the aggregated counters plus cluster
+  health/PG-state gauges in the Prometheus text exposition format.
+* **balancer-lite** (reference src/pybind/mgr/balancer/): reports
+  per-OSD PG-count spread and which moves would flatten it.
+* **pg_autoscaler-lite** (reference src/pybind/mgr/pg_autoscaler/):
+  recommends pg_num per pool from the OSD count and replication
+  factor (the reference's target ~100 PGs/OSD heuristic).
+
+Both advisory modules only *recommend* (the reference's default
+"warn" mode); applying is the operator's call via the CLI.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from ..mon.client import MonClient
+from ..msg.messages import MCommand, MCommandReply
+from ..msg.messenger import Connection, Dispatcher, Messenger
+from ..osd.osdmap import OSDMap
+from ..utils.config import Config, default_config
+from ..utils.log import Dout
+
+
+def pg_autoscale_recommendations(osdmap: OSDMap,
+                                 target_per_osd: int = 100
+                                 ) -> List[dict]:
+    """Per-pool pg_num advice (reference pg_autoscaler's
+    target ratio heuristic: ~target_per_osd PGs per OSD divided
+    across pools, rounded to a power of two)."""
+    n_osds = max(1, sum(1 for i in osdmap.osds.values()
+                        if i.weight > 0))
+    pools = list(osdmap.pools.values())
+    if not pools:
+        return []
+    budget = n_osds * target_per_osd
+    out = []
+    for pool in pools:
+        # pool.size is replica count (replicated) or k+m (EC): either
+        # way the number of PG instances one logical PG creates
+        share = budget // (len(pools) * max(1, pool.size))
+        target = 1
+        while target * 2 <= max(1, share):
+            target *= 2
+        out.append({
+            "pool": pool.name, "pool_id": pool.pool_id,
+            "pg_num": pool.pg_num, "target_pg_num": target,
+            "would_adjust": target != pool.pg_num,
+        })
+    return out
+
+
+def balancer_report(osdmap: OSDMap) -> dict:
+    """PG spread per OSD + naive flattening advice (reference
+    balancer module's upmap scoring)."""
+    counts: Dict[int, int] = {o: 0 for o in osdmap.osds}
+    for pool in osdmap.pools.values():
+        for pgid in osdmap.pgs_for_pool(pool.pool_id):
+            up, _, _, _ = osdmap.pg_to_up_acting_osds(pgid)
+            for o in up:
+                if o is not None:
+                    counts[o] = counts.get(o, 0) + 1
+    if not counts:
+        return {"per_osd": {}, "spread": 0, "moves": []}
+    mean = sum(counts.values()) / len(counts)
+    overloaded = sorted((o for o in counts if counts[o] > mean + 1),
+                        key=lambda o: -counts[o])
+    underloaded = sorted((o for o in counts if counts[o] < mean - 1),
+                         key=lambda o: counts[o])
+    moves = [{"from": a, "to": b}
+             for a, b in zip(overloaded, underloaded)]
+    return {
+        "per_osd": {str(o): c for o, c in sorted(counts.items())},
+        "spread": max(counts.values()) - min(counts.values()),
+        "mean": round(mean, 2),
+        "moves": moves,
+    }
+
+
+class Manager(Dispatcher):
+    """The mgr daemon (reference src/mgr/DaemonServer + Mgr)."""
+
+    def __init__(self, mon_addr, conf: Optional[Config] = None,
+                 http_port: int = 0):
+        self.conf = conf or default_config()
+        self.log = Dout("mgr", "mgr ")
+        self.lock = threading.RLock()
+        self.msgr = Messenger("mgr.x", conf=self.conf)
+        self.msgr.add_dispatcher(self)
+        self.monc = MonClient(self.msgr, mon_addr,
+                              map_cb=self._on_map)
+        self.osdmap = OSDMap()
+        # daemon name -> {"ts": float, "perf": {...}}
+        self.daemon_perf: Dict[str, dict] = {}
+        self._next_tid = 0
+        self._pending: Dict[int, str] = {}    # tid -> daemon name
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._http: Optional[ThreadingHTTPServer] = None
+        self._http_port = http_port
+        self.http_addr: Optional[Tuple[str, int]] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> "Manager":
+        self.msgr.start()
+        self.monc.subscribe_osdmap()
+        t = threading.Thread(target=self._collect_loop,
+                             name="mgr-collect", daemon=True)
+        t.start()
+        self._threads.append(t)
+        self._start_http()
+        self.log.dout(1, f"mgr up, metrics at {self.http_addr}")
+        return self
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._http is not None:
+            self._http.shutdown()
+            self._http.server_close()
+        self.msgr.shutdown()
+        for t in self._threads:
+            t.join(timeout=5)
+
+    def _on_map(self, wire: dict) -> None:
+        newmap = OSDMap.from_wire_dict(wire)
+        with self.lock:
+            if newmap.epoch > self.osdmap.epoch:
+                self.osdmap = newmap
+
+    # ------------------------------------------------------------------
+    # collection (reference MMgrReport flow, inverted to pull)
+    # ------------------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MCommandReply):
+            with self.lock:
+                name = self._pending.pop(msg.tid, None)
+                if name is not None and msg.retcode == 0:
+                    self.daemon_perf[name] = {"ts": time.time(),
+                                              "perf": msg.out}
+            return True
+        return False
+
+    def _collect_loop(self) -> None:
+        interval = self.conf["mgr_tick_interval"]
+        while not self._stop.wait(interval):
+            try:
+                self._collect_once()
+            except Exception as e:
+                self.log.dout(5, f"collect failed: {e!r}")
+
+    def _collect_once(self) -> None:
+        with self.lock:
+            # expire requests that never got an answer (wedged OSD):
+            # anything still pending from previous ticks is dead
+            self._pending.clear()
+            osds = [(o, i.addr) for o, i in self.osdmap.osds.items()
+                    if i.up and i.addr]
+        for osd, addr in osds:
+            with self.lock:
+                self._next_tid += 1
+                tid = self._next_tid
+                self._pending[tid] = f"osd.{osd}"
+            try:
+                conn = self.msgr.connect_to(tuple(addr),
+                                            peer_name=f"osd.{osd}")
+                conn.send_message(MCommand(
+                    tid=tid, cmd={"prefix": "perf dump"}))
+            except Exception:
+                pass
+        # drop snapshots of daemons gone from the map
+        with self.lock:
+            live = {f"osd.{o}" for o, _ in osds}
+            for name in list(self.daemon_perf):
+                if name not in live:
+                    del self.daemon_perf[name]
+
+    # ------------------------------------------------------------------
+    # module surface
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        with self.lock:
+            osdmap = self.osdmap
+            perf = {k: v["perf"] for k, v in self.daemon_perf.items()}
+        return {
+            "osdmap_epoch": osdmap.epoch,
+            "daemons_reporting": sorted(perf),
+            "balancer": balancer_report(osdmap),
+            "pg_autoscaler": pg_autoscale_recommendations(osdmap),
+        }
+
+    # ------------------------------------------------------------------
+    # prometheus exporter (reference pybind/mgr/prometheus)
+    # ------------------------------------------------------------------
+    def render_metrics(self) -> str:
+        """Prometheus text exposition of every aggregated counter."""
+        lines: List[str] = []
+        with self.lock:
+            perf = {k: v for k, v in self.daemon_perf.items()}
+            osdmap = self.osdmap
+        n_up = sum(1 for i in osdmap.osds.values() if i.up)
+        n_in = sum(1 for i in osdmap.osds.values() if i.weight > 0)
+        lines.append("# TYPE ceph_osd_up gauge")
+        lines.append(f"ceph_osd_up {n_up}")
+        lines.append("# TYPE ceph_osd_in gauge")
+        lines.append(f"ceph_osd_in {n_in}")
+        lines.append("# TYPE ceph_osdmap_epoch counter")
+        lines.append(f"ceph_osdmap_epoch {osdmap.epoch}")
+        lines.append("# TYPE ceph_pool_count gauge")
+        lines.append(f"ceph_pool_count {len(osdmap.pools)}")
+        # metric-major grouping: the exposition format requires all
+        # samples of one family to be contiguous under its # TYPE line
+        families: Dict[str, List[Tuple[str, float]]] = {}
+        for daemon in sorted(perf):
+            snap = perf[daemon]["perf"]
+            for subsys, counters in snap.items():
+                for cname, val in counters.items():
+                    metric = f"ceph_{subsys}_{cname}"
+                    if isinstance(val, dict):      # timeavg
+                        for part, sfx in (("sum", "total"),
+                                          ("avgcount", "count")):
+                            if part in val:
+                                families.setdefault(
+                                    f"{metric}_{sfx}", []).append(
+                                    (daemon, val[part]))
+                    elif isinstance(val, (int, float)):
+                        families.setdefault(metric, []).append(
+                            (daemon, val))
+        for metric in sorted(families):
+            lines.append(f"# TYPE {metric} counter")
+            for daemon, val in families[metric]:
+                lines.append(f'{metric}{{daemon="{daemon}"}} {val}')
+        return "\n".join(lines) + "\n"
+
+    def _start_http(self) -> None:
+        mgr = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib API)
+                if self.path.rstrip("/") in ("", "/metrics"):
+                    body = mgr.render_metrics().encode()
+                    ctype = "text/plain; version=0.0.4"
+                elif self.path.rstrip("/") == "/status":
+                    body = json.dumps(mgr.status(), indent=2,
+                                      default=str).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._http = ThreadingHTTPServer(("127.0.0.1", self._http_port),
+                                         Handler)
+        self.http_addr = self._http.server_address
+        t = threading.Thread(target=self._http.serve_forever,
+                             name="mgr-http", daemon=True)
+        t.start()
+        self._threads.append(t)

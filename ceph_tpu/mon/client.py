@@ -173,8 +173,15 @@ class MonClient(Dispatcher):
                         old, self.conn = self.conn, None
                     else:
                         old = None
+                    sub = self._sub_epoch
                 if old is not None:
                     old.mark_down()
+                if old is not None and sub is not None:
+                    # the new mon knows nothing of our subscription
+                    try:
+                        self.subscribe_osdmap(self._latest_epoch + 1)
+                    except Exception:
+                        pass
                 continue
             if ret == self.REDIRECT_RETCODE and "leader" in out:
                 self._retarget(tuple(out["leader"]))

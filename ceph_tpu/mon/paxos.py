@@ -40,10 +40,12 @@ from ..utils.log import Dout
 
 
 class Proposal:
-    def __init__(self, version: int, value: dict, needed: int):
+    def __init__(self, version: int, value: dict, needed: int,
+                 epoch: int):
         self.version = version
         self.value = value
         self.needed = needed             # majority count
+        self.epoch = epoch               # election epoch of this round
         self.accepted: Set[int] = set()
         self.done = threading.Event()
         self.ok = False
@@ -98,14 +100,9 @@ class QuorumService:
         if rank == self.rank:
             return
         try:
-            addr = (self.monmap[rank][0], int(self.monmap[rank][1]))
-            name = f"mon.{rank}"
-            conn = self.mon.msgr.connect_to(addr, peer_name=name)
-            if conn.connector and tuple(conn.peer_addr) != addr:
-                # the peer rebound (restart moved its port): this
-                # session dials a dead address forever — replace it
-                conn.mark_down()
-                conn = self.mon.msgr.connect_to(addr, peer_name=name)
+            conn = self.mon.msgr.connect_to(
+                (self.monmap[rank][0], int(self.monmap[rank][1])),
+                peer_name=f"mon.{rank}")
             conn.send_message(msg)
         except Exception:
             pass
@@ -280,7 +277,8 @@ class QuorumService:
             raise RuntimeError("propose on non-leader")
         if self.n_mons == 1 or len(self.quorum) == 1:
             return True
-        prop = Proposal(version, value, self.majority)
+        prop = Proposal(version, value, self.majority,
+                        self.election_epoch)
         prop.accepted.add(self.rank)
         self._proposal = prop
         self._broadcast(MMonMon(op="begin", from_rank=self.rank,
@@ -333,7 +331,10 @@ class QuorumService:
 
     def _handle_accept(self, msg: MMonMon) -> None:
         prop = self._proposal
-        if prop is None or msg.version != prop.version:
+        if prop is None or msg.version != prop.version \
+                or msg.epoch != prop.epoch:
+            # a stale accept from an aborted round must not vouch for
+            # a different value re-proposed under the same version
             return
         prop.accepted.add(msg.from_rank)
         if len(prop.accepted) >= prop.needed:

@@ -837,6 +837,51 @@ class MRepScrubMap(Message):
 
 
 @register
+class MCommand(Message):
+    """Daemon-direct command (reference messages/MCommand.h — the
+    transport behind ``ceph tell <daemon> ...`` and the mgr's perf
+    collection)."""
+    TYPE = 94
+
+    def __init__(self, tid: int = 0, cmd: Optional[dict] = None):
+        super().__init__()
+        self.tid = tid
+        self.cmd = cmd or {}
+
+    def encode_payload(self) -> bytes:
+        return Encoder().u64(self.tid).bytes(_enc_json(self.cmd)).build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MCommand":
+        d = Decoder(buf)
+        return cls(tid=d.u64(), cmd=_dec_json(d.bytes()))
+
+
+@register
+class MCommandReply(Message):
+    """Reply to MCommand (reference messages/MCommandReply.h)."""
+    TYPE = 95
+
+    def __init__(self, tid: int = 0, retcode: int = 0, rs: str = "",
+                 out: Optional[dict] = None):
+        super().__init__()
+        self.tid = tid
+        self.retcode = retcode
+        self.rs = rs
+        self.out = out or {}
+
+    def encode_payload(self) -> bytes:
+        return (Encoder().u64(self.tid).i32(self.retcode).str(self.rs)
+                .bytes(_enc_json(self.out)).build())
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MCommandReply":
+        d = Decoder(buf)
+        return cls(tid=d.u64(), retcode=d.i32(), rs=d.str(),
+                   out=_dec_json(d.bytes()))
+
+
+@register
 class MMonMon(Message):
     """Mon <-> mon quorum traffic (reference messages/MMonElection.h +
     MMonPaxos.h collapsed into one op-tagged frame).  ``op`` is one of:

@@ -399,17 +399,29 @@ class Messenger:
         peer_addr is an ephemeral port, so the addr scan alone can
         never find it (reference msgr keeps one session per entity)."""
         addr = (addr[0], int(addr[1]))
+        stale = None
         with self.lock:
             if peer_name:
                 conn = self.conns_by_name.get(peer_name)
                 if conn is not None and conn.state != "closed":
-                    return conn
-            for conn in self.conns:
-                if conn.peer_addr == addr and conn.state != "closed":
-                    return conn
+                    if conn.connector and \
+                            tuple(conn.peer_addr) != addr:
+                        # the peer moved (restart rebound its port):
+                        # this session redials a dead address forever —
+                        # replace it with a dial to the current addr
+                        stale = conn
+                    else:
+                        return conn
+            if stale is None:
+                for conn in self.conns:
+                    if conn.peer_addr == addr and \
+                            conn.state != "closed":
+                        return conn
             conn = Connection(self, addr, lossless, connector=True)
             conn.intended_peer = peer_name
             self.conns.append(conn)
+        if stale is not None:
+            stale.mark_down()
         with conn.lock:
             conn._spawn_reconnect_locked()
         return conn

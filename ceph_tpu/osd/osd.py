@@ -39,18 +39,19 @@ from typing import Dict, List, Optional, Tuple
 
 from ..ec import registry as ec_registry
 from ..mon.client import MonClient
-from ..msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
-                            MOSDECSubOpWrite, MOSDECSubOpWriteReply,
-                            MOSDMap, MOSDOp, MOSDPGLog, MOSDPGNotify,
-                            MOSDPGPush, MOSDPGPushReply, MOSDPGQuery,
-                            MOSDPing, MOSDRepOp, MOSDRepOpReply,
-                            MOSDScrub, MRepScrub, MRepScrubMap)
+from ..msg.messages import (MCommand, MCommandReply, MOSDECSubOpRead,
+                            MOSDECSubOpReadReply, MOSDECSubOpWrite,
+                            MOSDECSubOpWriteReply, MOSDMap, MOSDOp,
+                            MOSDPGLog, MOSDPGNotify, MOSDPGPush,
+                            MOSDPGPushReply, MOSDPGQuery, MOSDPing,
+                            MOSDRepOp, MOSDRepOpReply, MOSDScrub,
+                            MRepScrub, MRepScrubMap)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..store.objectstore import ObjectStore
 from ..utils.config import Config, default_config
 from ..utils.log import Dout
 from .osdmap import OSDMap, PGid
-from .pg import PG, STATE_ACTIVE, STATE_PEERING
+from .pg import PG, STATE_ACTIVE, STATE_PEERING, WRITE_OPS
 
 _BACKEND_MSGS = (MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                  MOSDECSubOpRead, MOSDECSubOpReadReply,
@@ -128,6 +129,26 @@ class OSD(Dispatcher):
         self._hb_last_rx: Dict[int, float] = {}
         self._hb_reported: Dict[int, float] = {}
         self._threads: List[threading.Thread] = []
+        # observability (reference l_osd_* counters OSD.cc:9630 +
+        # OpTracker dump_historic_ops OSD.cc:2457)
+        from ..utils.optracker import OpTracker
+        from ..utils.perf import PerfCountersCollection, TYPE_TIME_AVG
+        self.perf_coll = PerfCountersCollection()
+        self.perf = self.perf_coll.create("osd")
+        self.perf.add("op", description="client operations")
+        self.perf.add("op_w", description="client writes")
+        self.perf.add("op_r", description="client reads")
+        self.perf.add("op_in_bytes", description="client bytes written")
+        self.perf.add("op_latency", TYPE_TIME_AVG,
+                      "client op latency (dequeue to reply)")
+        self.perf.add("op_w_latency", TYPE_TIME_AVG,
+                      "client write latency")
+        self.perf.add("op_r_latency", TYPE_TIME_AVG,
+                      "client read latency")
+        self.perf.add("subop", description="replica/shard sub-ops")
+        self.perf.add("recovery_ops", description="objects recovered")
+        self.op_tracker = OpTracker(
+            slow_op_warn_threshold=self.conf["osd_op_complaint_time"])
 
     # ------------------------------------------------------------------
     # lifecycle (reference OSD::init)
@@ -238,11 +259,15 @@ class OSD(Dispatcher):
             self._enqueue_op(conn, msg)
             return True
         if isinstance(msg, _BACKEND_MSGS):
+            self.perf.inc("subop")
             pgid = PGid.parse(msg.pgid)
             pg = self._lookup_pg(pgid)
             if pg is not None:
                 with pg.lock:
                     pg.backend.handle_message(msg)
+            return True
+        if isinstance(msg, MCommand):
+            self._handle_command(conn, msg)
             return True
         if isinstance(msg, _PEERING_MSGS):
             pgid = PGid.parse(msg.pgid)
@@ -293,11 +318,62 @@ class OSD(Dispatcher):
                 conn.send_message(MOSDOpReply(
                     tid=msg.tid, result=-108, epoch=self.osdmap.epoch))
                 continue
+            is_write = any(op.op in WRITE_OPS for op in msg.ops)
+            tracked = self.op_tracker.create(
+                f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
+                f"{'+'.join(op.op for op in msg.ops)})")
+            t0 = time.monotonic()
+            self.perf.inc("op")
+            self.perf.inc("op_w" if is_write else "op_r")
+            if is_write:
+                self.perf.inc("op_in_bytes",
+                              sum(len(op.data or b"") for op in msg.ops))
             try:
                 pg.do_request(msg, conn)
             except Exception:
                 import traceback
                 traceback.print_exc()
+            finally:
+                # latency = queue dispatch time; commit waits are async
+                # (reference splits l_osd_op_*_lat similarly)
+                dt = time.monotonic() - t0
+                self.perf.tinc("op_latency", dt)
+                self.perf.tinc("op_w_latency" if is_write
+                               else "op_r_latency", dt)
+                tracked.finish()
+
+    # ------------------------------------------------------------------
+    # daemon-direct commands (reference 'ceph tell osd.N', MCommand;
+    # command set mirrors the admin socket's, common/admin_socket.cc)
+    # ------------------------------------------------------------------
+    def _handle_command(self, conn: Connection, msg: MCommand) -> None:
+        prefix = msg.cmd.get("prefix", "")
+        retcode, rs, out = 0, "", {}
+        try:
+            if prefix == "perf dump":
+                out = self.perf_coll.perf_dump()
+            elif prefix == "dump_historic_ops":
+                out = {"ops": self.op_tracker.dump_historic_ops()}
+            elif prefix == "dump_ops_in_flight":
+                out = {"ops": self.op_tracker.dump_ops_in_flight()}
+            elif prefix == "dump_slow_ops":
+                out = {"ops": self.op_tracker.slow_ops()}
+            elif prefix == "status":
+                with self.pg_lock:
+                    n_pgs = len(self.pgs)
+                out = {"osd": self.whoami, "num_pgs": n_pgs,
+                       "osdmap_epoch": self.osdmap.epoch,
+                       "state": "active"}
+            elif prefix == "config get":
+                out = {"value": self.conf.get(msg.cmd["name"])}
+            elif prefix == "config set":
+                self.conf.set(msg.cmd["name"], msg.cmd["value"])
+            else:
+                retcode, rs = -22, f"unknown command {prefix!r}"
+        except Exception as e:
+            retcode, rs = -22, str(e)
+        conn.send_message(MCommandReply(tid=msg.tid, retcode=retcode,
+                                        rs=rs, out=out))
 
     # ------------------------------------------------------------------
     # peer messaging

@@ -107,6 +107,25 @@ def _build_command(words: List[str], ns: argparse.Namespace
         return ({"prefix": f"pg {w[1]}",
                  "pgid": arg(2, f"pg {w[1]} <pgid>")}, w[3:])
 
+    if is_("tell"):
+        # handled out-of-band: direct daemon command, not a mon command
+        target = arg(1, "tell osd.<id> <command...>")
+        rest = w[2:]
+        if not rest:
+            raise SystemExit("usage: tell osd.<id> <command...>")
+        if rest[:2] == ["config", "get"]:
+            if len(rest) < 3:
+                raise SystemExit("usage: tell <osd> config get <name>")
+            return ({"_tell": target, "prefix": "config get",
+                     "name": rest[2]}, [])
+        if rest[:2] == ["config", "set"]:
+            if len(rest) < 4:
+                raise SystemExit("usage: tell <osd> config set "
+                                 "<name> <value>")
+            return ({"_tell": target, "prefix": "config set",
+                     "name": rest[2], "value": rest[3]}, [])
+        return ({"_tell": target, "prefix": " ".join(rest)}, [])
+
     if is_("config", "set"):
         arg(3, "config set <name> <value>")
         return ({"prefix": "config set", "name": w[2], "value": w[3]}, w[4:])
@@ -144,6 +163,49 @@ def _split_argv(argv: List[str]) -> Tuple[List[str], List[str]]:
     return opts, words
 
 
+def _tell(cluster, target: str, cmd: dict, timeout: float
+          ) -> Tuple[int, str, dict]:
+    """Direct daemon command (reference 'ceph tell osd.N ...' over
+    MCommand): resolve the daemon's address from the osdmap, dial it,
+    await the reply."""
+    import threading
+
+    from ..msg.messages import MCommand, MCommandReply
+    from ..msg.messenger import Dispatcher
+
+    if not target.startswith("osd."):
+        raise SystemExit(f"tell target {target!r} not supported "
+                         f"(osd.<id> only)")
+    osd = int(target.split(".", 1)[1])
+    ret, rs, out = cluster.mon_command({"prefix": "osd dump"}, timeout)
+    if ret != 0:
+        return ret, rs, out
+    info = next((o for o in out.get("osds", []) if o["osd"] == osd),
+                None)
+    if info is None or not info.get("up") or not info.get("addr"):
+        return -2, f"osd.{osd} is not up", {}
+
+    got = threading.Event()
+    reply = {}
+
+    class _Collector(Dispatcher):
+        def ms_dispatch(self, conn, msg) -> bool:
+            if isinstance(msg, MCommandReply):
+                reply["msg"] = msg
+                got.set()
+                return True
+            return False
+
+    cluster.msgr.add_dispatcher(_Collector())
+    conn = cluster.msgr.connect_to(tuple(info["addr"]),
+                                   peer_name=f"osd.{osd}")
+    conn.send_message(MCommand(tid=1, cmd=cmd))
+    if not got.wait(timeout):
+        return -110, f"osd.{osd} did not answer", {}
+    m = reply["msg"]
+    return m.retcode, m.rs, m.out
+
+
 def main(argv: List[str] = None) -> int:
     p = argparse.ArgumentParser(
         prog="ceph", description=__doc__.splitlines()[0])
@@ -165,7 +227,11 @@ def main(argv: List[str] = None) -> int:
         raise SystemExit(f"trailing arguments: {leftover}")
 
     with connect(ns.mon) as cluster:
-        retcode, rs, out = cluster.mon_command(cmd, ns.timeout)
+        if "_tell" in cmd:
+            retcode, rs, out = _tell(cluster, cmd.pop("_tell"), cmd,
+                                     ns.timeout)
+        else:
+            retcode, rs, out = cluster.mon_command(cmd, ns.timeout)
     print_out(rs, out, ns.format == "json")
     if retcode < 0:
         print(f"Error: {rs} ({retcode})", file=sys.stderr)

@@ -26,6 +26,9 @@ def main(argv: List[str] = None) -> int:
     p.add_argument("-n", "--num-osds", type=int, default=3)
     p.add_argument("--num-mons", type=int, default=1,
                    help="monitor quorum size (paxos replication)")
+    p.add_argument("--mgr", action="store_true",
+                   help="start a manager (perf aggregation + "
+                        "prometheus /metrics endpoint)")
     p.add_argument("-d", "--data-dir",
                    help="FileStore-backed daemons (default: MemStore)")
     p.add_argument("-e", "--ec-pool", action="store_true",
@@ -41,7 +44,7 @@ def main(argv: List[str] = None) -> int:
     from ..cluster import Cluster
 
     cluster = Cluster(n_osds=ns.num_osds, data_dir=ns.data_dir,
-                      n_mons=ns.num_mons)
+                      n_mons=ns.num_mons, with_mgr=ns.mgr)
     cluster.start()
     host, port = cluster.mon_addr
     addr = f"{host}:{port}"
@@ -57,6 +60,9 @@ def main(argv: List[str] = None) -> int:
             f.write(addr + "\n")
     print(f"vstart: {ns.num_osds} osds up, "
           f"{ns.num_mons} mon(s), mon.0 at {addr}")
+    if cluster.mgr is not None:
+        mh, mp = cluster.mgr.http_addr
+        print(f"mgr metrics: http://{mh}:{mp}/metrics")
     print(f"export CEPH_TPU_MON={addr}")
     sys.stdout.flush()
 

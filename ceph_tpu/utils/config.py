@@ -101,6 +101,12 @@ def _opts() -> List[Option]:
         Option("osd_pool_default_pg_num", int, 32, min=1),
         Option("osd_scrub_interval", float, 0.0, min=0.0,
                description="0 disables background scrub"),
+        Option("osd_op_complaint_time", float, 30.0, min=0.1,
+               description="ops in flight longer than this surface as "
+                           "slow ops (reference osd_op_complaint_time)"),
+        Option("mgr_tick_interval", float, 1.0, min=0.05,
+               description="mgr perf-collection cadence "
+                           "(reference mgr_tick_period)"),
         Option("osd_deep_scrub_interval", float, 0.0, min=0.0,
                description="deep-scrub cadence when background scrub "
                            "is on (reference osd_deep_scrub_interval)"),

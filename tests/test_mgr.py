@@ -1,0 +1,126 @@
+"""Manager daemon tests.
+
+Reference analog: src/mgr/ perf aggregation (DaemonPerfCounters via
+MMgrReport — pull-inverted here), the prometheus module's /metrics
+endpoint (src/pybind/mgr/prometheus/), balancer and pg_autoscaler
+advisory modules, and 'ceph tell osd.N' daemon commands (MCommand)."""
+import json
+import time
+import urllib.request
+
+import pytest
+
+from ceph_tpu.cluster import Cluster, test_config
+from ceph_tpu.mgr.manager import (balancer_report,
+                                  pg_autoscale_recommendations)
+from ceph_tpu.tools import ceph_cli
+
+
+@pytest.fixture(scope="module")
+def cl():
+    conf = test_config(mgr_tick_interval=0.3)
+    with Cluster(n_osds=3, conf=conf, with_mgr=True) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("mgrp", "replicated", size=2)
+        io = c.rados().open_ioctx("mgrp")
+        for i in range(5):
+            io.write_full(f"m{i}", b"x" * 4096)
+        for i in range(5):
+            io.read(f"m{i}")
+        yield c
+
+
+def test_mgr_aggregates_daemon_perf(cl):
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        st = cl.mgr.status()
+        if len(st["daemons_reporting"]) == 3:
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError(f"mgr never heard all osds: {st}")
+    with cl.mgr.lock:
+        perf = dict(cl.mgr.daemon_perf)
+    total_ops = sum(p["perf"]["osd"]["op"] for p in perf.values())
+    assert total_ops >= 10          # 5 writes + 5 reads landed somewhere
+    one = next(iter(perf.values()))["perf"]["osd"]
+    assert one["op_latency"]["avgcount"] == one["op"]
+
+
+def test_prometheus_endpoint(cl):
+    host, port = cl.mgr.http_addr
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5).read().decode()
+        if 'ceph_osd_op{daemon="osd.0"}' in body:
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError("metrics never included daemon counters")
+    assert "ceph_osd_up 3" in body
+    assert "ceph_pool_count" in body
+    assert "# TYPE ceph_osd_op counter" in body
+    assert 'ceph_osd_op_latency_total{daemon=' in body
+
+    st = json.loads(urllib.request.urlopen(
+        f"http://{host}:{port}/status", timeout=5).read().decode())
+    assert st["osdmap_epoch"] >= 1
+    assert "balancer" in st and "pg_autoscaler" in st
+
+
+def test_tell_osd_perf_dump(cl, capsys):
+    host, port = cl.mon_addr
+    assert ceph_cli.main(["-m", f"{host}:{port}", "--format", "json",
+                          "tell", "osd.0", "perf", "dump"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "osd" in out and "op" in out["osd"]
+    assert ceph_cli.main(["-m", f"{host}:{port}", "--format", "json",
+                          "tell", "osd.1", "status"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["osd"] == 1 and st["state"] == "active"
+    assert ceph_cli.main(["-m", f"{host}:{port}", "--format", "json",
+                          "tell", "osd.0", "dump_historic_ops"]) == 0
+    ops = json.loads(capsys.readouterr().out)["ops"]
+    assert isinstance(ops, list)
+    if ops:
+        assert "events" in ops[0] and "duration" not in ops[0]
+    # config get/set need their args split out of the prefix
+    assert ceph_cli.main(["-m", f"{host}:{port}", "--format", "json",
+                          "tell", "osd.0", "config", "get",
+                          "osd_op_complaint_time"]) == 0
+    assert float(json.loads(capsys.readouterr().out)["value"]) > 0
+    assert ceph_cli.main(["-m", f"{host}:{port}", "tell", "osd.0",
+                          "config", "set", "osd_op_complaint_time",
+                          "12.5"]) == 0
+    capsys.readouterr()
+
+
+def test_autoscaler_and_balancer_logic():
+    """Pure-logic checks of the advisory modules."""
+    from ceph_tpu.crush.wrapper import build_flat_map
+    from ceph_tpu.osd.osdmap import Incremental, OSDMap, PGPool
+    m = OSDMap()
+    inc = Incremental(1)
+    inc.new_crush = build_flat_map(10)
+    rule = inc.new_crush.add_simple_rule("r", "default", "host",
+                                         mode="firstn")
+    for o in range(10):
+        inc.new_up[o] = ("127.0.0.1", 1)
+        inc.new_weight[o] = 0x10000
+    m.apply_incremental(inc)
+    inc2 = Incremental(2)
+    inc2.new_pools[1] = PGPool(name="p1", pool_id=1, size=3, pg_num=8,
+                               crush_rule=rule)
+    m.apply_incremental(inc2)
+
+    recs = pg_autoscale_recommendations(m)
+    assert len(recs) == 1
+    # one pool, 10 osds, size 3 -> ~333 target, power of two = 256
+    assert recs[0]["target_pg_num"] == 256
+    assert recs[0]["would_adjust"]
+
+    rep = balancer_report(m)
+    assert sum(rep["per_osd"].values()) == 8 * 3
+    assert rep["spread"] >= 0

@@ -83,6 +83,9 @@ def sample_messages():
         M.MRepScrubMap(pgid="1.4", shard=2, from_osd=1, tid=5,
                        scrub_map={"obj": {"size": 512, "data_crc": 7,
                                           "hinfo_ok": True}}),
+        M.MCommand(tid=4, cmd={"prefix": "perf dump"}),
+        M.MCommandReply(tid=4, retcode=0, rs="",
+                        out={"osd": {"op": 12}}),
         M.MMonMon(op="begin", from_rank=0, epoch=6, version=9,
                   last_committed=8, value={"epoch": 9},
                   quorum=[0, 1, 2], maps={8: {"epoch": 8}}),
