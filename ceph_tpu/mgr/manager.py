@@ -108,7 +108,7 @@ class Manager(Dispatcher):
         # daemon name -> {"ts": float, "perf": {...}}
         self.daemon_perf: Dict[str, dict] = {}
         self._next_tid = 0
-        self._pending: Dict[int, str] = {}    # tid -> daemon name
+        self._pending: Dict[int, Tuple[str, float]] = {}  # tid -> (name, ts)
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._http: Optional[ThreadingHTTPServer] = None
@@ -148,10 +148,10 @@ class Manager(Dispatcher):
     def ms_dispatch(self, conn: Connection, msg) -> bool:
         if isinstance(msg, MCommandReply):
             with self.lock:
-                name = self._pending.pop(msg.tid, None)
-                if name is not None and msg.retcode == 0:
-                    self.daemon_perf[name] = {"ts": time.time(),
-                                              "perf": msg.out}
+                entry = self._pending.pop(msg.tid, None)
+                if entry is not None and msg.retcode == 0:
+                    self.daemon_perf[entry[0]] = {"ts": time.time(),
+                                                  "perf": msg.out}
             return True
         return False
 
@@ -164,17 +164,22 @@ class Manager(Dispatcher):
                 self.log.dout(5, f"collect failed: {e!r}")
 
     def _collect_once(self) -> None:
+        interval = self.conf["mgr_tick_interval"]
+        now = time.time()
         with self.lock:
-            # expire requests that never got an answer (wedged OSD):
-            # anything still pending from previous ticks is dead
-            self._pending.clear()
+            # expire requests unanswered for several ticks (wedged
+            # OSD) — clearing every tick would starve any OSD whose
+            # reply round-trip exceeds one interval
+            for tid in [t for t, (_, ts) in self._pending.items()
+                        if now - ts > 3 * interval]:
+                del self._pending[tid]
             osds = [(o, i.addr) for o, i in self.osdmap.osds.items()
                     if i.up and i.addr]
         for osd, addr in osds:
             with self.lock:
                 self._next_tid += 1
                 tid = self._next_tid
-                self._pending[tid] = f"osd.{osd}"
+                self._pending[tid] = (f"osd.{osd}", now)
             try:
                 conn = self.msgr.connect_to(tuple(addr),
                                             peer_name=f"osd.{osd}")

@@ -408,8 +408,12 @@ class Messenger:
                             tuple(conn.peer_addr) != addr:
                         # the peer moved (restart rebound its port):
                         # this session redials a dead address forever —
-                        # replace it with a dial to the current addr
+                        # replace it with a dial to the current addr.
+                        # Unregister NOW, inside the lock: a racing
+                        # connect_to must not also find it and spawn a
+                        # second competing replacement
                         stale = conn
+                        del self.conns_by_name[peer_name]
                     else:
                         return conn
             if stale is None:

@@ -176,7 +176,11 @@ def _tell(cluster, target: str, cmd: dict, timeout: float
     if not target.startswith("osd."):
         raise SystemExit(f"tell target {target!r} not supported "
                          f"(osd.<id> only)")
-    osd = int(target.split(".", 1)[1])
+    try:
+        osd = int(target.split(".", 1)[1])
+    except ValueError:
+        raise SystemExit(f"bad tell target {target!r} "
+                         f"(want osd.<id>)")
     ret, rs, out = cluster.mon_command({"prefix": "osd dump"}, timeout)
     if ret != 0:
         return ret, rs, out
@@ -197,7 +201,11 @@ def _tell(cluster, target: str, cmd: dict, timeout: float
             return False
 
     cluster.msgr.add_dispatcher(_Collector())
+    # lossy, like every client->daemon dial: a lossless session would
+    # leave the OSD waiting forever for this short-lived CLI process
+    # to reconnect
     conn = cluster.msgr.connect_to(tuple(info["addr"]),
+                                   lossless=False,
                                    peer_name=f"osd.{osd}")
     conn.send_message(MCommand(tid=1, cmd=cmd))
     if not got.wait(timeout):

@@ -1,0 +1,154 @@
+"""Striper tests.
+
+Reference analog: src/osdc/Striper file_to_extents invariants
+(src/test/osdc/ and the striping doc in doc/dev/file-striping.rst)
+plus libradosstriper read/write/trunc/stat round trips
+(src/test/libradosstriper/)."""
+import os
+import random
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.client.striper import (Layout, StripedIoCtx,
+                                     file_to_extents, object_name)
+from ceph_tpu.cluster import Cluster
+
+
+# ---------------------------------------------------------------- math
+
+
+def simulate(layout, offset, length):
+    """Oracle: place every byte individually."""
+    su, sc, spo = (layout.stripe_unit, layout.stripe_count,
+                   layout.stripes_per_object)
+    placed = {}
+    for pos in range(offset, offset + length):
+        blockno = pos // su
+        stripeno = blockno // sc
+        objectno = (stripeno // spo) * sc + blockno % sc
+        x = (stripeno % spo) * su + pos % su
+        placed[pos] = (objectno, x)
+    return placed
+
+
+@pytest.mark.parametrize("layout", [
+    Layout(stripe_unit=4, stripe_count=1, object_size=16),
+    Layout(stripe_unit=4, stripe_count=3, object_size=8),
+    Layout(stripe_unit=16, stripe_count=2, object_size=64),
+])
+@pytest.mark.parametrize("offset,length", [
+    (0, 1), (0, 100), (3, 29), (17, 64), (64, 1), (5, 0)])
+def test_file_to_extents_matches_byte_oracle(layout, offset, length):
+    exts = file_to_extents("s", layout, offset, length)
+    oracle = simulate(layout, offset, length)
+    got = {}
+    for ext in exts:
+        x = ext.offset
+        for lo, ln in ext.buffer_extents:
+            for i in range(ln):
+                got[lo + i] = (ext.objectno, x)
+                x += 1
+    assert got == oracle
+    # every extent's buffer lengths sum to its length
+    for ext in exts:
+        assert sum(ln for _, ln in ext.buffer_extents) == ext.length
+
+
+def test_extents_coalesce_within_object():
+    # su=4 sc=1: consecutive su blocks land back-to-back in one object
+    layout = Layout(stripe_unit=4, stripe_count=1, object_size=16)
+    exts = file_to_extents("s", layout, 0, 16)
+    assert len(exts) == 1
+    assert exts[0].offset == 0 and exts[0].length == 16
+
+
+def test_layout_validation():
+    with pytest.raises(ValueError):
+        Layout(stripe_unit=5, stripe_count=1,
+               object_size=16).validate()
+    with pytest.raises(ValueError):
+        Layout(stripe_unit=0).validate()
+
+
+def test_object_naming_matches_libradosstriper():
+    assert object_name("vol", 0) == "vol.0000000000000000"
+    assert object_name("vol", 255) == "vol.00000000000000ff"
+
+
+# ------------------------------------------------------------- cluster
+
+
+@pytest.fixture(scope="module")
+def cl():
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("strp", "replicated", size=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def sio(cl):
+    io = cl.rados().open_ioctx("strp")
+    return StripedIoCtx(io, Layout(stripe_unit=8 << 10,
+                                   stripe_count=3,
+                                   object_size=32 << 10))
+
+
+def test_striped_write_read_roundtrip(sio):
+    data = os.urandom(200_000)        # spans several object sets
+    sio.write("vol1", data)
+    assert sio.read("vol1") == data
+    size, layout = sio.stat("vol1")
+    assert size == len(data)
+    assert layout.stripe_count == 3
+    # the data really is spread over multiple objects
+    objs = [o for o in sio.ioctx.list_objects() if o.startswith("vol1.")]
+    assert len(objs) > 3
+
+
+def test_striped_partial_reads_and_overwrites(sio):
+    base = bytearray(os.urandom(100_000))
+    sio.write("vol2", bytes(base))
+    rng = random.Random(3)
+    for _ in range(10):
+        off = rng.randrange(0, 90_000)
+        ln = rng.randrange(1, 9_000)
+        assert sio.read("vol2", ln, off) == bytes(base[off:off + ln])
+    patch = os.urandom(20_000)
+    sio.write("vol2", patch, 37_123)
+    base[37_123:37_123 + len(patch)] = patch
+    assert sio.read("vol2") == bytes(base)
+
+
+def test_striped_sparse_write_reads_zeros(sio):
+    sio.write("vol3", b"tail", 150_000)
+    data = sio.read("vol3")
+    assert len(data) == 150_004
+    assert data[:150_000] == b"\0" * 150_000
+    assert data[150_000:] == b"tail"
+
+
+def test_striped_truncate(sio):
+    data = os.urandom(120_000)
+    sio.write("vol4", data)
+    sio.truncate("vol4", 50_000)
+    assert sio.read("vol4") == data[:50_000]
+    size, _ = sio.stat("vol4")
+    assert size == 50_000
+    # grow again: hole past the old end
+    sio.truncate("vol4", 60_000)
+    got = sio.read("vol4")
+    assert got[:50_000] == data[:50_000]
+    assert got[50_000:] == b"\0" * 10_000
+
+
+def test_striped_remove(sio):
+    sio.write("vol5", os.urandom(100_000))
+    sio.remove("vol5")
+    with pytest.raises(RadosError):
+        sio.stat("vol5")
+    leftovers = [o for o in sio.ioctx.list_objects()
+                 if o.startswith("vol5.")]
+    assert leftovers == []
