@@ -1,0 +1,1 @@
+"""Authentication (reference src/auth/ — CephX, SURVEY §2.6)."""

@@ -142,8 +142,15 @@ class StripedIoCtx:
                                            XATTR_SIZE))
             layout = Layout.load(json.loads(self.ioctx.getxattr(
                 self._meta_oid(soid), XATTR_LAYOUT)))
-        except RadosError:
-            raise RadosError(2, f"no striped object {soid!r}")
+        except RadosError as e:
+            if e.errno in (2, 61):       # ENOENT / ENODATA
+                # genuinely absent (no object, or object without the
+                # striper xattrs) -> ENOENT.  Anything else (EIO,
+                # timeout, cluster unhealthy) must NOT read as "new
+                # entity" — write() would reset size/layout and corrupt
+                # the existing data
+                raise RadosError(2, f"no striped object {soid!r}")
+            raise
         return size, layout
 
     def _store_meta(self, soid: str, size: int, layout: Layout) -> None:
@@ -159,7 +166,9 @@ class StripedIoCtx:
         (reference RadosStriperImpl::write -> one aio per extent)."""
         try:
             size, layout = self._load_meta(soid)
-        except RadosError:
+        except RadosError as e:
+            if e.errno != 2:
+                raise
             layout = layout or self.default_layout
             size = 0
         completions = []

@@ -150,8 +150,14 @@ class Manager(Dispatcher):
             with self.lock:
                 entry = self._pending.pop(msg.tid, None)
                 if entry is not None and msg.retcode == 0:
-                    self.daemon_perf[entry[0]] = {"ts": time.time(),
-                                                  "perf": msg.out}
+                    name, req_ts = entry
+                    cur = self.daemon_perf.get(name)
+                    # a straggler reply for an old request must not
+                    # roll counters backwards over a fresher sample
+                    if cur is None or req_ts >= cur["req_ts"]:
+                        self.daemon_perf[name] = {
+                            "ts": time.time(), "req_ts": req_ts,
+                            "perf": msg.out}
             return True
         return False
 

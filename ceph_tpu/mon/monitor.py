@@ -80,6 +80,17 @@ class MonitorDBStore:
         raw = self.db.get(f"osdmap.{epoch:010d}")
         return json.loads(raw.decode()) if raw else None
 
+    def put_raw(self, key: str, value: dict) -> None:
+        """Non-map monitor state (auth keyring etc.; the reference
+        stores every PaxosService's data in the same backing kv)."""
+        batch = WriteBatch()
+        batch.set(f"raw.{key}", json.dumps(value).encode())
+        self.db.submit(batch, sync=True)
+
+    def get_raw(self, key: str) -> Optional[dict]:
+        raw = self.db.get(f"raw.{key}")
+        return json.loads(raw.decode()) if raw else None
+
     def close(self) -> None:
         self.db.close()
 
@@ -118,7 +129,23 @@ class Monitor(Dispatcher):
         # set_monmap with every mon's address before start()
         from .paxos import QuorumService
         self.quorum = QuorumService(self, rank, [self.my_addr])
+        # entity keyring (reference AuthMonitor/KeyServer; replicated
+        # with every paxos commit — the transport-level cluster secret
+        # is conf auth_key, not stored here).  Before
+        # _load_or_bootstrap: the genesis commit persists it.
+        from ..auth.keyring import Keyring
+        rows = self.store.get_raw("keyring")
+        self.keyring = Keyring.load(rows) if rows else Keyring()
+        if not self.keyring.names():
+            # each mon bootstraps an admin key; in a quorum the
+            # leader's keyring wholesale-replaces peons' at the first
+            # commit, so the cluster converges on the leader's
+            self.keyring.get_or_create(
+                "client.admin", {"mon": "allow *", "osd": "allow *"})
         self._load_or_bootstrap()
+
+    def _persist_keyring(self) -> None:
+        self.store.put_raw("keyring", self.keyring.dump())
 
     def set_monmap(self, monmap: List[Tuple[str, int]]) -> None:
         """Install the full monitor map (reference MonMap); must be
@@ -185,11 +212,17 @@ class Monitor(Dispatcher):
             if self.quorum.n_mons > 1:
                 if not self.quorum.is_leader():
                     raise Monitor.NoQuorum("not the leader")
-                if not self.quorum.propose(epoch, wire):
+                # the replicated value carries the keyring alongside
+                # the map (reference: AuthMonitor state rides the same
+                # paxos store as the OSDMonitor's)
+                value = {"osdmap": wire,
+                         "keyring": self.keyring.dump()}
+                if not self.quorum.propose(epoch, value):
                     raise Monitor.NoQuorum(
                         "no quorum majority, map change rejected")
             self.osdmap = candidate
             self.store.put_map(epoch, wire)
+            self._persist_keyring()
             targets = [(conn, since) for conn, since in self.subs.items()
                        if since <= epoch]
             for conn, _ in targets:
@@ -197,10 +230,22 @@ class Monitor(Dispatcher):
         for conn, _ in targets:
             conn.send_message(MOSDMap(maps={epoch: wire}))
 
-    def apply_replicated(self, version: int, wire: dict) -> None:
-        """Peon-side: install a map the leader replicated (paxos commit
-        or catch-up sync) and publish to this mon's subscribers."""
+    def apply_replicated(self, version: int, value: dict) -> None:
+        """Peon-side: install state the leader replicated (paxos commit
+        or catch-up sync) and publish to this mon's subscribers.
+        ``value`` is {"osdmap": wire, "keyring": rows} (or a bare map
+        wire dict from the catch-up path)."""
+        if "osdmap" in value and "epoch" not in value:
+            wire = value["osdmap"]
+            keyring_rows = value.get("keyring")
+        else:
+            wire = value
+            keyring_rows = None
         with self.lock:
+            if keyring_rows is not None:
+                from ..auth.keyring import Keyring
+                self.keyring = Keyring.load(keyring_rows)
+                self._persist_keyring()
             if version <= self.osdmap.epoch:
                 return
             self.osdmap = OSDMap.from_wire_dict(wire)
@@ -807,6 +852,58 @@ class Monitor(Dispatcher):
                 "pg_stats": dict(self.pg_stats),
                 "reported_by": dict(self.pg_stats_from)})
 
+    # -- auth (reference AuthMonitor handlers, mon/MonCommands.h auth) --
+    @staticmethod
+    def _parse_caps(items: List[str]) -> Dict[str, str]:
+        """['mon', 'allow *', 'osd', 'allow rwx'] -> caps map (the
+        reference's pairwise caps syntax)."""
+        if len(items) % 2:
+            raise ValueError("caps must be <service> <spec> pairs")
+        return {items[i]: items[i + 1] for i in range(0, len(items), 2)}
+
+    def _commit_keyring(self) -> None:
+        """Replicate a keyring mutation: an (otherwise empty) map
+        epoch bump carries the full keyring through paxos — peons and
+        a future leader keep the same credentials (reference
+        AuthMonitor's paxos-versioned KeyServerData)."""
+        with self.lock:
+            self._commit(self._pending())
+
+    def _cmd_auth_get_or_create(self, cmd: dict):
+        caps = self._parse_caps(cmd.get("caps", []))
+        with self.lock:
+            ent = self.keyring.get_or_create(cmd["entity"], caps)
+            text = self.keyring.to_text(only=ent.name)
+            dump = ent.dump()
+        self._commit_keyring()
+        return (0, text, dump)
+
+    def _cmd_auth_get(self, cmd: dict):
+        with self.lock:
+            ent = self.keyring.get(cmd["entity"])
+            if ent is None:
+                return (-2, f"no such entity {cmd['entity']!r}", {})
+            return (0, self.keyring.to_text(only=ent.name), ent.dump())
+
+    def _cmd_auth_ls(self, cmd: dict):
+        with self.lock:
+            return (0, self.keyring.to_text(),
+                    {"entities": self.keyring.dump()})
+
+    def _cmd_auth_rm(self, cmd: dict):
+        with self.lock:
+            if not self.keyring.remove(cmd["entity"]):
+                return (-2, f"no such entity {cmd['entity']!r}", {})
+        self._commit_keyring()
+        return (0, "updated", {})
+
+    def _cmd_auth_print_key(self, cmd: dict):
+        with self.lock:
+            ent = self.keyring.get(cmd["entity"])
+        if ent is None:
+            return (-2, f"no such entity {cmd['entity']!r}", {})
+        return (0, ent.key, {"key": ent.key})
+
     def _cmd_config_set(self, cmd: dict):
         try:
             self.conf.set(cmd["name"], cmd["value"])
@@ -843,4 +940,9 @@ class Monitor(Dispatcher):
         "pg repair": _cmd_pg_repair,
         "config set": _cmd_config_set,
         "config get": _cmd_config_get,
+        "auth get-or-create": _cmd_auth_get_or_create,
+        "auth get": _cmd_auth_get,
+        "auth ls": _cmd_auth_ls,
+        "auth rm": _cmd_auth_rm,
+        "auth print-key": _cmd_auth_print_key,
     }

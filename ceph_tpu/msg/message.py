@@ -60,9 +60,22 @@ class Message(abc.ABC):
         return f"<{self.get_type_name()} seq={self.seq}>"
 
 
-def encode_frame(msg: Message) -> bytes:
+# type-field flag: payload is [1-byte codec id][compressed bytes]
+# (reference msgr2 negotiates compression per-connection; here each
+# frame is self-describing)
+COMPRESSED_FLAG = 0x8000
+
+
+def encode_frame(msg: Message, compressor=None,
+                 compress_min: int = 4096) -> bytes:
     payload = msg.encode_payload()
-    head = _PREAMBLE.pack(FRAME_MAGIC, msg.TYPE, msg.seq, len(payload))
+    mtype = msg.TYPE
+    if compressor is not None and len(payload) >= compress_min:
+        comp = compressor.compress(payload)
+        if len(comp) + 1 < len(payload):
+            payload = bytes([compressor.numeric_id]) + comp
+            mtype |= COMPRESSED_FLAG
+    head = _PREAMBLE.pack(FRAME_MAGIC, mtype, msg.seq, len(payload))
     crc = zlib.crc32(payload, zlib.crc32(head))
     return head + payload + _CRC.pack(crc)
 
@@ -86,6 +99,16 @@ def decode_frame_body(mtype: int, seq: int, head: bytes, payload: bytes,
     if crc != actual:
         raise DecodeError(
             f"payload crc mismatch: {crc:#x} != {actual:#x}")
+    if mtype & COMPRESSED_FLAG:
+        mtype &= ~COMPRESSED_FLAG
+        if not payload:
+            raise DecodeError("empty compressed payload")
+        from ..compressor import registry
+        try:
+            codec = registry().create_by_id(payload[0])
+            payload = codec.decompress(payload[1:])
+        except Exception as e:
+            raise DecodeError(f"decompress failed: {e}")
     cls = MSG_REGISTRY.get(mtype)
     if cls is None:
         raise DecodeError(f"unknown message type {mtype}")

@@ -89,6 +89,35 @@ def _recv_banner(sock: socket.socket) -> Tuple[str, int, int, bool]:
     return name, nonce, in_seq, bool(lossless)
 
 
+def _auth_exchange(sock: socket.socket, key: bytes,
+                   acceptor: bool) -> None:
+    """Mutual shared-secret proof (reference cephx's
+    challenge/authenticator flow, collapsed to one round).  Each proof
+    is HMAC-SHA256(key, role_tag || connector_challenge ||
+    acceptor_challenge): covering BOTH challenges with a per-role tag
+    defeats reflection — a digest harvested from a second session
+    toward the same daemon carries the wrong role tag and the wrong
+    challenge pair.  Both sides send-first, so no deadlock.  Raises
+    ConnectionError on mismatch; runs BEFORE any session state is
+    touched so an unauthenticated dial cannot disturb live sessions."""
+    import hmac as _hmac
+    import os as _os
+    my_chal = _os.urandom(16)
+    sock.sendall(my_chal)
+    peer_chal = _read_exact(sock, 16)
+    c_chal, a_chal = (peer_chal, my_chal) if acceptor \
+        else (my_chal, peer_chal)
+    my_tag = b"S" if acceptor else b"C"
+    peer_tag = b"C" if acceptor else b"S"
+    sock.sendall(_hmac.new(key, my_tag + c_chal + a_chal,
+                           "sha256").digest())
+    proof = _read_exact(sock, 32)
+    want = _hmac.new(key, peer_tag + c_chal + a_chal,
+                     "sha256").digest()
+    if not _hmac.compare_digest(proof, want):
+        raise ConnectionError("cephx: bad authenticator")
+
+
 def _shutdown_close(sock: Optional[socket.socket]) -> None:
     """shutdown() then close(): shutdown wakes any thread blocked in
     recv/send on the socket (close alone does not on Linux)."""
@@ -276,7 +305,9 @@ class Connection:
                 try:
                     if inject and random.randrange(inject) == 0:
                         raise ConnectionError("injected socket failure")
-                    sock.sendall(encode_frame(msg))
+                    sock.sendall(encode_frame(
+                        msg, compressor=self.msgr.compressor,
+                        compress_min=self.msgr.compress_min))
                 except (OSError, ConnectionError):
                     self._socket_dead(sock, gen)
                     break
@@ -349,6 +380,23 @@ class Messenger:
         self.conns: List[Connection] = []
         self._accept_thread: Optional[threading.Thread] = None
         self._stopping = False
+        # frame compression (reference msgr2 compression; conf
+        # ms_compress_mode names a registry codec, "" = off)
+        self.compressor = None
+        self.compress_min = self.conf["ms_compress_min_size"]
+        mode = self.conf["ms_compress_mode"]
+        if mode:
+            from ..compressor import registry as _creg
+            self.compressor = _creg().create(mode)
+        # cluster auth (reference auth_cluster_required=cephx): a
+        # shared-secret mutual challenge-response at session accept
+        self.auth_required = \
+            self.conf["auth_cluster_required"] == "cephx"
+        self.auth_key = self.conf["auth_key"].encode()
+        if self.auth_required and not self.auth_key:
+            raise ValueError(
+                "auth_cluster_required=cephx needs a non-empty "
+                "auth_key (an empty HMAC secret protects nothing)")
 
     # -- lifecycle ---------------------------------------------------------
     def bind(self, addr: Tuple[str, int] = ("127.0.0.1", 0)
@@ -448,6 +496,9 @@ class Messenger:
                                     socket.TCP_NODELAY, 1)
                     _send_banner(sock, self.name, self.nonce, in_seq,
                                  conn.lossless)
+                    if self.auth_required:
+                        _auth_exchange(sock, self.auth_key,
+                                       acceptor=False)
                     peer_name, peer_nonce, peer_in_seq, _ = \
                         _recv_banner(sock)
                     sock.settimeout(None)
@@ -508,6 +559,11 @@ class Messenger:
             sock.settimeout(5.0)
             peer_name, peer_nonce, peer_in_seq, peer_lossless = \
                 _recv_banner(sock)
+            if self.auth_required:
+                # BEFORE touching session state: an unauthenticated
+                # dial must not be able to retire/replace live
+                # sessions just by naming them in its banner
+                _auth_exchange(sock, self.auth_key, acceptor=True)
             stale = None
             with self.lock:
                 if not peer_lossless:

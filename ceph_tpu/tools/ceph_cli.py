@@ -126,6 +126,23 @@ def _build_command(words: List[str], ns: argparse.Namespace
                      "name": rest[2], "value": rest[3]}, [])
         return ({"_tell": target, "prefix": " ".join(rest)}, [])
 
+    if is_("auth", "get-or-create"):
+        return ({"prefix": "auth get-or-create",
+                 "entity": arg(2, "auth get-or-create <entity> "
+                               "[<svc> <caps> ...]"),
+                 "caps": w[3:]}, [])
+    if is_("auth", "get"):
+        return ({"prefix": "auth get",
+                 "entity": arg(2, "auth get <entity>")}, [])
+    if is_("auth", "ls"):
+        return ({"prefix": "auth ls"}, w[2:])
+    if is_("auth", "rm") or is_("auth", "del"):
+        return ({"prefix": "auth rm",
+                 "entity": arg(2, "auth rm <entity>")}, [])
+    if is_("auth", "print-key"):
+        return ({"prefix": "auth print-key",
+                 "entity": arg(2, "auth print-key <entity>")}, [])
+
     if is_("config", "set"):
         arg(3, "config set <name> <value>")
         return ({"prefix": "config set", "name": w[2], "value": w[3]}, w[4:])

@@ -35,17 +35,27 @@ def connect(mon: Optional[str], timeout: float = 10.0) -> Rados:
 
 def print_out(rs: str, out: dict, as_json: bool, file=None) -> None:
     """Command output: human string + structured payload (reference
-    ``ceph`` prints outs to stderr and outbl to stdout)."""
+    ``ceph`` prints outs to stderr and outbl to stdout).  A closed
+    pipe (``| head``) ends output quietly instead of tracebacking."""
     file = file or sys.stdout
-    if as_json or (out and not rs):
-        if out:
-            json.dump(out, file, indent=2, sort_keys=True, default=str)
-            file.write("\n")
-        if rs:
-            print(rs, file=sys.stderr)
-    else:
-        if rs:
-            print(rs, file=file)
-        if out:
-            json.dump(out, file, indent=2, sort_keys=True, default=str)
-            file.write("\n")
+    try:
+        if as_json or (out and not rs):
+            if out:
+                json.dump(out, file, indent=2, sort_keys=True,
+                          default=str)
+                file.write("\n")
+            if rs:
+                print(rs, file=sys.stderr)
+        else:
+            if rs:
+                print(rs, file=file)
+            if out:
+                json.dump(out, file, indent=2, sort_keys=True,
+                          default=str)
+                file.write("\n")
+    except BrokenPipeError:
+        try:
+            devnull = os.open(os.devnull, os.O_WRONLY)
+            os.dup2(devnull, file.fileno())
+        except OSError:
+            pass

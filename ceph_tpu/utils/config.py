@@ -132,6 +132,18 @@ def _opts() -> List[Option]:
                description="one in N sends fails (fault injection)"),
         Option("ms_connection_retry_interval", float, 0.2, min=0.01),
         Option("ms_crc_data", bool, True),
+        Option("ms_compress_mode", str, "",
+               description="frame compression codec ('' off; zlib/"
+                           "bz2/lzma; reference msgr2 compression)"),
+        Option("ms_compress_min_size", int, 4096, min=0,
+               description="only compress frames at least this big"),
+        Option("auth_cluster_required", str, "none",
+               enum_allowed=("none", "cephx"),
+               description="'cephx' = mutual shared-secret handshake "
+                           "on every session (reference "
+                           "auth_cluster_required)"),
+        Option("auth_key", str, "",
+               description="cluster shared secret for cephx mode"),
         # -- logging -------------------------------------------------------
         Option("log_to_stderr", bool, False),
         Option("log_file", str, ""),

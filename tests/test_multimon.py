@@ -120,6 +120,23 @@ def test_no_quorum_blocks_mutations():
         assert ret == 0, rs
 
 
+def test_auth_keyring_survives_leader_failover(cl):
+    """Keyring mutations replicate through paxos: credentials created
+    on one leader must be served identically by its successor."""
+    ret, _, out = cl.mon_command(
+        {"prefix": "auth get-or-create", "entity": "client.ha",
+         "caps": ["mon", "allow r"]})
+    assert ret == 0
+    key = out["key"]
+    leader = cl.wait_for_quorum()
+    cl.kill_mon(leader)
+    cl.wait_for_quorum(30)
+    ret, _, out = cl.mon_command(
+        {"prefix": "auth get", "entity": "client.ha"})
+    assert ret == 0, "credential lost across failover"
+    assert out["key"] == key
+
+
 def test_mon_restart_resumes_from_store(tmp_path):
     ddir = str(tmp_path / "mm")
     with Cluster(n_osds=1, n_mons=3, data_dir=ddir,

@@ -260,7 +260,7 @@ def test_objectstore_tool(tmp_path, capsys):
         io = r.open_ioctx("ostp")
         io.write_full("ostobj", b"ostool-payload")
         io.setxattr("ostobj", "user.a", b"xv")
-        c.wait_for_clean(20)
+        c.wait_for_clean(45)
     # cluster stopped: examine osd.0's store offline
     path = os.path.join(ddir, "osd.0")
     assert objectstore_tool.main(["--data-path", path, "--op",
@@ -291,7 +291,7 @@ def test_objectstore_tool_ec_shard_objects(tmp_path, capsys):
         c.create_pool("ostec", "erasure", erasure_code_profile="ostprof")
         io = c.rados().open_ioctx("ostec")
         io.write_full("shardobj", b"z" * 8192)
-        c.wait_for_clean(20)
+        c.wait_for_clean(45)
     found = False
     for osd in range(3):
         path = os.path.join(ddir, f"osd.{osd}")
