@@ -325,17 +325,21 @@ class IoCtx:
 
 
 class Rados:
-    """Cluster handle (reference librados::Rados / RadosClient)."""
+    """Cluster handle (reference librados::Rados / RadosClient).
 
-    _next_client = 0
-    _client_lock = threading.Lock()
+    The client id MUST be globally unique: PG-log dup detection keys
+    on (client_name, tid), so two processes both named "client.1"
+    issuing tid 2 would have the second's write silently swallowed as
+    a resend of the first's — an acknowledged lost write.  The
+    reference gets a mon-assigned global_id at authentication; here a
+    random 48-bit id makes collisions negligible without a round
+    trip."""
 
     def __init__(self, mon_addr: Tuple[str, int],
                  conf: Optional[Config] = None,
                  op_timeout: float = 30.0):
-        with Rados._client_lock:
-            Rados._next_client += 1
-            n = Rados._next_client
+        import secrets
+        n = secrets.randbits(48)
         self.conf = conf or default_config()
         self.op_timeout = op_timeout
         self.msgr = Messenger(f"client.{n}", conf=self.conf)

@@ -100,7 +100,9 @@ class Manager(Dispatcher):
         self.conf = conf or default_config()
         self.log = Dout("mgr", "mgr ")
         self.lock = threading.RLock()
-        self.msgr = Messenger("mgr.x", conf=self.conf)
+        import secrets
+        self.msgr = Messenger(f"mgr.{secrets.randbits(32):x}",
+                              conf=self.conf)
         self.msgr.add_dispatcher(self)
         self.monc = MonClient(self.msgr, mon_addr,
                               map_cb=self._on_map)

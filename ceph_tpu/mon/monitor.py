@@ -147,6 +147,13 @@ class Monitor(Dispatcher):
     def _persist_keyring(self) -> None:
         self.store.put_raw("keyring", self.keyring.dump())
 
+    def install_keyring(self, rows: List[dict]) -> None:
+        """Adopt replicated keyring state (paxos commit / sync)."""
+        from ..auth.keyring import Keyring
+        with self.lock:
+            self.keyring = Keyring.load(rows)
+            self._persist_keyring()
+
     def set_monmap(self, monmap: List[Tuple[str, int]]) -> None:
         """Install the full monitor map (reference MonMap); must be
         called on every mon before start() in multi-mon deployments."""

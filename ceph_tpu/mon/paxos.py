@@ -360,9 +360,14 @@ class QuorumService:
                 wire = self.mon.store.get_map(e)
                 if wire is not None:
                     maps[e] = wire
+            # auth state rides along: a rejoiner that catches up maps
+            # but not the keyring could later win an election and
+            # replicate its stale credentials over the quorum's
+            keyring = self.mon.keyring.dump()
         if maps:
             self._send(rank, MMonMon(op="sync", from_rank=self.rank,
-                                     maps=maps))
+                                     maps=maps,
+                                     value={"keyring": keyring}))
 
     def _handle_sync_req(self, msg: MMonMon) -> None:
         if self.is_leader():
@@ -371,6 +376,8 @@ class QuorumService:
     def _handle_sync(self, msg: MMonMon) -> None:
         for e in sorted(msg.maps):
             self.mon.apply_replicated(e, msg.maps[e])
+        if msg.value and "keyring" in msg.value:
+            self.mon.install_keyring(msg.value["keyring"])
 
     # ----------------------------------------------------------------- #
     # leases + tick

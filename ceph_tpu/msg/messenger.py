@@ -386,6 +386,14 @@ class Messenger:
         self.compress_min = self.conf["ms_compress_min_size"]
         mode = self.conf["ms_compress_mode"]
         if mode:
+            # wire frames must decode on ANY peer: only the stdlib
+            # codecs are allowed on the wire (an optional codec the
+            # receiver lacks would read as a corrupt stream and
+            # kill/reconnect the session forever)
+            if mode not in ("zlib", "bz2", "lzma"):
+                raise ValueError(
+                    f"ms_compress_mode {mode!r}: wire compression "
+                    f"supports zlib/bz2/lzma only")
             from ..compressor import registry as _creg
             self.compressor = _creg().create(mode)
         # cluster auth (reference auth_cluster_required=cephx): a

@@ -111,6 +111,32 @@ def test_pool_delete_frees_objects(cl):
         time.sleep(0.1)
 
 
+def test_distinct_processes_never_share_reqids(cl):
+    """PG dup-detection keys on (client, tid).  Client ids must be
+    globally unique or a second process's early-tid write is silently
+    swallowed as a resend — the header-update-lost bug: process A
+    (client.1, tid=2) writes X; process B (also client.1, tid=2)
+    writes Y; Y was acked but never applied."""
+    from ceph_tpu.client.rados import Rados
+    cl.create_pool("reqid", "replicated", size=2)
+    # client names must differ even across "fresh processes"
+    names = set()
+    for _ in range(4):
+        r = Rados(cl.mon_addr, conf=cl.conf)
+        names.add(r.msgr.name)
+        r.msgr.shutdown()
+    assert len(names) == 4
+    # sequential short-lived clients: each one's FIRST write to the
+    # same object must apply (this is exactly the rbd-CLI snap flow)
+    for i in range(3):
+        r = Rados(cl.mon_addr, conf=cl.conf).connect()
+        io = r.open_ioctx("reqid")
+        io.write_full("hdr", f"generation-{i}".encode())
+        r.shutdown()
+    r = cl.rados()
+    assert r.open_ioctx("reqid").read("hdr") == b"generation-2"
+
+
 def test_client_resend_on_primary_death(cl):
     """Objecter must retarget+resend when the acting primary dies
     mid-stream (reference Objecter resend on map change)."""

@@ -1,0 +1,183 @@
+"""RBD block image tests.
+
+Reference analog: src/test/librbd/ behavior — image lifecycle,
+object-granular IO, COW snapshots/rollback, clones + flatten, CLI
+import/export (src/tools/rbd)."""
+import os
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.rbd import RBD, Image, ImageNotFound
+from ceph_tpu.tools import rbd_cli
+
+ORDER = 14                           # 16 KiB objects: test-scale
+
+
+@pytest.fixture(scope="module")
+def cl():
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rbdp", "replicated", size=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def io(cl):
+    return cl.rados().open_ioctx("rbdp")
+
+
+def test_image_lifecycle(io):
+    rbd = RBD(io)
+    rbd.create("life", 1 << 20, order=ORDER)
+    assert "life" in rbd.list()
+    img = Image(io, "life")
+    st = img.stat()
+    assert st["size"] == 1 << 20 and st["object_size"] == 1 << ORDER
+    with pytest.raises(RadosError):
+        rbd.create("life", 1 << 20)
+    rbd.remove("life")
+    assert "life" not in rbd.list()
+    with pytest.raises(ImageNotFound):
+        Image(io, "life")
+
+
+def test_image_io_across_objects(io):
+    rbd = RBD(io)
+    rbd.create("io1", 256 << 10, order=ORDER)
+    img = Image(io, "io1")
+    data = os.urandom(100_000)
+    img.write(5_000, data)
+    assert img.read(5_000, len(data)) == data
+    # unwritten space reads zeros
+    assert img.read(0, 5_000) == b"\0" * 5_000
+    # overwrite spanning object boundaries
+    patch = os.urandom(40_000)
+    img.write(30_000, patch)
+    got = img.read(0, 256 << 10)
+    assert got[30_000:70_000] == patch
+    assert got[5_000:30_000] == data[:25_000]
+    with pytest.raises(RadosError):
+        img.write((256 << 10) - 10, b"x" * 20)   # past the end
+
+
+def test_snapshots_cow_and_rollback(io):
+    rbd = RBD(io)
+    rbd.create("snp", 128 << 10, order=ORDER)
+    img = Image(io, "snp")
+    v1 = os.urandom(64 << 10)
+    img.write(0, v1)
+    img.snap_create("s1")
+    # post-snap writes must not alter the snapshot view
+    v2 = os.urandom(64 << 10)
+    img.write(0, v2)
+    assert img.read(0, 64 << 10) == v2
+    snap_view = Image(io, "snp", snap_name="s1")
+    assert snap_view.read(0, 64 << 10) == v1
+    with pytest.raises(RadosError):
+        snap_view.write(0, b"nope")
+    # second snapshot layers on the first
+    img.snap_create("s2")
+    v3 = os.urandom(32 << 10)
+    img.write(10_000, v3)
+    assert Image(io, "snp", "s1").read(0, 64 << 10) == v1
+    assert Image(io, "snp", "s2").read(0, 64 << 10) == v2
+    names = [s["name"] for s in img.snap_list()]
+    assert names == ["s1", "s2"]
+    # rollback to s1: head == v1 again
+    img.snap_rollback("s1")
+    assert img.read(0, 64 << 10) == v1
+    # snapshots still intact after rollback
+    assert Image(io, "snp", "s2").read(0, 64 << 10) == v2
+
+
+def test_snap_rm_and_gc(io):
+    rbd = RBD(io)
+    rbd.create("gc", 64 << 10, order=ORDER)
+    img = Image(io, "gc")
+    a = os.urandom(32 << 10)
+    img.write(0, a)
+    img.snap_create("keep")
+    b = os.urandom(32 << 10)
+    img.write(0, b)
+    img.snap_create("drop")
+    c0 = os.urandom(32 << 10)
+    img.write(0, c0)
+    img.snap_rm("drop")
+    # head and the remaining snap both still correct
+    assert img.read(0, 32 << 10) == c0
+    assert Image(io, "gc", "keep").read(0, 32 << 10) == a
+    with pytest.raises(RadosError):
+        img.snap_rm("missing")
+
+
+def test_clone_and_flatten(io):
+    rbd = RBD(io)
+    rbd.create("par", 96 << 10, order=ORDER)
+    parent = Image(io, "par")
+    base = os.urandom(96 << 10)
+    parent.write(0, base)
+    parent.snap_create("golden")
+    rbd.clone("par", "golden", "child")
+    assert rbd.children("par", "golden") == ["child"]
+
+    child = Image(io, "child")
+    # unwritten extents come from the parent snapshot
+    assert child.read(0, 96 << 10) == base
+    # child writes COW, parent untouched
+    patch = os.urandom(20_000)
+    child.write(8_000, patch)
+    got = child.read(0, 96 << 10)
+    assert got[8_000:28_000] == patch
+    assert got[:8_000] == base[:8_000]
+    assert parent.read(0, 96 << 10) == base
+    # parent snap is protected while the clone exists
+    with pytest.raises(RadosError):
+        parent.snap_rm("golden")
+    # flatten severs the dependency
+    child.flatten()
+    assert Image(io, "child").header["parent"] is None
+    parent2 = Image(io, "par")
+    parent2.snap_rm("golden")
+    assert Image(io, "child").read(0, 96 << 10)[:8_000] == base[:8_000]
+
+
+def test_resize(io):
+    rbd = RBD(io)
+    rbd.create("rz", 128 << 10, order=ORDER)
+    img = Image(io, "rz")
+    data = os.urandom(128 << 10)
+    img.write(0, data)
+    img.resize(40 << 10)
+    assert img.size() == 40 << 10
+    assert img.read(0, 128 << 10) == data[:40 << 10]
+    img.resize(80 << 10)
+    got = img.read(0, 80 << 10)
+    assert got[:40 << 10] == data[:40 << 10]
+    assert got[40 << 10:] == b"\0" * (40 << 10)
+
+
+def test_rbd_cli_roundtrip(cl, tmp_path, capsys):
+    host, port = cl.mon_addr
+    m = f"{host}:{port}"
+    src = tmp_path / "disk.img"
+    src.write_bytes(os.urandom(150_000))
+    assert rbd_cli.main(["-m", m, "-p", "rbdp", "import", str(src),
+                         "cliimg", "--order", str(ORDER)]) == 0
+    assert rbd_cli.main(["-m", m, "-p", "rbdp", "ls"]) == 0
+    assert "cliimg" in capsys.readouterr().out.split()
+    assert rbd_cli.main(["-m", m, "-p", "rbdp", "snap", "create",
+                         "cliimg@s1"]) == 0
+    assert rbd_cli.main(["-m", m, "-p", "rbdp", "clone", "cliimg@s1",
+                         "clichild"]) == 0
+    dst = tmp_path / "out.img"
+    assert rbd_cli.main(["-m", m, "-p", "rbdp", "export", "clichild",
+                         str(dst)]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+    assert rbd_cli.main(["-m", m, "-p", "rbdp", "info",
+                         "clichild"]) == 0
+    import json
+    info = json.loads(capsys.readouterr().out)
+    assert info["parent"]["image"] == "cliimg"
