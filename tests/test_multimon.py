@@ -25,7 +25,7 @@ def cl():
     with Cluster(n_osds=2, n_mons=3, conf=quorum_conf()) as c:
         c.wait_for_quorum()
         for i in range(2):
-            c.wait_for_osd_up(i, 20)
+            c.wait_for_osd_up(i, 45)
         yield c
 
 
