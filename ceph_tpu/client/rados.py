@@ -268,6 +268,15 @@ class IoCtx:
     def omap_rm_keys(self, oid: str, keys: List[str]) -> None:
         self._obj_op(oid, [OSDOp("omap_rm", name=k) for k in keys])
 
+    def exec_cls(self, oid: str, cls: str, method: str,
+                 indata: bytes = b"") -> bytes:
+        """Run an object-class method (reference rados_exec /
+        IoCtx::exec): the handler executes inside the primary OSD
+        atomically with the op; -> its output payload."""
+        reply = self._obj_op(oid, [OSDOp("call", name=f"{cls}.{method}",
+                                         data=indata)])
+        return reply.out_data[0] if reply.out_data else b""
+
     # -- read class --------------------------------------------------------
     def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
         reply = self._obj_op(

@@ -62,7 +62,7 @@ STATE_ACTIVE = "active"
 
 WRITE_OPS = {"write", "writefull", "append", "create", "delete",
              "truncate", "setxattr", "rmxattr", "omap_set", "omap_rm",
-             "omap_clear"}
+             "omap_clear", "call"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
             "pgls"}
 
@@ -512,8 +512,16 @@ class PG:
                    for s, ms in self.peer_missing.items()
                    if self.acting[s] is not None)
 
+    @staticmethod
+    def _op_is_write(op) -> bool:
+        if op.op == "call":
+            # method flags decide (reference CLS_METHOD_WR)
+            from ..objclass import call_is_write
+            return call_is_write(op.name)
+        return op.op in WRITE_OPS
+
     def _do_op(self, msg: MOSDOp, conn) -> None:
-        has_write = any(op.op in WRITE_OPS for op in msg.ops)
+        has_write = any(self._op_is_write(op) for op in msg.ops)
         oid = msg.oid
         if has_write and self.scrubber.write_blocked():
             # scrub snapshots must describe one committed state; new
@@ -562,9 +570,25 @@ class PG:
         full_replace = any(op.op == "writefull" for op in msg.ops)
         info = self.backend.get_object_info(msg.oid)
         cur_size = info.size if info else 0
-        for op in msg.ops:
+        call_outputs: List[bytes] = [b""] * len(msg.ops)
+        for i, op in enumerate(msg.ops):
             o = op.op
-            if o == "write":
+            if o == "call":
+                # object classes run at the primary and stage their
+                # effects into this op's mutation (reference
+                # CEPH_OSD_OP_CALL); ENOTSUP on EC pools like the
+                # reference (ecbackend.rst "Object Classes")
+                if ec:
+                    err = -95
+                    break
+                from ..objclass import dispatch_call
+                ret, out = dispatch_call(self, msg.oid, op.name,
+                                         op.data, mut)
+                call_outputs[i] = out
+                if ret < 0:
+                    err = ret
+                    break
+            elif o == "write":
                 mut.writes.append((op.offset, op.data))
             elif o == "writefull":
                 mut.writes.append((0, op.data))
@@ -617,11 +641,13 @@ class PG:
         self.inflight_writes.add(msg.oid)
         self.backend.submit_transaction(
             msg.oid, mut, version, [entry],
-            lambda res: self._op_committed(msg, conn, res))
+            lambda res: self._op_committed(msg, conn, res,
+                                           call_outputs))
 
-    def _op_committed(self, msg: MOSDOp, conn, res: int) -> None:
+    def _op_committed(self, msg: MOSDOp, conn, res: int,
+                      out_data: Optional[List[bytes]] = None) -> None:
         self.inflight_writes.discard(msg.oid)
-        self._reply(conn, msg, res, [])
+        self._reply(conn, msg, res, out_data or [])
         q = self.waiting_for_obj.get(msg.oid)
         if q:
             nmsg, nconn = q.popleft()
@@ -654,7 +680,20 @@ class PG:
                 length = op.length if op.length else (1 << 62)
                 self.backend.objects_read(msg.oid, op.offset, length, cb)
                 return
-            if o == "stat":
+            if o == "call":
+                # read-only class method (reference CLS_METHOD_RD):
+                # no transaction; staging writes fails in dispatch
+                if self.pool.is_erasure():
+                    finish(-95)
+                    return
+                from ..objclass import dispatch_call
+                ret, out = dispatch_call(self, msg.oid, op.name,
+                                         op.data, None)
+                if ret < 0:
+                    finish(ret)
+                    return
+                out_data[i] = out
+            elif o == "stat":
                 info = self.backend.get_object_info(msg.oid)
                 if info is None:
                     finish(-2)

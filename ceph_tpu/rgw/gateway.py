@@ -1,0 +1,185 @@
+"""Object gateway core: buckets + objects over RADOS.
+
+Python-native equivalent of the reference's RGW data layer (reference
+``src/rgw/`` 182.6k LoC reduced to the S3 essentials): buckets are
+metadata objects plus an omap **bucket index** listing keys in order
+(reference cls_rgw bucket-index objects; omap gives the sorted
+prefix/marker listing semantics S3 needs), object data+metadata live
+in per-key RADOS objects, ETag is the content MD5 like S3.
+
+Large objects stripe via the striper when they exceed one chunk
+(reference RGW stripes tail objects the same way).  Auth, multisite,
+lifecycle, versioning are out of scope; the HTTP frontend lives in
+``server.py``.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ..client.rados import IoCtx, RadosError
+from ..client.striper import Layout, StripedIoCtx
+
+BUCKETS_DIR = "rgw.buckets"          # gateway-wide bucket directory
+CHUNK = 4 << 20
+
+
+class RGWError(Exception):
+    def __init__(self, status: int, code: str, msg: str = ""):
+        super().__init__(f"{status} {code} {msg}")
+        self.status = status
+        self.code = code
+
+
+def _index_oid(bucket: str) -> str:
+    # length-prefixed bucket name: '.' is legal inside bucket names,
+    # so 'rgw.index.<bucket>' alone would let (bucket, key) pairs
+    # collide across buckets
+    return f"rgw.index.{len(bucket)}.{bucket}"
+
+
+def _data_soid(bucket: str, key: str) -> str:
+    return f"rgw.data.{len(bucket)}.{bucket}.{key}"
+
+
+class RGWService:
+    """Bucket/object operations (reference RGWRados)."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+        self.striper = StripedIoCtx(
+            ioctx, Layout(stripe_unit=CHUNK, stripe_count=1,
+                          object_size=CHUNK))
+
+    # -- buckets (reference RGWRados::create_bucket) -------------------
+    # The directory is an omap on one object: per-key mutations are
+    # atomic at the OSD, so concurrent bucket create/delete cannot
+    # lose each other's updates (a read-modify-write JSON blob could).
+    def list_buckets(self) -> List[dict]:
+        try:
+            omap = self.ioctx.omap_get(BUCKETS_DIR)
+        except RadosError:
+            return []
+        return [json.loads(v.decode())
+                for _, v in sorted(omap.items())]
+
+    def create_bucket(self, bucket: str) -> None:
+        if not bucket or "/" in bucket or "." == bucket[0]:
+            raise RGWError(400, "InvalidBucketName", bucket)
+        try:
+            if bucket in self.ioctx.omap_get(BUCKETS_DIR):
+                raise RGWError(409, "BucketAlreadyExists", bucket)
+        except RadosError:
+            pass
+        meta = {"name": bucket, "created": time.time()}
+        self.ioctx.omap_set(BUCKETS_DIR,
+                            {bucket: json.dumps(meta).encode()})
+        self.ioctx.create(_index_oid(bucket))
+
+    def _check_bucket(self, bucket: str) -> None:
+        try:
+            if bucket in self.ioctx.omap_get(BUCKETS_DIR):
+                return
+        except RadosError:
+            pass
+        raise RGWError(404, "NoSuchBucket", bucket)
+
+    def delete_bucket(self, bucket: str) -> None:
+        self._check_bucket(bucket)
+        if self.ioctx.omap_get(_index_oid(bucket)):
+            raise RGWError(409, "BucketNotEmpty", bucket)
+        try:
+            self.ioctx.remove(_index_oid(bucket))
+        except RadosError:
+            pass
+        self.ioctx.omap_rm_keys(BUCKETS_DIR, [bucket])
+
+    # -- objects (reference RGWRados::Object::Write/Read) --------------
+    def put_object(self, bucket: str, key: str, data: bytes,
+                   content_type: str = "binary/octet-stream",
+                   meta: Optional[Dict[str, str]] = None) -> str:
+        self._check_bucket(bucket)
+        if not key:
+            raise RGWError(400, "InvalidArgument", "empty key")
+        etag = hashlib.md5(data).hexdigest()
+        soid = _data_soid(bucket, key)
+        self.striper.write(soid, data)
+        # shrink past the new end: overwriting a larger object must
+        # not serve the previous object's tail
+        self.striper.truncate(soid, len(data))
+        # index entry AFTER data (reference prepare/complete index
+        # transaction: a failed put must not list)
+        entry = {"size": len(data), "etag": etag,
+                 "mtime": time.time(), "content_type": content_type,
+                 "meta": meta or {}}
+        self.ioctx.omap_set(_index_oid(bucket),
+                            {key: json.dumps(entry).encode()})
+        return etag
+
+    def head_object(self, bucket: str, key: str) -> dict:
+        self._check_bucket(bucket)
+        entry = self.ioctx.omap_get(_index_oid(bucket)).get(key)
+        if entry is None:
+            raise RGWError(404, "NoSuchKey", key)
+        return json.loads(entry.decode())
+
+    def get_object(self, bucket: str, key: str,
+                   rng: Optional[Tuple[int, int]] = None
+                   ) -> Tuple[dict, bytes]:
+        head = self.head_object(bucket, key)
+        soid = _data_soid(bucket, key)
+        if head["size"] == 0:
+            return head, b""
+        if rng is None:
+            return head, self.striper.read(soid)
+        start, end = rng
+        end = min(end, head["size"] - 1)
+        if start > end:
+            raise RGWError(416, "InvalidRange", key)
+        return head, self.striper.read(soid, end - start + 1, start)
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        self._check_bucket(bucket)
+        idx = _index_oid(bucket)
+        if key not in self.ioctx.omap_get(idx):
+            raise RGWError(404, "NoSuchKey", key)
+        try:
+            self.striper.remove(_data_soid(bucket, key))
+        except RadosError:
+            pass
+        self.ioctx.omap_rm_keys(idx, [key])
+
+    def list_objects(self, bucket: str, prefix: str = "",
+                     marker: str = "", max_keys: int = 1000,
+                     delimiter: str = "") -> dict:
+        """S3 ListObjects semantics: sorted keys, prefix filter,
+        marker resume, delimiter common-prefix rollup (reference
+        cls_rgw bucket listing + RGWListBucket)."""
+        self._check_bucket(bucket)
+        omap = self.ioctx.omap_get(_index_oid(bucket))
+        keys = sorted(k for k in omap
+                      if k.startswith(prefix) and k > marker)
+        contents: List[dict] = []
+        common: List[str] = []
+        truncated = False
+        for k in keys:
+            if len(contents) + len(common) >= max_keys:
+                truncated = True
+                break
+            if delimiter:
+                rest = k[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter, 1)[0] \
+                        + delimiter
+                    if cp not in common:
+                        common.append(cp)
+                    continue
+            entry = json.loads(omap[k].decode())
+            contents.append({"key": k, "size": entry["size"],
+                             "etag": entry["etag"],
+                             "mtime": entry["mtime"]})
+        return {"bucket": bucket, "prefix": prefix, "marker": marker,
+                "contents": contents, "common_prefixes": common,
+                "is_truncated": truncated}

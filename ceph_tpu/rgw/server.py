@@ -1,0 +1,206 @@
+"""RGW HTTP frontend: S3 path-style REST over the gateway core.
+
+Python-native equivalent of the reference's beast/civetweb frontend +
+REST dispatch (reference ``src/rgw/rgw_rest_s3.cc``): path-style
+routes (``/bucket``, ``/bucket/key``), ListAllMyBuckets /
+ListObjects XML, ETag/Content-Type headers, Range reads, S3-style XML
+error bodies.  No signature auth (the reference supports anonymous
+access too); single-site.
+"""
+from __future__ import annotations
+
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Tuple
+from xml.sax.saxutils import escape
+
+from .gateway import RGWError, RGWService
+
+
+def _iso(ts: float) -> str:
+    import datetime
+    return datetime.datetime.fromtimestamp(
+        ts, datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%S.000Z")
+
+
+class RGWServer:
+    """HTTP server hosting one RGWService (reference RGWFrontend)."""
+
+    def __init__(self, ioctx, addr: Tuple[str, int] = ("127.0.0.1", 0)):
+        self.service = RGWService(ioctx)
+        svc = self.service
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            # ---------------------------------------------------- util
+            def _split(self) -> Tuple[str, str, dict]:
+                parsed = urllib.parse.urlparse(self.path)
+                parts = parsed.path.lstrip("/").split("/", 1)
+                bucket = urllib.parse.unquote(parts[0])
+                key = urllib.parse.unquote(parts[1]) \
+                    if len(parts) > 1 else ""
+                q = {k: v[0] for k, v in
+                     urllib.parse.parse_qs(parsed.query).items()}
+                return bucket, key, q
+
+            def _send(self, status: int, body: bytes = b"",
+                      ctype: str = "application/xml",
+                      headers: Optional[dict] = None) -> None:
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _error(self, e: RGWError) -> None:
+                body = (f"<?xml version='1.0'?><Error><Code>{e.code}"
+                        f"</Code><Message>{escape(str(e))}</Message>"
+                        f"</Error>").encode()
+                self._send(e.status, body)
+
+            def _body(self) -> bytes:
+                n = int(self.headers.get("Content-Length", 0))
+                return self.rfile.read(n) if n else b""
+
+            # --------------------------------------------------- verbs
+            def do_GET(self):          # noqa: N802
+                bucket, key, q = self._split()
+                try:
+                    if not bucket:
+                        self._list_buckets()
+                    elif not key:
+                        self._list_objects(bucket, q)
+                    else:
+                        self._get_object(bucket, key)
+                except RGWError as e:
+                    self._error(e)
+
+            def do_HEAD(self):         # noqa: N802
+                bucket, key, _ = self._split()
+                try:
+                    head = svc.head_object(bucket, key)
+                    self.send_response(200)
+                    self.send_header("Content-Length",
+                                     str(head["size"]))
+                    self.send_header("ETag", f'"{head["etag"]}"')
+                    self.send_header("Content-Type",
+                                     head["content_type"])
+                    self.end_headers()
+                except RGWError as e:
+                    self.send_response(e.status)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+
+            def do_PUT(self):          # noqa: N802
+                bucket, key, _ = self._split()
+                try:
+                    if not key:
+                        svc.create_bucket(bucket)
+                        self._send(200)
+                    else:
+                        etag = svc.put_object(
+                            bucket, key, self._body(),
+                            content_type=self.headers.get(
+                                "Content-Type",
+                                "binary/octet-stream"))
+                        self._send(200, headers={"ETag": f'"{etag}"'})
+                except RGWError as e:
+                    self._error(e)
+
+            def do_DELETE(self):       # noqa: N802
+                bucket, key, _ = self._split()
+                try:
+                    if not key:
+                        svc.delete_bucket(bucket)
+                    else:
+                        svc.delete_object(bucket, key)
+                    self._send(204)
+                except RGWError as e:
+                    self._error(e)
+
+            # ------------------------------------------------ handlers
+            def _list_buckets(self):
+                rows = "".join(
+                    f"<Bucket><Name>{escape(b['name'])}</Name>"
+                    f"<CreationDate>{_iso(b['created'])}"
+                    f"</CreationDate></Bucket>"
+                    for b in svc.list_buckets())
+                body = (f"<?xml version='1.0'?>"
+                        f"<ListAllMyBucketsResult><Buckets>{rows}"
+                        f"</Buckets></ListAllMyBucketsResult>").encode()
+                self._send(200, body)
+
+            def _list_objects(self, bucket: str, q: dict):
+                try:
+                    max_keys = int(q.get("max-keys", 1000))
+                except ValueError:
+                    raise RGWError(400, "InvalidArgument", "max-keys")
+                res = svc.list_objects(
+                    bucket, prefix=q.get("prefix", ""),
+                    marker=q.get("marker", ""),
+                    max_keys=max_keys,
+                    delimiter=q.get("delimiter", ""))
+                rows = "".join(
+                    f"<Contents><Key>{escape(c['key'])}</Key>"
+                    f"<Size>{c['size']}</Size>"
+                    f"<ETag>\"{c['etag']}\"</ETag>"
+                    f"<LastModified>{_iso(c['mtime'])}</LastModified>"
+                    f"</Contents>" for c in res["contents"])
+                cps = "".join(
+                    f"<CommonPrefixes><Prefix>{escape(p)}</Prefix>"
+                    f"</CommonPrefixes>"
+                    for p in res["common_prefixes"])
+                body = (f"<?xml version='1.0'?><ListBucketResult>"
+                        f"<Name>{escape(bucket)}</Name>"
+                        f"<Prefix>{escape(res['prefix'])}</Prefix>"
+                        f"<IsTruncated>"
+                        f"{str(res['is_truncated']).lower()}"
+                        f"</IsTruncated>{rows}{cps}"
+                        f"</ListBucketResult>").encode()
+                self._send(200, body)
+
+            def _get_object(self, bucket: str, key: str):
+                rng = None
+                hdr = self.headers.get("Range", "")
+                if hdr.startswith("bytes="):
+                    lo, _, hi = hdr[6:].partition("-")
+                    try:
+                        if lo == "" and hi:
+                            # suffix range: last N bytes
+                            size = svc.head_object(bucket,
+                                                   key)["size"]
+                            n = int(hi)
+                            rng = (max(0, size - n), size - 1)
+                        else:
+                            rng = (int(lo),
+                                   int(hi) if hi else (1 << 62))
+                    except ValueError:
+                        raise RGWError(416, "InvalidRange", hdr)
+                head, data = svc.get_object(bucket, key, rng)
+                self._send(206 if rng else 200, data,
+                           ctype=head["content_type"],
+                           headers={"ETag": f'"{head["etag"]}"'})
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer(addr, Handler)
+        self.addr = self._httpd.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RGWServer":
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="rgw-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def shutdown(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

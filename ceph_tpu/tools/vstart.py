@@ -29,6 +29,9 @@ def main(argv: List[str] = None) -> int:
     p.add_argument("--mgr", action="store_true",
                    help="start a manager (perf aggregation + "
                         "prometheus /metrics endpoint)")
+    p.add_argument("--rgw", action="store_true",
+                   help="start an S3 gateway on pool '.rgw' "
+                        "(created if absent)")
     p.add_argument("-d", "--data-dir",
                    help="FileStore-backed daemons (default: MemStore)")
     p.add_argument("-e", "--ec-pool", action="store_true",
@@ -63,6 +66,15 @@ def main(argv: List[str] = None) -> int:
     if cluster.mgr is not None:
         mh, mp = cluster.mgr.http_addr
         print(f"mgr metrics: http://{mh}:{mp}/metrics")
+    rgw_srv = None
+    if ns.rgw:
+        from ..rgw.server import RGWServer
+        cluster.create_pool(".rgw", "replicated",
+                            size=min(2, ns.num_osds))
+        rgw_client = cluster.rados()
+        rgw_srv = RGWServer(rgw_client.open_ioctx(".rgw")).start()
+        rh, rp = rgw_srv.addr
+        print(f"rgw S3 endpoint: http://{rh}:{rp}/")
     print(f"export CEPH_TPU_MON={addr}")
     sys.stdout.flush()
 
@@ -73,6 +85,8 @@ def main(argv: List[str] = None) -> int:
         while not stop:
             time.sleep(0.2)
     finally:
+        if rgw_srv is not None:
+            rgw_srv.shutdown()
         cluster.stop()
     return 0
 

@@ -1,0 +1,262 @@
+"""Object classes + RGW gateway tests.
+
+Reference analog: src/test/cls_lock/, src/test/cls_version/ behaviors
+(lock exclusivity, version checks) over the exec op, and RGW S3
+semantics (bucket lifecycle, object CRUD + ETag, prefix/marker/
+delimiter listing, HTTP frontend) per src/test/rgw/."""
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.rgw import RGWError, RGWService
+from ceph_tpu.rgw.server import RGWServer
+
+
+@pytest.fixture(scope="module")
+def cl():
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("clsp", "replicated", size=2)
+        yield c
+
+
+@pytest.fixture(scope="module")
+def io(cl):
+    return cl.rados().open_ioctx("clsp")
+
+
+# ------------------------------------------------------------- cls
+
+
+def test_cls_lock_exclusive(io):
+    req = {"name": "l1", "type": "exclusive", "owner": "alice",
+           "cookie": "c1"}
+    io.exec_cls("lk1", "lock", "lock", json.dumps(req).encode())
+    # same locker: re-lock ok
+    io.exec_cls("lk1", "lock", "lock", json.dumps(req).encode())
+    # other owner: EBUSY
+    other = dict(req, owner="bob", cookie="c2")
+    with pytest.raises(RadosError) as ei:
+        io.exec_cls("lk1", "lock", "lock", json.dumps(other).encode())
+    assert ei.value.errno == 16
+    info = json.loads(io.exec_cls(
+        "lk1", "lock", "get_info",
+        json.dumps({"name": "l1"}).encode()))
+    assert list(info["lockers"]) == ["alice c1"]
+    # unlock then bob can take it
+    io.exec_cls("lk1", "lock", "unlock",
+                json.dumps({"name": "l1", "owner": "alice",
+                            "cookie": "c1"}).encode())
+    io.exec_cls("lk1", "lock", "lock", json.dumps(other).encode())
+    # break bob's lock (operator recovery)
+    io.exec_cls("lk1", "lock", "break_lock",
+                json.dumps({"name": "l1", "locker_owner": "bob",
+                            "locker_cookie": "c2"}).encode())
+    info = json.loads(io.exec_cls(
+        "lk1", "lock", "get_info",
+        json.dumps({"name": "l1"}).encode()))
+    assert info["lockers"] == {}
+
+
+def test_cls_lock_shared(io):
+    a = {"name": "s", "type": "shared", "owner": "a", "tag": "t"}
+    b = {"name": "s", "type": "shared", "owner": "b", "tag": "t"}
+    io.exec_cls("lk2", "lock", "lock", json.dumps(a).encode())
+    io.exec_cls("lk2", "lock", "lock", json.dumps(b).encode())
+    info = json.loads(io.exec_cls(
+        "lk2", "lock", "get_info", json.dumps({"name": "s"}).encode()))
+    assert len(info["lockers"]) == 2
+    # exclusive attempt on shared-held lock: EBUSY
+    x = {"name": "s", "type": "exclusive", "owner": "c"}
+    with pytest.raises(RadosError):
+        io.exec_cls("lk2", "lock", "lock", json.dumps(x).encode())
+
+
+def test_cls_version(io):
+    io.exec_cls("v1", "version", "set",
+                json.dumps({"ver": 5}).encode())
+    out = json.loads(io.exec_cls("v1", "version", "read", b""))
+    assert out["ver"] == 5
+    out = json.loads(io.exec_cls("v1", "version", "inc", b""))
+    assert out["ver"] == 6
+    io.exec_cls("v1", "version", "check",
+                json.dumps({"ver": 6}).encode())
+    with pytest.raises(RadosError) as ei:
+        io.exec_cls("v1", "version", "check",
+                    json.dumps({"ver": 99}).encode())
+    assert ei.value.errno == 125
+
+
+def test_cls_unknown_and_ec_rejected(cl, io):
+    with pytest.raises(RadosError) as ei:
+        io.exec_cls("x", "nope", "nothing", b"")
+    assert ei.value.errno == 95
+    cl.create_ec_profile("clsec", plugin="jerasure", k="2", m="1")
+    cl.create_pool("clsecp", "erasure", erasure_code_profile="clsec")
+    ecio = cl.rados().open_ioctx("clsecp")
+    with pytest.raises(RadosError) as ei:
+        ecio.exec_cls("o", "version", "read", b"")
+    assert ei.value.errno == 95          # ENOTSUP on EC pools
+
+
+def test_cls_effects_are_replicated_writes(cl, io):
+    """Class effects commit through the normal write path: they must
+    survive the primary's death like any write."""
+    io.exec_cls("dur", "version", "set",
+                json.dumps({"ver": 42}).encode())
+    with cl.rados().objecter.lock:
+        osdmap = cl.rados().objecter.osdmap
+    pgid = osdmap.object_locator_to_pg("dur", io.pool_id)
+    _, primary, _, _ = osdmap.pg_to_up_acting_osds(pgid)
+    cl.kill_osd(primary)
+    cl.wait_for_osd_down(primary)
+    out = json.loads(io.exec_cls("dur", "version", "read", b""))
+    assert out["ver"] == 42
+    cl.revive_osd(primary)
+    cl.wait_for_osd_up(primary)
+
+
+# ------------------------------------------------------------- rgw
+
+
+@pytest.fixture(scope="module")
+def rgw(cl):
+    c = cl.rados()
+    c2 = c.open_ioctx("clsp")
+    return RGWService(c2)
+
+
+def test_rgw_bucket_lifecycle(rgw):
+    rgw.create_bucket("photos")
+    assert "photos" in [b["name"] for b in rgw.list_buckets()]
+    with pytest.raises(RGWError):
+        rgw.create_bucket("photos")
+    rgw.delete_bucket("photos")
+    assert "photos" not in [b["name"] for b in rgw.list_buckets()]
+    with pytest.raises(RGWError):
+        rgw.delete_bucket("never-was")
+
+
+def test_rgw_object_crud_and_listing(rgw):
+    rgw.create_bucket("docs")
+    import hashlib
+    data = os.urandom(100_000)
+    etag = rgw.put_object("docs", "a/1.bin", data)
+    assert etag == hashlib.md5(data).hexdigest()
+    rgw.put_object("docs", "a/2.bin", b"two")
+    rgw.put_object("docs", "b/3.bin", b"three")
+
+    head, got = rgw.get_object("docs", "a/1.bin")
+    assert got == data and head["etag"] == etag
+    _, part = rgw.get_object("docs", "a/1.bin", rng=(10, 29))
+    assert part == data[10:30]
+
+    res = rgw.list_objects("docs")
+    assert [c["key"] for c in res["contents"]] == \
+        ["a/1.bin", "a/2.bin", "b/3.bin"]
+    res = rgw.list_objects("docs", prefix="a/")
+    assert len(res["contents"]) == 2
+    res = rgw.list_objects("docs", delimiter="/")
+    assert res["common_prefixes"] == ["a/", "b/"]
+    res = rgw.list_objects("docs", marker="a/2.bin")
+    assert [c["key"] for c in res["contents"]] == ["b/3.bin"]
+    res = rgw.list_objects("docs", max_keys=2)
+    assert res["is_truncated"]
+
+    rgw.delete_object("docs", "a/1.bin")
+    with pytest.raises(RGWError):
+        rgw.get_object("docs", "a/1.bin")
+    # bucket not empty
+    with pytest.raises(RGWError):
+        rgw.delete_bucket("docs")
+
+
+def test_rgw_overwrite_shrinks(rgw):
+    """Replacing a large object with a small one must not serve the
+    old tail."""
+    rgw.create_bucket("shrink")
+    rgw.put_object("shrink", "k", os.urandom(60_000))
+    rgw.put_object("shrink", "k", b"tiny")
+    head, got = rgw.get_object("shrink", "k")
+    assert got == b"tiny" and head["size"] == 4
+
+
+def test_rgw_dotted_buckets_do_not_collide(rgw):
+    rgw.create_bucket("x")
+    rgw.create_bucket("x.y")
+    rgw.put_object("x", "y.z", b"AAA")
+    rgw.put_object("x.y", "z", b"BBB")
+    assert rgw.get_object("x", "y.z")[1] == b"AAA"
+    assert rgw.get_object("x.y", "z")[1] == b"BBB"
+
+
+def test_readonly_cls_call_does_not_create_object(io):
+    """A read-only probe (CLS_METHOD_RD) must not materialize the
+    object or write a PG-log entry."""
+    out = json.loads(io.exec_cls("ghost2", "version", "read", b""))
+    assert out["ver"] == 0
+    with pytest.raises(RadosError):
+        io.stat("ghost2")
+    # and a subsequent create must not hit EEXIST from a phantom
+    io.create("ghost2")
+
+
+def test_shared_locker_cannot_convert_to_exclusive(io):
+    a = {"name": "cv", "type": "shared", "owner": "a", "tag": "t"}
+    b = {"name": "cv", "type": "shared", "owner": "b", "tag": "t"}
+    io.exec_cls("lk3", "lock", "lock", json.dumps(a).encode())
+    io.exec_cls("lk3", "lock", "lock", json.dumps(b).encode())
+    with pytest.raises(RadosError) as ei:
+        io.exec_cls("lk3", "lock", "lock", json.dumps(
+            dict(a, type="exclusive")).encode())
+    assert ei.value.errno == 16
+
+
+def test_rgw_http_frontend(cl):
+    io = cl.rados().open_ioctx("clsp")
+    srv = RGWServer(io).start()
+    try:
+        host, port = srv.addr
+        base = f"http://{host}:{port}"
+
+        def req(method, path, data=None, headers=None):
+            r = urllib.request.Request(base + path, data=data,
+                                       method=method,
+                                       headers=headers or {})
+            return urllib.request.urlopen(r, timeout=10)
+
+        # bucket + object put
+        assert req("PUT", "/web").status == 200
+        body = os.urandom(50_000)
+        resp = req("PUT", "/web/site/index.html", data=body,
+                   headers={"Content-Type": "text/html"})
+        etag = resp.headers["ETag"].strip('"')
+        # get + headers
+        resp = req("GET", "/web/site/index.html")
+        assert resp.read() == body
+        assert resp.headers["ETag"].strip('"') == etag
+        assert resp.headers["Content-Type"] == "text/html"
+        # range
+        resp = req("GET", "/web/site/index.html",
+                   headers={"Range": "bytes=100-199"})
+        assert resp.status == 206 and resp.read() == body[100:200]
+        # listing XML
+        xml = req("GET", "/web?prefix=site/").read().decode()
+        assert "<Key>site/index.html</Key>" in xml
+        xml = req("GET", "/").read().decode()
+        assert "<Name>web</Name>" in xml
+        # delete then 404
+        assert req("DELETE", "/web/site/index.html").status == 204
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            req("GET", "/web/site/index.html")
+        assert ei.value.code == 404
+        assert "NoSuchKey" in ei.value.read().decode()
+        assert req("DELETE", "/web").status == 204
+    finally:
+        srv.shutdown()
