@@ -297,6 +297,18 @@ class IoCtx:
         return {k: v.encode("latin1")
                 for k, v in reply.extra["xattrs"].items()}
 
+    def omap_get_by_key(self, oid: str, key: str) -> Optional[bytes]:
+        """Single omap entry, None when absent (reference
+        omap_get_vals_by_keys) — O(entry), not O(index)."""
+        try:
+            reply = self._obj_op(oid, [OSDOp("omap_get_by_key",
+                                             name=key)])
+        except RadosError as e:
+            if e.errno == 61:            # ENODATA: key absent
+                return None
+            raise
+        return reply.out_data[0] if reply.out_data else None
+
     def omap_get(self, oid: str) -> Dict[str, bytes]:
         reply = self._obj_op(oid, [OSDOp("omap_get")])
         return {k: v.encode("latin1")

@@ -50,8 +50,11 @@ def call_is_write(spec: str) -> bool:
 
 class MethodContext:
     """What a class method may do to its object (reference
-    cls_cxx_* helpers).  Reads see committed state; writes stage into
-    the op's Mutation and commit with it."""
+    cls_cxx_* helpers).  Reads see committed state OVERLAID with the
+    op's already-staged mutation, so sequential calls inside one
+    client op observe each other's effects (the reference executes
+    ops sequentially against the in-progress transaction); writes
+    stage into the Mutation and commit with it."""
 
     def __init__(self, pg, oid: str, mutation) -> None:
         self._pg = pg
@@ -59,7 +62,7 @@ class MethodContext:
         self._mut = mutation
         self._obj = None
 
-    # -- reads (committed state on the primary) ------------------------
+    # -- reads (committed state + staged overlay) ----------------------
     def _handle(self):
         from ..store.objectstore import GHObject
         if self._obj is None:
@@ -67,39 +70,78 @@ class MethodContext:
         return self._pg.store, self._pg.coll, self._obj
 
     def exists(self) -> bool:
+        if self._mut.delete:
+            return False
+        if self._mut.create or self._mut.writes or self._mut.attrs:
+            return True
         store, coll, obj = self._handle()
         return store.exists(coll, obj)
 
     def read(self, offset: int = 0, length=None) -> bytes:
         store, coll, obj = self._handle()
         try:
-            return store.read(coll, obj, offset, length)
+            base = bytearray(store.read(coll, obj))
         except FileNotFoundError:
-            return b""
+            base = bytearray()
+        if self._mut.delete:
+            base = bytearray()
+        for off, data in self._mut.writes:
+            if off + len(data) > len(base):
+                base.extend(b"\0" * (off + len(data) - len(base)))
+            base[off:off + len(data)] = data
+        if self._mut.truncate is not None:
+            base = base[:self._mut.truncate]
+        end = len(base) if length is None else offset + length
+        return bytes(base[offset:end])
 
     def stat(self):
         store, coll, obj = self._handle()
         return store.stat(coll, obj)
 
     def getxattr(self, name: str) -> bytes:
+        # staged attrs win (class attrs share the client path's user
+        # prefix so plain getxattr sees them too)
+        if name in self._mut.attrs:
+            val = self._mut.attrs[name]
+            if val is None:
+                raise KeyError(name)
+            return val
         store, coll, obj = self._handle()
-        # class attrs live under the same user prefix the client path
-        # uses so plain getxattr sees them too
         return store.getattr(coll, obj, "u_" + name)
 
     def getxattrs(self) -> Dict[str, bytes]:
         store, coll, obj = self._handle()
-        return {k[2:]: v for k, v in store.getattrs(coll, obj).items()
-                if k.startswith("u_")}
+        try:
+            out = {k[2:]: v for k, v in
+                   store.getattrs(coll, obj).items()
+                   if k.startswith("u_")}
+        except FileNotFoundError:
+            out = {}
+        for name, val in self._mut.attrs.items():
+            if val is None:
+                out.pop(name, None)
+            else:
+                out[name] = val
+        return out
 
     def omap_get(self) -> Dict[str, bytes]:
         store, coll, obj = self._handle()
-        return store.omap_get(coll, obj)
+        try:
+            out = dict(store.omap_get(coll, obj))
+        except FileNotFoundError:
+            out = {}
+        if self._mut.omap_clear:
+            out = {}
+        out.update(self._mut.omap_set)
+        for k in self._mut.omap_rm:
+            out.pop(k, None)
+        return out
 
     def omap_get_keys(self, start_after: str = "",
                       max_return=None):
-        store, coll, obj = self._handle()
-        return store.omap_get_keys(coll, obj, start_after, max_return)
+        keys = sorted(self.omap_get())
+        keys = [k for k in keys if k > start_after]
+        return keys[:max_return] if max_return else keys
 
     # -- staged writes (commit with the op) ----------------------------
     def write(self, offset: int, data: bytes) -> None:

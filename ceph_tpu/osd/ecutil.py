@@ -22,7 +22,7 @@ src/osd/ECUtil.{h,cc}):
 from __future__ import annotations
 
 import json
-import zlib
+from ..utils.crc import crc32c
 from typing import Dict, List, Mapping, Optional, Sequence, Set, Tuple
 
 import numpy as np
@@ -215,7 +215,7 @@ class HashInfo:
             f"append at {old_size} != hashed {self.total_chunk_size}"
         size = None
         for i, buf in chunks.items():
-            self.crcs[i] = zlib.crc32(buf, self.crcs[i])
+            self.crcs[i] = crc32c(bytes(buf), self.crcs[i])
             if size is None:
                 size = len(buf)
             assert size == len(buf), "unequal chunk appends"
@@ -241,4 +241,4 @@ class HashInfo:
 
 def chunk_crc(data: bytes) -> int:
     """CRC of a full shard object, for deep-scrub comparison."""
-    return zlib.crc32(data)
+    return crc32c(bytes(data))

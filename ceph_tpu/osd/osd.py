@@ -51,7 +51,7 @@ from ..store.objectstore import ObjectStore
 from ..utils.config import Config, default_config
 from ..utils.log import Dout
 from .osdmap import OSDMap, PGid
-from .pg import PG, STATE_ACTIVE, STATE_PEERING, WRITE_OPS
+from .pg import PG, STATE_ACTIVE, STATE_PEERING
 
 _BACKEND_MSGS = (MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                  MOSDECSubOpRead, MOSDECSubOpReadReply,
@@ -318,7 +318,7 @@ class OSD(Dispatcher):
                 conn.send_message(MOSDOpReply(
                     tid=msg.tid, result=-108, epoch=self.osdmap.epoch))
                 continue
-            is_write = any(op.op in WRITE_OPS for op in msg.ops)
+            is_write = any(PG._op_is_write(op) for op in msg.ops)
             tracked = self.op_tracker.create(
                 f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
                 f"{'+'.join(op.op for op in msg.ops)})")

@@ -64,7 +64,7 @@ WRITE_OPS = {"write", "writefull", "append", "create", "delete",
              "truncate", "setxattr", "rmxattr", "omap_set", "omap_rm",
              "omap_clear", "call"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
-            "pgls"}
+            "omap_get_by_key", "pgls"}
 
 
 class PG:
@@ -94,6 +94,9 @@ class PG:
         self.inflight_writes: Set[str] = set()
         self.waiting_for_obj: Dict[str, deque] = {}
         self.waiting_for_scrub: deque = deque()
+        # recent committed-op outputs for dup-resend replay (class
+        # call payloads); insertion-ordered, bounded
+        self._reply_cache: Dict[Tuple[str, int], List[bytes]] = {}
         # every client op this PG currently holds, by reqid; on an
         # interval change they all bounce back to the client for
         # re-targeting (reference on_change requeue + client resend)
@@ -562,7 +565,11 @@ class PG:
         # dup detection: a resend of an already-committed op must not
         # re-apply (reference PGLog dup handling / already_complete)
         if self.log.has_reqid(msg.client, msg.tid) is not None:
-            self._reply(conn, msg, 0, [])
+            # resend of a committed op: replay its outputs so calls
+            # with payloads (class methods) don't lose their result
+            # (reference keeps completed-op reply data with the log)
+            cached = self._reply_cache.get((msg.client, msg.tid), [])
+            self._reply(conn, msg, 0, cached)
             return
         mut = Mutation()
         err = 0
@@ -647,6 +654,11 @@ class PG:
     def _op_committed(self, msg: MOSDOp, conn, res: int,
                       out_data: Optional[List[bytes]] = None) -> None:
         self.inflight_writes.discard(msg.oid)
+        if res == 0 and out_data and any(out_data):
+            self._reply_cache[(msg.client, msg.tid)] = out_data
+            while len(self._reply_cache) > 128:
+                self._reply_cache.pop(
+                    next(iter(self._reply_cache)))
         self._reply(conn, msg, res, out_data or [])
         q = self.waiting_for_obj.get(msg.oid)
         if q:
@@ -730,6 +742,22 @@ class PG:
                     return
                 extra["omap"] = {k: v.decode("latin1")
                                  for k, v in omap.items()}
+            elif o == "omap_get_by_key":
+                # single-key lookup (reference omap_get_vals_by_keys):
+                # avoids shipping a huge index to read one entry
+                if self.pool.is_erasure():
+                    finish(-95)
+                    return
+                try:
+                    omap = self.store.omap_get(
+                        self.coll, GHObject(msg.oid, self.own_shard))
+                except FileNotFoundError:
+                    finish(-2)
+                    return
+                if op.name not in omap:
+                    finish(-61)          # -ENODATA
+                    return
+                out_data[i] = omap[op.name]
             elif o == "pgls":
                 objs = []
                 for oid in self.backend.list_objects():

@@ -308,7 +308,7 @@ class ReplicatedBackend(PGBackend):
         """Full-object snapshot (reference be_scan_list; deep CRCs per
         ReplicatedBackend::be_deep_scrub, ReplicatedBackend.cc:614 —
         whole-object data hash, omap hash, attr hash)."""
-        import zlib
+        from ..utils.crc import crc32c
         out: Dict[str, dict] = {}
         store = self.host.store
         coll = self.host.coll
@@ -321,18 +321,18 @@ class ReplicatedBackend(PGBackend):
                 info = self.get_object_info(obj.oid)
                 entry["oi_version"] = list(info.version) if info else None
                 if deep:
-                    entry["data_crc"] = zlib.crc32(store.read(coll, obj))
+                    entry["data_crc"] = crc32c(store.read(coll, obj))
                     oc = 0
                     omap = store.omap_get(coll, obj)
                     for k in sorted(omap):
-                        oc = zlib.crc32(k.encode() + b"\0" + omap[k],
-                                        oc)
+                        oc = crc32c(k.encode() + b"\0" + omap[k],
+                                    oc)
                     entry["omap_crc"] = oc
                     ac = 0
                     attrs = store.getattrs(coll, obj)
                     for k in sorted(attrs):
-                        ac = zlib.crc32(k.encode() + b"\0" + attrs[k],
-                                        ac)
+                        ac = crc32c(k.encode() + b"\0" + attrs[k],
+                                    ac)
                     entry["attrs_crc"] = ac
             except FileNotFoundError:
                 entry = {"error": "read_error"}

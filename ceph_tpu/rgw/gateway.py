@@ -80,7 +80,8 @@ class RGWService:
 
     def _check_bucket(self, bucket: str) -> None:
         try:
-            if bucket in self.ioctx.omap_get(BUCKETS_DIR):
+            if self.ioctx.omap_get_by_key(BUCKETS_DIR,
+                                          bucket) is not None:
                 return
         except RadosError:
             pass
@@ -120,7 +121,11 @@ class RGWService:
 
     def head_object(self, bucket: str, key: str) -> dict:
         self._check_bucket(bucket)
-        entry = self.ioctx.omap_get(_index_oid(bucket)).get(key)
+        try:
+            entry = self.ioctx.omap_get_by_key(_index_oid(bucket),
+                                               key)
+        except RadosError:
+            entry = None
         if entry is None:
             raise RGWError(404, "NoSuchKey", key)
         return json.loads(entry.decode())
@@ -143,7 +148,7 @@ class RGWService:
     def delete_object(self, bucket: str, key: str) -> None:
         self._check_bucket(bucket)
         idx = _index_oid(bucket)
-        if key not in self.ioctx.omap_get(idx):
+        if self.ioctx.omap_get_by_key(idx, key) is None:
             raise RGWError(404, "NoSuchKey", key)
         try:
             self.striper.remove(_data_soid(bucket, key))

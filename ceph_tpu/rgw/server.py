@@ -97,13 +97,17 @@ class RGWServer:
 
             def do_PUT(self):          # noqa: N802
                 bucket, key, _ = self._split()
+                # always drain the body first: leaving it unread
+                # desyncs the keep-alive connection (the next request
+                # line would parse from leftover body bytes)
+                body = self._body()
                 try:
                     if not key:
                         svc.create_bucket(bucket)
                         self._send(200)
                     else:
                         etag = svc.put_object(
-                            bucket, key, self._body(),
+                            bucket, key, body,
                             content_type=self.headers.get(
                                 "Content-Type",
                                 "binary/octet-stream"))
@@ -181,9 +185,16 @@ class RGWServer:
                     except ValueError:
                         raise RGWError(416, "InvalidRange", hdr)
                 head, data = svc.get_object(bucket, key, rng)
+                headers = {"ETag": f'"{head["etag"]}"'}
+                if rng:
+                    # RFC 7233: 206 must carry Content-Range
+                    start = rng[0]
+                    headers["Content-Range"] = (
+                        f"bytes {start}-{start + len(data) - 1}"
+                        f"/{head['size']}")
                 self._send(206 if rng else 200, data,
                            ctype=head["content_type"],
-                           headers={"ETag": f'"{head["etag"]}"'})
+                           headers=headers)
 
             def log_message(self, *a):
                 pass

@@ -218,6 +218,27 @@ def test_shared_locker_cannot_convert_to_exclusive(io):
     assert ei.value.errno == 16
 
 
+def test_sequential_cls_calls_see_staged_state(io):
+    """Two lock calls in ONE client op: the second must observe the
+    first's staged xattr (reference executes ops sequentially against
+    the in-progress transaction)."""
+    from ceph_tpu.msg.messages import OSDOp
+    a = json.dumps({"name": "q", "type": "exclusive",
+                    "owner": "a", "cookie": "1"}).encode()
+    b = json.dumps({"name": "q", "type": "exclusive",
+                    "owner": "b", "cookie": "2"}).encode()
+    with pytest.raises(RadosError) as ei:
+        io._obj_op("seq1", [OSDOp("call", name="lock.lock", data=a),
+                            OSDOp("call", name="lock.lock", data=b)])
+    assert ei.value.errno == 16          # second call sees first lock
+
+
+def test_omap_get_by_key(io):
+    io.omap_set("kv", {"alpha": b"1", "beta": b"2"})
+    assert io.omap_get_by_key("kv", "alpha") == b"1"
+    assert io.omap_get_by_key("kv", "gamma") is None
+
+
 def test_rgw_http_frontend(cl):
     io = cl.rados().open_ioctx("clsp")
     srv = RGWServer(io).start()

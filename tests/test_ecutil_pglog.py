@@ -65,8 +65,10 @@ def test_hashinfo_append_and_roundtrip():
     hi.append(0, {0: b"aaaa", 1: b"bbbb", 2: b"cccc"})
     hi.append(4, {0: b"dddd", 1: b"eeee", 2: b"ffff"})
     assert hi.total_chunk_size == 8
-    import zlib
-    assert hi.crcs[0] == zlib.crc32(b"aaaadddd")
+    from ceph_tpu.utils.crc import crc32c
+    # CRC32C (Castagnoli) like the reference's hinfo, chained across
+    # appends
+    assert hi.crcs[0] == crc32c(b"aaaadddd")
     hi2 = ecutil.HashInfo.decode(hi.encode())
     assert hi2.crcs == hi.crcs
     assert hi2.total_chunk_size == 8
