@@ -605,6 +605,17 @@ class ECBackend(PGBackend):
         """Shard-side recovery write (reference handle_recovery_push)."""
         coll = self.host.coll_of(shard)
         obj = GHObject(push.oid, shard)
+        # late answers from abandoned recovery rounds must not roll a
+        # shard back (strictly-newer check: equal-version pushes are
+        # scrub repairs and must apply)
+        try:
+            info = ObjectInfo.decode(
+                self.host.store.getattr(coll, obj, OI_ATTR))
+            if tuple(info.version) > tuple(push.version):
+                on_commit()
+                return
+        except (FileNotFoundError, KeyError):
+            pass
         txn = Transaction()
         # remove-then-recreate: a stale local copy must not leak attrs
         # the authoritative copy no longer has

@@ -42,10 +42,10 @@ from ..mon.client import MonClient
 from ..msg.messages import (MCommand, MCommandReply, MOSDECSubOpRead,
                             MOSDECSubOpReadReply, MOSDECSubOpWrite,
                             MOSDECSubOpWriteReply, MOSDMap, MOSDOp,
-                            MOSDPGLog, MOSDPGNotify, MOSDPGPush,
-                            MOSDPGPushReply, MOSDPGQuery, MOSDPing,
-                            MOSDRepOp, MOSDRepOpReply, MOSDScrub,
-                            MRepScrub, MRepScrubMap)
+                            MOSDPGLog, MOSDPGNotify, MOSDPGPull,
+                            MOSDPGPush, MOSDPGPushReply, MOSDPGQuery,
+                            MOSDPing, MOSDRepOp, MOSDRepOpReply,
+                            MOSDScrub, MRepScrub, MRepScrubMap)
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..store.objectstore import ObjectStore
 from ..utils.config import Config, default_config
@@ -55,7 +55,8 @@ from .pg import PG, STATE_ACTIVE, STATE_PEERING
 
 _BACKEND_MSGS = (MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                  MOSDECSubOpRead, MOSDECSubOpReadReply,
-                 MOSDRepOp, MOSDRepOpReply, MOSDPGPush, MOSDPGPushReply)
+                 MOSDRepOp, MOSDRepOpReply, MOSDPGPush,
+                 MOSDPGPushReply, MOSDPGPull)
 _PEERING_MSGS = (MOSDPGQuery, MOSDPGNotify, MOSDPGLog)
 
 

@@ -45,6 +45,7 @@ class ReplicatedBackend(PGBackend):
         super().__init__(host)
         self.in_flight: Dict[int, _RepOp] = {}
         self.recovery_ops: Dict[str, _RecOp] = {}
+        self._pull_attempts: Dict[str, int] = {}  # holder rotation
 
     # ------------------------------------------------------------------
     # write path
@@ -156,32 +157,48 @@ class ReplicatedBackend(PGBackend):
         rec = _RecOp(oid, cb)
         rec.version = version
         obj = GHObject(oid, -1)
-        try:
-            data = self.host.store.read(self.host.coll, obj)
-            attrs = self.host.store.getattrs(self.host.coll, obj)
-            omap = self.host.store.omap_get(self.host.coll, obj)
-        except FileNotFoundError:
-            # the primary itself lacks the object: pull it from a
-            # surviving holder first (reference prep_object_replica_
-            # pushes -> recover_primary pull path, MOSDPGPull)
+        # a primary that ITSELF needs the object must not source from
+        # its own store — any local copy is a stale prior version and
+        # self-"recovery" from it would silently resurrect old bytes
+        self_missing = any(o == self.host.whoami
+                           for _, o in missing_on)
+        have_local = False
+        if not self_missing:
+            try:
+                data = self.host.store.read(self.host.coll, obj)
+                attrs = self.host.store.getattrs(self.host.coll, obj)
+                omap = self.host.store.omap_get(self.host.coll, obj)
+                have_local = True
+            except FileNotFoundError:
+                pass
+        if not have_local:
+            # pull from a surviving holder (reference
+            # prep_object_replica_pushes -> recover_primary pull path,
+            # MOSDPGPull).  Rotate holders across retries: a holder
+            # that silently lacks the data (lost disk) never answers,
+            # and re-asking it forever wedges recovery.
             missing_osds = {o for _, o in missing_on}
             holders = [(s, o) for s, o in self.host.acting_shards()
                        if o is not None and o != self.host.whoami
                        and o not in missing_osds]
             if not holders:
+                self._pull_attempts.pop(oid, None)
                 cb(-5)                   # nobody has it
                 return
+            attempt = self._pull_attempts.get(oid, 0)
+            self._pull_attempts[oid] = attempt + 1
             self.recovery_ops[oid] = rec
             rec.push_after_pull = [
                 (s, o) for s, o in missing_on
                 if o is not None and o != self.host.whoami]
-            shard, osd = holders[0]
+            shard, osd = holders[attempt % len(holders)]
             self.host.send_shard(osd, MOSDPGPull(
                 pgid=self.host.pgid_str, shard=shard,
                 from_osd=self.host.whoami, epoch=self.host.epoch,
                 oids=[oid]))
             return
         self.recovery_ops[oid] = rec
+        self._pull_attempts.pop(oid, None)   # completed via local copy
         self._push_to(rec, data, attrs, omap,
                       [(s, o) for s, o in missing_on
                        if o is not None and o != self.host.whoami])
@@ -208,6 +225,7 @@ class ReplicatedBackend(PGBackend):
         rec = self.recovery_ops.get(push.oid)
         if rec is None:
             return
+        self._pull_attempts.pop(push.oid, None)
         self._push_to(rec, push.data, dict(push.attrs),
                       dict(push.omap), rec.push_after_pull)
 
@@ -215,6 +233,16 @@ class ReplicatedBackend(PGBackend):
                     on_commit: Callable[[], None]) -> None:
         coll = self.host.coll
         obj = GHObject(push.oid, -1)
+        # a LATE answer to an abandoned/rotated pull can arrive after
+        # the object already advanced: never let an older version
+        # overwrite newer bytes (strictly-newer check only — an
+        # equal-version push is a scrub repair of corrupt data and
+        # must apply)
+        info = self.get_object_info(push.oid)
+        if info is not None and \
+                tuple(info.version) > tuple(push.version):
+            on_commit()
+            return
         txn = Transaction()
         # remove-then-recreate so stale attrs/omap don't survive
         txn.remove(coll, obj)
@@ -342,3 +370,4 @@ class ReplicatedBackend(PGBackend):
     def on_change(self) -> None:
         self.in_flight.clear()
         self.recovery_ops.clear()
+        self._pull_attempts.clear()

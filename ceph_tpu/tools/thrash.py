@@ -1,0 +1,285 @@
+"""Thrash harness: random faults under a model-checked workload.
+
+Python-native equivalent of the reference's chaos engine (reference
+``qa/tasks/thrashosds.py`` + ``ceph_manager.py`` kill_osd:2748 /
+revive_osd:2790, driving the model-checking random-op client
+``ceph_test_rados`` — ``src/test/osd/RadosModel.h`` / ``TestRados.cc``,
+SURVEY §4 tier 2: "the workhorse of thrash testing").
+
+Two pieces:
+
+* **RadosModel**: issues random ops (write/append/truncate/delete/
+  xattr) against a pool while tracking the EXPECTED state of every
+  object; ``verify_all`` reads everything back and compares
+  byte-for-byte.  Any acknowledged-write loss, stale read, or
+  resurrection after delete is caught.
+* **Thrasher**: a background loop randomly killing/reviving OSDs and
+  marking them out/in mid-workload, always leaving ``min_alive``
+  OSDs up; ``settle`` revives everyone and waits for clean.
+
+CLI::
+
+    python -m ceph_tpu.tools.thrash --osds 4 --seconds 20 \\
+        --pool-type erasure --seed 7
+"""
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..client.rados import RadosError
+
+
+class RadosModel:
+    """Random ops + expected-state tracking (reference RadosModel.h)."""
+
+    OPS = ("write", "append", "writefull", "truncate", "delete",
+           "setxattr", "read")
+    # EC pools without ec_overwrites reject overwrites/truncate
+    # (EOPNOTSUPP, like the reference) — restrict to the append-only
+    # vocabulary there (reference thrash-erasure-code workloads
+    # likewise use append-style ops)
+    EC_OPS = ("append", "writefull", "delete", "setxattr", "read")
+
+    def __init__(self, ioctx, n_objects: int = 20,
+                 seed: int = 0, max_size: int = 1 << 16,
+                 ec_mode: bool = False):
+        self.ioctx = ioctx
+        if ec_mode:
+            self.OPS = self.EC_OPS
+        self.rng = random.Random(seed)
+        self.names = [f"model_{i}" for i in range(n_objects)]
+        self.expect: Dict[str, bytearray] = {}
+        self.expect_attrs: Dict[str, Dict[str, bytes]] = {}
+        self.max_size = max_size
+        self.ops_done = 0
+        self.errors: List[str] = []
+
+    def _blob(self, n: int) -> bytes:
+        return self.rng.randbytes(n)
+
+    def step(self) -> None:
+        """One random op, model updated only on acknowledged success
+        (an op that raises must not change expectations — the client
+        resend machinery makes acks exactly-once)."""
+        oid = self.rng.choice(self.names)
+        op = self.rng.choice(self.OPS)
+        cur = self.expect.get(oid)
+        self.ops_done += 1           # attempts (no-op picks count too)
+        try:
+            if op == "write":
+                off = self.rng.randrange(0, self.max_size // 2)
+                data = self._blob(self.rng.randrange(1, 4096))
+                self.ioctx.write(oid, data, off)
+                base = cur if cur is not None else bytearray()
+                if off + len(data) > len(base):
+                    base.extend(b"\0" * (off + len(data) - len(base)))
+                base[off:off + len(data)] = data
+                self.expect[oid] = base
+            elif op == "append":
+                data = self._blob(self.rng.randrange(1, 4096))
+                self.ioctx.append(oid, data)
+                base = cur if cur is not None else bytearray()
+                base.extend(data)
+                self.expect[oid] = base
+            elif op == "writefull":
+                data = self._blob(self.rng.randrange(1, 8192))
+                self.ioctx.write_full(oid, data)
+                self.expect[oid] = bytearray(data)
+            elif op == "truncate":
+                if cur is None:
+                    return
+                size = self.rng.randrange(0, len(cur) + 1)
+                self.ioctx.truncate(oid, size)
+                base = cur[:size]
+                self.expect[oid] = base
+            elif op == "delete":
+                if cur is None:
+                    return
+                self.ioctx.remove(oid)
+                self.expect.pop(oid, None)
+                self.expect_attrs.pop(oid, None)
+            elif op == "setxattr":
+                if cur is None:
+                    return
+                name = f"user.k{self.rng.randrange(4)}"
+                val = self._blob(16)
+                self.ioctx.setxattr(oid, name, val)
+                self.expect_attrs.setdefault(oid, {})[name] = val
+            elif op == "read":
+                got = None
+                try:
+                    got = self.ioctx.read(oid)
+                except RadosError as e:
+                    if e.errno != 2:
+                        raise
+                want = bytes(cur) if cur is not None else None
+                if cur is None and got not in (None, b""):
+                    self.errors.append(
+                        f"{oid}: read returned data after delete")
+                elif cur is not None and got != want:
+                    self.errors.append(
+                        f"{oid}: stale read ({len(got or b'')}B != "
+                        f"{len(want)}B expected)")
+        except RadosError:
+            # op failed (cluster churn): the model keeps the PRIOR
+            # expectation; correctness requires failed ops to not
+            # partially apply... writes are atomic per-op here, and a
+            # lost-ack op that DID apply shows up in verify_all as a
+            # mismatch — which is exactly what this harness hunts.
+            raise
+
+    def run(self, n_ops: int) -> None:
+        for _ in range(n_ops):
+            self.step()
+
+    def verify_all(self) -> List[str]:
+        """Read every object back; -> list of mismatch descriptions
+        (reference RadosModel verification at op completion)."""
+        problems = list(self.errors)
+        for oid in self.names:
+            want = self.expect.get(oid)
+            try:
+                got = self.ioctx.read(oid)
+            except RadosError as e:
+                got = None if e.errno == 2 else b"<error>"
+            if want is None:
+                if got not in (None, b""):
+                    problems.append(f"{oid}: exists after delete")
+            elif got != bytes(want):
+                problems.append(
+                    f"{oid}: content mismatch "
+                    f"({len(got) if got else 0} != {len(want)})")
+            for name, val in self.expect_attrs.get(oid, {}).items():
+                if want is None:
+                    continue
+                try:
+                    if self.ioctx.getxattr(oid, name) != val:
+                        problems.append(f"{oid}: xattr {name} differs")
+                except RadosError:
+                    problems.append(f"{oid}: xattr {name} missing")
+        return problems
+
+
+class Thrasher:
+    """Random OSD kill/revive/out/in loop (reference thrashosds.py)."""
+
+    def __init__(self, cluster, seed: int = 0, min_alive: int = 2,
+                 interval: float = 4.5, lose_data_prob: float = 0.3):
+        self.cluster = cluster
+        self.rng = random.Random(seed)
+        self.min_alive = min_alive
+        self.interval = interval
+        self.lose_data_prob = lose_data_prob
+        self.down: List[int] = []
+        self.actions: List[str] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def _alive(self) -> List[int]:
+        return [i for i, osd in self.cluster.osds.items()
+                if osd is not None]
+
+    def _act(self) -> None:
+        alive = self._alive()
+        # revive when at the floor or by coin flip
+        if self.down and (len(alive) <= self.min_alive
+                          or self.rng.random() < 0.5):
+            osd = self.down.pop(self.rng.randrange(len(self.down)))
+            self.cluster.revive_osd(osd)
+            self.actions.append(f"revive osd.{osd}")
+            return
+        if len(alive) > self.min_alive:
+            osd = self.rng.choice(alive)
+            lose = self.rng.random() < self.lose_data_prob
+            self.cluster.kill_osd(osd, lose_data=lose)
+            self.down.append(osd)
+            self.actions.append(
+                f"kill osd.{osd}{' (lose data)' if lose else ''}")
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            try:
+                self._act()
+            except Exception as e:       # noqa: BLE001
+                self.actions.append(f"error: {e!r}")
+
+    def start(self) -> "Thrasher":
+        self._thread = threading.Thread(target=self._loop,
+                                        name="thrasher", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop_and_settle(self, timeout: float = 120.0) -> float:
+        """Stop thrashing, revive everyone, wait for clean; -> seconds
+        to clean (the rebuild-time metric)."""
+        self._stop.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+        for osd in list(self.down):
+            self.cluster.revive_osd(osd)
+            self.actions.append(f"final revive osd.{osd}")
+        self.down.clear()
+        return self.cluster.wait_for_clean(timeout)
+
+
+def run_thrash(n_osds: int, seconds: float, pool_type: str,
+               seed: int, out=sys.stdout) -> int:
+    from ..cluster import Cluster
+    with Cluster(n_osds=n_osds) as cluster:
+        for i in range(n_osds):
+            cluster.wait_for_osd_up(i, 30)
+        if pool_type == "erasure":
+            cluster.create_ec_profile("thrash", plugin="jerasure",
+                                      k="2", m="1")
+            cluster.create_pool("tp", "erasure",
+                                erasure_code_profile="thrash")
+        else:
+            cluster.create_pool("tp", "replicated",
+                                size=min(3, n_osds))
+        # ops on degraded objects legitimately wait for recovery that
+        # relentless churn keeps restarting — the reference's thrash
+        # runs don't bound op latency at all; integrity (verify_all)
+        # is the assertion, so give ops a long leash
+        client = cluster.rados(timeout=30)
+        client.op_timeout = 120.0
+        io = client.open_ioctx("tp")
+        model = RadosModel(io, seed=seed,
+                           ec_mode=pool_type == "erasure")
+        thrasher = Thrasher(cluster, seed=seed,
+                            min_alive=max(2, n_osds - 1
+                                          if pool_type == "erasure"
+                                          else 2)).start()
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline:
+            model.step()
+        took = thrasher.stop_and_settle()
+        problems = model.verify_all()
+        print(f"ops={model.ops_done} actions={len(thrasher.actions)} "
+              f"clean_in={took:.1f}s problems={len(problems)}",
+              file=out)
+        for a in thrasher.actions:
+            print(f"  {a}", file=out)
+        for p in problems:
+            print(f"  PROBLEM: {p}", file=out)
+        return 1 if problems else 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="thrash",
+                                description=__doc__.splitlines()[0])
+    p.add_argument("--osds", type=int, default=4)
+    p.add_argument("--seconds", type=float, default=20.0)
+    p.add_argument("--pool-type", choices=("replicated", "erasure"),
+                   default="replicated")
+    p.add_argument("--seed", type=int, default=0)
+    ns = p.parse_args(argv)
+    return run_thrash(ns.osds, ns.seconds, ns.pool_type, ns.seed)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -35,7 +35,7 @@ def test_quorum_forms_and_maps_replicate(cl):
     cl.create_pool("mm1", "replicated", size=2)
     # commits reach every mon (paxos to the quorum, lease catch-up for
     # any straggler outside it)
-    deadline = time.monotonic() + 15
+    deadline = time.monotonic() + 30
     while time.monotonic() < deadline:
         epochs = {r: m.osdmap.epoch for r, m in cl.mons.items()}
         if len(set(epochs.values())) == 1:

@@ -1,0 +1,69 @@
+"""Thrash + model-checking tests.
+
+Reference analog: qa/tasks/thrashosds.py matrices over
+ceph_test_rados (RadosModel) — random faults under a random workload
+with byte-exact verification afterwards (SURVEY §4 tiers 2-3)."""
+import time
+
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.tools.thrash import RadosModel, Thrasher
+
+
+def test_model_clean_cluster_no_false_positives():
+    """On an unthrashed cluster the model must verify clean — any
+    problem here is a model bug, not a cluster bug."""
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        c.create_pool("m0", "replicated", size=2)
+        io = c.rados().open_ioctx("m0")
+        model = RadosModel(io, seed=11)
+        model.run(300)
+        assert model.ops_done == 300
+        assert model.verify_all() == []
+
+
+@pytest.mark.parametrize("pool_type,seed", [("replicated", 1),
+                                            ("erasure", 2)])
+def test_thrash_workload_integrity(pool_type, seed):
+    """Random kill/revive (incl. disk loss) during random IO: after
+    settling, every object must match the model byte-for-byte and the
+    cluster must reach active+clean."""
+    n = 4
+    with Cluster(n_osds=n) as c:
+        for i in range(n):
+            c.wait_for_osd_up(i, 30)
+        if pool_type == "erasure":
+            c.create_ec_profile("thp", plugin="jerasure",
+                                k="2", m="1")
+            c.create_pool("th", "erasure",
+                          erasure_code_profile="thp")
+            min_alive = 3
+        else:
+            c.create_pool("th", "replicated", size=3)
+            min_alive = 2
+        client = c.rados(timeout=30)
+        # ops block on degraded objects while churn restarts recovery;
+        # integrity, not latency, is what this test asserts
+        client.op_timeout = 120.0
+        io = client.open_ioctx("th")
+        model = RadosModel(io, seed=seed,
+                           ec_mode=pool_type == "erasure")
+        model.run(50)                  # seed data before the storm
+        # pace the storm at ~1.5x the heartbeat grace (3s in test
+        # config): churn faster than failure detection can converge
+        # livelocks recovery — the reference thrasher's sleeps are
+        # likewise a small multiple of its grace period
+        thrasher = Thrasher(c, seed=seed, min_alive=min_alive,
+                            interval=4.5).start()
+        deadline = time.monotonic() + 14.0
+        while time.monotonic() < deadline:
+            model.step()
+        took = thrasher.stop_and_settle(timeout=120)
+        assert took < 120
+        assert len(thrasher.actions) >= 2, thrasher.actions
+        problems = model.verify_all()
+        assert problems == [], (problems, thrasher.actions)
+        assert model.ops_done > 60
