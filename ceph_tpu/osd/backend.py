@@ -221,11 +221,16 @@ class PGBackend(abc.ABC):
         raise NotImplementedError
 
     # -- local object metadata helpers ------------------------------------
-    def get_object_info(self, oid: str) -> Optional[ObjectInfo]:
-        obj = GHObject(oid, self.host.own_shard)
+    def get_object_info(self, oid: str,
+                        shard: Optional[int] = None
+                        ) -> Optional[ObjectInfo]:
+        """OI xattr of the local copy; ``shard`` overrides own_shard
+        (EC shard-side paths touching another shard's collection)."""
+        s = self.host.own_shard if shard is None else shard
+        obj = GHObject(oid, s)
         try:
-            return ObjectInfo.decode(
-                self.host.store.getattr(self.host.coll, obj, OI_ATTR))
+            return ObjectInfo.decode(self.host.store.getattr(
+                self.host.coll_of(s), obj, OI_ATTR))
         except (FileNotFoundError, KeyError):
             return None
 

@@ -608,14 +608,11 @@ class ECBackend(PGBackend):
         # late answers from abandoned recovery rounds must not roll a
         # shard back (strictly-newer check: equal-version pushes are
         # scrub repairs and must apply)
-        try:
-            info = ObjectInfo.decode(
-                self.host.store.getattr(coll, obj, OI_ATTR))
-            if tuple(info.version) > tuple(push.version):
-                on_commit()
-                return
-        except (FileNotFoundError, KeyError):
-            pass
+        info = self.get_object_info(push.oid, shard=shard)
+        if info is not None and \
+                tuple(info.version) > tuple(push.version):
+            on_commit()
+            return
         txn = Transaction()
         # remove-then-recreate: a stale local copy must not leak attrs
         # the authoritative copy no longer has

@@ -126,11 +126,13 @@ class RadosModel:
                         f"{oid}: stale read ({len(got or b'')}B != "
                         f"{len(want)}B expected)")
         except RadosError:
-            # op failed (cluster churn): the model keeps the PRIOR
-            # expectation; correctness requires failed ops to not
-            # partially apply... writes are atomic per-op here, and a
-            # lost-ack op that DID apply shows up in verify_all as a
-            # mismatch — which is exactly what this harness hunts.
+            # deliberate FAIL-FAST: the framework's resend machinery
+            # is supposed to absorb churn, so an op error (or timeout)
+            # surfacing here IS a finding, exactly like
+            # ceph_test_rados treating op failure as fatal.  (The
+            # model keeps the prior expectation; whether the failed op
+            # partially applied would surface in verify_all if a
+            # caller chose to continue.)
             raise
 
     def run(self, n_ops: int) -> None:
@@ -193,6 +195,17 @@ class Thrasher:
             self.cluster.revive_osd(osd)
             self.actions.append(f"revive osd.{osd}")
             return
+        # occasionally exercise the mark-out/in remap path (the
+        # reference thrasher's out/in actions): CRUSH reweights and
+        # data moves without any daemon dying
+        if self.rng.random() < 0.25 and len(alive) > self.min_alive:
+            osd = self.rng.choice(alive)
+            verb = self.rng.choice(("out", "in"))
+            ret, _, _ = self.cluster.mon_command(
+                {"prefix": f"osd {verb}", "ids": [osd]})
+            if ret == 0:
+                self.actions.append(f"mark osd.{osd} {verb}")
+            return
         if len(alive) > self.min_alive:
             osd = self.rng.choice(alive)
             lose = self.rng.random() < self.lose_data_prob
@@ -224,6 +237,10 @@ class Thrasher:
             self.cluster.revive_osd(osd)
             self.actions.append(f"final revive osd.{osd}")
         self.down.clear()
+        # undo any mark-outs so the final state is whole
+        self.cluster.mon_command(
+            {"prefix": "osd in",
+             "ids": sorted(self.cluster.osds)})
         return self.cluster.wait_for_clean(timeout)
 
 

@@ -1,0 +1,154 @@
+"""File-layer tests.
+
+Reference analog: libcephfs client behaviors (src/test/libcephfs/):
+hierarchy ops, cross-stripe IO, renames, EC data pools, CLI."""
+import os
+
+import pytest
+
+from ceph_tpu.client.striper import Layout
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.fs import FileSystem, FSError
+from ceph_tpu.tools import cephfs_cli
+
+
+@pytest.fixture(scope="module")
+def cl():
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("fsmeta", "replicated", size=2)
+        c.create_ec_profile("fsp", plugin="jerasure", k="2", m="1")
+        c.create_pool("fsdata", "erasure", erasure_code_profile="fsp")
+        yield c
+
+
+@pytest.fixture(scope="module")
+def fs(cl):
+    r = cl.rados()
+    return FileSystem(r.open_ioctx("fsmeta"),
+                      layout=Layout(stripe_unit=8 << 10,
+                                    stripe_count=2,
+                                    object_size=32 << 10))
+
+
+def test_hierarchy_and_io(fs):
+    fs.mkdir("/proj")
+    fs.mkdir("/proj/src")
+    data = os.urandom(150_000)           # spans many striped objects
+    fs.write_file("/proj/src/main.bin", data)
+    assert fs.read_file("/proj/src/main.bin") == data
+    assert fs.read_file("/proj/src/main.bin", 1000, 140_000) == \
+        data[140_000:141_000]
+    names = [e["name"] for e in fs.listdir("/proj")]
+    assert names == ["src"]
+    st = fs.stat("/proj/src/main.bin")
+    assert st["size"] == 150_000 and st["type"] == "file"
+    assert fs.stat("/proj")["type"] == "dir"
+
+
+def test_offset_write_and_truncate(fs):
+    fs.write_file("/f1", b"hello world")
+    fs.write_file("/f1", b"WORLD", 6)
+    assert fs.read_file("/f1") == b"hello WORLD"
+    fs.truncate("/f1", 5)
+    assert fs.read_file("/f1") == b"hello"
+    assert fs.stat("/f1")["size"] == 5
+
+
+def test_errors(fs):
+    with pytest.raises(FSError):
+        fs.read_file("/nope")
+    with pytest.raises(FSError):
+        fs.mkdir("/proj")                # exists
+    with pytest.raises(FSError):
+        fs.listdir("/f1")                # not a dir
+    with pytest.raises(FSError):
+        fs.unlink("/proj")               # is a dir
+    with pytest.raises(FSError):
+        fs.rmdir("/proj")                # not empty
+    with pytest.raises(FSError):
+        fs.read_file("/a/../b")          # dotdot rejected
+
+
+def test_rename_and_unlink(fs):
+    fs.mkdir("/mv")
+    fs.write_file("/mv/a.txt", b"content-a")
+    fs.rename("/mv/a.txt", "/mv/b.txt")
+    assert not fs.exists("/mv/a.txt")
+    assert fs.read_file("/mv/b.txt") == b"content-a"
+    # overwrite-rename unlinks the target
+    fs.write_file("/mv/c.txt", b"content-c")
+    fs.rename("/mv/c.txt", "/mv/b.txt")
+    assert fs.read_file("/mv/b.txt") == b"content-c"
+    fs.unlink("/mv/b.txt")
+    fs.rmdir("/mv")
+    assert not fs.exists("/mv")
+
+
+def test_dir_rename(fs):
+    fs.mkdir("/d1")
+    fs.write_file("/d1/x", b"x")
+    fs.rename("/d1", "/d2")
+    assert fs.read_file("/d2/x") == b"x"
+    assert not fs.exists("/d1")
+
+
+def test_walk(fs):
+    fs.mkdir("/w")
+    fs.mkdir("/w/sub")
+    fs.write_file("/w/f1", b"1")
+    fs.write_file("/w/sub/f2", b"2")
+    seen = {p: (d, f) for p, d, f in fs.walk("/w")}
+    assert seen["/w"] == (["sub"], ["f1"])
+    assert seen["/w/sub"] == ([], ["f2"])
+
+
+def test_ec_data_pool(cl):
+    """Metadata on replicated, data on EC — the reference's layout."""
+    r = cl.rados()
+    fs2 = FileSystem(r.open_ioctx("fsmeta"),
+                     data=r.open_ioctx("fsdata"))
+    payload = os.urandom(100_000)
+    fs2.write_file("/ecfile", payload)
+    assert fs2.read_file("/ecfile") == payload
+    # data objects live in the EC pool, not the metadata pool
+    data_objs = [o for o in r.open_ioctx("fsdata").list_objects()
+                 if o.startswith("data.")]
+    assert data_objs
+
+
+def test_persistence_across_mounts(cl):
+    """A second 'mount' (fresh FileSystem over fresh client) sees
+    everything (no MDS session state to lose)."""
+    r = cl.rados()
+    fs2 = FileSystem(r.open_ioctx("fsmeta"))
+    assert fs2.exists("/proj/src/main.bin")
+    assert fs2.stat("/proj/src/main.bin")["size"] == 150_000
+
+
+def test_cephfs_cli(cl, tmp_path, capsys):
+    host, port = cl.mon_addr
+    m = f"{host}:{port}"
+    base = ["-m", m, "--meta-pool", "fsmeta"]
+    assert cephfs_cli.main([*base, "mkdir", "/cli"]) == 0
+    src = tmp_path / "in.bin"
+    src.write_bytes(os.urandom(50_000))
+    assert cephfs_cli.main([*base, "put", str(src),
+                            "/cli/file.bin"]) == 0
+    dst = tmp_path / "out.bin"
+    assert cephfs_cli.main([*base, "get", "/cli/file.bin",
+                            str(dst)]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+    assert cephfs_cli.main([*base, "ls", "/cli"]) == 0
+    assert "file.bin" in capsys.readouterr().out
+    assert cephfs_cli.main([*base, "mv", "/cli/file.bin",
+                            "/cli/rn.bin"]) == 0
+    assert cephfs_cli.main([*base, "stat", "/cli/rn.bin"]) == 0
+    assert "size=50000" in capsys.readouterr().out
+    assert cephfs_cli.main([*base, "tree", "/"]) == 0
+    capsys.readouterr()
+    assert cephfs_cli.main([*base, "rm", "/cli/rn.bin"]) == 0
+    assert cephfs_cli.main([*base, "rmdir", "/cli"]) == 0
+    assert cephfs_cli.main([*base, "rm", "/cli/never"]) == 1
+    capsys.readouterr()

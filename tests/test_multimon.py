@@ -42,7 +42,12 @@ def test_quorum_forms_and_maps_replicate(cl):
             break
         time.sleep(0.2)
     else:
-        raise TimeoutError(f"mon epochs diverged: {epochs}")
+        detail = {r: {"epoch": m.osdmap.epoch,
+                      "leader": m.quorum.leader,
+                      "e_epoch": m.quorum.election_epoch,
+                      "is_leader": m.quorum.is_leader()}
+                  for r, m in cl.mons.items()}
+        raise TimeoutError(f"mon epochs diverged: {detail}")
     names = {r: list(m.osdmap.pools)
              for r, m in cl.mons.items()}
     assert all(v == list(names.values())[0] for v in names.values())
