@@ -79,6 +79,7 @@ class _InflightOp:
         self.pgid_seed = pgid_seed     # explicit PG target (pgls)
         self.target_osd: Optional[int] = None
         self.sent_epoch = 0
+        self.trace_id = 0
 
 
 class Objecter(Dispatcher):
@@ -120,13 +121,15 @@ class Objecter(Dispatcher):
     # op submission (reference op_submit :2263)
     # ------------------------------------------------------------------
     def submit(self, pool: int, oid: str, ops: List[OSDOp],
-               pgid_seed: Optional[int] = None) -> Completion:
+               pgid_seed: Optional[int] = None,
+               trace_id: int = 0) -> Completion:
         with self.lock:
             self._next_tid += 1
             tid = self._next_tid
             completion = Completion(self, tid)
             op = _InflightOp(tid, pool, oid, ops, completion,
                              pgid_seed=pgid_seed)
+            op.trace_id = trace_id
             self.inflight[tid] = op
         self._send_op(op)
         return completion
@@ -170,7 +173,7 @@ class Objecter(Dispatcher):
         conn.send_message(MOSDOp(
             client=self.msgr.name, tid=op.tid, epoch=osdmap.epoch,
             pool=op.pool, oid=op.oid, ops=op.ops,
-            pgid_seed=pgid.seed))
+            pgid_seed=pgid.seed, trace_id=op.trace_id))
 
     def _fail_op(self, op: _InflightOp, result: int) -> None:
         with self.lock:
@@ -229,8 +232,17 @@ class IoCtx:
     def _obj_op(self, oid: str, ops: List[OSDOp],
                 timeout: Optional[float] = None) -> MOSDOpReply:
         timeout = timeout or self.rados.op_timeout
-        c = self.rados.objecter.submit(self.pool_id, oid, ops)
-        res = c.wait(timeout)
+        span = self.rados.tracer.maybe_start("rados_op") \
+            if self.rados.tracer else None
+        c = self.rados.objecter.submit(
+            self.pool_id, oid, ops,
+            trace_id=span.trace_id if span else 0)
+        try:
+            res = c.wait(timeout)
+        finally:
+            if span is not None:
+                span.tag("oid", oid).tag(
+                    "op", "+".join(o.op for o in ops)).finish()
         if res < 0:
             raise RadosError(-res, f"{ops[0].op} {oid!r}: {res}")
         return c.reply
@@ -363,6 +375,12 @@ class Rados:
         n = secrets.randbits(48)
         self.conf = conf or default_config()
         self.op_timeout = op_timeout
+        self.tracer = None
+        if self.conf["rados_tracing"]:
+            from ..utils.tracer import Tracer
+            self.tracer = Tracer(
+                "client", enabled=True,
+                sample_every=self.conf["trace_sample_every"])
         self.msgr = Messenger(f"client.{n}", conf=self.conf)
         self.monc = MonClient(self.msgr, mon_addr,
                               map_cb=self._on_map)

@@ -172,8 +172,12 @@ class FileSystem:
         try:
             if self.meta.omap_get(_dir_oid(ent["ino"])):
                 raise FSError(39, f"directory not empty: {path!r}")
-        except RadosError:
-            pass
+        except RadosError as e:
+            if e.errno != 2:
+                # transient failure must NOT read as "empty" — that
+                # would rmdir a populated directory and orphan its
+                # subtree
+                raise
         self._unlink(parent, name)
         self._remove_oid(_dir_oid(ent["ino"]))
         self._remove_oid(_ino_oid(ent["ino"]))
@@ -257,10 +261,17 @@ class FileSystem:
     def rename(self, old: str, new: str) -> None:
         """reference Server::handle_client_rename, collapsed: relink
         the dentry; overwriting an existing file target unlinks it."""
+        oparts = self._parts(old)
+        nparts = self._parts(new)
         oparent, oname = self._resolve_parent(old)
         ent = self._lookup(oparent, oname)
         if ent is None:
             raise FSError(2, old)
+        if oparts == nparts:
+            return                       # POSIX: rename(p, p) no-op
+        if ent["type"] == DIR_TYPE and nparts[:len(oparts)] == oparts:
+            # moving a directory into its own subtree would orphan it
+            raise FSError(22, f"cannot move {old!r} into itself")
         nparent, nname = self._resolve_parent(new)
         target = self._lookup(nparent, nname)
         if target is not None:

@@ -71,6 +71,8 @@ class QuorumService:
         self._proposal: Optional[Proposal] = None
         # peon: pending begin awaiting commit
         self._pending: Optional[Tuple[int, dict]] = None
+        # candidate: accepted-but-uncommitted values carried in acks
+        self._ack_pendings: Dict[int, dict] = {}
         # set lock-free by handle() when evidence of a newer election
         # arrives: lets propose() (which blocks holding mon.lock, so
         # handlers couldn't depose us through the lock) bail out early
@@ -132,6 +134,7 @@ class QuorumService:
             self.leader = None
             self._deferred_to = None
             self._acks = {self.rank: self.mon.osdmap.epoch}
+            self._ack_pendings = {}
             self._election_started = time.monotonic()
             epoch = self.election_epoch
             lc = self.mon.osdmap.epoch
@@ -207,8 +210,18 @@ class QuorumService:
         if reply is not None:
             self._send(rank, reply)
         elif rank is not None:
-            self._send(rank, MMonMon(op="ack", from_rank=self.rank,
-                                     epoch=epoch, last_committed=lc))
+            # the ack carries any accepted-but-uncommitted value
+            # (reference Paxos collect/last phase): a leader that died
+            # between majority-accept and commit-broadcast had already
+            # acked the client — the new leader must complete the
+            # round, not lose it
+            with self.mon.lock:
+                pend = self._pending
+            self._send(rank, MMonMon(
+                op="ack", from_rank=self.rank, epoch=epoch,
+                last_committed=lc,
+                version=pend[0] if pend else 0,
+                value=pend[1] if pend else None))
         else:
             # they're worse but opened a round: contest it, ratcheting
             # at least past their epoch
@@ -219,6 +232,8 @@ class QuorumService:
             if msg.epoch != self.election_epoch or self.in_quorum():
                 return
             self._acks[msg.from_rank] = msg.last_committed
+            if msg.version and msg.value is not None:
+                self._ack_pendings[msg.version] = msg.value
             if len(self._acks) < self.majority:
                 return
             # victory: epoch goes even, quorum = the acked set
@@ -228,6 +243,17 @@ class QuorumService:
             epoch = self.election_epoch
             quorum = sorted(self.quorum)
             acks = dict(self._acks)
+            # complete uncommitted rounds (reference Paxos collect):
+            # our own pending plus any carried in acks, newest first
+            pendings = dict(self._ack_pendings)
+            if self._pending is not None:
+                pendings.setdefault(self._pending[0],
+                                    self._pending[1])
+            self._ack_pendings = {}
+        for version in sorted(pendings):
+            if version > self.mon.osdmap.epoch:
+                self.mon.apply_replicated(version, pendings[version])
+        with self.mon.lock:
             my_lc = self.mon.osdmap.epoch
         self.log.dout(1, f"won election e{epoch}, quorum {quorum}")
         self._broadcast(MMonMon(op="victory", from_rank=self.rank,

@@ -96,7 +96,8 @@ class MOSDOp(Message):
     def __init__(self, client: str = "", tid: int = 0, epoch: int = 0,
                  pool: int = 0, oid: str = "",
                  ops: Optional[List[OSDOp]] = None,
-                 pgid_seed: int = 0, flags: int = 0):
+                 pgid_seed: int = 0, flags: int = 0,
+                 trace_id: int = 0):
         super().__init__()
         self.client = client
         self.tid = tid
@@ -106,12 +107,13 @@ class MOSDOp(Message):
         self.ops = ops or []
         self.pgid_seed = pgid_seed
         self.flags = flags
+        self.trace_id = trace_id     # blkin-style trace context (0=off)
 
     def encode_payload(self) -> bytes:
         e = Encoder()
         e.str(self.client).u64(self.tid).u32(self.epoch)
         e.i64(self.pool).str(self.oid).u32(self.pgid_seed)
-        e.u32(self.flags)
+        e.u32(self.flags).u64(self.trace_id)
         e.u32(len(self.ops))
         for op in self.ops:
             op.encode(e)
@@ -121,7 +123,8 @@ class MOSDOp(Message):
     def decode_payload(cls, buf: bytes) -> "MOSDOp":
         d = Decoder(buf)
         m = cls(client=d.str(), tid=d.u64(), epoch=d.u32(), pool=d.i64(),
-                oid=d.str(), pgid_seed=d.u32(), flags=d.u32())
+                oid=d.str(), pgid_seed=d.u32(), flags=d.u32(),
+                trace_id=d.u64())
         m.ops = [OSDOp.decode(d) for _ in range(d.u32())]
         return m
 
@@ -171,7 +174,8 @@ class MOSDECSubOpWrite(Message):
     def __init__(self, pgid: str = "", shard: int = -1,
                  from_osd: int = -1, tid: int = 0, epoch: int = 0,
                  txn: bytes = b"", log_entries: Optional[list] = None,
-                 at_version: Tuple[int, int] = (0, 0)):
+                 at_version: Tuple[int, int] = (0, 0),
+                 trace_id: int = 0):
         super().__init__()
         self.pgid = pgid             # str(PGid), shard-free
         self.shard = shard           # destination shard position
@@ -181,6 +185,7 @@ class MOSDECSubOpWrite(Message):
         self.txn = txn               # encoded store Transaction
         self.log_entries = log_entries or []   # pg-log dicts
         self.at_version = at_version
+        self.trace_id = trace_id     # blkin-style trace context
 
     def encode_payload(self) -> bytes:
         e = Encoder()
@@ -188,6 +193,7 @@ class MOSDECSubOpWrite(Message):
         e.u64(self.tid).u32(self.epoch).bytes(self.txn)
         e.bytes(_enc_json(self.log_entries))
         e.u32(self.at_version[0]).u64(self.at_version[1])
+        e.u64(self.trace_id)
         return e.build()
 
     @classmethod
@@ -197,6 +203,7 @@ class MOSDECSubOpWrite(Message):
                 tid=d.u64(), epoch=d.u32(), txn=d.bytes())
         m.log_entries = _dec_json(d.bytes())
         m.at_version = (d.u32(), d.u64())
+        m.trace_id = d.u64()
         return m
 
 
@@ -331,7 +338,8 @@ class MOSDRepOp(Message):
     def __init__(self, pgid: str = "", from_osd: int = -1, tid: int = 0,
                  epoch: int = 0, txn: bytes = b"",
                  log_entries: Optional[list] = None,
-                 at_version: Tuple[int, int] = (0, 0)):
+                 at_version: Tuple[int, int] = (0, 0),
+                 trace_id: int = 0):
         super().__init__()
         self.pgid = pgid
         self.from_osd = from_osd
@@ -340,6 +348,7 @@ class MOSDRepOp(Message):
         self.txn = txn
         self.log_entries = log_entries or []
         self.at_version = at_version
+        self.trace_id = trace_id
 
     def encode_payload(self) -> bytes:
         e = Encoder()
@@ -347,6 +356,7 @@ class MOSDRepOp(Message):
         e.u32(self.epoch).bytes(self.txn)
         e.bytes(_enc_json(self.log_entries))
         e.u32(self.at_version[0]).u64(self.at_version[1])
+        e.u64(self.trace_id)
         return e.build()
 
     @classmethod
@@ -356,6 +366,7 @@ class MOSDRepOp(Message):
                 epoch=d.u32(), txn=d.bytes())
         m.log_entries = _dec_json(d.bytes())
         m.at_version = (d.u32(), d.u64())
+        m.trace_id = d.u64()
         return m
 
 

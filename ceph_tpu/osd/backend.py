@@ -51,6 +51,7 @@ class Mutation:
     omap_set: Dict[str, bytes] = field(default_factory=dict)
     omap_rm: List[str] = field(default_factory=list)
     omap_clear: bool = False
+    trace_id: int = 0               # blkin-style trace context (0=off)
 
     def is_data_op(self) -> bool:
         return bool(self.writes) or self.truncate is not None \
@@ -154,6 +155,13 @@ class PGHost(abc.ABC):
         this shard's persistent missing set (reference
         recover_got / pg_missing_t::got).  Default no-op for fake
         hosts."""
+
+    def trace_span(self, name: str, trace_id: int,
+                   parent_id: int = 0):
+        """Record a tracing span when the daemon traces (reference
+        ZTracer::Trace threaded through sub-ops); None when off.
+        Default no-op for fake hosts."""
+        return None
 
 
 class PGBackend(abc.ABC):

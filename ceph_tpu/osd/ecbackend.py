@@ -214,7 +214,8 @@ class ECBackend(PGBackend):
                 from_osd=self.host.whoami, tid=op.tid,
                 epoch=self.host.epoch, txn=txn.encode(),
                 log_entries=wire_entries,
-                at_version=op.at_version))
+                at_version=op.at_version,
+                trace_id=op.mutation.trace_id))
         if local_txn is not None:
             # the primary's own shard goes through the same sub-write
             # handler, local call (reference ECBackend.cc:2086-2092)
@@ -644,6 +645,12 @@ class ECBackend(PGBackend):
     # ------------------------------------------------------------------
     def handle_message(self, msg) -> bool:
         if isinstance(msg, MOSDECSubOpWrite):
+            span = self.host.trace_span("ec_sub_write", msg.trace_id)
+            if span is not None:
+                # child span per shard sub-write (reference
+                # ECBackend.cc:2063-2068 blkin spans)
+                span.tag("shard", msg.shard).tag(
+                    "pgid", msg.pgid).finish()
             txn = Transaction.decode(msg.txn)
             self._apply_sub_write(
                 msg.shard, txn, msg.log_entries,

@@ -83,6 +83,10 @@ class OSDService:
     def ec_registry(self):
         return self._osd.ec_registry
 
+    @property
+    def tracer(self):
+        return self._osd.tracer
+
     def get_osdmap(self) -> OSDMap:
         return self._osd.osdmap
 
@@ -150,6 +154,9 @@ class OSD(Dispatcher):
         self.perf.add("recovery_ops", description="objects recovered")
         self.op_tracker = OpTracker(
             slow_op_warn_threshold=self.conf["osd_op_complaint_time"])
+        from ..utils.tracer import Tracer
+        self.tracer = Tracer(f"osd.{whoami}",
+                             enabled=self.conf["osd_tracing"])
 
     # ------------------------------------------------------------------
     # lifecycle (reference OSD::init)
@@ -320,6 +327,11 @@ class OSD(Dispatcher):
                     tid=msg.tid, result=-108, epoch=self.osdmap.epoch))
                 continue
             is_write = any(PG._op_is_write(op) for op in msg.ops)
+            span = self.tracer.start("osd_op", msg.trace_id) \
+                if msg.trace_id else None
+            if span is not None:
+                span.tag("pg", str(pgid)).tag("oid", msg.oid) \
+                    .tag("write", is_write)
             tracked = self.op_tracker.create(
                 f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
                 f"{'+'.join(op.op for op in msg.ops)})")
@@ -342,6 +354,8 @@ class OSD(Dispatcher):
                 self.perf.tinc("op_w_latency" if is_write
                                else "op_r_latency", dt)
                 tracked.finish()
+                if span is not None:
+                    span.finish()
 
     # ------------------------------------------------------------------
     # daemon-direct commands (reference 'ceph tell osd.N', MCommand;
@@ -353,6 +367,8 @@ class OSD(Dispatcher):
         try:
             if prefix == "perf dump":
                 out = self.perf_coll.perf_dump()
+            elif prefix == "dump_traces":
+                out = {"spans": self.tracer.dump()}
             elif prefix == "dump_historic_ops":
                 out = {"ops": self.op_tracker.dump_historic_ops()}
             elif prefix == "dump_ops_in_flight":

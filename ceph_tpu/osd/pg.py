@@ -174,6 +174,13 @@ class PG:
             self.pool.erasure_code_profile)
         return dict(prof or {"plugin": "jerasure", "k": "2", "m": "1"})
 
+    def trace_span(self, name: str, trace_id: int,
+                   parent_id: int = 0):
+        tracer = getattr(self.service, "tracer", None)
+        if tracer is None:
+            return None
+        return tracer.start(name, trace_id, parent_id)
+
     def note_object_recovered(self, oid: str, version) -> None:
         """A recovery push committed on THIS shard: durable missing-set
         update (reference recover_got)."""
@@ -572,6 +579,7 @@ class PG:
             self._reply(conn, msg, 0, cached)
             return
         mut = Mutation()
+        mut.trace_id = msg.trace_id
         err = 0
         ec = self.pool.is_erasure()
         full_replace = any(op.op == "writefull" for op in msg.ops)

@@ -75,7 +75,8 @@ class ReplicatedBackend(PGBackend):
             self.host.send_shard(osd, MOSDRepOp(
                 pgid=self.host.pgid_str, from_osd=self.host.whoami,
                 tid=op.tid, epoch=self.host.epoch, txn=enc,
-                log_entries=wire_entries, at_version=at_version))
+                log_entries=wire_entries, at_version=at_version,
+                trace_id=mutation.trace_id))
         tid = op.tid
         self._apply_local(txn, wire_entries,
                           lambda: self._committed(tid, self.host.whoami))
@@ -275,6 +276,10 @@ class ReplicatedBackend(PGBackend):
     # ------------------------------------------------------------------
     def handle_message(self, msg) -> bool:
         if isinstance(msg, MOSDRepOp):
+            span = self.host.trace_span("rep_sub_write", msg.trace_id)
+            if span is not None:
+                span.tag("pgid", msg.pgid).tag("from",
+                                               msg.from_osd).finish()
             txn = Transaction.decode(msg.txn)
             self._apply_local(
                 txn, msg.log_entries,

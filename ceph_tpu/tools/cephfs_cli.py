@@ -58,7 +58,11 @@ def main(argv: List[str] = None) -> int:
                 fs.mkdir(ns.path)
             elif ns.op == "put":
                 with open(ns.infile, "rb") as f:
-                    fs.write_file(ns.path, f.read())
+                    data = f.read()
+                fs.write_file(ns.path, data)
+                # put is whole-file replacement; write_file alone is
+                # pwrite (a smaller upload would keep the old tail)
+                fs.truncate(ns.path, len(data))
             elif ns.op == "get":
                 with open(ns.outfile, "wb") as f:
                     f.write(fs.read_file(ns.path))

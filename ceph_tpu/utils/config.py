@@ -104,6 +104,14 @@ def _opts() -> List[Option]:
         Option("osd_op_complaint_time", float, 30.0, min=0.1,
                description="ops in flight longer than this surface as "
                            "slow ops (reference osd_op_complaint_time)"),
+        Option("osd_tracing", bool, False,
+               description="record blkin-style spans for traced ops "
+                           "(reference osd_blkin_trace_all)"),
+        Option("rados_tracing", bool, False,
+               description="client starts a trace per op "
+                           "(reference rbd_blkin_trace_all analog)"),
+        Option("trace_sample_every", int, 1, min=1,
+               description="trace every Nth client op"),
         Option("mgr_tick_interval", float, 1.0, min=0.05,
                description="mgr perf-collection cadence "
                            "(reference mgr_tick_period)"),

@@ -94,6 +94,36 @@ def test_dir_rename(fs):
     assert not fs.exists("/d1")
 
 
+def test_rename_edge_cases(fs):
+    """POSIX edges: self-rename is a no-op; moving a directory into
+    its own subtree is EINVAL (not silent orphaning)."""
+    fs.mkdir("/re")
+    fs.write_file("/re/f", b"keep me")
+    fs.rename("/re/f", "/re/f")
+    assert fs.read_file("/re/f") == b"keep me"
+    fs.mkdir("/re/sub")
+    with pytest.raises(FSError):
+        fs.rename("/re", "/re/sub/inside")
+    assert fs.exists("/re/sub")
+
+
+def test_cli_put_replaces_whole_file(cl, tmp_path):
+    """put then a smaller put must round-trip (no stale tail)."""
+    host, port = cl.mon_addr
+    base = ["-m", f"{host}:{port}", "--meta-pool", "fsmeta"]
+    big = tmp_path / "big.bin"
+    big.write_bytes(os.urandom(80_000))
+    small = tmp_path / "small.bin"
+    small.write_bytes(os.urandom(20_000))
+    out = tmp_path / "round.bin"
+    assert cephfs_cli.main([*base, "put", str(big), "/repl.bin"]) == 0
+    assert cephfs_cli.main([*base, "put", str(small),
+                            "/repl.bin"]) == 0
+    assert cephfs_cli.main([*base, "get", "/repl.bin",
+                            str(out)]) == 0
+    assert out.read_bytes() == small.read_bytes()
+
+
 def test_walk(fs):
     fs.mkdir("/w")
     fs.mkdir("/w/sub")

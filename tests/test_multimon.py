@@ -142,6 +142,35 @@ def test_auth_keyring_survives_leader_failover(cl):
     assert out["key"] == key
 
 
+def test_paxos_completes_uncommitted_round():
+    """A leader that dies between majority-ACCEPT and the COMMIT
+    broadcast has already acked the client: the next leader must
+    complete the round from the pendings carried in election acks
+    (classic Paxos collect), not lose the acknowledged value."""
+    with Cluster(n_osds=0, n_mons=3, conf=quorum_conf()) as c:
+        leader = c.wait_for_quorum()
+        lm = c.mons[leader]
+        orig = lm.quorum._broadcast
+
+        def drop_commits(msg, ranks=None):
+            if msg.op == "commit":
+                return                   # die before commit broadcast
+            return orig(msg, ranks)
+
+        lm.quorum._broadcast = drop_commits
+        ret, _, out = c.mon_command(
+            {"prefix": "auth get-or-create",
+             "entity": "client.lost", "caps": []})
+        assert ret == 0                  # client was acked
+        key = out["key"]
+        c.kill_mon(leader)
+        c.wait_for_quorum(30)
+        ret, _, out = c.mon_command(
+            {"prefix": "auth get", "entity": "client.lost"})
+        assert ret == 0, "acknowledged mutation lost across failover"
+        assert out["key"] == key
+
+
 def test_mon_restart_resumes_from_store(tmp_path):
     ddir = str(tmp_path / "mm")
     with Cluster(n_osds=1, n_mons=3, data_dir=ddir,

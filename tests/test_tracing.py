@@ -1,0 +1,109 @@
+"""Distributed tracing tests.
+
+Reference analog: blkin/ZTracer spans threaded through the EC write
+path (osd/ECBackend.cc:2063-2068) with child spans per shard
+sub-write; LTTng process-local tracepoints."""
+import time
+
+import pytest
+
+from ceph_tpu.cluster import Cluster, test_config
+from ceph_tpu.client.rados import Rados
+from ceph_tpu.utils.tracer import Tracer
+
+
+def test_tracer_spans_and_sampling():
+    t = Tracer("svc", enabled=True, sample_every=2)
+    spans = [t.maybe_start("op") for _ in range(8)]
+    started = [s for s in spans if s is not None]
+    assert len(started) == 4             # every 2nd sampled
+    for s in started:
+        s.tag("k", "v").finish()
+    dump = t.dump()
+    assert len(dump) == 4
+    assert dump[0]["tags"] == {"k": "v"}
+    assert dump[0]["service"] == "svc"
+    # child continuation inherits the trace id
+    child = t.start("sub", started[0].trace_id,
+                    started[0].span_id)
+    child.finish()
+    same = t.dump(trace_id=started[0].trace_id)
+    assert {d["name"] for d in same} == {"op", "sub"}
+    # disabled tracer costs one branch — including for propagated
+    # contexts (an operator who turned tracing off records nothing)
+    off = Tracer("svc2", enabled=False)
+    assert off.maybe_start("x") is None
+    assert off.start("x", 0) is None
+    assert off.start("x", 12345) is None
+
+
+def test_spans_cross_daemons_ec_write():
+    """One traced client write to an EC pool must produce spans with
+    the SAME trace id on the client, the primary, and shard OSDs."""
+    conf = test_config(osd_tracing=True, rados_tracing=True)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("trp", plugin="jerasure", k="2", m="1")
+        c.create_pool("trpool", "erasure",
+                      erasure_code_profile="trp")
+        client = Rados(c.mon_addr, conf=conf).connect()
+        try:
+            io = client.open_ioctx("trpool")
+            io.write_full("traced", b"x" * 8192)
+            assert io.read("traced") == b"x" * 8192
+            # the client recorded root spans
+            client_spans = client.tracer.dump()
+            assert client_spans
+            tid = client_spans[0]["trace_id"]
+            # the same trace id shows up inside the daemons
+            deadline = time.monotonic() + 10
+            osd_spans = []
+            while time.monotonic() < deadline:
+                osd_spans = [s for osd in c.osds.values()
+                             if osd is not None
+                             for s in osd.tracer.dump()]
+                if any(s["trace_id"] == tid for s in osd_spans):
+                    break
+                time.sleep(0.2)
+            names = {s["name"] for s in osd_spans
+                     if s["trace_id"] == tid}
+            assert "osd_op" in names, osd_spans
+            # the EC write fanned out: shard sub-write spans exist
+            all_names = {s["name"] for s in osd_spans}
+            assert "ec_sub_write" in all_names, all_names
+            # sub-write spans share trace ids with osd_op spans
+            sub_tids = {s["trace_id"] for s in osd_spans
+                        if s["name"] == "ec_sub_write"}
+            op_tids = {s["trace_id"] for s in osd_spans
+                       if s["name"] == "osd_op"}
+            assert sub_tids & op_tids
+        finally:
+            client.shutdown()
+
+
+def test_dump_traces_tell_command():
+    conf = test_config(osd_tracing=True, rados_tracing=True)
+    with Cluster(n_osds=2, conf=conf) as c:
+        for i in range(2):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("trp2", "replicated", size=2)
+        client = Rados(c.mon_addr, conf=conf).connect()
+        try:
+            io = client.open_ioctx("trp2")
+            io.write_full("t1", b"data")
+            from ceph_tpu.tools import ceph_cli
+            host, port = c.mon_addr
+            import json
+            import io as _io
+            import contextlib
+            buf = _io.StringIO()
+            with contextlib.redirect_stdout(buf):
+                ret = ceph_cli.main(["-m", f"{host}:{port}",
+                                     "--format", "json", "tell",
+                                     "osd.0", "dump_traces"])
+            assert ret == 0
+            spans = json.loads(buf.getvalue())["spans"]
+            assert isinstance(spans, list)
+        finally:
+            client.shutdown()
