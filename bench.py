@@ -98,19 +98,40 @@ def main():
 
     if args.workload == "encode":
         # codec-kernel boundary: HBM-resident, like the reference's
-        # in-RAM encode loop.  Dispatches are streamed (sync once per
-        # window, not per call) — dispatch round-trip latency to the
-        # device is pipeline-hidden in the OSD batching layer, and
-        # through this image's network tunnel it is ~70ms, which would
-        # otherwise swamp the 1ms compute.
-        INNER = 16
+        # in-RAM encode loop.  Measured as the SLOPE of n dependency-
+        # chained encodes executed inside one device program
+        # (lax.fori_loop): t(n2) - t(n1) isolates pure on-chip encode
+        # time from per-dispatch round trips — through this image's
+        # network tunnel a dispatch costs ~5ms, which would otherwise
+        # be the thing measured.  The OSD batching layer similarly
+        # streams encodes without per-call sync.
+        # spread the chain lengths far enough apart that the encode
+        # signal (hundreds of chained iterations) dominates network
+        # jitter on the dispatch/fetch, and take the MEDIAN slope of
+        # several trials
+        N1, N2 = 64, 576
 
-        def hbm_encode():
-            out = None
-            for _ in range(INNER):
-                out = tpu.encode_batch_device(dev_data)
-            out.block_until_ready()   # FIFO queue: last done = all done
-        tpu_s = time_fn(hbm_encode) / INNER
+        def chain_time(n: int) -> float:
+            t0 = time.perf_counter()
+            out = tpu.encode_chain_device(dev_data, n)
+            _ = np.asarray(out)          # 1-byte fetch forces the chain
+            return time.perf_counter() - t0
+
+        chain_time(N1)                   # compile
+        chain_time(N2)
+        slopes = []
+        for _ in range(5):
+            t1, t2 = chain_time(N1), chain_time(N2)
+            slope = (t2 - t1) / (N2 - N1)
+            if slope > 0:
+                slopes.append(slope)
+        slopes.sort()
+        if slopes:
+            tpu_s = slopes[len(slopes) // 2]
+        else:
+            # degenerate (clock noise swamped the chain): fall back to
+            # one whole-chain average rather than crashing
+            tpu_s = chain_time(N2) / N2
 
         # fully end-to-end, double-buffered (reported in metric string)
         data2 = rng.integers(0, 256, (batch, k, L), dtype=np.uint8)

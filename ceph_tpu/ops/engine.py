@@ -198,11 +198,25 @@ class CodecCore:
         self.bitmatrix = np.asarray(bitmatrix, dtype=np.uint8)
         self._decode_cache: dict = {}
 
+    def gf8_encode_fast(self) -> bool:
+        """Single source of truth for the w=8 XOR-chain eligibility:
+        byte-domain, a GF coding matrix in hand, and a backend whose
+        platform makes per-matrix static compilation worthwhile.
+        ENCODE ONLY — decode matrices vary per erasure signature and
+        must stay runtime arguments (no recompiles)."""
+        return (self.layout == "byte" and self.w == 8
+                and self.coding_matrix is not None
+                and hasattr(self.backend, "apply_gf8_matrix")
+                and self.backend.gf8_fast_path())
+
     # -- encode -----------------------------------------------------------
     def encode_batch(self, data: np.ndarray) -> np.ndarray:
         """data uint8 [..., k, L] -> parity uint8 [..., m, L]."""
         if data.shape[-2] != self.k:
             raise ValueError(f"expected {self.k} data chunks")
+        if self.gf8_encode_fast():
+            return self.backend.apply_gf8_matrix(self.coding_matrix,
+                                                 data)
         return self._apply(self.bitmatrix, self.coding_matrix, data)
 
     def encode(self, data: np.ndarray) -> np.ndarray:

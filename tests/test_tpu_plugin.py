@@ -103,18 +103,40 @@ def test_bucketing_shapes(batch, length):
         assert np.array_equal(parity[b], cpu.core.encode(data[b]))
 
 
+def test_gf8_xor_chain_bit_exact():
+    """The TPU encode fast path (fused XOR/xtime chain) must be
+    bit-exact with the scalar GF reference — one small matrix keeps
+    this a single cheap compile on the CPU backend."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.engine import NumpyBackend
+    from ceph_tpu.ops.jax_engine import _apply_gf8_xor
+    from ceph_tpu.ops.matrix import reed_sol_vandermonde_coding_matrix
+    M = reed_sol_vandermonde_coding_matrix(3, 2, 8)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, (2, 3, 256), dtype=np.uint8)
+    coeffs = tuple(tuple(int(v) for v in row) for row in M)
+    out = np.asarray(_apply_gf8_xor(jnp.asarray(data), coeffs))
+    ref = NumpyBackend().apply_matrix(M, data, 8)
+    assert np.array_equal(out, ref)
+
+
 def test_jit_cache_reused_across_instances():
-    """Two codec instances with the same geometry share compiled kernels."""
+    """Two codec instances with the same geometry share one backend
+    (so jit caches are shared: the w=8 XOR-chain keys on the static
+    coeff tuple, the bit-plane path on the device-matrix cache)."""
     from ceph_tpu.ec.plugins import tpu as tpumod
     reg = ecreg.instance()
     a = reg.factory("tpu", {"k": "4", "m": "2"})
     b = reg.factory("tpu", {"k": "4", "m": "2"})
     assert a.core.backend is b.core.backend
     be = tpumod.shared_backend()
-    n0 = len(be._dev_matrices)
-    a.encode_batch(np.zeros((2, 4, 256), dtype=np.uint8))
-    b.encode_batch(np.zeros((2, 4, 256), dtype=np.uint8))
-    # both instances share one device-matrix entry (may predate this test)
-    key = (a.core.bitmatrix.shape, a.core.bitmatrix.tobytes())
+    pa = a.encode_batch(np.zeros((2, 4, 256), dtype=np.uint8))
+    pb = b.encode_batch(np.zeros((2, 4, 256), dtype=np.uint8))
+    assert np.array_equal(pa, pb)
+    # the bit-plane device-matrix cache still serves non-w8 paths:
+    # a w=16 codec populates it
+    c = reg.factory("tpu", {"k": "3", "m": "2", "w": "16"})
+    c.encode_batch(np.zeros((2, 3, 256), dtype=np.uint8))
+    key = (c.core.bitmatrix.shape, c.core.bitmatrix.tobytes())
     assert key in be._dev_matrices
-    assert len(be._dev_matrices) <= n0 + 1
