@@ -99,6 +99,29 @@ def sharded_encode_fn(mesh: Mesh, w: int):
     return jax.jit(fn)
 
 
+def sharded_encode_gf8_fn(mesh: Mesh, coding_matrix: np.ndarray):
+    """Sharded w=8 fast path: the fused XOR/xtime chain
+    (ops.jax_engine._apply_gf8_xor) under the same (dp, sp) sharding —
+    GF(2^8) math is per byte position, so width shards need no halo
+    and the only collective remains the integrity-digest psum.
+    ``coding_matrix`` is static (per-pool), like the single-chip fast
+    path."""
+    from ..ops.jax_engine import _apply_gf8_xor
+    coeffs = tuple(tuple(int(v) for v in row) for row in coding_matrix)
+
+    def local_encode(data):
+        parity = _apply_gf8_xor(data, coeffs)
+        digest = _fold_digest(jnp.sum(parity.astype(jnp.uint32)))
+        digest = jax.lax.psum(jax.lax.psum(digest, "dp"), "sp")
+        return parity, digest
+
+    fn = shard_map(
+        local_encode, mesh=mesh,
+        in_specs=(P("dp", None, "sp"),),
+        out_specs=(P("dp", None, "sp"), P()))
+    return jax.jit(fn)
+
+
 def shard_batch(mesh: Mesh, data: np.ndarray) -> jax.Array:
     """Place a host batch [batch, k, L] onto the mesh (dp, None, sp)."""
     sharding = NamedSharding(mesh, P("dp", None, "sp"))

@@ -91,6 +91,44 @@ def test_replicated_pool_size_and_write_through_restart(tmp_path):
         assert io.omap_get("persist")["mk"] == b"mv"
 
 
+def test_ec_overwrites_pool(cl):
+    """allow_ec_overwrites=true enables partial overwrites and
+    truncate on EC pools (reference allows_ecoverwrites,
+    osd_types.h:1600; RMW path ECBackend try_state_to_reads)."""
+    cl.create_ec_profile("ovw", plugin="jerasure", k="2", m="1")
+    cl.create_pool("ecow", "erasure", erasure_code_profile="ovw")
+    r = cl.rados()
+    io = r.open_ioctx("ecow")
+    base = os.urandom(16384)
+    io.write_full("o", base)
+    # without the flag: overwrite rejected EOPNOTSUPP
+    with pytest.raises(RadosError) as ei:
+        io.write("o", b"X" * 100, 50)
+    assert ei.value.errno == 95
+    ret, rs, _ = cl.mon_command({"prefix": "osd pool set",
+                                 "pool": "ecow",
+                                 "var": "allow_ec_overwrites",
+                                 "val": "true"})
+    assert ret == 0, rs
+    r.wait_for_epoch(cl.mon.osdmap.epoch, 10)
+    # RMW overwrite mid-object + truncate now work, bytes exact
+    patch = os.urandom(5000)
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            io.write("o", patch, 3000)
+            break
+        except RadosError as e:      # OSD may not have the flag yet
+            if e.errno != 95 or time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
+    expect = bytearray(base)
+    expect[3000:8000] = patch
+    assert io.read("o") == bytes(expect)
+    io.truncate("o", 6000)
+    assert io.read("o") == bytes(expect[:6000])
+
+
 def test_pool_delete_frees_objects(cl):
     cl.create_pool("tmp1", "replicated", size=2)
     r = cl.rados()
