@@ -55,10 +55,12 @@ class Cluster:
                  data_dir: Optional[str] = None,
                  conf: Optional[Config] = None,
                  n_mons: int = 1,
-                 with_mgr: bool = False):
+                 with_mgr: bool = False,
+                 store_kind: str = "file"):
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.with_mgr = with_mgr
+        self.store_kind = store_kind     # file | block (with data_dir)
         self.mgr = None
         self.data_dir = data_dir
         self.conf = conf or test_config()
@@ -76,11 +78,20 @@ class Cluster:
 
     def _make_store(self, osd_id: int) -> ObjectStore:
         if self.data_dir is None:
+            if self.store_kind == "block":
+                raise ValueError(
+                    "store_kind='block' needs a data_dir (a durable "
+                    "backend silently downgraded to MemStore would "
+                    "lose data)")
             store = MemStore()
             store.mkfs()
         else:
             path = os.path.join(self.data_dir, f"osd.{osd_id}")
-            store = FileStore(path)
+            if self.store_kind == "block":
+                from .store.blockstore import BlockStore
+                store = BlockStore(path)
+            else:
+                store = FileStore(path)
             if not os.path.exists(os.path.join(path, "meta.kv")):
                 store.mkfs()
         return store

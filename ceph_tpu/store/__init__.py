@@ -4,7 +4,8 @@
   (reference os/ObjectStore.h)
 - memstore: in-RAM test double (reference os/memstore/MemStore.cc)
 - filestore: persistent files + LogDB metadata + WAL journal
-  (the BlueStore seat)
+- blockstore: raw block space + bitmap allocator + KV metadata with
+  copy-on-write overwrites (reference os/bluestore/)
 - kv: KeyValueDB abstraction, MemDB/LogDB backends (reference
   src/kv/KeyValueDB.h)
 """
@@ -12,8 +13,10 @@ from .objectstore import COLL_META, GHObject, ObjectStat, ObjectStore, \
     Transaction
 from .memstore import MemStore
 from .filestore import FileStore
+from .blockstore import BlockStore
 from .kv import KeyValueDB, LogDB, MemDB, WriteBatch
 
 __all__ = ["COLL_META", "GHObject", "ObjectStat", "ObjectStore",
-           "Transaction", "MemStore", "FileStore", "KeyValueDB",
+           "Transaction", "MemStore", "FileStore", "BlockStore",
+           "KeyValueDB",
            "LogDB", "MemDB", "WriteBatch"]

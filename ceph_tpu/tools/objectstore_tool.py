@@ -16,6 +16,7 @@ from __future__ import annotations
 import argparse
 import base64
 import json
+import os
 import re
 import sys
 from typing import List
@@ -28,13 +29,24 @@ def main(argv: List[str] = None) -> int:
     p = argparse.ArgumentParser(prog="ceph-objectstore-tool",
                                 description=__doc__.splitlines()[0])
     p.add_argument("--data-path", required=True)
+    p.add_argument("--type", choices=("auto", "file", "block"),
+                   default="auto",
+                   help="store backend (auto: detect block.dev)")
     p.add_argument("--op", choices=("list", "meta-list", "fsck"))
     p.add_argument("rest", nargs="*",
                    help="<coll> <obj> dump|get-bytes|set-bytes|remove|"
                    "list-attrs|get-attr|list-omap [args]")
     ns = p.parse_args(argv)
 
-    store = FileStore(ns.data_path)
+    kind = ns.type
+    if kind == "auto":
+        kind = "block" if os.path.exists(
+            os.path.join(ns.data_path, "block.dev")) else "file"
+    if kind == "block":
+        from ..store.blockstore import BlockStore
+        store = BlockStore(ns.data_path)
+    else:
+        store = FileStore(ns.data_path)
     store.mount()
     try:
         if ns.op == "list":

@@ -34,6 +34,10 @@ def main(argv: List[str] = None) -> int:
                         "(created if absent)")
     p.add_argument("-d", "--data-dir",
                    help="FileStore-backed daemons (default: MemStore)")
+    p.add_argument("--objectstore", choices=("file", "block"),
+                   default="file",
+                   help="store backend with -d (block = BlueStore-"
+                        "style raw block space + allocator)")
     p.add_argument("-e", "--ec-pool", action="store_true",
                    help="pre-create EC profile 'tpuprof' (plugin=tpu "
                    "k=2 m=1) + pool 'ecpool' (vstart.sh -e)")
@@ -47,7 +51,8 @@ def main(argv: List[str] = None) -> int:
     from ..cluster import Cluster
 
     cluster = Cluster(n_osds=ns.num_osds, data_dir=ns.data_dir,
-                      n_mons=ns.num_mons, with_mgr=ns.mgr)
+                      n_mons=ns.num_mons, with_mgr=ns.mgr,
+                      store_kind=ns.objectstore)
     cluster.start()
     host, port = cluster.mon_addr
     addr = f"{host}:{port}"

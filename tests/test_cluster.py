@@ -91,6 +91,25 @@ def test_replicated_pool_size_and_write_through_restart(tmp_path):
         assert io.omap_get("persist")["mk"] == b"mv"
 
 
+def test_blockstore_backed_cluster(tmp_path):
+    """OSDs on the BlueStore-style BlockStore: EC IO + restart-resume
+    from raw block space."""
+    ddir = str(tmp_path / "bs")
+    with Cluster(n_osds=3, data_dir=ddir, store_kind="block") as c:
+        c.create_ec_profile("bse", plugin="jerasure", k="2", m="1")
+        c.create_pool("bsp", "erasure", erasure_code_profile="bse")
+        io = c.rados().open_ioctx("bsp")
+        payload = os.urandom(100_000)
+        io.write_full("bo", payload)
+        assert io.read("bo") == payload
+        c.wait_for_clean(30)
+        assert os.path.exists(os.path.join(ddir, "osd.0",
+                                           "block.dev"))
+    with Cluster(n_osds=3, data_dir=ddir, store_kind="block") as c:
+        io = c.rados().open_ioctx("bsp")
+        assert io.read("bo") == payload
+
+
 def test_ec_overwrites_pool(cl):
     """allow_ec_overwrites=true enables partial overwrites and
     truncate on EC pools (reference allows_ecoverwrites,
