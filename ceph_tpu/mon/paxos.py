@@ -69,10 +69,14 @@ class QuorumService:
         self._election_started = 0.0
         self._lease_expiry = 0.0
         self._proposal: Optional[Proposal] = None
-        # peon: pending begin awaiting commit
-        self._pending: Optional[Tuple[int, dict]] = None
-        # candidate: accepted-but-uncommitted values carried in acks
-        self._ack_pendings: Dict[int, dict] = {}
+        # peon: pending begin awaiting commit, as (version, value, pn)
+        # where pn is the election epoch of the begin that carried it
+        # (reference Paxos accepted_pn)
+        self._pending: Optional[Tuple[int, dict, int]] = None
+        # candidate: accepted-but-uncommitted values carried in acks,
+        # version -> (pn, value); only the highest-pn value per version
+        # may be completed (reference Paxos uncommitted_pn handling)
+        self._ack_pendings: Dict[int, Tuple[int, dict]] = {}
         # set lock-free by handle() when evidence of a newer election
         # arrives: lets propose() (which blocks holding mon.lock, so
         # handlers couldn't depose us through the lock) bail out early
@@ -221,7 +225,8 @@ class QuorumService:
                 op="ack", from_rank=self.rank, epoch=epoch,
                 last_committed=lc,
                 version=pend[0] if pend else 0,
-                value=pend[1] if pend else None))
+                value=pend[1] if pend else None,
+                pn=pend[2] if pend else 0))
         else:
             # they're worse but opened a round: contest it, ratcheting
             # at least past their epoch
@@ -233,7 +238,10 @@ class QuorumService:
                 return
             self._acks[msg.from_rank] = msg.last_committed
             if msg.version and msg.value is not None:
-                self._ack_pendings[msg.version] = msg.value
+                prev = self._ack_pendings.get(msg.version)
+                if prev is None or msg.pn > prev[0]:
+                    self._ack_pendings[msg.version] = (msg.pn,
+                                                      msg.value)
             if len(self._acks) < self.majority:
                 return
             # victory: epoch goes even, quorum = the acked set
@@ -244,15 +252,23 @@ class QuorumService:
             quorum = sorted(self.quorum)
             acks = dict(self._acks)
             # complete uncommitted rounds (reference Paxos collect):
-            # our own pending plus any carried in acks, newest first
+            # our own pending plus any carried in acks.  Values for the
+            # same version compete by pn — a value the dead leader got
+            # majority-accepted (and possibly committed on some mons)
+            # carries the newest begin's epoch, so highest pn wins;
+            # completing a lower-pn loser could fork the committed map
+            # between monitor incarnations.
             pendings = dict(self._ack_pendings)
             if self._pending is not None:
-                pendings.setdefault(self._pending[0],
-                                    self._pending[1])
+                v, val, pn = self._pending
+                prev = pendings.get(v)
+                if prev is None or pn > prev[0]:
+                    pendings[v] = (pn, val)
             self._ack_pendings = {}
         for version in sorted(pendings):
             if version > self.mon.osdmap.epoch:
-                self.mon.apply_replicated(version, pendings[version])
+                self.mon.apply_replicated(version,
+                                          pendings[version][1])
         with self.mon.lock:
             my_lc = self.mon.osdmap.epoch
         self.log.dout(1, f"won election e{epoch}, quorum {quorum}")
@@ -345,7 +361,7 @@ class QuorumService:
         with self.mon.lock:
             behind = self.mon.osdmap.epoch \
                 if msg.version > self.mon.osdmap.epoch + 1 else None
-            self._pending = (msg.version, msg.value)
+            self._pending = (msg.version, msg.value, msg.epoch)
         if behind is not None:
             # gap before this value: ask for the missing epochs too
             self._send(msg.from_rank, MMonMon(

@@ -907,7 +907,8 @@ class MMonMon(Message):
                  last_committed: int = 0,
                  value: Optional[dict] = None,
                  quorum: Optional[List[int]] = None,
-                 maps: Optional[Dict[int, dict]] = None):
+                 maps: Optional[Dict[int, dict]] = None,
+                 pn: int = 0):
         super().__init__()
         self.op = op
         self.from_rank = from_rank
@@ -917,6 +918,9 @@ class MMonMon(Message):
         self.value = value                  # proposed full-map wire dict
         self.quorum = quorum or []
         self.maps = maps or {}              # epoch -> wire dict (sync)
+        self.pn = pn                        # proposal number of a carried
+                                            # accepted-but-uncommitted value
+                                            # (reference Paxos uncommitted_pn)
 
     def encode_payload(self) -> bytes:
         e = Encoder()
@@ -925,6 +929,7 @@ class MMonMon(Message):
         e.bytes(_enc_json(self.value))
         e.i64_list(self.quorum)
         e.bytes(_enc_json({str(k): v for k, v in self.maps.items()}))
+        e.u32(self.pn)
         return e.build()
 
     @classmethod
@@ -935,6 +940,7 @@ class MMonMon(Message):
         out.value = _dec_json(d.bytes())
         out.quorum = [int(x) for x in d.i64_list()]
         out.maps = {int(k): v for k, v in _dec_json(d.bytes()).items()}
+        out.pn = d.u32()
         return out
 
 

@@ -75,7 +75,8 @@ class RBD:
         if name in names:
             raise RadosError(17, f"image {name!r} exists")  # EEXIST
         header = {"size": size, "order": order, "gen": 0,
-                  "snap_seq": 0, "snaps": {}, "parent": None}
+                  "snap_seq": 0, "snaps": {}, "parent": None,
+                  "hwm": size}   # high-water size: bounds object scans
         self.ioctx.write_full(_header_oid(name),
                               json.dumps(header).encode())
         self._dir_update(names + [name])
@@ -102,7 +103,8 @@ class RBD:
             raise RadosError(17, f"image {child_name!r} exists")
         header = {"size": snap["size"], "order": parent.header["order"],
                   "gen": 0, "snap_seq": 0, "snaps": {},
-                  "parent": {"image": parent_name, "snap": snap_name}}
+                  "parent": {"image": parent_name, "snap": snap_name},
+                  "hwm": snap["size"]}
         self.ioctx.write_full(_header_oid(child_name),
                               json.dumps(header).encode())
         self._dir_update(names + [child_name])
@@ -247,32 +249,70 @@ class Image:
         except RadosError:
             return False
 
+    def _underlying_holds(self, objectno: int, gen: int) -> bool:
+        """Would a read of this object at head still find content
+        below ``gen`` (an older generation, or the clone parent)?
+        Stat/header-only — no data transfer."""
+        if any(self._object_exists(_data_oid(self.name, g, objectno))
+               for g in range(gen - 1, -1, -1)):
+            return True
+        parent = self.header.get("parent")
+        if parent is None:
+            return False
+        psize = getattr(self, "_parent_size_cache", None)
+        if psize is None:
+            try:
+                psize = Image(self.ioctx, parent["image"],
+                              snap_name=parent["snap"]).size()
+            except RadosError:
+                psize = 0
+            self._parent_size_cache = psize
+        return objectno * self.object_size < psize
+
     def resize(self, new_size: int) -> None:
         if self.snap_name is not None:
             raise RadosError(30, "snapshot views are read-only")
         old = self.header["size"]
         self.header["size"] = new_size
+        self.header["hwm"] = max(self._hwm(), new_size)
         self._save_header()
         if new_size < old:
-            # drop whole current-gen objects past the end; shrink the
-            # boundary object (older generations stay for snapshots)
+            # Drop whole current-gen objects past the end; older
+            # generations keep their data for snapshots, so where an
+            # older gen (or a clone parent) still holds content, leave
+            # an empty tombstone at the current gen — otherwise a
+            # later grow would re-expose the stale bytes instead of
+            # zeros.
             osize = self.object_size
             gen = self.header["gen"]
             first_gone = (new_size + osize - 1) // osize
             for objectno in range(first_gone,
                                   (old + osize - 1) // osize):
+                oid = _data_oid(self.name, gen, objectno)
                 try:
-                    self.ioctx.remove(
-                        _data_oid(self.name, gen, objectno))
+                    self.ioctx.remove(oid)
                 except RadosError:
                     pass
-            if new_size % osize and not self.header["snaps"]:
-                try:
-                    self.ioctx.truncate(
-                        _data_oid(self.name, gen, new_size // osize),
-                        new_size % osize)
-                except RadosError:
-                    pass
+                if self._underlying_holds(objectno, gen):
+                    self.ioctx.write_full(oid, b"")
+            if new_size % osize:
+                # boundary object: truncate in place when it exists at
+                # the current generation (metadata-only); otherwise
+                # promote a clamped copy of the resolved content
+                # (current gen is always strictly newer than every
+                # snap gen, so this never corrupts a snapshot view)
+                objectno = new_size // osize
+                oid = _data_oid(self.name, gen, objectno)
+                if self._object_exists(oid):
+                    try:
+                        self.ioctx.truncate(oid, new_size % osize)
+                    except RadosError:
+                        pass
+                elif self._underlying_holds(objectno, gen):
+                    data = self._read_object(objectno, gen)
+                    if len(data) > new_size % osize:
+                        self.ioctx.write_full(
+                            oid, data[:new_size % osize])
 
     # -- snapshots (reference librbd snap_create/rollback/remove) ------
     def snap_create(self, snap_name: str) -> None:
@@ -310,22 +350,53 @@ class Image:
         if snap is None:
             raise RadosError(2, f"no snap {snap_name!r}")
         src_gen = snap["gen"]
+        old_size = self.header["size"]
         self.header["gen"] += 1
         new_gen = self.header["gen"]
         self.header["size"] = snap["size"]
         osize = self.object_size
-        n_objs = (snap["size"] + osize - 1) // osize
-        for objectno in range(n_objs):
-            data = self._read_object(objectno, src_gen)
-            oid = _data_oid(self.name, new_gen, objectno)
-            if data:
-                self.ioctx.write_full(oid, data)
-            else:
-                try:
-                    self.ioctx.remove(oid)
-                except RadosError:
-                    pass
+        # Cover every object either view may have touched.  An object
+        # written after the snapshot must come back as the snap's
+        # content — or, where the snap view is empty, as an explicit
+        # empty object at the new generation: a tombstone that stops
+        # _read_object falling through to the intermediate (post-snap)
+        # generations.  Objects no intermediate generation touched
+        # already resolve to the snap's content through <=src_gen, so
+        # a sparse or unchanged image rolls back in O(dirty objects),
+        # not O(image size).
+        max_objs = (max(snap["size"], old_size) + osize - 1) // osize
+        for objectno in range(max_objs):
+            keep = max(0, min(osize, snap["size"] - objectno * osize))
+            dirty = any(
+                self._object_exists(_data_oid(self.name, g, objectno))
+                for g in range(src_gen + 1, new_gen))
+            if dirty:
+                data = self._read_object(objectno, src_gen)[:keep] \
+                    if keep else b""
+                self.ioctx.write_full(
+                    _data_oid(self.name, new_gen, objectno), data)
+            elif keep == 0:
+                # wholly past the snap's size: a stat-only probe
+                # decides whether a tombstone is needed at all
+                if self._underlying_holds(objectno, src_gen + 1):
+                    self.ioctx.write_full(
+                        _data_oid(self.name, new_gen, objectno), b"")
+            elif keep < osize:
+                # boundary object, clean: promote a clamped copy so a
+                # later grow re-exposes zeros, not stale bytes
+                data = self._read_object(objectno, src_gen)
+                if len(data) > keep:
+                    self.ioctx.write_full(
+                        _data_oid(self.name, new_gen, objectno),
+                        data[:keep])
         self._save_header()
+
+    def _hwm(self) -> int:
+        """Largest size this image has ever had: tombstones from
+        shrinks can sit past the current and snap sizes, so cleanup
+        scans must cover the high-water mark."""
+        return max([self.header.get("hwm", 0), self.header["size"]] +
+                   [s["size"] for s in self.header["snaps"].values()])
 
     def _live_gens(self) -> List[int]:
         gens = {self.header["gen"]}
@@ -337,10 +408,8 @@ class Image:
         An unreachable gen g's objects are first folded into the next
         live gen if it lacks them (they are its COW base)."""
         live = self._live_gens()
-        max_objs = (max([self.header["size"]] +
-                        [s["size"] for s in
-                         self.header["snaps"].values()])
-                    + self.object_size - 1) // self.object_size
+        max_objs = (self._hwm() + self.object_size - 1) \
+            // self.object_size
         for gen in range(self.header["gen"] + 1):
             if gen in live:
                 continue
@@ -382,10 +451,7 @@ class Image:
     # -- maintenance ---------------------------------------------------
     def _remove_all_data(self) -> None:
         osize = self.object_size
-        max_size = max([self.header["size"]] +
-                       [s["size"] for s in
-                        self.header["snaps"].values()] + [0])
-        n_objs = (max_size + osize - 1) // osize
+        n_objs = (self._hwm() + osize - 1) // osize
         for gen in range(self.header["gen"] + 1):
             for objectno in range(n_objs):
                 try:

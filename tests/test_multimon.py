@@ -171,6 +171,71 @@ def test_paxos_completes_uncommitted_round():
         assert out["key"] == key
 
 
+def test_paxos_uncommitted_pn_highest_wins():
+    """Two different values pending for the same version (a dead
+    leader's majority-accepted value vs an older aborted round's):
+    the new leader must complete the one accepted under the highest
+    proposal number, regardless of ack arrival order (reference
+    Paxos uncommitted_pn)."""
+    import threading
+
+    from ceph_tpu.mon.paxos import QuorumService
+    from ceph_tpu.msg.messages import MMonMon
+
+    class StubMap:
+        epoch = 5
+
+    class StubStore:
+        def get_map(self, e):
+            return None
+
+    class StubKeyring:
+        def dump(self):
+            return {}
+
+    class StubMon:
+        name = "stub"
+
+        def __init__(self):
+            self.lock = threading.RLock()
+            self.osdmap = StubMap()
+            self.conf = {"mon_lease": 5.0,
+                         "mon_election_timeout": 5.0}
+            self.store = StubStore()
+            self.keyring = StubKeyring()
+            self.applied = []
+
+        def apply_replicated(self, version, value):
+            self.applied.append((version, value))
+            self.osdmap.epoch = version
+
+        def on_quorum_formed(self):
+            pass
+
+    for order in ("old-first", "new-first"):
+        mon = StubMon()
+        # 4 mons -> majority 3: victory needs both peer acks, so both
+        # competing pendings are on the table when the round completes
+        q = QuorumService(mon, 0, [("h", 1), ("h", 2), ("h", 3),
+                                   ("h", 4)])
+        q._send = lambda *a, **k: None
+        q._broadcast = lambda *a, **k: None
+        q.election_epoch = 11            # electing
+        q._acks = {0: 5}
+        losing = MMonMon(op="ack", from_rank=1, epoch=11,
+                         last_committed=5, version=6,
+                         value={"who": "loser"}, pn=6)
+        winning = MMonMon(op="ack", from_rank=2, epoch=11,
+                          last_committed=5, version=6,
+                          value={"who": "winner"}, pn=10)
+        first, second = (losing, winning) if order == "old-first" \
+            else (winning, losing)
+        q._handle_ack(first)
+        q._handle_ack(second)
+        assert q.is_leader()
+        assert mon.applied == [(6, {"who": "winner"})], order
+
+
 def test_mon_restart_resumes_from_store(tmp_path):
     ddir = str(tmp_path / "mm")
     with Cluster(n_osds=1, n_mons=3, data_dir=ddir,

@@ -93,6 +93,43 @@ def test_snapshots_cow_and_rollback(io):
     assert Image(io, "snp", "s2").read(0, 64 << 10) == v2
 
 
+def test_rollback_shadows_post_snap_holes(io):
+    """An object unwritten at snap time but written afterwards must
+    read as zeros after rollback (not the post-snap write)."""
+    rbd = RBD(io)
+    rbd.create("hole", 64 << 10, order=ORDER)
+    img = Image(io, "hole")
+    pre = os.urandom(16 << 10)
+    img.write(0, pre)                 # object 0 exists at snap time
+    img.snap_create("s")
+    late = os.urandom(16 << 10)
+    img.write(32 << 10, late)         # object 2: born after the snap
+    img.snap_rollback("s")
+    assert img.read(0, 16 << 10) == pre
+    assert img.read(32 << 10, 16 << 10) == b"\0" * (16 << 10)
+    # and writes after rollback behave normally
+    img.write(32 << 10, b"z" * 100)
+    assert img.read(32 << 10, 200) == b"z" * 100 + b"\0" * 100
+
+
+def test_shrink_grow_with_snapshot_exposes_zeros(io):
+    """Shrink-then-grow must re-expose zeros, not stale bytes, even
+    while a snapshot pins the old data in an older generation."""
+    rbd = RBD(io)
+    rbd.create("szg", 64 << 10, order=ORDER)
+    img = Image(io, "szg")
+    data = os.urandom(64 << 10)
+    img.write(0, data)
+    img.snap_create("pin")
+    img.resize(20 << 10)              # mid-object boundary at 20 KiB
+    img.resize(64 << 10)
+    got = img.read(0, 64 << 10)
+    assert got[:20 << 10] == data[:20 << 10]
+    assert got[20 << 10:] == b"\0" * (44 << 10)
+    # the snapshot still sees the original content
+    assert Image(io, "szg", "pin").read(0, 64 << 10) == data
+
+
 def test_snap_rm_and_gc(io):
     rbd = RBD(io)
     rbd.create("gc", 64 << 10, order=ORDER)
