@@ -1,0 +1,214 @@
+"""Cross-op TPU stripe batcher — the OSD-level encode coalescer.
+
+This is the framework's "batching point" (SURVEY.md §3.1): where the
+reference encodes each write's stripes on the submitting thread inside
+ECBackend::try_reads_to_commit (reference src/osd/ECBackend.cc:1939,
+via ECUtil::encode's per-stripe loop, src/osd/ECUtil.cc:136-148), a
+TPU pays per *device call*, not per stripe — so the win is gathering
+stripes from MANY in-flight ops (across PGs, one batcher per OSD) into
+ONE batched MXU call.
+
+Mechanics:
+
+* ``submit()`` (called under the PG lock from the EC write pipeline)
+  enqueues an encode request keyed by codec geometry and wakes the
+  collector.  The submitting thread never blocks on the device.
+* The collector thread waits ``ec_tpu_queue_window_us`` from the first
+  queued request (or until ``ec_tpu_batch_stripes`` stripes are
+  pending) for more ops to arrive, then concatenates each geometry
+  group to one ``[N, k, chunk]`` array and issues a single
+  ``encode_batch_async`` device call — h2d staging, MXU compute and
+  parity d2h overlap across consecutive batches exactly like the
+  bench's double buffering.
+* Parity is split back per request and each continuation runs in
+  submission order (per-PG FIFO holds: the PG pipeline admits one
+  encode per PG at a time, and one collector drains batches serially).
+
+Locking: ``submit`` takes only the batcher lock; continuations take
+the owning PG's lock while the batcher lock is dropped — no ordering
+cycle with the op workers (which take PG lock then ``submit``).
+
+Reference anchors: the op queue this rides behind is the sharded work
+queue (reference src/osd/OSD.cc:9612 enqueue_op -> op_shardedwq); the
+in-order commit contract it must preserve is ECBackend::check_ops
+(reference src/osd/ECBackend.cc:2151-2156).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import ecutil
+
+
+class _Req:
+    def __init__(self, ec_impl, sinfo: ecutil.StripeInfo, data: bytes,
+                 cb: Callable[[Dict[int, bytes]], None]):
+        self.ec_impl = ec_impl
+        self.sinfo = sinfo
+        self.data = data
+        self.cb = cb
+        self.nstripes = len(data) // sinfo.stripe_width
+
+
+def _geometry_key(ec_impl, sinfo: ecutil.StripeInfo) -> Tuple:
+    """Requests may share one device call iff they encode with the
+    same coding matrix over the same chunk size.  The matrix is a
+    deterministic function of (plugin, technique, k, m, w,
+    packetsize), so that tuple + chunk_size is a sound key even
+    across codec instances from different PGs of the same pool."""
+    return (type(ec_impl).__name__,
+            ec_impl.get_data_chunk_count(),
+            ec_impl.get_coding_chunk_count(),
+            getattr(ec_impl, "technique", ""),
+            getattr(ec_impl, "w", 0),
+            getattr(ec_impl, "packetsize", 0),
+            sinfo.chunk_size)
+
+
+class EncodeBatcher:
+    """Per-OSD encode coalescer (one collector thread)."""
+
+    def __init__(self, conf=None, perf=None):
+        get = (lambda k, d: conf[k] if conf is not None else d)
+        self.max_stripes = get("ec_tpu_batch_stripes", 1024)
+        self.window_s = get("ec_tpu_queue_window_us", 200) / 1e6
+        self.perf = perf
+        self._cond = threading.Condition()
+        self._queues: Dict[Tuple, List[_Req]] = {}
+        self._pending_stripes = 0
+        self._first_enqueue = 0.0
+        self._stop = False
+        # introspection (tested + surfaced via perf counters)
+        self.calls = 0               # device calls issued
+        self.reqs_total = 0          # requests encoded
+        self.reqs_coalesced = 0      # requests that shared a call
+        self._thread = threading.Thread(target=self._run,
+                                        name="ec-batcher", daemon=True)
+        self._thread.start()
+
+    # -- producer side ---------------------------------------------------
+    def submit(self, ec_impl, sinfo: ecutil.StripeInfo, data: bytes,
+               cb: Callable[[Dict[int, bytes]], None]) -> None:
+        """Queue one aligned extent for encoding; ``cb`` receives the
+        full {shard: bytes} chunk map (data + parity) later, from the
+        collector thread.  Codecs without the batched async API don't
+        benefit from coalescing — they encode inline."""
+        if self._stop or not hasattr(ec_impl, "encode_batch_async"):
+            cb(ecutil.encode(sinfo, ec_impl, data))
+            return
+        req = _Req(ec_impl, sinfo, data, cb)
+        if req.nstripes == 0:
+            cb({i: b"" for i in range(ec_impl.get_chunk_count())})
+            return
+        with self._cond:
+            if not self._queues:
+                self._first_enqueue = time.monotonic()
+            self._queues.setdefault(_geometry_key(ec_impl, sinfo),
+                                    []).append(req)
+            self._pending_stripes += req.nstripes
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify()
+        self._thread.join(timeout=5)
+
+    # -- collector -------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queues and not self._stop:
+                    self._cond.wait()
+                if not self._queues and self._stop:
+                    return
+                # linger for the window so concurrent ops can join,
+                # unless the stripe budget is already met
+                deadline = self._first_enqueue + self.window_s
+                while (not self._stop
+                       and self._pending_stripes < self.max_stripes
+                       and (remaining := deadline - time.monotonic())
+                       > 0):
+                    self._cond.wait(remaining)
+                queues, self._queues = self._queues, {}
+                self._pending_stripes = 0
+            # dispatch EVERY group's device call before joining any:
+            # h2d staging + MXU compute of group B overlap group A's
+            # parity d2h and continuations (same double buffering the
+            # bench uses).  A continuation that raises must not kill
+            # the collector — that would wedge every EC write on the
+            # OSD — so each step is fault-isolated to its own ops.
+            groups = []
+            for key, reqs in queues.items():
+                groups.append((reqs, self._dispatch_group(reqs)))
+            for reqs, handle in groups:
+                try:
+                    self._complete_group(reqs, handle)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+
+    def _dispatch_group(self, reqs: List[_Req]):
+        """Issue one async device call for every request of one
+        geometry; returns (arrs, async_handle) or None on dispatch
+        failure (completion falls back to per-request CPU encode)."""
+        try:
+            sinfo = reqs[0].sinfo
+            k = reqs[0].ec_impl.get_data_chunk_count()
+            arrs = [np.frombuffer(r.data, dtype=np.uint8).reshape(
+                r.nstripes, k, sinfo.chunk_size) for r in reqs]
+            batch = np.concatenate(arrs, axis=0) \
+                if len(arrs) > 1 else arrs[0]
+            return arrs, reqs[0].ec_impl.encode_batch_async(batch)
+        except Exception:
+            return None
+
+    def _complete_group(self, reqs: List[_Req], handle) -> None:
+        k = reqs[0].ec_impl.get_data_chunk_count()
+        m = reqs[0].ec_impl.get_coding_chunk_count()
+        parity = None
+        if handle is not None:
+            arrs, async_batch = handle
+            try:
+                parity = async_batch.wait()
+            except Exception:
+                parity = None
+        if parity is None:
+            # device trouble: encode each request on the CPU path so
+            # client ops fail only if that fails too
+            for r in reqs:
+                try:
+                    r.cb(ecutil.encode(r.sinfo, r.ec_impl, r.data))
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+            return
+        self.calls += 1
+        self.reqs_total += len(reqs)
+        nstripes = sum(r.nstripes for r in reqs)
+        if len(reqs) > 1:
+            self.reqs_coalesced += len(reqs)
+        if self.perf is not None:
+            self.perf.inc("ec_batch_calls")
+            self.perf.inc("ec_batch_stripes", nstripes)
+            if len(reqs) > 1:
+                self.perf.inc("ec_batch_coalesced", len(reqs))
+        off = 0
+        for r, arr in zip(reqs, arrs):
+            p = parity[off:off + r.nstripes]
+            off += r.nstripes
+            out: Dict[int, bytes] = {}
+            for i in range(k):
+                out[i] = arr[:, i].tobytes()
+            for j in range(m):
+                out[k + j] = np.ascontiguousarray(p[:, j]).tobytes()
+            try:
+                r.cb(out)
+            except Exception:
+                # a failing continuation affects only its own op
+                import traceback
+                traceback.print_exc()

@@ -10,9 +10,10 @@ src/osd/ECBackend.{h,cc}, 2.6k LoC), the engine behind every EC pool:
   (``try_state_to_reads``, :1865), then encodes and fans out per-shard
   sub-writes (``try_reads_to_commit``, :1939) — the **encode happens
   here**, and is where this framework diverges TPU-first: the whole
-  aligned extent is encoded as ONE ``[nstripes, k, chunk]`` batch on
-  the MXU via ecutil.encode instead of the reference's per-stripe CPU
-  loop (ECUtil.cc:136-148);
+  aligned extent goes to the OSD's cross-op batcher (osd/batcher.py)
+  as ONE ``[nstripes, k, chunk]`` array, where it coalesces with
+  concurrent ops from other PGs into a single MXU device call instead
+  of the reference's per-stripe CPU loop (ECUtil.cc:136-148);
 * **reads** reconstruct from the minimum shard set
   (``objects_read_and_reconstruct`` -> ECSubRead fan-out ->
   batched decode; reference ECBackend.cc:2345,1594,2287);
@@ -193,8 +194,60 @@ class ECBackend(PGBackend):
 
     def _reads_to_commit(self, op: _WriteOp) -> None:
         """Encode + fan out per-shard sub-writes (reference
-        try_reads_to_commit, ECBackend.cc:1939-2101)."""
-        shard_txns = self._generate_transactions(op)
+        try_reads_to_commit, ECBackend.cc:1939-2101).
+
+        The encode does NOT run inline here: writes with data hand
+        their stripe-aligned buffer to the OSD's cross-op batcher
+        (osd/batcher.py), which coalesces stripes from concurrent ops
+        across PGs into one device call and calls back into
+        _encode_done.  Codec or host without batching support encodes
+        synchronously on this thread instead."""
+        mut = op.mutation
+        if mut.delete or not mut.writes:
+            self._commit_fanout(op, self._generate_transactions(op))
+            return
+        lo = min(off for off, _ in mut.writes)
+        hi = max(off + len(d) for off, d in mut.writes)
+        astart, alen = self.sinfo.offset_len_to_stripe_bounds(
+            lo, hi - lo)
+        buf = bytearray(alen)            # zero padding to stripe bounds
+        if op.read_data:
+            buf[0:len(op.read_data)] = op.read_data
+        for off, data in mut.writes:
+            buf[off - astart:off - astart + len(data)] = data
+        batcher = getattr(self.host, "encode_batcher", None)
+        if batcher is not None and \
+                hasattr(self.ec_impl, "encode_batch_async"):
+            batcher.submit(
+                self.ec_impl, self.sinfo, bytes(buf),
+                lambda chunks: self._encode_done(op, astart, hi,
+                                                 chunks))
+        else:
+            chunks = ecutil.encode(self.sinfo, self.ec_impl,
+                                   bytes(buf))
+            self._encoded_to_commit(op, astart, hi, chunks)
+
+    def _encode_done(self, op: _WriteOp, astart: int, hi: int,
+                     chunks: Dict[int, bytes]) -> None:
+        """Continuation from the batcher's collector thread: re-enter
+        the PG under its lock and fan out, unless an interval change
+        dropped the op mid-encode."""
+        lock = getattr(self.host, "lock", None)
+        if lock is None:
+            self._encoded_to_commit(op, astart, hi, chunks)
+            return
+        with lock:
+            if not self._pipeline or self._pipeline[0] is not op:
+                return               # on_change() cleared the pipeline
+            self._encoded_to_commit(op, astart, hi, chunks)
+
+    def _encoded_to_commit(self, op: _WriteOp, astart: int, hi: int,
+                           chunks: Dict[int, bytes]) -> None:
+        self._commit_fanout(op, self._generate_transactions(
+            op, write_plan=(astart, hi, chunks)))
+
+    def _commit_fanout(self, op: _WriteOp,
+                       shard_txns: Dict[int, Transaction]) -> None:
         wire_entries = [e.to_dict() for e in op.log_entries]
         # populate pending_commits for the WHOLE acting set before any
         # send: a fast commit reply must not find a half-filled set and
@@ -225,11 +278,14 @@ class ECBackend(PGBackend):
                 lambda: self._sub_write_committed(
                     tid, self.host.own_shard))
 
-    def _generate_transactions(self, op: _WriteOp
+    def _generate_transactions(self, op: _WriteOp,
+                               write_plan: Optional[Tuple] = None
                                ) -> Dict[int, Transaction]:
         """Lower the logical mutation to per-shard store transactions
         (reference ECTransaction::generate_transactions ->
-        encode_and_write, ECTransaction.cc:97,28)."""
+        encode_and_write, ECTransaction.cc:97,28).  ``write_plan`` is
+        (astart, hi, chunks) with the already-encoded chunk map from
+        the batcher when the mutation carries data."""
         mut, oid = op.mutation, op.oid
         txns: Dict[int, Transaction] = {
             shard: Transaction()
@@ -250,21 +306,16 @@ class ECBackend(PGBackend):
         for_all(lambda s, t, o, c: t.touch(c, o))
 
         if mut.writes:
-            lo = min(off for off, _ in mut.writes)
-            hi = max(off + len(d) for off, d in mut.writes)
-            astart, alen = self.sinfo.offset_len_to_stripe_bounds(
-                lo, hi - lo)
-            buf = bytearray(alen)        # zero padding to stripe bounds
-            if op.read_data:
-                buf[0:len(op.read_data)] = op.read_data
-            for off, data in mut.writes:
-                buf[off - astart:off - astart + len(data)] = data
+            assert write_plan is not None, \
+                "writes with data must arrive pre-encoded"
+            # ★ the batched encode already happened: one [nstripes, k,
+            # chunk] device call in the OSD batcher, shared with
+            # concurrent ops from other PGs
+            astart, hi, chunks = write_plan
             new_size = max(info.size, hi)
             is_append = mut.append_only_at(info.size) and \
                 astart >= self.sinfo.logical_to_prev_stripe_offset(
                     info.size)
-            # ★ the batched encode: one [nstripes, k, chunk] device call
-            chunks = ecutil.encode(self.sinfo, self.ec_impl, bytes(buf))
             chunk_off = \
                 self.sinfo.aligned_logical_offset_to_chunk_offset(astart)
             hinfo = self._update_hinfo(oid, chunks, chunk_off, is_append)
@@ -311,8 +362,8 @@ class ECBackend(PGBackend):
         try:
             hinfo = ecutil.HashInfo.decode(self.host.store.getattr(
                 self.host.coll, obj, ecutil.HINFO_KEY))
-        except (FileNotFoundError, KeyError):
-            pass
+        except (FileNotFoundError, KeyError, ValueError):
+            pass            # absent or corrupt: rebuilt below
         if hinfo is None or len(hinfo.crcs) != self.k + self.m:
             hinfo = ecutil.HashInfo(self.k + self.m)
         if is_append and hinfo.total_chunk_size == chunk_off:

@@ -84,6 +84,10 @@ class OSDService:
         return self._osd.ec_registry
 
     @property
+    def encode_batcher(self):
+        return self._osd.encode_batcher
+
+    @property
     def tracer(self):
         return self._osd.tracer
 
@@ -152,6 +156,15 @@ class OSD(Dispatcher):
                       "client read latency")
         self.perf.add("subop", description="replica/shard sub-ops")
         self.perf.add("recovery_ops", description="objects recovered")
+        self.perf.add("ec_batch_calls",
+                      description="batched EC encode device calls")
+        self.perf.add("ec_batch_stripes",
+                      description="stripes encoded through the batcher")
+        self.perf.add("ec_batch_coalesced",
+                      description="write ops that shared a device call")
+        # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
+        from .batcher import EncodeBatcher
+        self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf)
         self.op_tracker = OpTracker(
             slow_op_warn_threshold=self.conf["osd_op_complaint_time"])
         from ..utils.tracer import Tracer
@@ -184,6 +197,7 @@ class OSD(Dispatcher):
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.encode_batcher.stop()
         self._recovery_kick.set()
         for q in self._shard_queues:
             q.put(None)

@@ -169,6 +169,13 @@ class PG:
         with self.lock:
             fn()
 
+    @property
+    def encode_batcher(self):
+        """The OSD-wide cross-op encode coalescer (osd/batcher.py);
+        None under hosts without one (unit-test stubs) — the backend
+        then encodes synchronously."""
+        return getattr(self.service, "encode_batcher", None)
+
     def ec_profile(self) -> Dict[str, str]:
         prof = self.service.get_osdmap().erasure_code_profiles.get(
             self.pool.erasure_code_profile)

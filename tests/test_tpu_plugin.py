@@ -121,6 +121,38 @@ def test_gf8_xor_chain_bit_exact():
     assert np.array_equal(out, ref)
 
 
+def test_gf8_fast_path_forced_on_cpu(monkeypatch):
+    """Force the w=8 XOR-chain fast path on the CPU backend and run
+    the full plugin surface through it (encode, async encode, decode):
+    the flagship kernel must be bit-exact with jerasure even off-TPU,
+    so the suite — not just the bench — guards it."""
+    from ceph_tpu.ec.plugins import tpu as tpumod
+    be = tpumod.shared_backend()
+    monkeypatch.setattr(type(be), "gf8_fast_path", lambda self: True)
+    reg = ecreg.instance()
+    k, m = 4, 2
+    tpu = reg.factory("tpu", {"k": str(k), "m": str(m),
+                              "technique": "reed_sol_van"})
+    cpu = reg.factory("jerasure", {"k": str(k), "m": str(m),
+                                   "technique": "reed_sol_van"})
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (3, k, 256), dtype=np.uint8)
+    for b in range(3):
+        assert np.array_equal(tpu.core.encode(data[b]),
+                              cpu.core.encode(data[b]))
+    # async entry point takes the same forced path
+    parity = tpu.encode_batch(data)
+    for b in range(3):
+        assert np.array_equal(parity[b], cpu.core.encode(data[b]))
+    # decode with erasures through the plugin API
+    full = np.concatenate([data[0], cpu.core.encode(data[0])], axis=0)
+    chunks = {i: full[i].tobytes() for i in range(k + m)
+              if i not in (0, 3)}
+    dec = tpu.decode({0, 3}, chunks)
+    assert dec[0] == full[0].tobytes()
+    assert dec[3] == full[3].tobytes()
+
+
 def test_jit_cache_reused_across_instances():
     """Two codec instances with the same geometry share one backend
     (so jit caches are shared: the w=8 XOR-chain keys on the static
