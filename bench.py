@@ -1,30 +1,28 @@
 #!/usr/bin/env python
-"""North-star benchmark: EC encode throughput, TPU plugin vs the native
-CPU baseline (the stand-in for jerasure, whose SIMD kernels live in the
-reference's empty vendored submodules — see BASELINE.md).
+"""BASELINE benchmark suite: the five configs of BASELINE.md measured
+head-to-head against the CPU reference, one JSON line each.
 
 Reproduces the semantics of the reference's harness
-(src/test/erasure-code/ceph_erasure_code_benchmark.cc:156-185: throughput
-= object bytes processed / seconds) for the BASELINE.json config
-"Reed-Solomon k=8 m=4, batched stripes", and prints ONE JSON line:
+(src/test/erasure-code/ceph_erasure_code_benchmark.cc:156-185 encode,
+:251-317 decode: throughput = object bytes processed / seconds), the
+LRC layered config (src/erasure-code/lrc/ErasureCodeLrc.cc:215-247
+inner-plugin wiring), and the 3-OSD vstart `rados bench` + rebuild run
+(qa/standalone/erasure-code/test-erasure-code.sh:56-98).
 
-    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+Output: one JSON line per config, each
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+The NORTH-STAR line (encode k=8 m=4) prints LAST so a consumer that
+reads a single line gets the headline number.
 
-Boundary note.  The reference benchmark times encode() over buffers
-already in RAM — the codec-kernel boundary.  The TPU analog is
-HBM-resident encode (stripes staged in device memory, parity left in
-device memory), which is what `value` reports; that is the boundary the
-OSD batching layer amortizes to, since stripe batches stream through a
-double-buffered pipeline.  For transparency the metric string also
-reports the fully end-to-end pipelined number (host in -> host out,
-transfers overlapped with compute) and the measured host<->device link
-bandwidth of this environment: in this dev image the TPU sits behind a
-network tunnel whose device->host path runs at ~10-30 MiB/s, so the
-e2e figure measures that tunnel, not the codec (a co-located TPU host
-moves >10 GiB/s over PCIe/DMA and e2e approaches the HBM number).
-
-vs_baseline is the speedup of the TPU codec boundary over the native
-CPU kernel boundary measured head-to-head on this host (target >= 10x).
+Boundary note.  The codec-kernel configs time HBM-resident encodes and
+decodes as the SLOPE of n dependency-chained kernel applications inside
+one device program (lax.fori_loop): t(n2)-t(n1) isolates pure on-chip
+time from per-dispatch round trips, which through this image's network
+tunnel cost ~5 ms each and would otherwise be the thing measured.  The
+cluster config is honestly end-to-end in-process daemons; over the
+tunnel its write path pays h2d+d2h per op (a co-located TPU host moves
+>10 GiB/s over PCIe and loses that tax).  vs_baseline is always the
+same workload on the CPU reference on this host.
 """
 import argparse
 import json
@@ -49,136 +47,251 @@ def time_fn(fn, min_iters=3, min_time=2.0):
             return dt / iters
 
 
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--k", type=int, default=8)
-    ap.add_argument("--m", type=int, default=4)
-    ap.add_argument("--batch", type=int, default=64,
-                    help="stripes per device call")
-    ap.add_argument("--stripe-mib", type=float, default=1.0,
-                    help="stripe unit (k chunks) size in MiB")
-    ap.add_argument("--workload", choices=["encode", "decode"],
-                    default="encode")
-    ap.add_argument("--platform", default=None,
-                    help="force a JAX platform (e.g. cpu) for debugging")
-    args = ap.parse_args()
+def chain_slope(run_chain, n1=64, n2=576, trials=5):
+    """Median per-iteration time of a device-resident chain."""
+    def t(n):
+        t0 = time.perf_counter()
+        out = run_chain(n)
+        _ = np.asarray(out)              # 1-byte fetch forces the chain
+        return time.perf_counter() - t0
 
-    if args.platform:
-        import jax
-        jax.config.update("jax_platforms", args.platform)
+    t(n1)                                # compile both shapes
+    t(n2)
+    slopes = []
+    for _ in range(trials):
+        d1, d2 = t(n1), t(n2)
+        s = (d2 - d1) / (n2 - n1)
+        if s > 0:
+            slopes.append(s)
+    slopes.sort()
+    if slopes:
+        return slopes[len(slopes) // 2]
+    return t(n2) / n2                    # clock-noise fallback
 
+
+def emit(metric, value, unit, vs_baseline):
+    print(json.dumps({"metric": metric, "value": round(value, 3),
+                      "unit": unit,
+                      "vs_baseline": round(vs_baseline, 3)}),
+          flush=True)
+
+
+def cpu_matrix_baseline(k, m, data):
+    """Native C++ kernel (SSSE3 split-table, jerasure-class) on the
+    same buffers; numpy if the toolchain is unavailable."""
+    from ceph_tpu.ops import native
+    from ceph_tpu.ops.matrix import reed_sol_vandermonde_coding_matrix
+    M = reed_sol_vandermonde_coding_matrix(k, m, 8)
+    try:
+        nb = native.NativeBackend()
+        name = "native-c++"
+        fn = lambda: nb.apply_matrix(M, data, 8)       # noqa: E731
+    except RuntimeError:
+        from ceph_tpu.ops.engine import NumpyBackend
+        nb2 = NumpyBackend()
+        name = "numpy"
+        fn = lambda: nb2.apply_matrix(M, data, 8)      # noqa: E731
+    return name, time_fn(fn, min_iters=2, min_time=1.0)
+
+
+# ---------------------------------------------------------------------------
+# configs
+# ---------------------------------------------------------------------------
+
+def bench_encode_rs(k, m, stripe_bytes, batch, headline=False):
+    """BASELINE configs 1 + 2: RS-Vandermonde encode at the codec
+    boundary (chain slope), CPU kernel head-to-head."""
     import jax
 
     from ceph_tpu.ec import registry as ecreg
-    from ceph_tpu.ops import native
 
-    k, m = args.k, args.m
-    L = int(args.stripe_mib * 2**20) // k
-    L = (L // 128) * 128
-    batch = args.batch
+    L = (stripe_bytes // k // 128) * 128
     rng = np.random.default_rng(0)
     data = rng.integers(0, 256, (batch, k, L), dtype=np.uint8)
     gib = data.nbytes / 2**30
+    tpu = ecreg.instance().factory(
+        "tpu", {"k": str(k), "m": str(m), "technique": "reed_sol_van"})
 
-    reg = ecreg.instance()
-    profile = {"k": str(k), "m": str(m), "technique": "reed_sol_van"}
-    tpu = reg.factory("tpu", dict(profile))
-
-    # -- link bandwidth probes (environment characterization) -------------
     t0 = time.perf_counter()
-    dev_data, real_batch, real_L = tpu.stage_batch(data)
-    h2d_mibs = data.nbytes / 2**20 / (time.perf_counter() - t0)
+    dev_data, _, _ = tpu.stage_batch(data)
+    h2d = data.nbytes / 2**20 / (time.perf_counter() - t0)
     parity_dev = tpu.encode_batch_device(dev_data)
     parity_dev.block_until_ready()
     t0 = time.perf_counter()
-    parity_host = np.asarray(parity_dev)
-    d2h_mibs = parity_dev.nbytes / 2**20 / (time.perf_counter() - t0)
-    # device output is bucket-padded; trim to the logical shape
-    parity_host = parity_host[:real_batch, :, :real_L]
+    _ = np.asarray(parity_dev)
+    d2h = parity_dev.nbytes / 2**20 / (time.perf_counter() - t0)
 
-    if args.workload == "encode":
-        # codec-kernel boundary: HBM-resident, like the reference's
-        # in-RAM encode loop.  Measured as the SLOPE of n dependency-
-        # chained encodes executed inside one device program
-        # (lax.fori_loop): t(n2) - t(n1) isolates pure on-chip encode
-        # time from per-dispatch round trips — through this image's
-        # network tunnel a dispatch costs ~5ms, which would otherwise
-        # be the thing measured.  The OSD batching layer similarly
-        # streams encodes without per-call sync.
-        # spread the chain lengths far enough apart that the encode
-        # signal (hundreds of chained iterations) dominates network
-        # jitter on the dispatch/fetch, and take the MEDIAN slope of
-        # several trials
-        N1, N2 = 64, 576
-
-        def chain_time(n: int) -> float:
-            t0 = time.perf_counter()
-            out = tpu.encode_chain_device(dev_data, n)
-            _ = np.asarray(out)          # 1-byte fetch forces the chain
-            return time.perf_counter() - t0
-
-        chain_time(N1)                   # compile
-        chain_time(N2)
-        slopes = []
-        for _ in range(5):
-            t1, t2 = chain_time(N1), chain_time(N2)
-            slope = (t2 - t1) / (N2 - N1)
-            if slope > 0:
-                slopes.append(slope)
-        slopes.sort()
-        if slopes:
-            tpu_s = slopes[len(slopes) // 2]
-        else:
-            # degenerate (clock noise swamped the chain): fall back to
-            # one whole-chain average rather than crashing
-            tpu_s = chain_time(N2) / N2
-
-        # fully end-to-end, double-buffered (reported in metric string)
+    tpu_s = chain_slope(lambda n: tpu.encode_chain_device(dev_data, n))
+    base_name, cpu_s = cpu_matrix_baseline(k, m, data)
+    value = gib / tpu_s
+    baseline = gib / cpu_s
+    dev = jax.devices()[0].platform
+    extra = ""
+    if headline:
+        # fully end-to-end, double-buffered (context for the headline)
         data2 = rng.integers(0, 256, (batch, k, L), dtype=np.uint8)
-        def e2e_pipelined():
+
+        def e2e():
             a = tpu.encode_batch_async(data)
             b = tpu.encode_batch_async(data2)
             a.wait()
             b.wait()
-        e2e_s = time_fn(e2e_pipelined, min_iters=2, min_time=1.0) / 2
-        e2e_gibs = gib / e2e_s
-    else:
-        present = {i: data[:, i] for i in range(2, k)}
-        present.update(
-            {k + i: parity_host[:, i] for i in range(m)})
-        tpu_s = time_fn(lambda: tpu.decode_batch(present, L))
-        e2e_gibs = gib / tpu_s
+        e2e_gibs = gib / (time_fn(e2e, min_iters=2, min_time=1.0) / 2)
+        extra = (f"; e2e-pipelined {e2e_gibs:.3f} GiB/s over a tunnel "
+                 f"link h2d {h2d:.0f} MiB/s d2h {d2h:.0f} MiB/s")
+    emit(f"EC encode GiB/s at the codec boundary (plugin=tpu "
+         f"reed_sol_van k={k} m={m}, {L * k // 1024} KiB stripes "
+         f"x{batch}, hbm-resident, device={dev}, "
+         f"baseline={base_name} {baseline:.2f} GiB/s{extra})",
+         value, "GiB/s", value / baseline)
 
-    # CPU baseline: native C++ kernel (SSSE3 split-table, jerasure-class);
-    # falls back to numpy if the toolchain is unavailable.
-    from ceph_tpu.ops.matrix import reed_sol_vandermonde_coding_matrix
-    M = reed_sol_vandermonde_coding_matrix(k, m, 8)
-    baseline_name = "native-c++"
-    try:
-        nb = native.NativeBackend()
-        cpu_fn = lambda: nb.apply_matrix(M, data, 8)  # noqa: E731
-    except RuntimeError:
-        from ceph_tpu.ops.engine import NumpyBackend
-        nb2 = NumpyBackend()
-        baseline_name = "numpy"
-        cpu_fn = lambda: nb2.apply_matrix(M, data, 8)  # noqa: E731
-    cpu_s = time_fn(cpu_fn, min_iters=2, min_time=1.0)
 
+def bench_decode_cauchy(k=10, m=4, stripe_bytes=4 << 20, batch=4,
+                        n_erasures=3):
+    """BASELINE config 3: cauchy_good decode with erasures, runtime
+    inverse rows (the OSD recovery path), CPU decode head-to-head."""
+    import jax
+
+    from ceph_tpu.ec import registry as ecreg
+
+    prof = {"k": str(k), "m": str(m), "technique": "cauchy_good"}
+    tpu = ecreg.instance().factory("tpu", dict(prof))
+    quantum = tpu.core.chunk_size_multiple()
+    L = (stripe_bytes // k // quantum) * quantum
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (batch, k, L), dtype=np.uint8)
+    parity = tpu.encode_batch(data)
+
+    erased = list(range(n_erasures))             # data chunks 0..e-1
+    chosen = [i for i in range(k + m)
+              if i not in erased][:k]
+    stack = np.stack(
+        [data[:, i] if i < k else parity[:, i - k] for i in chosen],
+        axis=1)
+    dev_stack, _, _ = tpu.stage_batch(stack)
+    tpu_s = chain_slope(
+        lambda n: tpu.decode_chain_device(dev_stack, n, chosen, erased),
+        n1=16, n2=144)
+
+    # CPU reference: same decode through the jerasure plugin's core
+    cpu = ecreg.instance().factory("jerasure", dict(prof))
+    present = {c: (data[:, c] if c < k else parity[:, c - k])
+               for c in chosen}
+    cpu_s = time_fn(lambda: cpu.core.decode_chunks(present, L),
+                    min_iters=2, min_time=1.0)
+
+    gib = batch * k * L / 2**30          # logical object bytes, as the
+    value = gib / tpu_s                  # reference benchmark counts
+    baseline = gib / cpu_s
     dev = jax.devices()[0].platform
+    emit(f"EC decode GiB/s at the codec boundary (plugin=tpu "
+         f"cauchy_good k={k} m={m}, {k * L >> 20} MiB stripes "
+         f"x{batch}, {n_erasures} data erasures, runtime inverse "
+         f"rows, device={dev}, baseline=jerasure-cpu "
+         f"{baseline:.2f} GiB/s)", value, "GiB/s", value / baseline)
+
+
+def bench_lrc(k=4, m=2, l3=3, obj_bytes=1 << 20):
+    """BASELINE config 4: layered LRC with inner=tpu vs inner=jerasure,
+    through the plugin's host-boundary encode API."""
+    from ceph_tpu.ec import registry as ecreg
+
+    reg = ecreg.instance()
+    prof = {"k": str(k), "m": str(m), "l": str(l3)}
+    tpu = reg.factory("lrc", dict(prof, inner="tpu"))
+    cpu = reg.factory("lrc", dict(prof))
+    n = tpu.get_chunk_count()
+    data = os.urandom(obj_bytes)
+    tpu_s = time_fn(lambda: tpu.encode(set(range(n)), data),
+                    min_iters=2, min_time=1.0)
+    cpu_s = time_fn(lambda: cpu.encode(set(range(n)), data),
+                    min_iters=2, min_time=1.0)
+    gib = obj_bytes / 2**30
     value = gib / tpu_s
     baseline = gib / cpu_s
-    print(json.dumps({
-        "metric": (f"EC {args.workload} GiB/s at the codec boundary "
-                   f"(plugin=tpu reed_sol_van k={k} m={m}, "
-                   f"{args.stripe_mib:g}MiB stripes x{batch}, hbm-resident, "
-                   f"device={dev}, baseline={baseline_name} "
-                   f"{baseline:.2f} GiB/s; e2e-pipelined "
-                   f"{e2e_gibs:.3f} GiB/s over a tunnel link h2d "
-                   f"{h2d_mibs:.0f} MiB/s d2h {d2h_mibs:.0f} MiB/s)"),
-        "value": round(value, 3),
-        "unit": "GiB/s",
-        "vs_baseline": round(value / baseline, 3),
-    }))
+    emit(f"LRC encode GiB/s host-boundary (plugin=lrc k={k} m={m} "
+         f"l={l3} inner=tpu, {obj_bytes >> 20} MiB objects, "
+         f"baseline=inner-jerasure {baseline:.3f} GiB/s)",
+         value, "GiB/s", value / baseline)
+
+
+def _cluster_run(plugin, n_objs, obj_bytes):
+    """One 3-OSD vstart-style run: write MB/s + rebuild MB/s."""
+    from ceph_tpu.cluster import Cluster, test_config
+
+    with Cluster(n_osds=3, conf=test_config()) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("bench", plugin=plugin, k="2", m="1")
+        c.create_pool("benchp", "erasure",
+                      erasure_code_profile="bench")
+        io = c.rados().open_ioctx("benchp")
+        blob = os.urandom(obj_bytes)
+        t0 = time.perf_counter()
+        comps = [io.aio_write_full(f"b{i}", blob)
+                 for i in range(n_objs)]
+        assert all(comp.wait(60) == 0 for comp in comps)
+        write_s = time.perf_counter() - t0
+        c.wait_for_clean(30)
+        c.kill_osd(2, lose_data=True)
+        c.wait_for_osd_down(2)
+        c.revive_osd(2)
+        c.wait_for_osd_up(2)
+        t0 = time.perf_counter()
+        c.wait_for_clean(120)
+        rebuild_s = time.perf_counter() - t0
+        total_mb = n_objs * obj_bytes / 2**20
+        return total_mb / write_s, total_mb / rebuild_s
+
+
+def bench_cluster(n_objs=8, obj_bytes=4 << 20):
+    """BASELINE config 5: 3-OSD cluster, plugin=tpu pool, 4 MiB
+    `rados bench`-style writes + OSD-down rebuild, vs plugin=jerasure
+    on the same host."""
+    w_tpu, r_tpu = _cluster_run("tpu", n_objs, obj_bytes)
+    w_cpu, r_cpu = _cluster_run("jerasure", n_objs, obj_bytes)
+    emit(f"cluster write MB/s (3-OSD vstart, pool plugin=tpu k=2 m=1, "
+         f"{n_objs}x{obj_bytes >> 20} MiB rados-bench-style writes, "
+         f"in-process daemons; over this image's device tunnel each "
+         f"op pays h2d+d2h; baseline=plugin-jerasure "
+         f"{w_cpu:.1f} MB/s)", w_tpu, "MB/s", w_tpu / w_cpu)
+    emit(f"OSD rebuild MB/s (kill osd with data loss, revive empty, "
+         f"time to active+clean; pool plugin=tpu k=2 m=1; "
+         f"baseline=plugin-jerasure {r_cpu:.1f} MB/s)",
+         r_tpu, "MB/s", r_tpu / r_cpu)
+
+
+CONFIGS = {
+    "rs_k2m1": lambda: bench_encode_rs(2, 1, 4 << 10, 1024),
+    "decode": bench_decode_cauchy,
+    "lrc": bench_lrc,
+    "cluster": bench_cluster,
+    # NORTH STAR last: a single-line consumer reads this one
+    "headline": lambda: bench_encode_rs(8, 4, 1 << 20, 64,
+                                        headline=True),
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--only", choices=sorted(CONFIGS), default=None,
+                    help="run a single config")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX platform (e.g. cpu)")
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+
+    names = [args.only] if args.only else list(CONFIGS)
+    for name in names:
+        try:
+            CONFIGS[name]()
+        except Exception as e:  # one failed config must not mute the rest
+            if name == "headline":
+                raise
+            print(f"# bench config {name} failed: {e!r}",
+                  file=sys.stderr, flush=True)
 
 
 if __name__ == "__main__":

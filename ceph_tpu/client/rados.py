@@ -27,7 +27,7 @@ import time
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..mon.client import MonClient
-from ..msg.messages import MOSDOp, MOSDOpReply, OSDOp
+from ..msg.messages import MOSDOp, MOSDOpReply, MWatchNotify, OSDOp
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..osd.osdmap import OSDMap, PGid
 from ..utils.config import Config, default_config
@@ -80,6 +80,8 @@ class _InflightOp:
         self.target_osd: Optional[int] = None
         self.sent_epoch = 0
         self.trace_id = 0
+        self.snapc: Tuple[int, List[int]] = (0, [])  # write SnapContext
+        self.snapid = 0                # read snap (0 = head)
 
 
 class Objecter(Dispatcher):
@@ -96,6 +98,11 @@ class Objecter(Dispatcher):
         self.map_ready = threading.Event()
         self._next_tid = 0
         self.inflight: Dict[int, _InflightOp] = {}
+        # lingering registrations (reference Objecter linger ops):
+        # re-sent whenever the target moves — the watch machinery
+        self.lingers: Dict[int, _InflightOp] = {}
+        # (pool, oid, cookie) -> callback(notifier, payload)
+        self.watch_callbacks: Dict[Tuple[int, str, int], Callable] = {}
         self._osd_conns: Dict[int, Connection] = {}
         msgr.add_dispatcher(self)
 
@@ -116,13 +123,23 @@ class Objecter(Dispatcher):
             target = self._target_of(op)
             if target != op.target_osd:
                 self._send_op(op)
+        # lingers re-register on EVERY new map, even when the target
+        # primary is unchanged: any interval change (a replica dying)
+        # wipes the PG's volatile watcher registry on that same
+        # primary, so "target moved" is not the right trigger
+        with self.lock:
+            lingers = list(self.lingers.values())
+        for op in lingers:
+            self._send_op(op)
 
     # ------------------------------------------------------------------
     # op submission (reference op_submit :2263)
     # ------------------------------------------------------------------
     def submit(self, pool: int, oid: str, ops: List[OSDOp],
                pgid_seed: Optional[int] = None,
-               trace_id: int = 0) -> Completion:
+               trace_id: int = 0,
+               snapc: Tuple[int, List[int]] = (0, []),
+               snapid: int = 0) -> Completion:
         with self.lock:
             self._next_tid += 1
             tid = self._next_tid
@@ -130,6 +147,8 @@ class Objecter(Dispatcher):
             op = _InflightOp(tid, pool, oid, ops, completion,
                              pgid_seed=pgid_seed)
             op.trace_id = trace_id
+            op.snapc = snapc
+            op.snapid = snapid
             self.inflight[tid] = op
         self._send_op(op)
         return completion
@@ -173,7 +192,9 @@ class Objecter(Dispatcher):
         conn.send_message(MOSDOp(
             client=self.msgr.name, tid=op.tid, epoch=osdmap.epoch,
             pool=op.pool, oid=op.oid, ops=op.ops,
-            pgid_seed=pgid.seed, trace_id=op.trace_id))
+            pgid_seed=pgid.seed, trace_id=op.trace_id,
+            snap_seq=op.snapc[0], snaps=list(op.snapc[1]),
+            snapid=op.snapid))
 
     def _fail_op(self, op: _InflightOp, result: int) -> None:
         with self.lock:
@@ -184,6 +205,9 @@ class Objecter(Dispatcher):
     # replies + resets
     # ------------------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MWatchNotify):
+            self._handle_watch_notify(msg)
+            return True
         if not isinstance(msg, MOSDOpReply):
             return False
         with self.lock:
@@ -201,6 +225,27 @@ class Objecter(Dispatcher):
         op.completion._complete(msg)
         return True
 
+    def linger_submit(self, pool: int, oid: str,
+                      ops: List[OSDOp]) -> Tuple[int, Completion]:
+        """Submit an op that stays registered (reference
+        Objecter::linger_register): re-sent on every map change that
+        moves the target and on session reset, so server-side volatile
+        registrations (watch) survive failover.  Linger ops must be
+        read-class (re-execution is their point)."""
+        with self.lock:
+            self._next_tid += 1
+            tid = self._next_tid
+            completion = Completion(self, tid)
+            op = _InflightOp(tid, pool, oid, ops, completion)
+            self.inflight[tid] = op
+            self.lingers[tid] = op
+        self._send_op(op)
+        return tid, completion
+
+    def linger_cancel(self, linger_id: int) -> None:
+        with self.lock:
+            self.lingers.pop(linger_id, None)
+
     def ms_handle_reset(self, conn: Connection) -> None:
         """Lossy OSD session died: resend everything targeted at it
         (reference Objecter::ms_handle_reset)."""
@@ -211,9 +256,32 @@ class Objecter(Dispatcher):
                 del self._osd_conns[osd]
             resend = [op for op in self.inflight.values()
                       if op.target_osd in dead]
+            resend += [op for op in self.lingers.values()
+                       if op.target_osd in dead
+                       and op.tid not in self.inflight]
         for op in resend:
             # the target may be freshly down; refresh then resend
             threading.Timer(0.1, self._send_op, args=(op,)).start()
+
+    def _handle_watch_notify(self, msg: MWatchNotify) -> None:
+        """A notify arrived for one of our watches: run the callback
+        off the dispatch thread, then ack so the notifier completes
+        (reference librados WatchContext + notify_ack)."""
+        cb = self.watch_callbacks.get((msg.pool, msg.oid, msg.cookie))
+        if cb is None:
+            return
+
+        def run():
+            try:
+                cb(msg.notifier, msg.payload)
+            except Exception:
+                pass
+            # cookie rides in length so the ack names the exact watch
+            self.submit(msg.pool, msg.oid, [OSDOp(
+                "notify_ack", offset=msg.notify_id,
+                length=msg.cookie)])
+        threading.Thread(target=run, daemon=True,
+                         name="watch-notify-cb").start()
 
     def wait_for_map(self, timeout: float = 10.0) -> None:
         if not self.map_ready.wait(timeout):
@@ -227,16 +295,40 @@ class IoCtx:
         self.rados = rados
         self.pool_id = pool_id
         self.pool_name = pool_name
+        # selfmanaged write SnapContext; None = derive from pool snaps
+        # (reference librados snapc handling, IoCtxImpl snapc member)
+        self._snapc: Optional[Tuple[int, List[int]]] = None
+        self._read_snap = 0            # snap_set_read target (0 = head)
+        self._watch_lingers: Dict[Tuple[str, int], int] = {}
 
     # -- internals ---------------------------------------------------------
+    def _write_snapc(self) -> Tuple[int, List[int]]:
+        """SnapContext for writes: the selfmanaged one when set, else
+        the pool's implicit context (pool snaps — reference IoCtxImpl
+        uses the pool's snap_seq/snaps unless selfmanaged)."""
+        if self._snapc is not None:
+            return self._snapc
+        with self.rados.objecter.lock:
+            pool = self.rados.objecter.osdmap.pools.get(self.pool_id)
+        if pool is None or not pool.pool_snaps:
+            return (0, [])
+        removed = set(pool.removed_snaps)
+        live = sorted((s for s in pool.pool_snaps.values()
+                       if s not in removed), reverse=True)
+        return (pool.snap_seq, live)
+
     def _obj_op(self, oid: str, ops: List[OSDOp],
                 timeout: Optional[float] = None) -> MOSDOpReply:
         timeout = timeout or self.rados.op_timeout
         span = self.rados.tracer.maybe_start("rados_op") \
             if self.rados.tracer else None
+        from ..osd.pg import WRITE_OPS
+        is_write = any(o.op in WRITE_OPS for o in ops)
         c = self.rados.objecter.submit(
             self.pool_id, oid, ops,
-            trace_id=span.trace_id if span else 0)
+            trace_id=span.trace_id if span else 0,
+            snapc=self._write_snapc() if is_write else (0, []),
+            snapid=0 if is_write else self._read_snap)
         try:
             res = c.wait(timeout)
         finally:
@@ -288,6 +380,119 @@ class IoCtx:
         reply = self._obj_op(oid, [OSDOp("call", name=f"{cls}.{method}",
                                          data=indata)])
         return reply.out_data[0] if reply.out_data else b""
+
+    # -- snapshots (reference librados snap API) ---------------------------
+    def set_snap_context(self, seq: int, snaps: List[int]) -> None:
+        """Selfmanaged SnapContext for subsequent writes (reference
+        rados_ioctx_selfmanaged_snap_set_write_ctx): ``snaps`` newest
+        first."""
+        self._snapc = (seq, list(snaps))
+
+    def snap_set_read(self, snapid: int) -> None:
+        """Subsequent reads observe this snap; 0 = head (reference
+        rados_ioctx_snap_set_read)."""
+        self._read_snap = snapid
+
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a new snap id from the pool (reference
+        rados_ioctx_selfmanaged_snap_create)."""
+        ret, rs, out = self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap create",
+             "pool": self.pool_name})
+        if ret != 0:
+            raise RadosError(-ret, rs)
+        return out["snapid"]
+
+    def selfmanaged_snap_remove(self, snapid: int) -> None:
+        """Delete a snap id; OSDs trim its clones (reference
+        rados_ioctx_selfmanaged_snap_remove)."""
+        ret, rs, _ = self.rados.mon_command(
+            {"prefix": "osd pool selfmanaged-snap rm",
+             "pool": self.pool_name, "snapid": snapid})
+        if ret != 0:
+            raise RadosError(-ret, rs)
+
+    def selfmanaged_snap_rollback(self, oid: str, snapid: int) -> None:
+        """Roll one object back to its state at ``snapid`` (reference
+        rados_ioctx_selfmanaged_snap_rollback)."""
+        self._obj_op(oid, [OSDOp("rollback", offset=snapid)])
+
+    def create_snap(self, name: str) -> None:
+        """Pool-wide named snapshot (reference rados_ioctx_snap_create
+        -> mksnap)."""
+        ret, rs, _ = self.rados.mon_command(
+            {"prefix": "osd pool mksnap", "pool": self.pool_name,
+             "snap": name})
+        if ret != 0:
+            raise RadosError(-ret, rs)
+
+    def remove_snap(self, name: str) -> None:
+        ret, rs, _ = self.rados.mon_command(
+            {"prefix": "osd pool rmsnap", "pool": self.pool_name,
+             "snap": name})
+        if ret != 0:
+            raise RadosError(-ret, rs)
+
+    def lookup_snap(self, name: str) -> int:
+        with self.rados.objecter.lock:
+            pool = self.rados.objecter.osdmap.pools.get(self.pool_id)
+        if pool is None or name not in pool.pool_snaps:
+            raise RadosError(2, f"no snap {name!r}")
+        return pool.pool_snaps[name]
+
+    def list_snaps(self, oid: str) -> Dict:
+        """Clone inventory of one object (reference
+        rados_ioctx_snap_list / LIST_SNAPS op)."""
+        reply = self._obj_op(oid, [OSDOp("list_snaps")])
+        return reply.extra["snaps"]
+
+    # -- watch/notify (reference rados_watch3 / rados_notify2) -------------
+    def watch(self, oid: str, callback: Callable[[str, bytes], None]
+              ) -> int:
+        """Register interest in ``oid``: ``callback(notifier_name,
+        payload)`` fires on every notify.  -> cookie for unwatch.
+        Survives primary failover (lingering registration)."""
+        objecter = self.rados.objecter
+        with objecter.lock:
+            cookie = len(objecter.watch_callbacks) + 1
+            while (self.pool_id, oid, cookie) in                     objecter.watch_callbacks:
+                cookie += 1
+            objecter.watch_callbacks[(self.pool_id, oid, cookie)] =                 callback
+        lid, c = objecter.linger_submit(
+            self.pool_id, oid, [OSDOp("watch", offset=cookie)])
+        res = c.wait(self.rados.op_timeout)
+        if res < 0:
+            objecter.linger_cancel(lid)
+            with objecter.lock:
+                objecter.watch_callbacks.pop(
+                    (self.pool_id, oid, cookie), None)
+            raise RadosError(-res, f"watch {oid!r}: {res}")
+        self._watch_lingers[(oid, cookie)] = lid
+        return cookie
+
+    def unwatch(self, oid: str, cookie: int) -> None:
+        objecter = self.rados.objecter
+        lid = self._watch_lingers.pop((oid, cookie), None)
+        if lid is not None:
+            objecter.linger_cancel(lid)
+        with objecter.lock:
+            objecter.watch_callbacks.pop(
+                (self.pool_id, oid, cookie), None)
+        self._obj_op(oid, [OSDOp("unwatch", offset=cookie)])
+
+    def notify(self, oid: str, payload: bytes = b"",
+               timeout_ms: int = 5000) -> Dict:
+        """Notify every watcher; blocks until all acked or timeout.
+        -> {"acks": [client names], "timed_out": [...]}."""
+        reply = self._obj_op(
+            oid, [OSDOp("notify", offset=timeout_ms, data=payload)],
+            timeout=timeout_ms / 1000.0 + self.rados.op_timeout)
+        return {"acks": reply.extra.get("acks", []),
+                "timed_out": reply.extra.get("timed_out", [])}
+
+    def list_watchers(self, oid: str) -> List[str]:
+        reply = self._obj_op(oid, [OSDOp("list_watchers")])
+        return reply.extra.get("watchers", [])
 
     # -- read class --------------------------------------------------------
     def read(self, oid: str, length: int = 0, offset: int = 0) -> bytes:
@@ -348,13 +553,15 @@ class IoCtx:
     # -- async forms (reference aio_*) -------------------------------------
     def aio_write_full(self, oid: str, data: bytes) -> Completion:
         return self.rados.objecter.submit(
-            self.pool_id, oid, [OSDOp("writefull", data=data)])
+            self.pool_id, oid, [OSDOp("writefull", data=data)],
+            snapc=self._write_snapc())
 
     def aio_read(self, oid: str, length: int = 0,
                  offset: int = 0) -> Completion:
         return self.rados.objecter.submit(
             self.pool_id, oid,
-            [OSDOp("read", offset=offset, length=length)])
+            [OSDOp("read", offset=offset, length=length)],
+            snapid=self._read_snap)
 
 
 class Rados:

@@ -133,6 +133,53 @@ class TpuCodecMixin:
             _CHAIN_CACHE[key] = chain
         return chain(dev_data, n)
 
+    def decode_chain_device(self, dev_stack, n: int, chosen,
+                            data_erased):
+        """Benchmark analog of encode_chain_device for the DECODE
+        path: ``n`` dependency-chained reconstructions of
+        ``data_erased`` from the staged ``chosen`` chunk stack
+        ``[B, len(chosen), L]`` in one device program.  Decode rows
+        arrive as runtime arguments exactly like the OSD recovery
+        path (per-erasure-signature inverse, cached host-side like
+        ISA-L's table cache — reference
+        isa/ErasureCodeIsaTableCache.cc)."""
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        core = self.core
+        rows_gf, rows_bits = core._decode_rows(tuple(chosen),
+                                               tuple(data_erased))
+        key = ("dec", rows_bits.tobytes(), core.w, core.layout,
+               core.packetsize)
+        chain = _CHAIN_CACHE.get(key)
+        if chain is None:
+            from ...ops import jax_engine as je
+            Bdev = core.backend._device_matrix(rows_bits)
+            w, layout, ps = core.w, core.layout, core.packetsize
+
+            @functools.partial(jax.jit, static_argnames=("n",))
+            def chain(d, n):
+                def body(i, carry):
+                    d0, tick = carry
+                    if layout == "byte":
+                        p = je._apply_byte_domain.__wrapped__(Bdev, d0,
+                                                              w)
+                    else:
+                        p = je._apply_packet_domain.__wrapped__(
+                            Bdev, d0, w, ps)
+                    d0 = d0.at[0, 0, 0].set(
+                        p[0, 0, 0] ^ i.astype(p.dtype))
+                    return (d0, tick ^ p[0, 0, 0])
+                _, tick = lax.fori_loop(0, n, body,
+                                        (d, jnp.uint8(0)))
+                return tick
+
+            _CHAIN_CACHE[key] = chain
+        return chain(dev_stack, n)
+
     def stage_batch(self, data: np.ndarray):
         """Transfer a stripe batch to device HBM ahead of encode."""
         data = np.asarray(data, dtype=np.uint8)

@@ -728,6 +728,81 @@ class Monitor(Dispatcher):
             self._commit(inc)
         return (0, "set", {})
 
+    def _cmd_snap_create(self, cmd: dict):
+        """osd pool selfmanaged-snap create <pool> -> new snap id
+        (reference OSDMonitor prepare_pool_op SELFMANAGED_SNAP_CREATE:
+        allocates from the pool's snap_seq)."""
+        with self.lock:
+            pool = self.osdmap.get_pool(cmd["pool"])
+            if pool is None:
+                return (-2, f"no pool {cmd['pool']}", {})
+            import copy as _copy
+            newpool = _copy.deepcopy(pool)
+            newpool.snap_seq += 1
+            inc = self._pending()
+            inc.new_pools[pool.pool_id] = newpool
+            self._commit(inc)
+            return (0, "", {"snapid": newpool.snap_seq})
+
+    def _cmd_snap_rm(self, cmd: dict):
+        """osd pool selfmanaged-snap rm <pool> <snapid> (reference
+        SELFMANAGED_SNAP_DELETE -> pool removed_snaps; OSDs trim)."""
+        with self.lock:
+            pool = self.osdmap.get_pool(cmd["pool"])
+            if pool is None:
+                return (-2, f"no pool {cmd['pool']}", {})
+            snapid = int(cmd["snapid"])
+            if snapid <= 0 or snapid > pool.snap_seq:
+                return (-2, f"no snap {snapid}", {})
+            import copy as _copy
+            newpool = _copy.deepcopy(pool)
+            if snapid not in newpool.removed_snaps:
+                newpool.removed_snaps.append(snapid)
+                newpool.removed_snaps.sort()
+            inc = self._pending()
+            inc.new_pools[pool.pool_id] = newpool
+            self._commit(inc)
+            return (0, f"removed snap {snapid}", {})
+
+    def _cmd_pool_mksnap(self, cmd: dict):
+        """osd pool mksnap <pool> <snapname> (reference
+        prepare_pool_op CREATE_SNAP — pool-wide named snaps)."""
+        with self.lock:
+            pool = self.osdmap.get_pool(cmd["pool"])
+            if pool is None:
+                return (-2, f"no pool {cmd['pool']}", {})
+            name = cmd["snap"]
+            if name in pool.pool_snaps:
+                return (-17, f"snap {name} exists", {})
+            import copy as _copy
+            newpool = _copy.deepcopy(pool)
+            newpool.snap_seq += 1
+            newpool.pool_snaps[name] = newpool.snap_seq
+            inc = self._pending()
+            inc.new_pools[pool.pool_id] = newpool
+            self._commit(inc)
+            return (0, f"created pool snap {name}",
+                    {"snapid": newpool.snap_seq})
+
+    def _cmd_pool_rmsnap(self, cmd: dict):
+        with self.lock:
+            pool = self.osdmap.get_pool(cmd["pool"])
+            if pool is None:
+                return (-2, f"no pool {cmd['pool']}", {})
+            name = cmd["snap"]
+            if name not in pool.pool_snaps:
+                return (-2, f"no snap {name}", {})
+            import copy as _copy
+            newpool = _copy.deepcopy(pool)
+            snapid = newpool.pool_snaps.pop(name)
+            if snapid not in newpool.removed_snaps:
+                newpool.removed_snaps.append(snapid)
+                newpool.removed_snaps.sort()
+            inc = self._pending()
+            inc.new_pools[pool.pool_id] = newpool
+            self._commit(inc)
+            return (0, f"removed pool snap {name}", {})
+
     def _cmd_pool_delete(self, cmd: dict):
         with self.lock:
             pool = self.osdmap.get_pool(cmd["pool"])
@@ -933,6 +1008,10 @@ class Monitor(Dispatcher):
         "osd pool set": _cmd_pool_set,
         "osd pool delete": _cmd_pool_delete,
         "osd pool ls": _cmd_pool_ls,
+        "osd pool selfmanaged-snap create": _cmd_snap_create,
+        "osd pool selfmanaged-snap rm": _cmd_snap_rm,
+        "osd pool mksnap": _cmd_pool_mksnap,
+        "osd pool rmsnap": _cmd_pool_rmsnap,
         "osd out": _cmd_osd_out,
         "osd in": _cmd_osd_in,
         "osd down": _cmd_osd_down,

@@ -72,7 +72,10 @@ def encode_frame(msg: Message, compressor=None,
     mtype = msg.TYPE
     if compressor is not None and len(payload) >= compress_min:
         comp = compressor.compress(payload)
-        if len(comp) + 1 < len(payload):
+        # require a REAL win, not a few bytes: a sub-percent size edge
+        # is not worth the receiver's decompress cost (reference's
+        # required-ratio idea, e.g. compression_required_ratio)
+        if len(comp) + 1 < len(payload) - (len(payload) >> 3):
             payload = bytes([compressor.numeric_id]) + comp
             mtype |= COMPRESSED_FLAG
     head = _PREAMBLE.pack(FRAME_MAGIC, mtype, msg.seq, len(payload))

@@ -97,7 +97,8 @@ class MOSDOp(Message):
                  pool: int = 0, oid: str = "",
                  ops: Optional[List[OSDOp]] = None,
                  pgid_seed: int = 0, flags: int = 0,
-                 trace_id: int = 0):
+                 trace_id: int = 0, snap_seq: int = 0,
+                 snaps: Optional[List[int]] = None, snapid: int = 0):
         super().__init__()
         self.client = client
         self.tid = tid
@@ -108,12 +109,17 @@ class MOSDOp(Message):
         self.pgid_seed = pgid_seed
         self.flags = flags
         self.trace_id = trace_id     # blkin-style trace context (0=off)
+        # write SnapContext (reference MOSDOp snapc) + read snap
+        self.snap_seq = snap_seq
+        self.snaps = snaps or []
+        self.snapid = snapid         # 0 = head (reference CEPH_NOSNAP)
 
     def encode_payload(self) -> bytes:
         e = Encoder()
         e.str(self.client).u64(self.tid).u32(self.epoch)
         e.i64(self.pool).str(self.oid).u32(self.pgid_seed)
         e.u32(self.flags).u64(self.trace_id)
+        e.u64(self.snap_seq).i64_list(self.snaps).u64(self.snapid)
         e.u32(len(self.ops))
         for op in self.ops:
             op.encode(e)
@@ -125,6 +131,9 @@ class MOSDOp(Message):
         m = cls(client=d.str(), tid=d.u64(), epoch=d.u32(), pool=d.i64(),
                 oid=d.str(), pgid_seed=d.u32(), flags=d.u32(),
                 trace_id=d.u64())
+        m.snap_seq = d.u64()
+        m.snaps = [int(x) for x in d.i64_list()]
+        m.snapid = d.u64()
         m.ops = [OSDOp.decode(d) for _ in range(d.u32())]
         return m
 
@@ -1014,3 +1023,39 @@ class MMonSubscribe(Message):
     def decode_payload(cls, buf: bytes) -> "MMonSubscribe":
         d = Decoder(buf)
         return cls(what={d.str(): d.u32() for _ in range(d.u32())})
+
+
+# ---------------------------------------------------------------------------
+# watch/notify (reference messages/MWatchNotify.h + osd/Watch.cc)
+# ---------------------------------------------------------------------------
+
+@register
+class MWatchNotify(Message):
+    """OSD -> watching client push: a notify on an object the client
+    watches (reference MWatchNotify.h).  The client answers with a
+    ``notify_ack`` OSD op carrying the same notify_id."""
+    TYPE = 44  # reference CEPH_MSG_WATCH_NOTIFY
+
+    def __init__(self, oid: str = "", pool: int = 0, cookie: int = 0,
+                 notify_id: int = 0, payload: bytes = b"",
+                 notifier: str = ""):
+        super().__init__()
+        self.oid = oid
+        self.pool = pool
+        self.cookie = cookie         # the watcher's registration handle
+        self.notify_id = notify_id
+        self.payload = payload
+        self.notifier = notifier     # notifying client's name
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.oid).i64(self.pool).u64(self.cookie)
+        e.u64(self.notify_id).bytes(self.payload).str(self.notifier)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MWatchNotify":
+        d = Decoder(buf)
+        return cls(oid=d.str(), pool=d.i64(), cookie=d.u64(),
+                   notify_id=d.u64(), payload=d.bytes(),
+                   notifier=d.str())

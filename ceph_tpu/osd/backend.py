@@ -52,6 +52,18 @@ class Mutation:
     omap_rm: List[str] = field(default_factory=list)
     omap_clear: bool = False
     trace_id: int = 0               # blkin-style trace context (0=off)
+    # -- snapshot machinery (reference make_writeable, osd/snaps.py) --
+    clone_to: Optional[str] = None  # COW the head to this oid FIRST
+    clone_attrs: Dict[str, bytes] = field(default_factory=dict)
+    rollback_from: Optional[str] = None   # replace head from this clone
+    rollback_size: int = 0                # logical size after rollback
+    snapset: Optional[bytes] = None       # SS_ATTR value for the target
+    # (oid, SS, OI) for the snapdir companion created on delete; the
+    # OI carries the snapdir's OWN logged version — snapdir create and
+    # remove get log entries like any object, or peering's missing-set
+    # bookkeeping diverges from the store under thrash
+    snapdir_set: Optional[Tuple[str, bytes, bytes]] = None
+    aux_remove: List[str] = field(default_factory=list)  # companions
 
     def is_data_op(self) -> bool:
         return bool(self.writes) or self.truncate is not None \

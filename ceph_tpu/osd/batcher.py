@@ -73,7 +73,13 @@ class EncodeBatcher:
     """Per-OSD encode coalescer (one collector thread)."""
 
     def __init__(self, conf=None, perf=None):
-        get = (lambda k, d: conf[k] if conf is not None else d)
+        def get(k, d):
+            if conf is None:
+                return d
+            try:
+                return conf[k]
+            except KeyError:
+                return d
         self.max_stripes = get("ec_tpu_batch_stripes", 1024)
         self.window_s = get("ec_tpu_queue_window_us", 200) / 1e6
         self.perf = perf
@@ -86,6 +92,7 @@ class EncodeBatcher:
         self.calls = 0               # device calls issued
         self.reqs_total = 0          # requests encoded
         self.reqs_coalesced = 0      # requests that shared a call
+        self._cpu_twins: Dict[Tuple, object] = {}  # device-failure path
         self._thread = threading.Thread(target=self._run,
                                         name="ec-batcher", daemon=True)
         self._thread.start()
@@ -105,12 +112,18 @@ class EncodeBatcher:
             cb({i: b"" for i in range(ec_impl.get_chunk_count())})
             return
         with self._cond:
-            if not self._queues:
-                self._first_enqueue = time.monotonic()
-            self._queues.setdefault(_geometry_key(ec_impl, sinfo),
-                                    []).append(req)
-            self._pending_stripes += req.nstripes
-            self._cond.notify()
+            if self._stop:
+                stopped = True       # raced shutdown: encode inline
+            else:
+                stopped = False
+                if not self._queues:
+                    self._first_enqueue = time.monotonic()
+                self._queues.setdefault(_geometry_key(ec_impl, sinfo),
+                                        []).append(req)
+                self._pending_stripes += req.nstripes
+                self._cond.notify()
+        if stopped:
+            cb(ecutil.encode(sinfo, ec_impl, data))
 
     def stop(self) -> None:
         with self._cond:
@@ -152,6 +165,27 @@ class EncodeBatcher:
                     import traceback
                     traceback.print_exc()
 
+    def _cpu_encode(self, req: _Req) -> Dict[int, bytes]:
+        """Device-free encode through a CPU twin codec of the same
+        geometry (cached); jerasure lacks the batched device API, so
+        ecutil.encode takes its per-stripe CPU loop."""
+        impl = req.ec_impl
+        key = _geometry_key(impl, req.sinfo)
+        twin = self._cpu_twins.get(key)
+        if twin is None:
+            from ..ec import registry as ecreg
+            prof = {"k": str(impl.get_data_chunk_count()),
+                    "m": str(impl.get_coding_chunk_count()),
+                    "technique": getattr(impl, "technique",
+                                         "reed_sol_van"),
+                    "w": str(getattr(impl, "w", 8))}
+            ps = getattr(impl, "packetsize", 0)
+            if ps:
+                prof["packetsize"] = str(ps)
+            twin = ecreg.instance().factory("jerasure", prof)
+            self._cpu_twins[key] = twin
+        return ecutil.encode(req.sinfo, twin, req.data)
+
     def _dispatch_group(self, reqs: List[_Req]):
         """Issue one async device call for every request of one
         geometry; returns (arrs, async_handle) or None on dispatch
@@ -178,11 +212,20 @@ class EncodeBatcher:
             except Exception:
                 parity = None
         if parity is None:
-            # device trouble: encode each request on the CPU path so
-            # client ops fail only if that fails too
+            # device trouble: encode each request on a REAL CPU path
+            # (a jerasure twin of the same geometry — bit-exact by the
+            # corpus contract, and free of the broken device).  A
+            # request that still cannot encode gets cb(None) so the
+            # write op fails with EIO instead of hanging.
             for r in reqs:
                 try:
-                    r.cb(ecutil.encode(r.sinfo, r.ec_impl, r.data))
+                    chunks = self._cpu_encode(r)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+                    chunks = None
+                try:
+                    r.cb(chunks)
                 except Exception:
                     import traceback
                     traceback.print_exc()

@@ -234,11 +234,15 @@ class ECBackend(PGBackend):
         dropped the op mid-encode."""
         lock = getattr(self.host, "lock", None)
         if lock is None:
-            self._encoded_to_commit(op, astart, hi, chunks)
-            return
+            import contextlib
+            lock = contextlib.nullcontext()
         with lock:
             if not self._pipeline or self._pipeline[0] is not op:
                 return               # on_change() cleared the pipeline
+            if chunks is None:       # encode failed even on CPU: EIO
+                op.on_all_commit(-5)
+                self._finish_write(op)
+                return
             self._encoded_to_commit(op, astart, hi, chunks)
 
     def _encoded_to_commit(self, op: _WriteOp, astart: int, hi: int,
@@ -297,13 +301,49 @@ class ECBackend(PGBackend):
                 fn(shard, txn, GHObject(oid, shard),
                    self.host.coll_of(shard))
 
+        from .snaps import SS_ATTR
+        if mut.clone_to is not None:
+            # snapshot COW: clone every shard's chunk object — the
+            # store's COW copies bytes; NO re-encode happens (the
+            # parity of unchanged data is unchanged).  This is the EC
+            # snapshot win on TPU: snapshots cost zero device work.
+            def _clone(s, t, o, c):
+                cobj = GHObject(mut.clone_to, s)
+                t.clone(c, o, cobj)
+                t.rmattr(c, cobj, SS_ATTR)   # clones carry no SnapSet
+                if mut.clone_attrs:
+                    t.setattrs(c, cobj, mut.clone_attrs)
+            for_all(_clone)
+        for aux in mut.aux_remove:
+            for_all(lambda s, t, o, c, a=aux:
+                    t.remove(c, GHObject(a, s)))
+
         if mut.delete:
             for_all(lambda s, t, o, c: t.remove(c, o))
+            if mut.snapdir_set is not None:
+                sd_oid, ss, sd_oi = mut.snapdir_set
+
+                def _snapdir(s, t, o, c):
+                    sd = GHObject(sd_oid, s)
+                    t.touch(c, sd)
+                    t.setattr(c, sd, SS_ATTR, ss)
+                    t.setattr(c, sd, OI_ATTR, sd_oi)
+                for_all(_snapdir)
             return txns
 
         info = op.obj_info or ObjectInfo()
         new_size = info.size
+        if mut.rollback_from is not None:
+            # head becomes the clone's content, shard by shard
+            def _rollback(s, t, o, c):
+                t.remove(c, o)
+                t.clone(c, GHObject(mut.rollback_from, s), o)
+            for_all(_rollback)
+            new_size = mut.rollback_size
         for_all(lambda s, t, o, c: t.touch(c, o))
+        if mut.snapset is not None:
+            for_all(lambda s, t, o, c:
+                    t.setattr(c, o, SS_ATTR, mut.snapset))
 
         if mut.writes:
             assert write_plan is not None, \

@@ -508,7 +508,20 @@ class OSD(Dispatcher):
             self._send_pg_stats()
             self._retry_stuck_peering()
             self._maybe_schedule_scrub()
+            self._maybe_trim_snaps()
             self._maybe_reboot()
+
+    def _maybe_trim_snaps(self) -> None:
+        """Drive snap trimming on primary PGs (reference OSD ticks the
+        SnapTrimmer via the snap_trim work queue)."""
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            try:
+                pg.maybe_trim_snaps()
+            except Exception:
+                import traceback
+                traceback.print_exc()
 
     def _maybe_reboot(self) -> None:
         """The boot can be lost to a mon election (commit rejected by

@@ -123,6 +123,10 @@ class PGPool:
     erasure_code_profile: str = ""
     stripe_width: int = 0
     ec_overwrites: bool = False   # allows_ecoverwrites, osd_types.h:1600
+    # snapshots (reference pg_pool_t snap fields, osd/osd_types.h):
+    snap_seq: int = 0                  # newest allocated snap id
+    removed_snaps: List[int] = field(default_factory=list)
+    pool_snaps: Dict[str, int] = field(default_factory=dict)  # name->id
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE
@@ -295,7 +299,10 @@ class OSDMap:
                 "crush_rule": p.crush_rule,
                 "erasure_code_profile": p.erasure_code_profile,
                 "stripe_width": p.stripe_width,
-                "ec_overwrites": p.ec_overwrites}
+                "ec_overwrites": p.ec_overwrites,
+                "snap_seq": p.snap_seq,
+                "removed_snaps": p.removed_snaps,
+                "pool_snaps": p.pool_snaps}
                 for p in self.pools.values()},
             "erasure_code_profiles": self.erasure_code_profiles,
             "crush": self.crush.to_wire_dict(),
@@ -318,7 +325,10 @@ class OSDMap:
                           pg_num=p["pg_num"], crush_rule=p["crush_rule"],
                           erasure_code_profile=p["erasure_code_profile"],
                           stripe_width=p["stripe_width"],
-                          ec_overwrites=p.get("ec_overwrites", False))
+                          ec_overwrites=p.get("ec_overwrites", False),
+                          snap_seq=p.get("snap_seq", 0),
+                          removed_snaps=list(p.get("removed_snaps", [])),
+                          pool_snaps=dict(p.get("pool_snaps", {})))
             m.pools[int(pid)] = pool
             m.pool_name_to_id[pool.name] = int(pid)
             m._next_pool_id = max(m._next_pool_id, int(pid) + 1)

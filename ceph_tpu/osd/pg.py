@@ -62,9 +62,11 @@ STATE_ACTIVE = "active"
 
 WRITE_OPS = {"write", "writefull", "append", "create", "delete",
              "truncate", "setxattr", "rmxattr", "omap_set", "omap_rm",
-             "omap_clear", "call"}
+             "omap_clear", "call", "rollback"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
-            "omap_get_by_key", "pgls"}
+            "omap_get_by_key", "pgls", "list_snaps",
+            "watch", "unwatch", "notify", "notify_ack",
+            "list_watchers"}
 
 
 class PG:
@@ -106,6 +108,12 @@ class PG:
         # that raced a map epoch, so stale entries are requeued by the
         # OSD tick (the reference retries via peering-event machinery)
         self.recovering: Dict[str, float] = {}
+        # watch/notify (reference osd/Watch.cc): primary-side watcher
+        # registry, volatile — clients re-register through lingering
+        # ops on every map change, so failover self-heals
+        self.watchers: Dict[str, Dict[Tuple[str, int], object]] = {}
+        self._notifies: Dict[int, Dict] = {}
+        self._next_notify_id = 0
         self.backend = build_pg_backend(self, pool, service.ec_registry)
         from .scrub import Scrubber
         self.scrubber = Scrubber(self)
@@ -294,6 +302,12 @@ class PG:
             # resends, reqid dedup suppressing re-application of
             # anything that already committed (reference: requeue_ops
             # on interval change + osd_reqid_t dup detection)
+            # watchers re-register through lingering client ops; in-
+            # flight notifies bounce with the other held client ops
+            self.watchers.clear()
+            for state in self._notifies.values():
+                state["timer"].cancel()
+            self._notifies.clear()
             held = list(self._client_ops.values())
             self._client_ops.clear()
             self.waiting_for_active.clear()
@@ -522,6 +536,22 @@ class PG:
                 return
             self._do_op(msg, conn)
 
+    def _get_snapset(self, oid: str):
+        """-> (SnapSet | None, came_from_snapdir).  The SnapSet lives
+        on the head, or on the snapdir companion while the head is
+        deleted (reference find_object_context's snapdir path)."""
+        from .snaps import SS_ATTR, SnapSet, snapdir_oid
+        for target, from_sd in ((oid, False),
+                                (snapdir_oid(oid), True)):
+            try:
+                buf = self.store.getattr(
+                    self.coll, GHObject(target, self.own_shard),
+                    SS_ATTR)
+                return SnapSet.decode(buf), from_sd
+            except (FileNotFoundError, KeyError, ValueError):
+                continue
+        return None, False
+
     def _is_degraded(self, oid: str) -> bool:
         if self.missing.is_missing(oid):
             return True
@@ -540,6 +570,14 @@ class PG:
     def _do_op(self, msg: MOSDOp, conn) -> None:
         has_write = any(self._op_is_write(op) for op in msg.ops)
         oid = msg.oid
+        if "@" in oid and not oid.startswith(".pgls."):
+            # '@' is the snapshot-object namespace (oid@snap,
+            # oid@snapdir): a client object named 'foo@10' would
+            # collide with clones — be hidden from listings, served
+            # for snap reads, even deleted by the trimmer.  EINVAL,
+            # like the reference reserving internal namespaces.
+            self._reply(conn, msg, -22, [])
+            return
         if has_write and self.scrubber.write_blocked():
             # scrub snapshots must describe one committed state; new
             # writes wait for the round (reference write blocking on
@@ -592,6 +630,7 @@ class PG:
         full_replace = any(op.op == "writefull" for op in msg.ops)
         info = self.backend.get_object_info(msg.oid)
         cur_size = info.size if info else 0
+        rollback_snap: Optional[int] = None
         call_outputs: List[bytes] = [b""] * len(msg.ops)
         for i, op in enumerate(msg.ops):
             o = op.op
@@ -627,6 +666,10 @@ class PG:
                     err = -95
                     break
                 mut.truncate = op.offset
+            elif o == "rollback":
+                # selfmanaged snap rollback: snapid rides in offset
+                # (reference CEPH_OSD_OP_ROLLBACK)
+                rollback_snap = op.offset
             elif o == "setxattr":
                 mut.attrs[op.name] = op.data
             elif o == "rmxattr":
@@ -654,15 +697,124 @@ class PG:
         if err:
             self._reply(conn, msg, err, [])
             return
+
+        # -- snapshots (reference PrimaryLogPG::make_writeable) --------
+        from .snaps import SnapContext, SnapSet, clone_oid, snapdir_oid
+        # stale client contexts may still list deleted snaps: filter
+        # against the pool's removed set (reference filter_snapc) so
+        # no clone is ever created covering a snap the trimmer already
+        # processed
+        removed = set(self.pool.removed_snaps)
+        snapc = SnapContext(msg.snap_seq,
+                            [s for s in msg.snaps if s not in removed])
+        ss, ss_from_snapdir = self._get_snapset(msg.oid)
+        entries: List[LogEntry] = []
+        if rollback_snap is not None:
+            solo = len(msg.ops) == 1
+            kind, cid = (ss or SnapSet()).resolve_read(rollback_snap)
+            if kind == "head" and solo:
+                # the head already IS the state at that snap: pure
+                # no-op — crucially it must NOT advance the SnapSet
+                # seq, or the snaps between ss.seq and snapc.seq
+                # (whose state is the head) become unresolvable.
+                # (Bundled with other ops, those still apply below;
+                # the rollback component simply contributes nothing.)
+                self._reply(conn, msg, 0, call_outputs)
+                return
+            if kind == "clone":
+                if not solo:
+                    # rollback replaces the whole head; mixing it with
+                    # other mutations in one op has no sound ordering
+                    # (the EC write plan would RMW pre-rollback bytes)
+                    self._reply(conn, msg, -22, [])
+                    return
+                src = clone_oid(msg.oid, cid)
+                if self._is_degraded(src):
+                    self.waiting_for_degraded.setdefault(
+                        src, deque()).append((msg, conn))
+                    self.service.kick_recovery(self)
+                    return
+                mut.rollback_from = src
+                mut.rollback_size = ss.clone_size[cid]
+            elif kind == "enoent":
+                # rolling back to before the object existed = delete
+                # (reference _rollback_to ENOENT -> whiteout/delete)
+                if not solo:
+                    # delete-then-apply-other-ops is inexpressible in
+                    # one Mutation; reject the mix instead of silently
+                    # dropping either half
+                    self._reply(conn, msg, -22, [])
+                    return
+                if info is None:
+                    self._reply(conn, msg, 0, call_outputs)
+                    return
+                mut.delete = True
+        if snapc and info is not None and \
+                (ss or SnapSet()).needs_clone(snapc):
+            # COW the head before this write/delete/ROLLBACK mutates
+            # it — a rollback destroys the head too, and a snap taken
+            # since the last write still needs the pre-rollback state
+            # (reference: rollback goes through make_writeable)
+            if ss is None:
+                ss = SnapSet()
+            cver = self._next_version()
+            cid = ss.add_clone(snapc, info.size)
+            coid = clone_oid(msg.oid, cid)
+            mut.clone_to = coid
+            mut.clone_attrs = {OI_ATTR: ObjectInfo(
+                size=info.size, version=cver).encode()}
+            entries.append(LogEntry(
+                MODIFY, coid, cver, prior_version=(0, 0),
+                reqid=(f"{msg.client}.clone", msg.tid)))
+        elif snapc and info is None:
+            # creating under a snap context: the era advances so snap
+            # reads at or before the creating snapc resolve to ENOENT.
+            # Existing objects never advance without cloning — the
+            # snaps in between see the (unchanged) head.
+            if ss is None:
+                ss = SnapSet()
+            ss.advance_seq(snapc)
+        if mut.delete:
+            if ss is not None and not ss.empty:
+                # clones outlive the head: SnapSet moves to snapdir.
+                # The snapdir's creation is LOGGED at its own version —
+                # unlogged object lifecycle diverges peering's missing
+                # sets from the store under thrash
+                sd_oid = snapdir_oid(msg.oid)
+                sd_ver = self._next_version()
+                mut.snapdir_set = (sd_oid, ss.encode(), ObjectInfo(
+                    size=0, version=sd_ver).encode())
+                sd_info = self.backend.get_object_info(sd_oid)
+                entries.append(LogEntry(
+                    MODIFY, sd_oid, sd_ver,
+                    prior_version=(sd_info.version if sd_info
+                                   else (0, 0)),
+                    reqid=(f"{msg.client}.snapdir", msg.tid)))
+        else:
+            if ss_from_snapdir:
+                # head recreated: the SnapSet moves back home; the
+                # snapdir's removal is likewise logged
+                sd_oid = snapdir_oid(msg.oid)
+                mut.aux_remove.append(sd_oid)
+                sd_ver = self._next_version()
+                sd_info = self.backend.get_object_info(sd_oid)
+                entries.append(LogEntry(
+                    DELETE, sd_oid, sd_ver,
+                    prior_version=(sd_info.version if sd_info
+                                   else (0, 0)),
+                    reqid=(f"{msg.client}.snapdir", msg.tid)))
+            if ss is not None:
+                mut.snapset = ss.encode()
+
         version = self._next_version()
-        entry = LogEntry(DELETE if mut.delete else MODIFY, msg.oid,
-                         version,
-                         prior_version=(info.version if info
-                                        else (0, 0)),
-                         reqid=(msg.client, msg.tid))
+        entries.append(LogEntry(DELETE if mut.delete else MODIFY,
+                                msg.oid, version,
+                                prior_version=(info.version if info
+                                               else (0, 0)),
+                                reqid=(msg.client, msg.tid)))
         self.inflight_writes.add(msg.oid)
         self.backend.submit_transaction(
-            msg.oid, mut, version, [entry],
+            msg.oid, mut, version, entries,
             lambda res: self._op_committed(msg, conn, res,
                                            call_outputs))
 
@@ -688,6 +840,27 @@ class PG:
         out_data: List[bytes] = [b""] * len(msg.ops)
         extra: Dict = {}
 
+        # snap read resolution (reference find_object_context): a
+        # snapid resolves to the head, a clone object, or ENOENT
+        oid = msg.oid
+        if msg.snapid:
+            from .snaps import clone_oid
+            ss, _ = self._get_snapset(msg.oid)
+            if ss is not None:
+                kind, cid = ss.resolve_read(msg.snapid)
+                if kind == "clone":
+                    oid = clone_oid(msg.oid, cid)
+                    if self.missing.is_missing(oid):
+                        self.waiting_for_degraded.setdefault(
+                            oid, deque()).append((msg, conn))
+                        self.service.kick_recovery(self)
+                        return
+                elif kind == "enoent":
+                    self._reply(conn, msg, -2, out_data)
+                    return
+            # no SnapSet: the object was never written under a snap
+            # context, so the head (if any) is its state at every snap
+
         def finish(res: int) -> None:
             self._reply(conn, msg, res, out_data, extra)
 
@@ -705,7 +878,7 @@ class PG:
                         out_data[i] = data
                         run(i + 1)
                 length = op.length if op.length else (1 << 62)
-                self.backend.objects_read(msg.oid, op.offset, length, cb)
+                self.backend.objects_read(oid, op.offset, length, cb)
                 return
             if o == "call":
                 # read-only class method (reference CLS_METHOD_RD):
@@ -721,16 +894,29 @@ class PG:
                     return
                 out_data[i] = out
             elif o == "stat":
-                info = self.backend.get_object_info(msg.oid)
+                info = self.backend.get_object_info(oid)
                 if info is None:
                     finish(-2)
                     return
                 extra["size"] = info.size
                 extra["version"] = list(info.version)
+            elif o == "list_snaps":
+                # reference CEPH_OSD_OP_LIST_SNAPS: the object's clone
+                # inventory from its SnapSet
+                ss, _ = self._get_snapset(msg.oid)
+                if ss is None:
+                    extra["snaps"] = {"seq": 0, "clones": []}
+                else:
+                    extra["snaps"] = {
+                        "seq": ss.seq,
+                        "clones": [{"id": c,
+                                    "snaps": ss.clone_snaps.get(c, []),
+                                    "size": ss.clone_size.get(c, 0)}
+                                   for c in ss.clones]}
             elif o == "getxattr":
                 try:
                     out_data[i] = self.store.getattr(
-                        self.coll, GHObject(msg.oid, self.own_shard),
+                        self.coll, GHObject(oid, self.own_shard),
                         "u_" + op.name)
                 except (FileNotFoundError, KeyError):
                     finish(-61)          # -ENODATA
@@ -738,7 +924,7 @@ class PG:
             elif o == "getxattrs":
                 try:
                     attrs = self.store.getattrs(
-                        self.coll, GHObject(msg.oid, self.own_shard))
+                        self.coll, GHObject(oid, self.own_shard))
                 except FileNotFoundError:
                     finish(-2)
                     return
@@ -751,7 +937,7 @@ class PG:
                     return
                 try:
                     omap = self.store.omap_get(
-                        self.coll, GHObject(msg.oid, self.own_shard))
+                        self.coll, GHObject(oid, self.own_shard))
                 except FileNotFoundError:
                     finish(-2)
                     return
@@ -765,7 +951,7 @@ class PG:
                     return
                 try:
                     omap = self.store.omap_get(
-                        self.coll, GHObject(msg.oid, self.own_shard))
+                        self.coll, GHObject(oid, self.own_shard))
                 except FileNotFoundError:
                     finish(-2)
                     return
@@ -773,15 +959,39 @@ class PG:
                     finish(-61)          # -ENODATA
                     return
                 out_data[i] = omap[op.name]
+            elif o == "watch":
+                # register this session as a watcher (reference
+                # CEPH_OSD_OP_WATCH, osd/Watch.cc); cookie in offset
+                if self.backend.get_object_info(msg.oid) is None:
+                    finish(-2)
+                    return
+                self.watchers.setdefault(msg.oid, {})[
+                    (msg.client, op.offset)] = conn
+            elif o == "unwatch":
+                ws = self.watchers.get(msg.oid, {})
+                ws.pop((msg.client, op.offset), None)
+                if not ws:
+                    self.watchers.pop(msg.oid, None)
+            elif o == "list_watchers":
+                extra["watchers"] = sorted(
+                    f"{cl}:{ck}" for cl, ck in
+                    self.watchers.get(msg.oid, {}))
+            elif o == "notify":
+                self._do_notify(msg, conn, op)
+                return               # reply deferred to acks/timeout
+            elif o == "notify_ack":
+                # notify_id in offset, acking watch's cookie in length
+                self._notify_acked(op.offset, msg.client, op.length)
             elif o == "pgls":
+                from .snaps import is_snap_oid
                 objs = []
-                for oid in self.backend.list_objects():
-                    if oid == PGMETA_OID:
-                        continue
-                    objs.append(oid)
-                for oid, (need, _) in self.missing.items.items():
-                    if oid not in objs:
-                        objs.append(oid)
+                for o2 in self.backend.list_objects():
+                    if o2 == PGMETA_OID or is_snap_oid(o2):
+                        continue         # clients list heads only
+                    objs.append(o2)
+                for o2, (need, _) in self.missing.items.items():
+                    if o2 not in objs and not is_snap_oid(o2):
+                        objs.append(o2)
                 extra["objects"] = sorted(objs)
             else:
                 finish(-95)
@@ -789,6 +999,74 @@ class PG:
             run(i + 1)
 
         run(0)
+
+    # ------------------------------------------------------------------
+    # watch/notify (reference osd/Watch.cc + PrimaryLogPG::do_osd_ops
+    # NOTIFY/NOTIFY_ACK handling)
+    # ------------------------------------------------------------------
+    def _do_notify(self, msg: MOSDOp, conn, op) -> None:
+        """Fan a notify out to every watcher session; the notifier's
+        reply waits for all acks or the timeout (reference Notify
+        completion)."""
+        from ..msg.messages import MWatchNotify
+        self._next_notify_id += 1
+        nid = self._next_notify_id
+        # pending keyed by (client, cookie): one client may hold
+        # several watches on the object, each must ack independently
+        pending: Set[Tuple[str, int]] = set()
+        watchers = self.watchers.get(msg.oid, {})
+        for (client, cookie), wconn in list(watchers.items()):
+            try:
+                wconn.send_message(MWatchNotify(
+                    oid=msg.oid, pool=msg.pool, cookie=cookie,
+                    notify_id=nid, payload=op.data,
+                    notifier=msg.client))
+                pending.add((client, cookie))
+            except Exception:
+                # dead session: the watch dies with it (reference
+                # watch timeout/con reset teardown)
+                watchers.pop((client, cookie), None)
+        if not pending:
+            self._reply(conn, msg, 0, [b""] * len(msg.ops),
+                        {"acks": [], "timed_out": []})
+            return
+        state = {"pending": pending, "acks": [], "msg": msg,
+                 "conn": conn, "nops": len(msg.ops)}
+        self._notifies[nid] = state
+        timeout = (op.offset or 5000) / 1000.0
+        t = threading.Timer(timeout, self._notify_timeout, args=(nid,))
+        t.daemon = True
+        state["timer"] = t
+        t.start()
+
+    def _notify_acked(self, nid: int, client: str,
+                      cookie: int) -> None:
+        state = self._notifies.get(nid)
+        if state is None:
+            return
+        state["pending"].discard((client, cookie))
+        tag = f"{client}:{cookie}"
+        if tag not in state["acks"]:
+            state["acks"].append(tag)
+        if not state["pending"]:
+            del self._notifies[nid]
+            state["timer"].cancel()
+            self._reply(state["conn"], state["msg"], 0,
+                        [b""] * state["nops"],
+                        {"acks": sorted(state["acks"]),
+                         "timed_out": []})
+
+    def _notify_timeout(self, nid: int) -> None:
+        with self.lock:
+            state = self._notifies.pop(nid, None)
+            if state is None:
+                return
+            self._reply(state["conn"], state["msg"], 0,
+                        [b""] * state["nops"],
+                        {"acks": sorted(state["acks"]),
+                         "timed_out": sorted(
+                             f"{cl}:{ck}" for cl, ck in
+                             state["pending"])})
 
     def _reply(self, conn, msg: MOSDOp, result: int,
                out_data: List[bytes], extra: Optional[Dict] = None
@@ -827,6 +1105,92 @@ class PG:
                 return False
             return None not in self.acting and \
                 len(self.acting) >= self.pool.min_size
+
+    # ------------------------------------------------------------------
+    # snap trimming (reference SnapTrimmer / PrimaryLogPG::trim_object,
+    # collapsed to an idempotent primary-side scan)
+    # ------------------------------------------------------------------
+    def maybe_trim_snaps(self) -> int:
+        """Remove clones whose every covered snap was deleted from the
+        pool (pool.removed_snaps); -> trim mutations submitted.  Runs
+        from the OSD tick; idempotent, so a crash mid-trim just
+        re-scans."""
+        from .snaps import SS_ATTR, SnapSet, clone_oid, is_snap_oid
+        with self.lock:
+            removed = set(self.pool.removed_snaps)
+            if not self.is_primary() or self.state != STATE_ACTIVE \
+                    or not removed \
+                    or removed == getattr(self, "_snaps_trimmed", None):
+                return 0
+            if self.is_primary() and self.num_missing() > 0:
+                return 0                 # recover first, then trim
+            submitted = 0
+            skipped = False
+            for oid in self.backend.list_objects():
+                if oid == PGMETA_OID:
+                    continue
+                if is_snap_oid(oid) and not oid.endswith("@snapdir"):
+                    continue             # clones are handled via heads
+                try:
+                    ss = SnapSet.decode(self.store.getattr(
+                        self.coll, GHObject(oid, self.own_shard),
+                        SS_ATTR))
+                except (FileNotFoundError, KeyError, ValueError):
+                    continue
+                before = ss.encode()
+                gone = ss.trim(removed)
+                if ss.encode() == before:
+                    continue             # nothing of ours was removed
+                head = oid.split("@", 1)[0]
+                if head in self.inflight_writes or \
+                        any(clone_oid(head, c) in self.inflight_writes
+                            for c in gone):
+                    skipped = True       # busy: retry next tick
+                    continue
+                for cid in gone:
+                    mut = Mutation()
+                    mut.delete = True
+                    self._submit_internal(clone_oid(head, cid), mut)
+                    submitted += 1
+                is_snapdir = oid.endswith("@snapdir")
+                mut = Mutation()
+                if is_snapdir and ss.empty:
+                    mut.delete = True    # last clone gone: drop snapdir
+                else:
+                    mut.snapset = ss.encode()
+                self._submit_internal(oid, mut)
+                submitted += 1
+            if not skipped and submitted == 0:
+                # memoize only a fully-clean pass: after submitting
+                # work (or skipping busy objects) the next tick
+                # re-scans until nothing is left to trim
+                self._snaps_trimmed = removed
+            return submitted
+
+    def _submit_internal(self, oid: str, mut: Mutation) -> None:
+        """Primary-internal mutation (snap trim): full log + replication
+        machinery, no client to answer."""
+        info = self.backend.get_object_info(oid)
+        version = self._next_version()
+        self._trim_seq = getattr(self, "_trim_seq", 0) + 1
+        entry = LogEntry(DELETE if mut.delete else MODIFY, oid, version,
+                         prior_version=(info.version if info
+                                        else (0, 0)),
+                         reqid=(f"osd.{self.whoami}.trim",
+                                self._trim_seq))
+        self.inflight_writes.add(oid)
+
+        def done(res: int, oid=oid) -> None:
+            self.inflight_writes.discard(oid)
+            q = self.waiting_for_obj.get(oid)
+            if q:
+                nmsg, nconn = q.popleft()
+                if not q:
+                    del self.waiting_for_obj[oid]
+                self._do_op(nmsg, nconn)
+            self.scrubber.kick()
+        self.backend.submit_transaction(oid, mut, version, [entry],
+                                        done)
 
     def start_recovery_ops(self, budget: int) -> int:
         """Launch up to ``budget`` object recoveries; -> ops started."""

@@ -85,14 +85,40 @@ class ReplicatedBackend(PGBackend):
                info: Optional[ObjectInfo]) -> Transaction:
         """Logical mutation -> one store transaction, applied identically
         on every replica (collection names match on all OSDs)."""
+        from .snaps import SS_ATTR
         coll = self.host.coll
         obj = GHObject(oid, -1)
         txn = Transaction()
+        if mut.clone_to is not None:
+            # COW the pre-write head into the snapshot clone (reference
+            # make_writeable's clone step) — store-level clone, the
+            # store's COW machinery does the copying
+            cobj = GHObject(mut.clone_to, -1)
+            txn.clone(coll, obj, cobj)
+            txn.rmattr(coll, cobj, SS_ATTR)   # clones carry no SnapSet
+            if mut.clone_attrs:
+                txn.setattrs(coll, cobj, mut.clone_attrs)
+        for aux in mut.aux_remove:
+            txn.remove(coll, GHObject(aux, -1))
         if mut.delete:
             txn.remove(coll, obj)
+            if mut.snapdir_set is not None:
+                # clones survive the head: SnapSet moves to the snapdir
+                # companion (reference pre-octopus snapdir objects)
+                sd_oid, ss, sd_oi = mut.snapdir_set
+                sd = GHObject(sd_oid, -1)
+                txn.touch(coll, sd)
+                txn.setattr(coll, sd, SS_ATTR, ss)
+                txn.setattr(coll, sd, OI_ATTR, sd_oi)
             return txn
         info = info or ObjectInfo()
         new_size = info.size
+        if mut.rollback_from is not None:
+            # head becomes the clone's content (reference rollback's
+            # _rollback_to): wipe, then store-clone back
+            txn.remove(coll, obj)
+            txn.clone(coll, GHObject(mut.rollback_from, -1), obj)
+            new_size = mut.rollback_size
         txn.touch(coll, obj)
         for off, data in mut.writes:
             txn.write(coll, obj, off, data)
@@ -100,6 +126,8 @@ class ReplicatedBackend(PGBackend):
         if mut.truncate is not None:
             txn.truncate(coll, obj, mut.truncate)
             new_size = mut.truncate
+        if mut.snapset is not None:
+            txn.setattr(coll, obj, SS_ATTR, mut.snapset)
         txn.setattr(coll, obj, OI_ATTR,
                     ObjectInfo(size=new_size,
                                version=at_version).encode())

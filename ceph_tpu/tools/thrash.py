@@ -44,20 +44,34 @@ class RadosModel:
     # vocabulary there (reference thrash-erasure-code workloads
     # likewise use append-style ops)
     EC_OPS = ("append", "writefull", "delete", "setxattr", "read")
+    # snapshot vocabulary (reference qa/.../thrash-erasure-code
+    # workloads/ec-rados-plugin=*.yaml: snap_create/snap_remove/
+    # rollback in the op mix); valid on both pool types
+    SNAP_OPS = ("snap_create", "snap_remove", "rollback", "snap_read")
+    MAX_LIVE_SNAPS = 3
 
     def __init__(self, ioctx, n_objects: int = 20,
                  seed: int = 0, max_size: int = 1 << 16,
-                 ec_mode: bool = False):
+                 ec_mode: bool = False, snaps: bool = False):
         self.ioctx = ioctx
         if ec_mode:
             self.OPS = self.EC_OPS
+        if snaps:
+            self.OPS = self.OPS + self.SNAP_OPS
         self.rng = random.Random(seed)
         self.names = [f"model_{i}" for i in range(n_objects)]
         self.expect: Dict[str, bytearray] = {}
         self.expect_attrs: Dict[str, Dict[str, bytes]] = {}
+        # live snapid -> frozen expected state at snap time
+        self.snaps: Dict[int, Dict] = {}
+        self.snap_seq = 0
         self.max_size = max_size
         self.ops_done = 0
         self.errors: List[str] = []
+
+    def _set_snapc(self) -> None:
+        live = sorted(self.snaps, reverse=True)
+        self.ioctx.set_snap_context(self.snap_seq, live)
 
     def _blob(self, n: int) -> bytes:
         return self.rng.randbytes(n)
@@ -125,6 +139,64 @@ class RadosModel:
                     self.errors.append(
                         f"{oid}: stale read ({len(got or b'')}B != "
                         f"{len(want)}B expected)")
+            elif op == "snap_create":
+                if len(self.snaps) >= self.MAX_LIVE_SNAPS:
+                    return
+                sid = self.ioctx.selfmanaged_snap_create()
+                self.snap_seq = max(self.snap_seq, sid)
+                # freeze the expected state as of this snapshot
+                self.snaps[sid] = {
+                    "data": {o: bytes(v)
+                             for o, v in self.expect.items()},
+                    "attrs": {o: dict(a) for o, a in
+                              self.expect_attrs.items()},
+                }
+                self._set_snapc()
+            elif op == "snap_remove":
+                if not self.snaps:
+                    return
+                sid = self.rng.choice(sorted(self.snaps))
+                self.ioctx.selfmanaged_snap_remove(sid)
+                del self.snaps[sid]
+                self._set_snapc()
+            elif op == "rollback":
+                if not self.snaps or cur is None and not any(
+                        oid in s["data"] for s in self.snaps.values()):
+                    return
+                sid = self.rng.choice(sorted(self.snaps))
+                self.ioctx.selfmanaged_snap_rollback(oid, sid)
+                frozen = self.snaps[sid]
+                if oid in frozen["data"]:
+                    self.expect[oid] = bytearray(frozen["data"][oid])
+                    self.expect_attrs[oid] = dict(
+                        frozen["attrs"].get(oid, {}))
+                else:
+                    # object did not exist at the snap: rollback = gone
+                    self.expect.pop(oid, None)
+                    self.expect_attrs.pop(oid, None)
+            elif op == "snap_read":
+                if not self.snaps:
+                    return
+                sid = self.rng.choice(sorted(self.snaps))
+                frozen = self.snaps[sid]["data"].get(oid)
+                got = None
+                self.ioctx.snap_set_read(sid)
+                try:
+                    got = self.ioctx.read(oid)
+                except RadosError as e:
+                    if e.errno != 2:
+                        raise
+                finally:
+                    self.ioctx.snap_set_read(0)
+                if frozen is None:
+                    if got not in (None, b""):
+                        self.errors.append(
+                            f"{oid}@{sid}: data at a snap before "
+                            f"creation")
+                elif got != frozen:
+                    self.errors.append(
+                        f"{oid}@{sid}: snap read mismatch "
+                        f"({len(got or b'')}B != {len(frozen)}B)")
         except RadosError:
             # deliberate FAIL-FAST: the framework's resend machinery
             # is supposed to absorb churn, so an op error (or timeout)
@@ -164,6 +236,28 @@ class RadosModel:
                         problems.append(f"{oid}: xattr {name} differs")
                 except RadosError:
                     problems.append(f"{oid}: xattr {name} missing")
+        # every live snapshot must still read back its frozen state
+        for sid, frozen in self.snaps.items():
+            self.ioctx.snap_set_read(sid)
+            try:
+                for oid in self.names:
+                    want = frozen["data"].get(oid)
+                    try:
+                        got = self.ioctx.read(oid)
+                    except RadosError as e:
+                        got = None if e.errno == 2 else b"<error>"
+                    if want is None:
+                        if got not in (None, b""):
+                            problems.append(
+                                f"{oid}@{sid}: exists at a snap "
+                                f"before creation")
+                    elif got != want:
+                        problems.append(
+                            f"{oid}@{sid}: snap content mismatch "
+                            f"({len(got) if got else 0} != "
+                            f"{len(want)})")
+            finally:
+                self.ioctx.snap_set_read(0)
         return problems
 
 
@@ -266,7 +360,8 @@ def run_thrash(n_osds: int, seconds: float, pool_type: str,
         client.op_timeout = 120.0
         io = client.open_ioctx("tp")
         model = RadosModel(io, seed=seed,
-                           ec_mode=pool_type == "erasure")
+                           ec_mode=pool_type == "erasure",
+                           snaps=True)
         thrasher = Thrasher(cluster, seed=seed,
                             min_alive=max(2, n_osds - 1
                                           if pool_type == "erasure"

@@ -88,7 +88,10 @@ def sample_messages():
                         out={"osd": {"op": 12}}),
         M.MMonMon(op="begin", from_rank=0, epoch=6, version=9,
                   last_committed=8, value={"epoch": 9},
-                  quorum=[0, 1, 2], maps={8: {"epoch": 8}}),
+                  quorum=[0, 1, 2], maps={8: {"epoch": 8}},
+                  pn=3),
+        M.MWatchNotify(oid="hdr", pool=2, cookie=5, notify_id=9,
+                       payload=b"ping", notifier="client.77"),
     ]
 
 

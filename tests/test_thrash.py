@@ -19,7 +19,7 @@ def test_model_clean_cluster_no_false_positives():
             c.wait_for_osd_up(i, 30)
         c.create_pool("m0", "replicated", size=2)
         io = c.rados().open_ioctx("m0")
-        model = RadosModel(io, seed=11)
+        model = RadosModel(io, seed=11, snaps=True)
         model.run(300)
         assert model.ops_done == 300
         assert model.verify_all() == []
@@ -50,7 +50,8 @@ def test_thrash_workload_integrity(pool_type, seed):
         client.op_timeout = 120.0
         io = client.open_ioctx("th")
         model = RadosModel(io, seed=seed,
-                           ec_mode=pool_type == "erasure")
+                           ec_mode=pool_type == "erasure",
+                           snaps=True)
         model.run(50)                  # seed data before the storm
         # pace the storm at ~1.5x the heartbeat grace (3s in test
         # config): churn faster than failure detection can converge

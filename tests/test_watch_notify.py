@@ -1,0 +1,171 @@
+"""watch/notify tests.
+
+Reference analog: src/test/librados/watch_notify.cc — registration,
+notify fan-out + ack gathering, timeouts, unwatch, and watch survival
+across primary failover (the lingering-op machinery RBD/RGW
+coordination relies on)."""
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.client.rados import RadosError
+from ceph_tpu.cluster import Cluster
+
+
+@pytest.fixture(scope="module")
+def cl():
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("wn", "replicated", size=2)
+        yield c
+
+
+def test_watch_notify_roundtrip(cl):
+    r1 = cl.rados()
+    r2 = cl.rados()
+    io1 = r1.open_ioctx("wn")
+    io2 = r2.open_ioctx("wn")
+    io1.write_full("obj", b"x")
+
+    got1, got2 = [], []
+    ev1, ev2 = threading.Event(), threading.Event()
+    c1 = io1.watch("obj", lambda who, pl: (got1.append((who, pl)),
+                                           ev1.set()))
+    c2 = io2.watch("obj", lambda who, pl: (got2.append((who, pl)),
+                                           ev2.set()))
+    assert len(io1.list_watchers("obj")) == 2
+
+    r3 = cl.rados()
+    io3 = r3.open_ioctx("wn")
+    out = io3.notify("obj", b"hello", timeout_ms=10_000)
+    assert ev1.wait(5) and ev2.wait(5)
+    assert got1[0][1] == b"hello" and got2[0][1] == b"hello"
+    assert got1[0][0].startswith("client.")   # notifier name
+    assert len(out["acks"]) == 2 and not out["timed_out"]
+
+    # unwatch: only the remaining watcher acks
+    io2.unwatch("obj", c2)
+    out = io3.notify("obj", b"again", timeout_ms=10_000)
+    assert len(out["acks"]) == 1 and not out["timed_out"]
+    io1.unwatch("obj", c1)
+    assert io1.list_watchers("obj") == []
+
+
+def test_notify_timeout_on_slow_watcher(cl):
+    r1 = cl.rados()
+    io1 = r1.open_ioctx("wn")
+    io1.write_full("slow", b"x")
+    cookie = io1.watch("slow", lambda who, pl: time.sleep(8))
+    r2 = cl.rados()
+    io2 = r2.open_ioctx("wn")
+    t0 = time.monotonic()
+    out = io2.notify("slow", b"p", timeout_ms=1500)
+    took = time.monotonic() - t0
+    assert out["timed_out"], "slow watcher should time the notify out"
+    assert took < 6, "notify must return at the timeout, not at ack"
+    io1.unwatch("slow", cookie)
+
+
+def test_watch_requires_object(cl):
+    io = cl.rados().open_ioctx("wn")
+    with pytest.raises(RadosError):
+        io.watch("missing-obj", lambda who, pl: None)
+
+
+def test_two_watches_one_client_both_must_ack(cl):
+    """A client with TWO watches on one object: the notify completes
+    only after BOTH ack (pending is keyed by (client, cookie))."""
+    r1 = cl.rados()
+    io1 = r1.open_ioctx("wn")
+    io1.write_full("dbl", b"x")
+    seen = []
+    c1 = io1.watch("dbl", lambda who, pl: seen.append(1))
+    c2 = io1.watch("dbl", lambda who, pl: (time.sleep(1.0),
+                                           seen.append(2)))
+    out = cl.rados().open_ioctx("wn").notify("dbl", b"p",
+                                             timeout_ms=10_000)
+    assert len(out["acks"]) == 2 and not out["timed_out"]
+    assert sorted(seen) == [1, 2]
+    io1.unwatch("dbl", c1)
+    io1.unwatch("dbl", c2)
+
+
+def test_watch_survives_replica_death_same_primary(cl):
+    """An interval change that KEEPS the primary (a replica dies)
+    still wipes the PG's volatile watcher registry — the lingering
+    registration must re-register anyway."""
+    r1 = cl.rados()
+    io1 = r1.open_ioctx("wn")
+    io1.write_full("rd", b"x")
+    ev = threading.Event()
+    io1.watch("rd", lambda who, pl: ev.set())
+    osdmap = r1.objecter.osdmap
+    pgid = osdmap.object_locator_to_pg("rd", io1.pool_id)
+    _, _, acting, primary = osdmap.pg_to_up_acting_osds(pgid)
+    replica = next(o for o in acting if o is not None and o != primary)
+    cl.kill_osd(replica)
+    cl.wait_for_osd_down(replica)
+    io2 = cl.rados().open_ioctx("wn")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if io2.list_watchers("rd"):
+            break
+        time.sleep(0.3)
+    assert io2.list_watchers("rd"), \
+        "watch lost across a same-primary interval change"
+    out = io2.notify("rd", b"still-there", timeout_ms=10_000)
+    assert ev.wait(10) and len(out["acks"]) == 1
+    cl.revive_osd(replica)
+    cl.wait_for_osd_up(replica)
+
+
+def test_aio_write_carries_snap_context(cl):
+    """aio_write_full must trigger snapshot COW exactly like the
+    synchronous path."""
+    io = cl.rados().open_ioctx("wn")
+    io.write_full("aiosnap", b"v1" * 100)
+    s1 = io.selfmanaged_snap_create()
+    io.set_snap_context(s1, [s1])
+    comp = io.aio_write_full("aiosnap", b"v2" * 100)
+    assert comp.wait(10) == 0
+    io.snap_set_read(s1)
+    assert io.read("aiosnap") == b"v1" * 100
+    io.snap_set_read(0)
+    assert io.read("aiosnap") == b"v2" * 100
+
+
+def test_watch_survives_primary_failover(cl):
+    r1 = cl.rados()
+    io1 = r1.open_ioctx("wn")
+    io1.write_full("fo", b"x")
+    hits = []
+    ev = threading.Event()
+    io1.watch("fo", lambda who, pl: (hits.append(pl), ev.set()))
+
+    # find and kill the primary of fo's PG
+    osdmap = r1.objecter.osdmap
+    pgid = osdmap.object_locator_to_pg("fo", io1.pool_id)
+    _, _, _, primary = osdmap.pg_to_up_acting_osds(pgid)
+    cl.kill_osd(primary)
+    cl.wait_for_osd_down(primary)
+
+    # the lingering watch must re-register on the new primary
+    r2 = cl.rados()
+    io2 = r2.open_ioctx("wn")
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            if io2.list_watchers("fo"):
+                break
+        except RadosError:
+            pass
+        time.sleep(0.3)
+    assert io2.list_watchers("fo"), "watch did not survive failover"
+    out = io2.notify("fo", b"after-failover", timeout_ms=10_000)
+    assert ev.wait(10)
+    assert hits[0] == b"after-failover"
+    assert len(out["acks"]) == 1
+    cl.revive_osd(primary)
+    cl.wait_for_osd_up(primary)
