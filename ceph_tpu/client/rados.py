@@ -212,7 +212,13 @@ class Objecter(Dispatcher):
             return False
         with self.lock:
             op = self.inflight.get(msg.tid)
+            linger = self.lingers.get(msg.tid)
         if op is None:
+            if linger is not None and msg.result < 0:
+                # a lingering registration (watch) failed to
+                # re-register — tell the owner instead of silently
+                # losing every future notify
+                self._linger_error(linger, msg.result)
             return True                  # late duplicate
         if msg.result == EAGAIN_WRONG_PRIMARY:
             # stale targeting: refresh the map and resend (reference
@@ -245,6 +251,26 @@ class Objecter(Dispatcher):
     def linger_cancel(self, linger_id: int) -> None:
         with self.lock:
             self.lingers.pop(linger_id, None)
+
+    def _linger_error(self, op: "_InflightOp", result: int) -> None:
+        """A linger re-registration was rejected (object deleted, for
+        example): drop it and fire the owner's error callback
+        (reference watch error callback / rados_watcherrcb_t)."""
+        with self.lock:
+            self.lingers.pop(op.tid, None)
+            key = None
+            for (pool, oid, cookie), cbs in \
+                    list(self.watch_callbacks.items()):
+                if pool == op.pool and oid == op.oid:
+                    key = (pool, oid, cookie)
+                    break
+            cbs = self.watch_callbacks.pop(key, None) \
+                if key is not None else None
+        if cbs is not None and getattr(cbs, "on_error", None):
+            try:
+                cbs.on_error(result)
+            except Exception:
+                pass
 
     def ms_handle_reset(self, conn: Connection) -> None:
         """Lossy OSD session died: resend everything targeted at it
@@ -324,11 +350,17 @@ class IoCtx:
             if self.rados.tracer else None
         from ..osd.pg import WRITE_OPS
         is_write = any(o.op in WRITE_OPS for o in ops)
+        # watch-class (and list_snaps) ops are head-pinned: they must
+        # not be snap-resolved even while a read snap is set
+        HEAD_PINNED = {"watch", "unwatch", "notify", "notify_ack",
+                       "list_watchers", "list_snaps", "pgls"}
+        head_pinned = any(o.op in HEAD_PINNED for o in ops)
         c = self.rados.objecter.submit(
             self.pool_id, oid, ops,
             trace_id=span.trace_id if span else 0,
             snapc=self._write_snapc() if is_write else (0, []),
-            snapid=0 if is_write else self._read_snap)
+            snapid=0 if (is_write or head_pinned)
+            else self._read_snap)
         try:
             res = c.wait(timeout)
         finally:

@@ -70,7 +70,16 @@ def _geometry_key(ec_impl, sinfo: ecutil.StripeInfo) -> Tuple:
 
 
 class EncodeBatcher:
-    """Per-OSD encode coalescer (one collector thread)."""
+    """Per-OSD encode coalescer (one collector thread).
+
+    The CPU/device crossover and measured CPU rates are CLASS-level:
+    the device and the link are machine properties, so every batcher
+    in the process (one per OSD in test clusters; one per daemon in a
+    real deployment) shares one learned estimate instead of each
+    paying its own slow probe."""
+
+    _cpu_bps: Dict[Tuple, float] = {}        # per geometry, shared
+    _min_device_bytes: float = 0.0           # learned crossover, shared
 
     def __init__(self, conf=None, perf=None):
         def get(k, d):
@@ -82,6 +91,14 @@ class EncodeBatcher:
                 return d
         self.max_stripes = get("ec_tpu_batch_stripes", 1024)
         self.window_s = get("ec_tpu_queue_window_us", 200) / 1e6
+        # adaptive CPU/device routing (ec_tpu_fallback_cpu): a device
+        # call pays a fixed dispatch+transfer cost that can dwarf the
+        # MXU win on small batches — especially over a slow link.  The
+        # crossover is LEARNED: batches below the threshold encode on
+        # the CPU twin; the threshold doubles when a device call loses
+        # to the predicted CPU time and halves when it wins big.
+        self.adaptive_cpu = get("ec_tpu_fallback_cpu", True)
+        self.cpu_reqs = 0                        # routed to CPU twin
         self.perf = perf
         self._cond = threading.Condition()
         self._queues: Dict[Tuple, List[_Req]] = {}
@@ -157,33 +174,137 @@ class EncodeBatcher:
             # OSD — so each step is fault-isolated to its own ops.
             groups = []
             for key, reqs in queues.items():
-                groups.append((reqs, self._dispatch_group(reqs)))
+                if self._route_to_cpu(key, reqs):
+                    groups.append((reqs, "cpu"))
+                else:
+                    groups.append((reqs, self._dispatch_group(reqs)))
+            n_dev = sum(1 for _, h in groups if h != "cpu")
             for reqs, handle in groups:
                 try:
-                    self._complete_group(reqs, handle)
+                    if handle == "cpu":
+                        self._complete_group_cpu(reqs)
+                    else:
+                        # crossover learning only when this cycle has
+                        # ONE device group: with several, a later
+                        # group's wait includes the earlier groups'
+                        # waits + completion callbacks, which would
+                        # spuriously ratchet the threshold up
+                        self._complete_group(reqs, handle,
+                                             learn=(n_dev == 1))
                 except Exception:
                     import traceback
                     traceback.print_exc()
 
-    def _cpu_encode(self, req: _Req) -> Dict[int, bytes]:
-        """Device-free encode through a CPU twin codec of the same
-        geometry (cached); jerasure lacks the batched device API, so
-        ecutil.encode takes its per-stripe CPU loop."""
-        impl = req.ec_impl
-        key = _geometry_key(impl, req.sinfo)
+    def _route_to_cpu(self, key: Tuple, reqs: List[_Req]) -> bool:
+        """True when the learned crossover says this batch is too
+        small to pay the device round trip."""
+        if not self.adaptive_cpu or self._min_device_bytes <= 0:
+            return False
+        total = sum(len(r.data) for r in reqs)
+        if total >= self._min_device_bytes:
+            return False
+        # periodic probe: route an occasional small batch to the
+        # device anyway so the threshold can come back down when the
+        # link/device recovers
+        self._probe_tick = getattr(self, "_probe_tick", 0) + 1
+        return self._probe_tick % 16 != 0
+
+    @classmethod
+    def reset_learning(cls) -> None:
+        """Forget the shared crossover/rates (tests; ops can call it
+        after a hardware change)."""
+        cls._min_device_bytes = 0.0
+        cls._cpu_bps = {}
+
+    def _cpu_rate(self, key: Tuple, req: _Req) -> float:
+        """CPU twin throughput for this geometry, measured once on
+        real data (bytes/sec); shared process-wide."""
+        rate = self._cpu_bps.get(key)
+        if rate is None:
+            t0 = time.monotonic()
+            self._cpu_encode(req)
+            dt = max(time.monotonic() - t0, 1e-6)
+            rate = len(req.data) / dt
+            EncodeBatcher._cpu_bps[key] = rate
+        return rate
+
+    def _complete_group_cpu(self, reqs: List[_Req]) -> None:
+        for r in reqs:
+            try:
+                chunks = self._cpu_encode(r)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                chunks = None
+            self.reqs_total += 1
+            self.cpu_reqs += 1
+            try:
+                r.cb(chunks)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _learn_crossover(self, reqs: List[_Req],
+                         dev_time: float) -> None:
+        """Compare the measured device time against the CPU twin's
+        predicted time for the same bytes and move the routing
+        threshold: lost -> raise it past this batch size; won big ->
+        lower it."""
+        try:
+            key = _geometry_key(reqs[0].ec_impl, reqs[0].sinfo)
+            total = sum(len(r.data) for r in reqs)
+            cpu_rate = max(self._cpu_rate(key, reqs[0]), 1.0)
+            cpu_pred = total / cpu_rate
+            if dev_time > cpu_pred:
+                # the device LOST: set the crossover where the CPU
+                # would have taken as long as this call did (one
+                # losing measurement teaches the whole region below
+                # it, not just 2x this batch — bursts must not need
+                # a convergence loop)
+                EncodeBatcher._min_device_bytes = max(
+                    self._min_device_bytes,
+                    dev_time * cpu_rate / 2, 64 << 10)
+            elif dev_time < cpu_pred / 2 and \
+                    self._min_device_bytes > 0:
+                EncodeBatcher._min_device_bytes = min(
+                    self._min_device_bytes, total / 2)
+        except Exception:
+            pass                     # learning is best-effort
+
+    # -- decode-side routing (consumed by ECBackend reads/recovery) ----
+    def prefer_cpu(self, nbytes: int) -> bool:
+        """Should a ``nbytes``-sized codec call avoid the device?
+        Shares the encode path's learned crossover — the fixed
+        dispatch/transfer cost is the same either direction."""
+        return (self.adaptive_cpu and self._min_device_bytes > 0
+                and nbytes < self._min_device_bytes)
+
+    def cpu_twin(self, ec_impl, sinfo: ecutil.StripeInfo):
+        """The device-free jerasure twin for this geometry (cached);
+        bit-exact by the corpus contract.  Used by encode fallback and
+        by read/recovery decode when prefer_cpu() says the device
+        round trip loses."""
+        key = _geometry_key(ec_impl, sinfo)
         twin = self._cpu_twins.get(key)
         if twin is None:
             from ..ec import registry as ecreg
-            prof = {"k": str(impl.get_data_chunk_count()),
-                    "m": str(impl.get_coding_chunk_count()),
-                    "technique": getattr(impl, "technique",
+            prof = {"k": str(ec_impl.get_data_chunk_count()),
+                    "m": str(ec_impl.get_coding_chunk_count()),
+                    "technique": getattr(ec_impl, "technique",
                                          "reed_sol_van"),
-                    "w": str(getattr(impl, "w", 8))}
-            ps = getattr(impl, "packetsize", 0)
+                    "w": str(getattr(ec_impl, "w", 8))}
+            ps = getattr(ec_impl, "packetsize", 0)
             if ps:
                 prof["packetsize"] = str(ps)
             twin = ecreg.instance().factory("jerasure", prof)
             self._cpu_twins[key] = twin
+        return twin
+
+    def _cpu_encode(self, req: _Req) -> Dict[int, bytes]:
+        """Device-free encode through the CPU twin; jerasure lacks the
+        batched device API, so ecutil.encode takes its per-stripe CPU
+        loop."""
+        twin = self.cpu_twin(req.ec_impl, req.sinfo)
         return ecutil.encode(req.sinfo, twin, req.data)
 
     def _dispatch_group(self, reqs: List[_Req]):
@@ -197,18 +318,22 @@ class EncodeBatcher:
                 r.nstripes, k, sinfo.chunk_size) for r in reqs]
             batch = np.concatenate(arrs, axis=0) \
                 if len(arrs) > 1 else arrs[0]
-            return arrs, reqs[0].ec_impl.encode_batch_async(batch)
+            return (arrs, reqs[0].ec_impl.encode_batch_async(batch),
+                    time.monotonic())
         except Exception:
             return None
 
-    def _complete_group(self, reqs: List[_Req], handle) -> None:
+    def _complete_group(self, reqs: List[_Req], handle,
+                        learn: bool = True) -> None:
         k = reqs[0].ec_impl.get_data_chunk_count()
         m = reqs[0].ec_impl.get_coding_chunk_count()
         parity = None
+        dev_time = None
         if handle is not None:
-            arrs, async_batch = handle
+            arrs, async_batch, t_dispatch = handle
             try:
                 parity = async_batch.wait()
+                dev_time = time.monotonic() - t_dispatch
             except Exception:
                 parity = None
         if parity is None:
@@ -230,6 +355,8 @@ class EncodeBatcher:
                     import traceback
                     traceback.print_exc()
             return
+        if dev_time is not None and self.adaptive_cpu and learn:
+            self._learn_crossover(reqs, dev_time)
         self.calls += 1
         self.reqs_total += len(reqs)
         nstripes = sum(r.nstripes for r in reqs)

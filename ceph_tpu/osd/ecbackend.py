@@ -71,17 +71,23 @@ class _WriteOp:
 
 
 class _ReadOp:
-    """One in-flight reconstructing read (reference ECBackend::ReadOp)."""
+    """One in-flight reconstructing read (reference ECBackend::ReadOp).
+    ``ranges`` optionally narrows a shard's read to sub-chunk byte
+    runs (CLAY repair); a shard's received payload is the in-order
+    concatenation of its runs."""
 
     def __init__(self, tid: int, oid: str, chunk_off: int,
                  chunk_len: int, want_shards: Dict[int, int],
                  cb: Callable[[Dict[int, bytes], Dict[int, int]], None],
-                 tried: Optional[Set[int]] = None):
+                 tried: Optional[Set[int]] = None,
+                 ranges: Optional[Dict[int, List[Tuple[int, int]]]]
+                 = None):
         self.tid = tid
         self.oid = oid
         self.chunk_off = chunk_off
         self.chunk_len = chunk_len
         self.want_shards = want_shards       # shard -> osd
+        self.ranges = ranges or {}           # shard -> [(off, len)]
         self.received: Dict[int, bytes] = {}
         self.errors: Dict[int, int] = {}
         self.tried: Set[int] = tried or set(want_shards)
@@ -117,6 +123,12 @@ class ECBackend(PGBackend):
         self.recovery_ops: Dict[str, _RecoveryOp] = {}
         # FIFO write pipeline: ops commit in submission order
         self._pipeline: deque = deque()
+        # total bytes requested through _start_read (observability +
+        # the CLAY repair-bandwidth test)
+        self.read_bytes_total = 0
+        self.subchunk_repairs = 0        # CLAY repairs taken
+        self.repair_read_bytes = 0       # bytes those repairs read
+        self.repair_whole_bytes = 0      # what whole-chunk would read
 
     # ------------------------------------------------------------------
     # write path (reference submit_transaction -> start_rmw -> check_ops)
@@ -472,8 +484,9 @@ class ECBackend(PGBackend):
                 cb(-5, b"")
                 return
             try:
-                data = ecutil.decode_concat(self.sinfo, self.ec_impl,
-                                            received)
+                nbytes = sum(len(v) for v in received.values())
+                data = ecutil.decode_concat(
+                    self.sinfo, self._decode_impl(nbytes), received)
             except Exception:
                 cb(-5, b"")
                 return
@@ -481,6 +494,20 @@ class ECBackend(PGBackend):
             cb(0, data[lo:lo + length])
 
         self._start_read(oid, chunk_off, chunk_len, shards, reads_done)
+
+    def _decode_impl(self, nbytes: int):
+        """Decode through the CPU twin when the OSD batcher's learned
+        crossover says a device round trip of this size loses (same
+        economics as the encode side; bit-exact either way)."""
+        batcher = getattr(self.host, "encode_batcher", None)
+        if batcher is not None and \
+                hasattr(self.ec_impl, "encode_batch_async") and \
+                batcher.prefer_cpu(nbytes):
+            try:
+                return batcher.cpu_twin(self.ec_impl, self.sinfo)
+            except Exception:
+                pass
+        return self.ec_impl
 
     def _min_read_shards(self, want: Set[int],
                          exclude: Optional[Set[int]] = None
@@ -502,21 +529,35 @@ class ECBackend(PGBackend):
                     shards: Dict[int, int],
                     cb: Callable[[Dict[int, bytes], Dict[int, int]],
                                  None],
-                    tried: Optional[Set[int]] = None) -> None:
+                    tried: Optional[Set[int]] = None,
+                    ranges: Optional[Dict[int, List[Tuple[int, int]]]]
+                    = None) -> None:
         rop = _ReadOp(self.new_tid(), oid, chunk_off, chunk_len,
-                      dict(shards), cb, tried)
+                      dict(shards), cb, tried, ranges)
         self.in_flight_reads[rop.tid] = rop
         for shard, osd in shards.items():
+            extents = rop.ranges.get(shard,
+                                     [(chunk_off, chunk_len)])
+            self.read_bytes_total += sum(ln for _, ln in extents)
             if osd == self.host.whoami:
-                data, err = self._local_chunk_read(
-                    oid, shard, chunk_off, chunk_len)
-                self._read_piece(rop, shard, data, err)
+                parts: List[bytes] = []
+                err = 0
+                for off, length in extents:
+                    data, err = self._local_chunk_read(
+                        oid, shard, off, length)
+                    if err < 0:
+                        break
+                    parts.append(data)
+                self._read_piece(rop, shard,
+                                 b"".join(parts) if err == 0 else b"",
+                                 err)
             else:
                 self.host.send_shard(osd, MOSDECSubOpRead(
                     pgid=self.host.pgid_str, shard=shard,
                     from_osd=self.host.whoami, tid=rop.tid,
                     epoch=self.host.epoch,
-                    reads=[(oid, chunk_off, chunk_len)]))
+                    reads=[(oid, off, length)
+                           for off, length in extents]))
 
     def _local_chunk_read(self, oid: str, shard: int, off: int,
                           length: int) -> Tuple[bytes, int]:
@@ -638,13 +679,23 @@ class ECBackend(PGBackend):
                            attrs: Dict[str, bytes]) -> None:
         """READING state: gather k shards, decode missing (reference
         handle_recovery_read_complete, ECBackend.cc:414-481)."""
-        oid = rec.oid
         shard_len = self.sinfo.object_size_to_shard_size(info.size)
         missing_shards = {s for s, _ in rec.missing_on}
         if shard_len == 0:
             self._push_recovered(
                 rec, attrs, {s: b"" for s in missing_shards})
             return
+        if self._try_subchunk_repair(rec, attrs, shard_len,
+                                     missing_shards):
+            return
+        self._recover_whole(rec, attrs, shard_len, missing_shards)
+
+    def _recover_whole(self, rec: _RecoveryOp,
+                       attrs: Dict[str, bytes], shard_len: int,
+                       missing_shards: Set[int]) -> None:
+        """Generic recovery: read whole chunks from the minimum shard
+        set and batch-decode the missing ones."""
+        oid = rec.oid
         shards = self._min_read_shards(set(missing_shards),
                                        exclude=missing_shards)
         if shards is None:
@@ -661,8 +712,10 @@ class ECBackend(PGBackend):
                 rec.cb(-5)
                 return
             try:
-                dec = ecutil.decode(self.sinfo, self.ec_impl, received,
-                                    set(missing_shards))
+                nbytes = sum(len(v) for v in received.values())
+                dec = ecutil.decode(self.sinfo,
+                                    self._decode_impl(nbytes),
+                                    received, set(missing_shards))
             except Exception:
                 self.recovery_ops.pop(oid, None)
                 rec.cb(-5)
@@ -670,6 +723,62 @@ class ECBackend(PGBackend):
             self._push_recovered(rec, attrs, dec)
 
         self._start_read(oid, 0, shard_len, shards, reads_done)
+
+    def _try_subchunk_repair(self, rec: _RecoveryOp,
+                             attrs: Dict[str, bytes], shard_len: int,
+                             missing_shards: Set[int]) -> bool:
+        """CLAY MSR single-shard repair: read only the repair
+        sub-chunks (q^(t-1) of q^t planes) from each of d helpers
+        instead of whole chunks from k — the repair-bandwidth saving
+        that makes CLAY MSR (reference ECBackend.cc:1594
+        get_min_avail_to_read_shards consulting the plugin +
+        ErasureCodeClay::get_repair_subchunks, :334-392)."""
+        impl = self.ec_impl
+        if len(missing_shards) != 1:
+            return False
+        sub_no = getattr(impl, "get_sub_chunk_count", lambda: 1)()
+        if sub_no <= 1 or shard_len % sub_no:
+            return False
+        avail_map = {s: o for s, o in self.host.acting_shards()
+                     if o is not None and s not in missing_shards}
+        want = set(missing_shards)
+        try:
+            if not impl.is_repair(want, set(avail_map)):
+                return False
+            minimum = impl.minimum_to_repair(want, set(avail_map))
+        except Exception:
+            return False
+        sc = shard_len // sub_no
+        ranges = {c: [(off * sc, cnt * sc) for off, cnt in runs]
+                  for c, runs in minimum.items()}
+        shards = {c: avail_map[c] for c in minimum}
+        oid = rec.oid
+
+        def reads_done(received: Dict[int, bytes],
+                       errors: Dict[int, int]) -> None:
+            if rec.oid not in self.recovery_ops:
+                return
+            dec = None
+            if not errors and len(received) == len(shards):
+                try:
+                    dec = impl.decode(want, received, shard_len)
+                except Exception:
+                    dec = None
+            if dec is None:
+                # a helper failed or repair math balked: fall back to
+                # the whole-chunk path rather than failing the object
+                self._recover_whole(rec, attrs, shard_len,
+                                    missing_shards)
+                return
+            self._push_recovered(rec, attrs, dec)
+
+        self.subchunk_repairs += 1
+        self.repair_read_bytes += sum(
+            ln for runs in ranges.values() for _, ln in runs)
+        self.repair_whole_bytes += self.k * shard_len
+        self._start_read(oid, 0, shard_len, shards, reads_done,
+                         ranges=ranges)
+        return True
 
     def _push_recovered(self, rec: _RecoveryOp, attrs: Dict[str, bytes],
                         dec: Dict[int, bytes]) -> None:
@@ -766,10 +875,15 @@ class ECBackend(PGBackend):
             rop = self.in_flight_reads.get(msg.tid)
             if rop is None:
                 return True
-            for oid, err in msg.errors:
-                self._read_piece(rop, msg.shard, b"", err)
-            for oid, off, data in msg.buffers:
-                self._read_piece(rop, msg.shard, data, 0)
+            if msg.errors:
+                self._read_piece(rop, msg.shard, b"",
+                                 msg.errors[0][1])
+            elif msg.buffers:
+                # multi-extent replies (CLAY sub-chunk repair runs)
+                # concatenate in request order into one payload
+                self._read_piece(
+                    rop, msg.shard,
+                    b"".join(b for _, _, b in msg.buffers), 0)
             return True
         if isinstance(msg, MOSDPGPush):
             for push in msg.pushes:

@@ -77,8 +77,12 @@ class SnapSet:
     def needs_clone(self, snapc: SnapContext) -> bool:
         """A head that exists must be cloned before this write mutates
         it iff the writer has seen a snap newer than our last clone
-        era (reference make_writeable's snapc.seq > snapset.seq)."""
-        return snapc.seq > self.seq
+        era (reference make_writeable's snapc.seq > snapset.seq) AND
+        some LIVE snap would actually be covered — a stale context
+        whose newer snaps were all removed must not cut an orphan
+        clone covering nothing (it could never be trimmed)."""
+        return snapc.seq > self.seq and \
+            any(s > self.seq for s in snapc.snaps)
 
     def add_clone(self, snapc: SnapContext, head_size: int) -> int:
         """Record the COW clone for this write; returns the clone id

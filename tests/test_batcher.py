@@ -24,6 +24,7 @@ def make_batcher(**over):
     conf = {"ec_tpu_batch_stripes": 1024,
             "ec_tpu_queue_window_us": 30_000}
     conf.update(over)
+    EncodeBatcher.reset_learning()   # crossover state is process-wide
     return EncodeBatcher(conf)
 
 
@@ -153,11 +154,56 @@ def test_collector_survives_raising_continuation(codec, capsys):
         b.stop()
 
 
+def test_adaptive_crossover_routes_small_batches_to_cpu(codec):
+    """A device whose round trip loses to the CPU twin must push the
+    learned crossover up, after which small batches encode on the CPU
+    — bit-exactly — and the stats show it."""
+    b = make_batcher(ec_tpu_queue_window_us=1000)
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = os.urandom(2 * 8192)
+
+        real_async = type(codec).encode_batch_async
+
+        class SlowBatch:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def wait(self):
+                time.sleep(0.5)      # simulated terrible link
+                return self.inner.wait()
+
+        def slow_async(self_codec, arr):
+            return SlowBatch(real_async(self_codec, arr))
+
+        type(codec).encode_batch_async = slow_async
+        try:
+            done = threading.Event()
+            b.submit(codec, sinfo, data, lambda c: done.set())
+            assert done.wait(30)
+            assert b._min_device_bytes > len(data), \
+                "losing device call should raise the crossover"
+            # subsequent small batches take the CPU path
+            out = {}
+            done2 = threading.Event()
+            b.submit(codec, sinfo, data,
+                     lambda c: (out.update(c), done2.set()))
+            assert done2.wait(30)
+            assert b.cpu_reqs >= 1
+            assert out == ecutil.encode(sinfo, codec, data)
+        finally:
+            type(codec).encode_batch_async = real_async
+    finally:
+        b.stop()
+
+
 def test_cluster_concurrent_writes_coalesce():
     """Live cluster: concurrent client writes across PGs land in
     shared device calls on the primaries (the README's 'gathers
     stripes from many in-flight ops into one device call' claim)."""
-    conf = make_conf(ec_tpu_queue_window_us=100_000)
+    # adaptive CPU routing off: this test asserts DEVICE coalescing
+    conf = make_conf(ec_tpu_queue_window_us=100_000,
+                     ec_tpu_fallback_cpu=False)
     with Cluster(n_osds=3, conf=conf) as c:
         for i in range(3):
             c.wait_for_osd_up(i, 20)

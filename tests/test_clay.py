@@ -137,3 +137,48 @@ class TestClayRepair:
                for x in range(c.q)} - {lost}
         avail = set(range(n)) - {lost} - {next(iter(col))}
         assert not c.is_repair({lost}, avail)
+
+
+def test_clay_subchunk_recovery_saves_bandwidth():
+    """Single-shard recovery on a CLAY pool must read only the repair
+    sub-chunks (d helpers x q^(t-1) planes), not whole chunks from k
+    shards — the MSR repair-bandwidth property, exercised through the
+    FULL cluster recovery path (reference ECBackend.cc:1594 +
+    ErasureCodeClay::get_repair_subchunks)."""
+    import os
+
+    from ceph_tpu.cluster import Cluster
+
+    with Cluster(n_osds=7) as c:
+        for i in range(7):
+            c.wait_for_osd_up(i, 30)
+        c.create_ec_profile("clayp", plugin="clay", k="4", m="2")
+        c.create_pool("claypool", "erasure",
+                      erasure_code_profile="clayp")
+        io = c.rados().open_ioctx("claypool")
+        blobs = {f"cl{i}": os.urandom(96 << 10) for i in range(6)}
+        for k, v in blobs.items():
+            io.write_full(k, v)
+        c.wait_for_clean(30)
+
+        c.kill_osd(2, lose_data=True)
+        c.wait_for_osd_down(2)
+        c.revive_osd(2)
+        c.wait_for_osd_up(2)
+        c.wait_for_clean(120)
+
+        repairs = whole = took = 0
+        for osd in c.osds.values():
+            if osd is None:
+                continue
+            for pg in osd.pgs.values():
+                be = pg.backend
+                if hasattr(be, "subchunk_repairs"):
+                    repairs += be.subchunk_repairs
+                    took += be.repair_read_bytes
+                    whole += be.repair_whole_bytes
+        assert repairs > 0, "no CLAY sub-chunk repair was taken"
+        assert took < 0.8 * whole, \
+            f"repair read {took}B, whole-chunk would be {whole}B"
+        for k, v in blobs.items():
+            assert io.read(k) == v, "recovered data diverged"
