@@ -214,11 +214,17 @@ class Objecter(Dispatcher):
             op = self.inflight.get(msg.tid)
             linger = self.lingers.get(msg.tid)
         if op is None:
-            if linger is not None and msg.result < 0:
-                # a lingering registration (watch) failed to
-                # re-register — tell the owner instead of silently
-                # losing every future notify
-                self._linger_error(linger, msg.result)
+            if linger is not None:
+                if msg.result == EAGAIN_WRONG_PRIMARY:
+                    # stale targeting during failover: refresh + retry
+                    # — the exact event lingers exist to survive
+                    self.monc.subscribe_osdmap(msg.epoch)
+                    threading.Timer(0.05, self._send_op,
+                                    args=(linger,)).start()
+                elif msg.result < 0:
+                    # re-registration REJECTED (object gone): tell the
+                    # owner instead of silently losing every notify
+                    self._linger_error(linger, msg.result)
             return True                  # late duplicate
         if msg.result == EAGAIN_WRONG_PRIMARY:
             # stale targeting: refresh the map and resend (reference
@@ -256,16 +262,11 @@ class Objecter(Dispatcher):
         """A linger re-registration was rejected (object deleted, for
         example): drop it and fire the owner's error callback
         (reference watch error callback / rados_watcherrcb_t)."""
+        cookie = op.ops[0].offset if op.ops else 0
         with self.lock:
             self.lingers.pop(op.tid, None)
-            key = None
-            for (pool, oid, cookie), cbs in \
-                    list(self.watch_callbacks.items()):
-                if pool == op.pool and oid == op.oid:
-                    key = (pool, oid, cookie)
-                    break
-            cbs = self.watch_callbacks.pop(key, None) \
-                if key is not None else None
+            cbs = self.watch_callbacks.pop(
+                (op.pool, op.oid, cookie), None)
         if cbs is not None and getattr(cbs, "on_error", None):
             try:
                 cbs.on_error(result)
@@ -348,13 +349,9 @@ class IoCtx:
         timeout = timeout or self.rados.op_timeout
         span = self.rados.tracer.maybe_start("rados_op") \
             if self.rados.tracer else None
-        from ..osd.pg import WRITE_OPS
+        from ..osd.pg import HEAD_PINNED_OPS, WRITE_OPS
         is_write = any(o.op in WRITE_OPS for o in ops)
-        # watch-class (and list_snaps) ops are head-pinned: they must
-        # not be snap-resolved even while a read snap is set
-        HEAD_PINNED = {"watch", "unwatch", "notify", "notify_ack",
-                       "list_watchers", "list_snaps", "pgls"}
-        head_pinned = any(o.op in HEAD_PINNED for o in ops)
+        head_pinned = any(o.op in HEAD_PINNED_OPS for o in ops)
         c = self.rados.objecter.submit(
             self.pool_id, oid, ops,
             trace_id=span.trace_id if span else 0,

@@ -89,8 +89,81 @@ def _recv_banner(sock: socket.socket) -> Tuple[str, int, int, bool]:
     return name, nonce, in_seq, bool(lossless)
 
 
+class _SecureSocket:
+    """AES-GCM transport wrapper (reference ProtocolV2 secure mode,
+    msg/async/ProtocolV2.cc): every ``sendall`` becomes one
+    ``[u32 len][ciphertext+16B tag]`` segment under a per-direction
+    counter nonce; ``recv`` serves decrypted plaintext.  Tampering or
+    truncation surfaces as ConnectionError (GCM tag failure), which
+    kills the socket exactly like a CRC-corrupt stream."""
+
+    def __init__(self, sock: socket.socket, key: bytes,
+                 send_prefix: bytes, recv_prefix: bytes):
+        from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+        self._sock = sock
+        self._aes = AESGCM(key)
+        self._send_prefix = send_prefix      # 4 bytes, per direction
+        self._recv_prefix = recv_prefix
+        self._send_ctr = 0
+        self._recv_ctr = 0
+        self._rbuf = b""
+        self._send_lock = threading.Lock()
+
+    def sendall(self, data) -> None:
+        with self._send_lock:
+            nonce = self._send_prefix + \
+                self._send_ctr.to_bytes(8, "little")
+            self._send_ctr += 1
+            ct = self._aes.encrypt(nonce, bytes(data), None)
+            self._sock.sendall(struct.pack("<I", len(ct)) + ct)
+
+    def recv(self, n: int) -> bytes:
+        if not self._rbuf:
+            (ln,) = struct.unpack("<I", _read_exact(self._sock, 4))
+            if ln > MAX_FRAME + (1 << 16):
+                raise ConnectionError(f"oversized secure segment {ln}")
+            ct = _read_exact(self._sock, ln)
+            nonce = self._recv_prefix + \
+                self._recv_ctr.to_bytes(8, "little")
+            self._recv_ctr += 1
+            try:
+                self._rbuf = self._aes.decrypt(nonce, ct, None)
+            except Exception as e:
+                raise ConnectionError(
+                    f"secure frame authentication failed: {e!r}")
+        out, self._rbuf = self._rbuf[:n], self._rbuf[n:]
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self._sock, name)
+
+
+def _secure_negotiate(sock: socket.socket, key: bytes,
+                      c_chal: bytes, a_chal: bytes,
+                      acceptor: bool, want_secure: bool):
+    """Post-auth crypto negotiation (reference ProtocolV2 con-mode
+    negotiation): both sides state their mode; a mismatch is a clear
+    error rather than a garbled stream.  In secure mode the session
+    key derives from the auth secret and BOTH handshake challenges,
+    so every connection gets a fresh key without extra round trips."""
+    import hmac as _hmac
+    sock.sendall(b"\x01" if want_secure else b"\x00")
+    peer_secure = _read_exact(sock, 1) == b"\x01"
+    if peer_secure != want_secure:
+        verb = "requires" if peer_secure else "refuses"
+        raise ConnectionError(
+            f"ms_secure_mode mismatch: peer {verb} encryption")
+    if not want_secure:
+        return sock
+    session_key = _hmac.new(key, b"secure-session" + c_chal + a_chal,
+                            "sha256").digest()
+    my_prefix, peer_prefix = (b"ACPT", b"CNCT") if acceptor \
+        else (b"CNCT", b"ACPT")
+    return _SecureSocket(sock, session_key, my_prefix, peer_prefix)
+
+
 def _auth_exchange(sock: socket.socket, key: bytes,
-                   acceptor: bool) -> None:
+                   acceptor: bool) -> Tuple[bytes, bytes]:
     """Mutual shared-secret proof (reference cephx's
     challenge/authenticator flow, collapsed to one round).  Each proof
     is HMAC-SHA256(key, role_tag || connector_challenge ||
@@ -116,6 +189,7 @@ def _auth_exchange(sock: socket.socket, key: bytes,
                      "sha256").digest()
     if not _hmac.compare_digest(proof, want):
         raise ConnectionError("cephx: bad authenticator")
+    return c_chal, a_chal
 
 
 def _shutdown_close(sock: Optional[socket.socket]) -> None:
@@ -405,6 +479,21 @@ class Messenger:
             raise ValueError(
                 "auth_cluster_required=cephx needs a non-empty "
                 "auth_key (an empty HMAC secret protects nothing)")
+        # wire encryption (reference msgr2 secure mode): needs the
+        # cephx secret for session-key derivation
+        self.secure_mode = bool(self.conf["ms_secure_mode"])
+        if self.secure_mode and not self.auth_required:
+            raise ValueError(
+                "ms_secure_mode needs auth_cluster_required=cephx "
+                "(the session key derives from the auth secret)")
+        if self.secure_mode:
+            try:
+                from cryptography.hazmat.primitives.ciphers.aead \
+                    import AESGCM                      # noqa: F401
+            except ImportError as e:
+                raise ValueError(
+                    "ms_secure_mode needs the 'cryptography' "
+                    "package for AES-GCM") from e
 
     # -- lifecycle ---------------------------------------------------------
     def bind(self, addr: Tuple[str, int] = ("127.0.0.1", 0)
@@ -505,8 +594,12 @@ class Messenger:
                     _send_banner(sock, self.name, self.nonce, in_seq,
                                  conn.lossless)
                     if self.auth_required:
-                        _auth_exchange(sock, self.auth_key,
-                                       acceptor=False)
+                        c_chal, a_chal = _auth_exchange(
+                            sock, self.auth_key, acceptor=False)
+                        sock = _secure_negotiate(
+                            sock, self.auth_key, c_chal, a_chal,
+                            acceptor=False,
+                            want_secure=self.secure_mode)
                     peer_name, peer_nonce, peer_in_seq, _ = \
                         _recv_banner(sock)
                     sock.settimeout(None)
@@ -571,7 +664,11 @@ class Messenger:
                 # BEFORE touching session state: an unauthenticated
                 # dial must not be able to retire/replace live
                 # sessions just by naming them in its banner
-                _auth_exchange(sock, self.auth_key, acceptor=True)
+                c_chal, a_chal = _auth_exchange(sock, self.auth_key,
+                                                acceptor=True)
+                sock = _secure_negotiate(
+                    sock, self.auth_key, c_chal, a_chal,
+                    acceptor=True, want_secure=self.secure_mode)
             stale = None
             with self.lock:
                 if not peer_lossless:

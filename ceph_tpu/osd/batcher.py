@@ -178,19 +178,19 @@ class EncodeBatcher:
                     groups.append((reqs, "cpu"))
                 else:
                     groups.append((reqs, self._dispatch_group(reqs)))
-            n_dev = sum(1 for _, h in groups if h != "cpu")
             for reqs, handle in groups:
                 try:
                     if handle == "cpu":
                         self._complete_group_cpu(reqs)
                     else:
                         # crossover learning only when this cycle has
-                        # ONE device group: with several, a later
-                        # group's wait includes the earlier groups'
-                        # waits + completion callbacks, which would
-                        # spuriously ratchet the threshold up
+                        # exactly ONE group of any kind: other groups'
+                        # synchronous completions (CPU encodes, commit
+                        # fanout callbacks) would inflate dev_time and
+                        # ratchet the threshold up on a healthy device
                         self._complete_group(reqs, handle,
-                                             learn=(n_dev == 1))
+                                             learn=(len(groups)
+                                                    == 1))
                 except Exception:
                     import traceback
                     traceback.print_exc()

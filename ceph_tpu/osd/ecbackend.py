@@ -770,12 +770,14 @@ class ECBackend(PGBackend):
                 self._recover_whole(rec, attrs, shard_len,
                                     missing_shards)
                 return
+            # stats record SUCCESSFUL repairs only — a fallback would
+            # otherwise report savings that did not happen
+            self.subchunk_repairs += 1
+            self.repair_read_bytes += sum(
+                ln for runs in ranges.values() for _, ln in runs)
+            self.repair_whole_bytes += self.k * shard_len
             self._push_recovered(rec, attrs, dec)
 
-        self.subchunk_repairs += 1
-        self.repair_read_bytes += sum(
-            ln for runs in ranges.values() for _, ln in runs)
-        self.repair_whole_bytes += self.k * shard_len
         self._start_read(oid, 0, shard_len, shards, reads_done,
                          ranges=ranges)
         return True

@@ -67,6 +67,10 @@ READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
             "omap_get_by_key", "pgls", "list_snaps",
             "watch", "unwatch", "notify", "notify_ack",
             "list_watchers"}
+# read-class ops that always address the HEAD (never snap-resolved
+# even while the client holds a read snap)
+HEAD_PINNED_OPS = {"watch", "unwatch", "notify", "notify_ack",
+                   "list_watchers", "list_snaps", "pgls"}
 
 
 class PG:

@@ -140,6 +140,10 @@ def _opts() -> List[Option]:
                description="one in N sends fails (fault injection)"),
         Option("ms_connection_retry_interval", float, 0.2, min=0.01),
         Option("ms_crc_data", bool, True),
+        Option("ms_secure_mode", bool, False,
+               description="AES-GCM-encrypt every wire frame "
+                           "(reference msgr2 secure mode); requires "
+                           "cephx auth for key material"),
         Option("ms_compress_mode", str, "",
                description="frame compression codec ('' off; zlib/"
                            "bz2/lzma; reference msgr2 compression)"),

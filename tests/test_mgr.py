@@ -10,7 +10,8 @@ import urllib.request
 
 import pytest
 
-from ceph_tpu.cluster import Cluster, test_config
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
 from ceph_tpu.mgr.manager import (balancer_report,
                                   pg_autoscale_recommendations)
 from ceph_tpu.tools import ceph_cli
@@ -18,7 +19,7 @@ from ceph_tpu.tools import ceph_cli
 
 @pytest.fixture(scope="module")
 def cl():
-    conf = test_config(mgr_tick_interval=0.3)
+    conf = make_conf(mgr_tick_interval=0.3)
     with Cluster(n_osds=3, conf=conf, with_mgr=True) as c:
         for i in range(3):
             c.wait_for_osd_up(i, 20)

@@ -9,14 +9,15 @@ import time
 import pytest
 
 from ceph_tpu.client.rados import Rados
-from ceph_tpu.cluster import Cluster, test_config
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
 from ceph_tpu.mon.client import CommandTimeout
 
 
 def quorum_conf(**over):
     # lease comfortably above tick so GIL stalls under pytest load
     # don't fake leader death
-    return test_config(mon_lease=2.5, mon_election_timeout=1.0,
+    return make_conf(mon_lease=2.5, mon_election_timeout=1.0,
                        mon_tick_interval=0.25, **over)
 
 

@@ -7,7 +7,8 @@ import time
 
 import pytest
 
-from ceph_tpu.cluster import Cluster, test_config
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
 from ceph_tpu.client.rados import Rados
 from ceph_tpu.utils.tracer import Tracer
 
@@ -40,7 +41,7 @@ def test_tracer_spans_and_sampling():
 def test_spans_cross_daemons_ec_write():
     """One traced client write to an EC pool must produce spans with
     the SAME trace id on the client, the primary, and shard OSDs."""
-    conf = test_config(osd_tracing=True, rados_tracing=True)
+    conf = make_conf(osd_tracing=True, rados_tracing=True)
     with Cluster(n_osds=3, conf=conf) as c:
         for i in range(3):
             c.wait_for_osd_up(i, 20)
@@ -83,7 +84,7 @@ def test_spans_cross_daemons_ec_write():
 
 
 def test_dump_traces_tell_command():
-    conf = test_config(osd_tracing=True, rados_tracing=True)
+    conf = make_conf(osd_tracing=True, rados_tracing=True)
     with Cluster(n_osds=2, conf=conf) as c:
         for i in range(2):
             c.wait_for_osd_up(i, 20)
