@@ -217,10 +217,13 @@ class Objecter(Dispatcher):
             if linger is not None:
                 if msg.result == EAGAIN_WRONG_PRIMARY:
                     # stale targeting during failover: refresh + retry
-                    # — the exact event lingers exist to survive
+                    # — the exact event lingers exist to survive.
+                    # Re-check registration at fire time: a ghost
+                    # re-send after linger_cancel would re-register a
+                    # watch nobody owns
                     self.monc.subscribe_osdmap(msg.epoch)
-                    threading.Timer(0.05, self._send_op,
-                                    args=(linger,)).start()
+                    threading.Timer(0.05, self._resend_linger,
+                                    args=(linger.tid,)).start()
                 elif msg.result < 0:
                     # re-registration REJECTED (object gone): tell the
                     # owner instead of silently losing every notify
@@ -257,6 +260,12 @@ class Objecter(Dispatcher):
     def linger_cancel(self, linger_id: int) -> None:
         with self.lock:
             self.lingers.pop(linger_id, None)
+
+    def _resend_linger(self, tid: int) -> None:
+        with self.lock:
+            op = self.lingers.get(tid)
+        if op is not None:
+            self._send_op(op)
 
     def _linger_error(self, op: "_InflightOp", result: int) -> None:
         """A linger re-registration was rejected (object deleted, for

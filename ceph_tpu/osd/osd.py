@@ -32,7 +32,6 @@ framework's PG/backend stack needs:
 """
 from __future__ import annotations
 
-import queue
 import threading
 import time
 from typing import Dict, List, Optional, Tuple
@@ -126,10 +125,17 @@ class OSD(Dispatcher):
         self.msgr.add_dispatcher(self)
         self.monc = MonClient(self.msgr, mon_addr,
                               map_cb=self._on_map_published)
-        # sharded op queue (reference op_shardedwq, OSD.h:1287)
+        # sharded op queue (reference op_shardedwq, OSD.h:1287) with
+        # mClock-style QoS per shard (reference osd/scheduler/): the
+        # client/recovery/scrub classes stop sharing a plain FIFO
+        from .scheduler import OpScheduler, qos_from_conf
         self._n_shards = self.conf["osd_op_num_shards"]
-        self._shard_queues: List[queue.Queue] = [
-            queue.Queue() for _ in range(self._n_shards)]
+        fifo = self.conf["osd_op_queue"] == "fifo"
+        qos = {} if fifo else qos_from_conf(self.conf)
+        hard = any(lim > 0 for _, _, lim in qos.values())
+        self._shard_queues: List[OpScheduler] = [
+            OpScheduler(qos, hard_limits=hard, fifo=fifo)
+            for _ in range(self._n_shards)]
         self._workers: List[threading.Thread] = []
         self._stop = threading.Event()
         self._recovery_kick = threading.Event()
@@ -200,7 +206,7 @@ class OSD(Dispatcher):
         self.encode_batcher.stop()
         self._recovery_kick.set()
         for q in self._shard_queues:
-            q.put(None)
+            q.close()
         self.msgr.shutdown()
         for t in self._workers + self._threads:
             t.join(timeout=5)
@@ -323,14 +329,61 @@ class OSD(Dispatcher):
     def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
         pgid = PGid(msg.pool, msg.pgid_seed)
         shard = hash(pgid) % self._n_shards
-        self._shard_queues[shard].put((conn, msg))
+        self._shard_queues[shard].enqueue("client", (conn, msg))
+
+    def _shard_of_pg(self, pg: PG) -> int:
+        return hash(pg.pgid) % self._n_shards
+
+    def queue_recovery_item(self, pg: PG) -> None:
+        """One recovery scheduling unit for this PG (reference
+        PGRecovery OpSchedulerItem); deduped so a PG holds at most one
+        queued item."""
+        with pg.lock:
+            if getattr(pg, "_recovery_queued", False):
+                return
+            pg._recovery_queued = True
+        self._shard_queues[self._shard_of_pg(pg)].enqueue(
+            "recovery", pg)
+
+    def _run_recovery_item(self, pg: PG) -> None:
+        with pg.lock:
+            pg._recovery_queued = False
+        try:
+            started = pg.start_recovery_ops(
+                self.conf["osd_recovery_max_active"])
+        except Exception:
+            import traceback
+            traceback.print_exc()
+            started = 0
+        if started:
+            self.perf.inc("recovery_ops", started)
+            sleep = self.conf["osd_recovery_sleep"]
+            if sleep:
+                time.sleep(sleep)    # reference recovery pacing knob
+            # more work may remain; requeue behind whatever the
+            # scheduler owes other classes
+            with pg.lock:
+                more = pg.is_primary() and pg.num_missing() > 0
+            if more:
+                self.queue_recovery_item(pg)
 
     def _op_worker(self, shard: int) -> None:
         q = self._shard_queues[shard]
         while True:
-            item = q.get()
-            if item is None:
+            out = q.dequeue()
+            if out is None:
                 return
+            cls, item = out
+            if cls == "recovery":
+                self._run_recovery_item(item)
+                continue
+            if cls == "scrub":
+                try:
+                    item()
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
+                continue
             conn, msg = item
             pgid = PGid(msg.pool, msg.pgid_seed)
             pg = self._lookup_pg(pgid)
@@ -478,8 +531,10 @@ class OSD(Dispatcher):
         self._recovery_kick.set()
 
     def _recovery_loop(self) -> None:
-        max_active = self.conf["osd_recovery_max_active"]
-        sleep = self.conf["osd_recovery_sleep"]
+        """Scan for PGs owing recovery and hand them to the sharded
+        op queues as ``recovery``-class items — the mClock scheduler
+        arbitrates them against client IO (reference: recovery work
+        rides OpSchedulerItems through the same queues)."""
         while not self._stop.is_set():
             self._recovery_kick.wait(timeout=0.2)
             self._recovery_kick.clear()
@@ -491,13 +546,16 @@ class OSD(Dispatcher):
                 if self._stop.is_set():
                     return
                 try:
-                    started = pg.start_recovery_ops(max_active)
+                    with pg.lock:
+                        need = pg.is_primary() and \
+                            pg.state == STATE_ACTIVE and \
+                            (pg.num_missing() > 0
+                             or pg.waiting_for_degraded)
+                    if need:
+                        self.queue_recovery_item(pg)
                 except Exception:
                     import traceback
                     traceback.print_exc()
-                    started = 0
-                if started and sleep:
-                    time.sleep(sleep)
 
     # ------------------------------------------------------------------
     # tick: pg stats + stuck-peering retry
@@ -562,7 +620,23 @@ class OSD(Dispatcher):
                     continue
                 deep = deep_iv > 0 and \
                     now - pg.scrubber.last_deep_scrub >= deep_iv
-                pg.scrubber.start(deep=deep, repair=False)
+                # scrub-class work goes through the scheduler so it
+                # never outruns client IO (reference PGScrub items)
+                self._shard_queues[self._shard_of_pg(pg)].enqueue(
+                    "scrub",
+                    lambda p=pg, d=deep: self._start_scrub(p, d))
+
+    def _start_scrub(self, pg: PG, deep: bool) -> None:
+        with pg.lock:
+            if not pg.is_primary() or pg.state != STATE_ACTIVE \
+                    or pg.scrubber.active:
+                return
+            # re-check freshness: stacked queue items must not run
+            # back-to-back scrubs of the same PG
+            if time.time() - pg.scrubber.last_scrub < \
+                    self.conf["osd_scrub_interval"]:
+                return
+            pg.scrubber.start(deep=deep, repair=False)
 
     def _send_pg_stats(self) -> None:
         stats: Dict[str, dict] = {}

@@ -85,6 +85,21 @@ def _opts() -> List[Option]:
         # -- osd (reference options.cc:2869-2901,2478,3159) ---------------
         Option("osd_op_num_shards", int, 5, min=1,
                description="sharded op queue shard count"),
+        Option("osd_op_queue", str, "mclock_scheduler",
+               description="op scheduler: mclock_scheduler or fifo "
+                           "(reference osd_op_queue)"),
+        # dmClock triples (reference osd_mclock_scheduler_*): res =
+        # guaranteed tokens/s, wgt = spare-capacity share, lim = cap
+        # (0 = none)
+        Option("osd_mclock_scheduler_client_res", float, 100.0),
+        Option("osd_mclock_scheduler_client_wgt", float, 100.0),
+        Option("osd_mclock_scheduler_client_lim", float, 0.0),
+        Option("osd_mclock_scheduler_recovery_res", float, 0.0),
+        Option("osd_mclock_scheduler_recovery_wgt", float, 10.0),
+        Option("osd_mclock_scheduler_recovery_lim", float, 0.0),
+        Option("osd_mclock_scheduler_scrub_res", float, 0.0),
+        Option("osd_mclock_scheduler_scrub_wgt", float, 5.0),
+        Option("osd_mclock_scheduler_scrub_lim", float, 0.0),
         Option("osd_op_num_threads_per_shard", int, 1, min=1),
         Option("osd_recovery_max_active", int, 3, min=1,
                description="recovery ops in flight per OSD"),

@@ -1,0 +1,115 @@
+"""Op scheduler (mClock-lite QoS) tests.
+
+Reference analog: src/osd/scheduler/mClockScheduler behavior —
+client reservation under background floods, weighted sharing of spare
+capacity, limits, and the live-cluster property the feature exists
+for: client IO stays served while recovery churns."""
+import os
+import time
+
+import pytest
+
+from ceph_tpu.osd.scheduler import OpScheduler
+
+
+def drain(sched, n):
+    out = []
+    for _ in range(n):
+        got = sched.dequeue(timeout=1.0)
+        if got is None:
+            break
+        out.append(got[0])
+    return out
+
+
+def test_client_beats_background_flood():
+    s = OpScheduler()
+    for i in range(500):
+        s.enqueue("recovery", i)
+    for i in range(10):
+        s.enqueue("client", i)
+    served = drain(s, 60)
+    first_client = [i for i, c in enumerate(served) if c == "client"]
+    assert len(first_client) == 10, "every client op must be served"
+    assert first_client[-1] < 40, \
+        f"client ops starved behind recovery: positions {first_client}"
+    s.close()
+
+
+def test_weighted_sharing_of_spare_capacity():
+    s = OpScheduler({"recovery": (0, 10, 0), "scrub": (0, 5, 0)})
+    for i in range(600):
+        s.enqueue("recovery", i)
+        s.enqueue("scrub", i)
+    served = drain(s, 300)
+    rec = served.count("recovery")
+    scr = served.count("scrub")
+    assert rec > scr, (rec, scr)
+    # 10:5 weights -> ~2:1 split; allow slack for the deficit rounding
+    assert 1.5 < rec / max(scr, 1) < 2.7, (rec, scr)
+    s.close()
+
+
+def test_hard_limit_caps_a_class():
+    s = OpScheduler({"scrub": (0, 5, 10.0)}, hard_limits=True)
+    for i in range(100):
+        s.enqueue("scrub", i)
+    t0 = time.monotonic()
+    served = drain(s, 15)
+    took = time.monotonic() - t0
+    # 10 tokens/s (plus <=1s initial burst): 15 items need >= ~0.5s
+    assert took > 0.3, f"limit not enforced ({took:.2f}s for 15)"
+    s.close()
+
+
+def test_unknown_class_still_served():
+    s = OpScheduler()
+    s.enqueue("exotic", "x")
+    got = s.dequeue(timeout=2.0)
+    assert got == ("exotic", "x")
+    s.close()
+
+
+def test_close_wakes_dequeue():
+    s = OpScheduler()
+    import threading
+    out = []
+    t = threading.Thread(target=lambda: out.append(s.dequeue()))
+    t.start()
+    time.sleep(0.1)
+    s.close()
+    t.join(5)
+    assert out == [None]
+
+
+def test_client_latency_under_recovery_load():
+    """Live cluster: while a large recovery churns, client reads must
+    keep completing promptly — the starvation the scheduler exists to
+    prevent."""
+    from ceph_tpu.cluster import Cluster
+
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("qos", "replicated", size=3)
+        client = c.rados(timeout=30)
+        client.op_timeout = 60.0
+        io = client.open_ioctx("qos")
+        blob = os.urandom(64 << 10)
+        for i in range(40):
+            io.write_full(f"q{i}", blob)
+        c.wait_for_clean(30)
+        c.kill_osd(2, lose_data=True)
+        c.wait_for_osd_down(2)
+        c.revive_osd(2)
+        c.wait_for_osd_up(2)
+        # recovery of 40 objects is now churning; client reads must
+        # not queue behind it
+        lat = []
+        for i in range(15):
+            t0 = time.monotonic()
+            assert io.read(f"q{i}") == blob
+            lat.append(time.monotonic() - t0)
+        lat.sort()
+        assert lat[-1] < 10.0, f"client read starved: {lat[-3:]}"
+        c.wait_for_clean(60)     # and recovery still finishes
