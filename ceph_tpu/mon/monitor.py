@@ -987,10 +987,25 @@ class Monitor(Dispatcher):
         return (0, ent.key, {"key": ent.key})
 
     def _cmd_config_set(self, cmd: dict):
+        """Central config (reference ConfigMonitor): the override is
+        validated locally, then replicated to every daemon by riding
+        the next map epoch — daemons apply it on publish and their
+        config observers fire."""
         try:
             self.conf.set(cmd["name"], cmd["value"])
         except (KeyError, ValueError) as e:
             return (-22, str(e), {})
+        with self.lock:
+            inc = self._pending()
+            inc.new_config[cmd["name"]] = str(cmd["value"])
+            self._commit(inc)
+        return (0, "", {})
+
+    def _cmd_config_rm(self, cmd: dict):
+        with self.lock:
+            inc = self._pending()
+            inc.old_config.append(cmd["name"])
+            self._commit(inc)
         return (0, "", {})
 
     def _cmd_config_get(self, cmd: dict):
@@ -1025,6 +1040,7 @@ class Monitor(Dispatcher):
         "pg deep-scrub": _cmd_pg_deep_scrub,
         "pg repair": _cmd_pg_repair,
         "config set": _cmd_config_set,
+        "config rm": _cmd_config_rm,
         "config get": _cmd_config_get,
         "auth get-or-create": _cmd_auth_get_or_create,
         "auth get": _cmd_auth_get,

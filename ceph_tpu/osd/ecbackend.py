@@ -53,7 +53,15 @@ from .pglog import Eversion, LogEntry
 
 
 class _WriteOp:
-    """One in-flight client write (reference ECBackend::Op)."""
+    """One in-flight client write (reference ECBackend::Op).
+
+    Pipeline states: PENDING (queued, not started) -> RMW (started,
+    gathering reads / encoding) -> ENCODED (chunks ready, awaiting
+    its turn to send) -> SENT (sub-writes out) -> DONE.  Barrier ops
+    (anything beyond plain data writes) start only at the pipeline
+    head and block everything behind them."""
+
+    PENDING, RMW, ENCODED, SENT, DONE = range(5)
 
     def __init__(self, tid: int, oid: str, mutation: Mutation,
                  at_version: Eversion, log_entries: List[LogEntry],
@@ -68,6 +76,17 @@ class _WriteOp:
         self.read_data: bytes = b""
         self.obj_info = None             # fetched once in _start_rmw
         self.pending_commits: Set[int] = set()           # shards
+        self.state = self.PENDING
+        self.barrier = True
+        self.alive = True                # False after on_change()
+        self.tracked = False             # registered in extent overlay
+        self.encoded: Optional[Tuple] = None  # (astart, hi, chunks)
+        self.committed_size = 0          # store-visible size at start
+        self.projected_base = 0          # + earlier in-flight writes
+        self.seq = 0                     # submission order (overlay)
+        self.poisoned = 0                # errno: earlier same-obj op
+                                         # failed after we may have
+                                         # absorbed its bytes
 
 
 class _ReadOp:
@@ -121,8 +140,19 @@ class ECBackend(PGBackend):
         self.in_flight_reads: Dict[int, _ReadOp] = {}
         self.attr_fetches: Dict[int, Tuple] = {}    # tid -> (rec,)
         self.recovery_ops: Dict[str, _RecoveryOp] = {}
-        # FIFO write pipeline: ops commit in submission order
+        # write pipeline: encodes run CONCURRENTLY (depth > 1), but
+        # sub-write fan-out happens strictly in submission order so
+        # every shard's log stays monotonic (reference check_ops
+        # ordering contract, ECBackend.cc:2151); the extent overlay
+        # below plays the reference ExtentCache's role for RMW reads
+        # of in-flight bytes
         self._pipeline: deque = deque()
+        # oid -> {"ops": n, "writes": [(off, bytes)...] in submission
+        # order, "size": projected logical size} for STARTED plain
+        # writes (reference ExtentCache pins)
+        self._pending_objs: Dict[str, Dict] = {}
+        self.max_pipeline_depth = 0      # queued depth high-water
+        self.max_concurrent_ops = 0      # simultaneously EXECUTING
         # total bytes requested through _start_read (observability +
         # the CLAY repair-bandwidth test)
         self.read_bytes_total = 0
@@ -139,23 +169,155 @@ class ECBackend(PGBackend):
                            on_all_commit: Callable[[int], None]) -> None:
         op = _WriteOp(self.new_tid(), oid, mutation, at_version,
                       log_entries, on_all_commit)
+        # plain data writes pipeline (depth > 1); anything that
+        # touches object lifecycle or metadata beyond the write is a
+        # BARRIER: it waits for the pipeline and blocks what follows
+        # (the reference pins such ops through the cache too; this
+        # split keeps the overlay algebra to pure byte extents)
+        mut = mutation
+        op.barrier = not (mut.writes and mut.truncate is None
+                          and not mut.delete and not mut.create
+                          and mut.clone_to is None
+                          and mut.rollback_from is None
+                          and not mut.aux_remove
+                          and mut.snapdir_set is None)
+        self._op_seq = getattr(self, "_op_seq", 0) + 1
+        op.seq = self._op_seq
         self._pipeline.append(op)
-        if len(self._pipeline) == 1:
-            self._start_rmw(op)
+        self.max_pipeline_depth = max(self.max_pipeline_depth,
+                                      len(self._pipeline))
+        self._admit_ops()
+
+    def _admit_ops(self) -> None:
+        """Start every op that may legally run: the consecutive run
+        of non-barrier ops at the head, or a barrier exactly at the
+        head (reference check_ops admission)."""
+        for op in list(self._pipeline):
+            if op.barrier:
+                if op.state == op.PENDING \
+                        and self._pipeline[0] is op:
+                    op.state = op.RMW
+                    self._start_rmw(op)
+                break                # nothing may pass a barrier
+            if op.state == op.PENDING:
+                op.state = op.RMW
+                self._track_pending(op)
+                self._start_rmw(op)
+        running = sum(1 for o in self._pipeline
+                      if o.state in (o.RMW, o.ENCODED, o.SENT))
+        self.max_concurrent_ops = max(self.max_concurrent_ops,
+                                      running)
+
+    # -- extent overlay (reference ExtentCache) ------------------------
+    def _track_pending(self, op: _WriteOp) -> None:
+        st = self._pending_objs.setdefault(
+            op.oid, {"ops": 0, "writes": [], "size": 0})
+        st["ops"] += 1
+        op.tracked = True
+        # snapshot the projection BEFORE this op's own writes land
+        op.projected_base = max(st["size"], 0)
+        for off, data in op.mutation.writes:
+            st["writes"].append((op.seq, off, data))
+            st["size"] = max(st["size"], off + len(data))
+
+    def _untrack_pending(self, op: _WriteOp,
+                         failed: bool = False) -> None:
+        if not op.tracked:
+            return
+        op.tracked = False
+        st = self._pending_objs.get(op.oid)
+        if st is None:
+            return
+        st["ops"] -= 1
+        if failed:
+            # a FAILED op's bytes must never reach another op's
+            # encode; any later op that may already have absorbed
+            # them gets poisoned by the caller
+            st["writes"] = [w for w in st["writes"]
+                            if w[0] != op.seq]
+        if st["ops"] <= 0:
+            # no in-flight writes left: committed state has absorbed
+            # every overlay byte — drop the object's cache.
+            # (Successful ops' entries stay until then: a concurrent
+            # reader's shard data may still predate them.)
+            del self._pending_objs[op.oid]
+
+    def _overlay(self, oid: str, buf: bytearray, astart: int,
+                 before_seq: int) -> None:
+        """Apply in-flight writes SUBMITTED BEFORE ``before_seq``
+        intersecting [astart, astart+len(buf)), in submission order —
+        the ExtentCache read: projected bytes come from memory, never
+        from shards whose application state is in flux.  Later ops'
+        bytes must not leak backwards in time."""
+        st = self._pending_objs.get(oid)
+        if st is None:
+            return
+        aend = astart + len(buf)
+        for seq, off, data in st["writes"]:
+            if seq >= before_seq:
+                continue
+            lo = max(off, astart)
+            hi = min(off + len(data), aend)
+            if lo < hi:
+                buf[lo - astart:hi - astart] = \
+                    data[lo - off:hi - off]
+
+    def _overlay_covers(self, oid: str, lo: int, hi: int,
+                        committed_end: int, before_seq: int) -> bool:
+        """True when [lo,hi) needs no shard read: every byte is either
+        beyond the committed size (zeros + overlay) or covered by an
+        in-flight write."""
+        if lo >= committed_end:
+            return True
+        st = self._pending_objs.get(oid)
+        if st is None:
+            return False
+        spans = sorted((off, off + len(d))
+                       for seq, off, d in st["writes"]
+                       if seq < before_seq)
+        pos = lo
+        end = min(hi, committed_end)
+        for s, e in spans:
+            if s > pos:
+                return False
+            pos = max(pos, e)
+            if pos >= end:
+                return True
+        return pos >= end
+
+    def _fail_op(self, op: _WriteOp, err: int) -> None:
+        """Fail an op mid-pipeline.  Its overlay bytes are withdrawn,
+        and any LATER in-flight op on the same object that may already
+        have absorbed them into its encode fails too (the client is
+        told; nothing lands silently)."""
+        op.on_all_commit(err)
+        self._untrack_pending(op, failed=True)
+        for o in self._pipeline:
+            if o.seq > op.seq and o.oid == op.oid \
+                    and o.state != o.DONE and not o.poisoned:
+                o.poisoned = err
+                self._untrack_pending(o, failed=True)
+        self._complete_op(op)
 
     def _start_rmw(self, op: _WriteOp) -> None:
         """Compute the WritePlan (reference get_write_plan,
         ECTransaction.h:40): which existing stripes must be read back
-        before this mutation can be encoded.  Runs when the op reaches
-        the head of the per-object queue, so object state (exclusive-
-        create check included) reflects all earlier queued writes."""
+        before this mutation can be encoded.  For pipelined ops the
+        logical size projects over the in-flight writes (the overlay
+        below plays ExtentCache), so sizes/appends stay correct even
+        though earlier ops have not committed yet."""
         info = self.get_object_info(op.oid)
         mut = op.mutation
         if mut.create and info is not None:
             op.on_all_commit(-17)        # -EEXIST: exclusive create
-            self._finish_write(op)
+            self._complete_op(op)
             return
         op.obj_info = info = info or ObjectInfo()
+        op.committed_size = info.size
+        if op.tracked:
+            # logical size as of this op's admission: committed state
+            # plus every earlier in-flight write
+            info.size = max(info.size, op.projected_base)
         if mut.delete or not mut.writes:
             self._reads_to_commit(op)
             return
@@ -169,12 +331,19 @@ class ECBackend(PGBackend):
         if mut.truncate is not None:
             existing_end = min(existing_end, max(lo, mut.truncate))
         if existing_end <= astart or \
-                self._fully_covers(mut.writes, astart, existing_end):
+                self._fully_covers(mut.writes, astart, existing_end) \
+                or self._overlay_covers(op.oid, astart, existing_end,
+                                        op.committed_size,
+                                        op.seq + 1):
+            # nothing to read from shards: gaps are zeros/overlay —
+            # the ExtentCache fast path (reference ECBackend.cc:
+            # 1891-1920: in-flight extents served from cache)
             self._reads_to_commit(op)
             return
         op.to_read = (astart, existing_end - astart)
         self.objects_read(
-            op.oid, astart, existing_end - astart,
+            op.oid, astart, min(existing_end, op.committed_size)
+            - astart,
             lambda res, data: self._rmw_read_done(op, res, data))
 
     @staticmethod
@@ -195,11 +364,12 @@ class ECBackend(PGBackend):
 
     def _rmw_read_done(self, op: _WriteOp, res: int,
                        data: bytes) -> None:
+        if not op.alive:
+            return                   # interval change dropped the op
         if res < 0:
-            # RMW source unreadable (shards down mid-pipeline): fail the
-            # op; the client will resend once the PG re-peers
-            op.on_all_commit(res)
-            self._finish_write(op)
+            # RMW source unreadable (shards down mid-pipeline): fail
+            # the op (and dependents); clients resend after re-peer
+            self._fail_op(op, res)
             return
         op.read_data = data
         self._reads_to_commit(op)
@@ -225,6 +395,11 @@ class ECBackend(PGBackend):
         buf = bytearray(alen)            # zero padding to stripe bounds
         if op.read_data:
             buf[0:len(op.read_data)] = op.read_data
+        if op.tracked:
+            # in-flight bytes of EARLIER ops shadow whatever the
+            # shards returned (they may predate those uncommitted
+            # writes); own writes applied below
+            self._overlay(op.oid, buf, astart, op.seq)
         for off, data in mut.writes:
             buf[off - astart:off - astart + len(data)] = data
         batcher = getattr(self.host, "encode_batcher", None)
@@ -242,25 +417,64 @@ class ECBackend(PGBackend):
     def _encode_done(self, op: _WriteOp, astart: int, hi: int,
                      chunks: Dict[int, bytes]) -> None:
         """Continuation from the batcher's collector thread: re-enter
-        the PG under its lock and fan out, unless an interval change
-        dropped the op mid-encode."""
+        the PG under its lock, unless an interval change dropped the
+        op mid-encode."""
         lock = getattr(self.host, "lock", None)
         if lock is None:
             import contextlib
             lock = contextlib.nullcontext()
         with lock:
-            if not self._pipeline or self._pipeline[0] is not op:
+            if not op.alive:
                 return               # on_change() cleared the pipeline
             if chunks is None:       # encode failed even on CPU: EIO
-                op.on_all_commit(-5)
-                self._finish_write(op)
+                self._fail_op(op, -5)
                 return
             self._encoded_to_commit(op, astart, hi, chunks)
 
     def _encoded_to_commit(self, op: _WriteOp, astart: int, hi: int,
                            chunks: Dict[int, bytes]) -> None:
-        self._commit_fanout(op, self._generate_transactions(
-            op, write_plan=(astart, hi, chunks)))
+        """Encode finished: queue for the ORDERED send.  Concurrent
+        encodes may finish out of order; sub-writes must not (shard
+        logs are monotonic — reference check_ops ordering)."""
+        op.encoded = (astart, hi, chunks)
+        op.state = op.ENCODED
+        self._flush_ready()
+
+    def _flush_ready(self) -> None:
+        """Send, in submission order, every encoded op not yet sent;
+        stop at the first op still encoding.  Poisoned ops (an earlier
+        same-object op failed under them) error out instead of
+        sending."""
+        for op in list(self._pipeline):
+            if op.state in (op.SENT, op.DONE):
+                continue
+            if op.state != op.ENCODED:
+                break
+            if op.poisoned:
+                op.on_all_commit(op.poisoned)
+                op.state = op.DONE
+                continue
+            op.state = op.SENT
+            if op.encoded is not None:
+                astart, hi, chunks = op.encoded
+                txns = self._generate_transactions(
+                    op, write_plan=(astart, hi, chunks))
+            else:
+                txns = self._generate_transactions(op)
+            self._commit_fanout(op, txns)
+        while self._pipeline and \
+                self._pipeline[0].state == _WriteOp.DONE:
+            self._untrack_pending(self._pipeline.popleft())
+
+    def _complete_op(self, op: _WriteOp) -> None:
+        """An op finished (committed everywhere, or failed early):
+        mark DONE and retire the completed prefix of the pipeline."""
+        op.state = op.DONE
+        while self._pipeline and self._pipeline[0].state == op.DONE:
+            done = self._pipeline.popleft()
+            self._untrack_pending(done)
+        self._admit_ops()
+        self._flush_ready()
 
     def _commit_fanout(self, op: _WriteOp,
                        shard_txns: Dict[int, Transaction]) -> None:
@@ -441,17 +655,11 @@ class ECBackend(PGBackend):
         op.pending_commits.discard(shard)
         if not op.pending_commits:
             del self.waiting_commit[tid]
-            # completion fires BEFORE the next queued write starts, so
-            # clients observe per-object commit order
+            # ordered sends over ordered channels make completions
+            # arrive in submission order; clients observe per-object
+            # commit order
             op.on_all_commit(0)
-            self._finish_write(op)
-
-    def _finish_write(self, op: _WriteOp) -> None:
-        """Advance the FIFO pipeline."""
-        if self._pipeline and self._pipeline[0] is op:
-            self._pipeline.popleft()
-            if self._pipeline:
-                self._start_rmw(self._pipeline[0])
+            self._complete_op(op)
 
     # ------------------------------------------------------------------
     # read path (reference objects_read_and_reconstruct)
@@ -975,6 +1183,9 @@ class ECBackend(PGBackend):
     def on_change(self) -> None:
         """New interval: drop every in-flight op (reference on_change);
         clients resend against the new acting set."""
+        for op in self._pipeline:
+            op.alive = False         # late encode callbacks must drop
+        self._pending_objs.clear()
         self.waiting_commit.clear()
         self.in_flight_reads.clear()
         self.attr_fetches.clear()

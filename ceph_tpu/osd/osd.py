@@ -224,6 +224,25 @@ class OSD(Dispatcher):
             if newmap.epoch <= self.osdmap.epoch:
                 return
             self.osdmap = newmap
+        # central config overrides ride the map (reference
+        # ConfigMonitor -> MConfig): apply changes, REVERT removals,
+        # observers fire either way
+        applied = getattr(self, "_applied_overrides", {})
+        for name, raw in newmap.cluster_config.items():
+            try:
+                if str(self.conf.get(name)) != raw:
+                    self.conf.set(name, raw)
+                applied[name] = raw
+            except (KeyError, ValueError):
+                pass                 # unknown/bad option: skip
+        for name in list(applied):
+            if name not in newmap.cluster_config:
+                try:
+                    self.conf.unset(name)
+                except KeyError:
+                    pass
+                del applied[name]
+        self._applied_overrides = applied
         self._advance_pgs(newmap)
         # if the monitor thinks we're down (e.g. spurious failure
         # reports) but we're alive, re-boot (reference OSD re-sends
@@ -357,15 +376,18 @@ class OSD(Dispatcher):
             started = 0
         if started:
             self.perf.inc("recovery_ops", started)
-            sleep = self.conf["osd_recovery_sleep"]
-            if sleep:
-                time.sleep(sleep)    # reference recovery pacing knob
-            # more work may remain; requeue behind whatever the
-            # scheduler owes other classes
             with pg.lock:
                 more = pg.is_primary() and pg.num_missing() > 0
             if more:
-                self.queue_recovery_item(pg)
+                sleep = self.conf["osd_recovery_sleep"]
+                if sleep:
+                    # pace WITHOUT blocking the shard worker (a sleep
+                    # here would stall queued client ops): defer the
+                    # requeue instead
+                    threading.Timer(sleep, self.queue_recovery_item,
+                                    args=(pg,)).start()
+                else:
+                    self.queue_recovery_item(pg)
 
     def _op_worker(self, shard: int) -> None:
         q = self._shard_queues[shard]

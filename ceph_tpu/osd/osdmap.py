@@ -162,6 +162,10 @@ class Incremental:
         self.old_profiles: List[str] = []
         self.new_crush: Optional[CrushWrapper] = None
         self.new_max_osd: Optional[int] = None
+        # central config deltas (reference ConfigMonitor collapsed
+        # into the map: overrides ride map publication to daemons)
+        self.new_config: Dict[str, str] = {}
+        self.old_config: List[str] = []
 
 
 class OSDMap:
@@ -177,6 +181,9 @@ class OSDMap:
                         "k": "2", "m": "1"}}
         self.crush = CrushWrapper()
         self._next_pool_id = 1
+        # cluster-wide config overrides (name -> raw string value);
+        # daemons apply them on every map publish (observers fire)
+        self.cluster_config: Dict[str, str] = {}
 
     # -- state queries ----------------------------------------------------
     def is_up(self, osd: int) -> bool:
@@ -266,6 +273,9 @@ class OSDMap:
                 self.osds[osd].down_at = inc.epoch
         for osd, w in inc.new_weight.items():
             self.osds.setdefault(osd, OSDInfo()).weight = w
+        self.cluster_config.update(inc.new_config)
+        for name in inc.old_config:
+            self.cluster_config.pop(name, None)
         for pid, pool in inc.new_pools.items():
             self.pools[pid] = pool
             self.pool_name_to_id[pool.name] = pid
@@ -305,6 +315,7 @@ class OSDMap:
                 "pool_snaps": p.pool_snaps}
                 for p in self.pools.values()},
             "erasure_code_profiles": self.erasure_code_profiles,
+            "cluster_config": dict(self.cluster_config),
             "crush": self.crush.to_wire_dict(),
         }
 
@@ -334,6 +345,7 @@ class OSDMap:
             m._next_pool_id = max(m._next_pool_id, int(pid) + 1)
         m.erasure_code_profiles = {
             k: dict(v) for k, v in d["erasure_code_profiles"].items()}
+        m.cluster_config = dict(d.get("cluster_config", {}))
         m.crush = CrushWrapper.from_wire_dict(d["crush"])
         return m
 

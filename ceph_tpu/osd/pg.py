@@ -94,10 +94,16 @@ class PG:
         self._peer_notifies: Dict[int, dict] = {}
         self.waiting_for_active: deque = deque()
         self.waiting_for_degraded: Dict[str, deque] = {}
-        # per-object write serialization at the PG level so an append's
-        # offset (computed here against ObjectInfo.size) can't go stale
-        # behind an in-flight write to the same object
-        self.inflight_writes: Set[str] = set()
+        # per-object write tracking at the PG level (oid -> in-flight
+        # count).  Most write classes serialize per object so size-
+        # dependent logic (appends, snapshots) can't go stale; plain
+        # partial overwrites on EC-overwrites pools PIPELINE instead —
+        # the backend's extent overlay (ExtentCache) keeps their RMW
+        # reads coherent
+        self.inflight_writes: Dict[str, int] = {}
+        # oid -> newest in-flight version (prior_version chaining for
+        # pipelined writes); dropped when the object settles
+        self._pending_versions: Dict[str, Eversion] = {}
         self.waiting_for_obj: Dict[str, deque] = {}
         self.waiting_for_scrub: deque = deque()
         # recent committed-op outputs for dup-resend replay (class
@@ -317,6 +323,7 @@ class PG:
             self.waiting_for_active.clear()
             self.waiting_for_obj.clear()
             self.inflight_writes.clear()
+            self._pending_versions.clear()
             for m, conn in held:
                 if conn is not None:
                     reply = MOSDOpReply(tid=m.tid, result=-108,
@@ -595,7 +602,8 @@ class PG:
             self.service.kick_recovery(self)
             return
         if has_write:
-            if oid in self.inflight_writes:
+            if oid in self.inflight_writes and \
+                    not self._can_pipeline(msg, oid):
                 self.waiting_for_obj.setdefault(oid, deque()).append(
                     (msg, conn))
                 return
@@ -609,6 +617,30 @@ class PG:
                 self.service.kick_recovery(self)
                 return
             self._do_reads(msg, conn)
+
+    def _can_pipeline(self, msg: MOSDOp, oid: str) -> bool:
+        """May this write run concurrently with in-flight writes on
+        the same object?  Plain partial overwrites on EC-overwrites
+        pools pipeline through the backend's extent overlay (reference
+        ExtentCache, ECBackend.cc:1891-1920).  Anything that depends
+        on settled object state — appends, snapshot contexts (the
+        SnapSet must be fresh for the clone decision), waiting
+        same-object ops (order!) — serializes as before."""
+        return (self.pool.is_erasure() and self.pool.ec_overwrites
+                and msg.snap_seq == 0
+                and oid not in self.waiting_for_obj
+                and all(op.op == "write" for op in msg.ops))
+
+    def _inflight_add(self, oid: str) -> None:
+        self.inflight_writes[oid] = \
+            self.inflight_writes.get(oid, 0) + 1
+
+    def _inflight_remove(self, oid: str) -> None:
+        n = self.inflight_writes.get(oid, 0) - 1
+        if n <= 0:
+            self.inflight_writes.pop(oid, None)
+        else:
+            self.inflight_writes[oid] = n
 
     def _next_version(self) -> Eversion:
         """Monotonic even while earlier writes are still in the async
@@ -811,12 +843,16 @@ class PG:
                 mut.snapset = ss.encode()
 
         version = self._next_version()
+        # prior_version chains through IN-FLIGHT writes on the object
+        # (committed store state lags pipelined ops; divergent-log
+        # handling in peering depends on the true predecessor)
+        prior = self._pending_versions.get(
+            msg.oid, info.version if info else (0, 0))
         entries.append(LogEntry(DELETE if mut.delete else MODIFY,
-                                msg.oid, version,
-                                prior_version=(info.version if info
-                                               else (0, 0)),
+                                msg.oid, version, prior_version=prior,
                                 reqid=(msg.client, msg.tid)))
-        self.inflight_writes.add(msg.oid)
+        self._pending_versions[msg.oid] = version
+        self._inflight_add(msg.oid)
         self.backend.submit_transaction(
             msg.oid, mut, version, entries,
             lambda res: self._op_committed(msg, conn, res,
@@ -824,14 +860,21 @@ class PG:
 
     def _op_committed(self, msg: MOSDOp, conn, res: int,
                       out_data: Optional[List[bytes]] = None) -> None:
-        self.inflight_writes.discard(msg.oid)
+        self._inflight_remove(msg.oid)
+        if msg.oid not in self.inflight_writes:
+            self._pending_versions.pop(msg.oid, None)
         if res == 0 and out_data and any(out_data):
             self._reply_cache[(msg.client, msg.tid)] = out_data
             while len(self._reply_cache) > 128:
                 self._reply_cache.pop(
                     next(iter(self._reply_cache)))
         self._reply(conn, msg, res, out_data or [])
-        q = self.waiting_for_obj.get(msg.oid)
+        # serialize-class waiters run only once the object is fully
+        # settled — popping one while pipelined writes are still in
+        # flight would requeue it BEHIND later waiters, inverting the
+        # client's submission order
+        q = self.waiting_for_obj.get(msg.oid) \
+            if msg.oid not in self.inflight_writes else None
         if q:
             nmsg, nconn = q.popleft()
             if not q:
@@ -1177,15 +1220,19 @@ class PG:
         info = self.backend.get_object_info(oid)
         version = self._next_version()
         self._trim_seq = getattr(self, "_trim_seq", 0) + 1
+        prior = self._pending_versions.get(
+            oid, info.version if info else (0, 0))
         entry = LogEntry(DELETE if mut.delete else MODIFY, oid, version,
-                         prior_version=(info.version if info
-                                        else (0, 0)),
+                         prior_version=prior,
                          reqid=(f"osd.{self.whoami}.trim",
                                 self._trim_seq))
-        self.inflight_writes.add(oid)
+        self._pending_versions[oid] = version
+        self._inflight_add(oid)
 
         def done(res: int, oid=oid) -> None:
-            self.inflight_writes.discard(oid)
+            self._inflight_remove(oid)
+            if oid not in self.inflight_writes:
+                self._pending_versions.pop(oid, None)
             q = self.waiting_for_obj.get(oid)
             if q:
                 nmsg, nconn = q.popleft()

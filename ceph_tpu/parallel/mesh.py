@@ -32,17 +32,28 @@ from ..ops.jax_engine import _matmul_mod2
 
 
 def make_mesh(n_devices: Optional[int] = None,
-              axis_names: Sequence[str] = ("dp", "sp")) -> Mesh:
-    """Build a 2-D mesh over the available devices, favoring the dp axis
-    (stripe batching) for the larger factor."""
+              axis_names: Sequence[str] = ("dp", "sp"),
+              sp: Optional[int] = None) -> Mesh:
+    """Build a 2-D mesh over the available devices.
+
+    ``sp`` (intra-chunk width axis) defaults to the largest factor of
+    n that keeps ``dp >= sp`` — the dp axis (stripe batching) carries
+    the bigger fan-out because stripe counts dwarf per-chunk width in
+    the OSD workload, but a 16-chip mesh now gets sp=4 (not the old
+    hardcoded 2) and odd counts get their true largest small factor.
+    Pass ``sp`` explicitly to override (must divide n)."""
     devices = jax.devices()
     n = n_devices or len(devices)
     devices = devices[:n]
-    sp = 1
-    for cand in (2, 1):
-        if n % cand == 0 and n // cand >= 1:
-            sp = cand
-            break
+    if sp is None:
+        sp = 1
+        f = 1
+        while f * f <= n:
+            if n % f == 0:
+                sp = f               # largest factor with dp >= sp
+            f += 1
+    if n % sp != 0:
+        raise ValueError(f"sp={sp} does not divide {n} devices")
     dp = n // sp
     arr = np.array(devices).reshape(dp, sp)
     return Mesh(arr, axis_names=tuple(axis_names))

@@ -103,7 +103,35 @@ def _opts() -> List[Option]:
         Option("osd_op_num_threads_per_shard", int, 1, min=1),
         Option("osd_recovery_max_active", int, 3, min=1,
                description="recovery ops in flight per OSD"),
-        Option("osd_max_backfills", int, 1, min=1),
+        # hdd/ssd-tuned variants (reference options.cc device-class
+        # defaults; consumers pick by store medium)
+        Option("osd_recovery_max_active_hdd", int, 3, min=1),
+        Option("osd_recovery_max_active_ssd", int, 10, min=1),
+        Option("osd_recovery_sleep_hdd", float, 0.1, min=0),
+        Option("osd_recovery_sleep_ssd", float, 0.0, min=0),
+        Option("osd_max_backfills", int, 1, min=1,
+               description="backfill reservations per OSD "
+                           "(reference osd_max_backfills)"),
+        Option("osd_recovery_max_single_start", int, 1, min=1),
+        Option("osd_max_object_size", int, 128 << 20, min=1,
+               description="reject client objects larger than this "
+                           "(reference osd_max_object_size)"),
+        Option("osd_client_message_size_cap", int, 500 << 20, min=0),
+        Option("osd_heartbeat_min_peers", int, 10, min=1),
+        Option("osd_deep_scrub_stride", int, 512 << 10, min=4096),
+        Option("osd_scrub_chunk_max", int, 25, min=1),
+        Option("osd_pool_default_flag_hashpspool", bool, True),
+        Option("mon_max_pg_per_osd", int, 250, min=1,
+               description="pool creation guard (reference "
+                           "mon_max_pg_per_osd)"),
+        Option("mon_osd_min_in_ratio", float, 0.75, min=0.0,
+               description="never auto-out below this in-fraction "
+                           "(reference mon_osd_min_in_ratio)"),
+        Option("mon_clock_drift_allowed", float, 0.05, min=0),
+        Option("objecter_inflight_ops", int, 1024, min=1,
+               description="client op window (reference "
+                           "objecter_inflight_ops)"),
+        Option("rados_osd_op_timeout", float, 0.0, min=0),
         Option("osd_recovery_sleep", float, 0.0, min=0.0),
         Option("osd_heartbeat_interval", float, 1.0, min=0.05,
                description="seconds between peer pings "
@@ -215,6 +243,20 @@ class Config:
 
     def __getitem__(self, name: str) -> Any:
         return self.get(name)
+
+    def unset(self, name: str, source: str = "runtime") -> None:
+        """Drop a layered override so the option falls back to the
+        next source/default; observers fire on an effective change."""
+        with self._lock:
+            if name not in self.schema:
+                raise KeyError(f"unknown option {name!r}")
+            old = self.get(name)
+            self._values.get(source, {}).pop(name, None)
+            new = self.get(name)
+            observers = list(self._observers.get(name, ())) \
+                if new != old else []
+        for fn in observers:
+            fn(name, new)
 
     def set(self, name: str, value: Any, source: str = "runtime") -> None:
         with self._lock:

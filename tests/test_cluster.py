@@ -251,3 +251,31 @@ def test_client_resend_on_primary_death(cl):
     cl.wait_for_osd_down(primary)
     io.write_full("pre0", b"b" * 1000)      # must retarget, not hang
     assert io.read("pre0") == b"b" * 1000
+
+
+def test_central_config_propagates_to_daemons():
+    """`config set` must reach every daemon (reference ConfigMonitor
+    -> MConfig): overrides ride map publication and fire the local
+    config observers."""
+    import time as _t
+    with Cluster(n_osds=2) as c:
+        for i in range(2):
+            c.wait_for_osd_up(i, 20)
+        seen = []
+        c.osds[0].conf.add_observer(
+            "osd_recovery_max_active",
+            lambda name, val: seen.append(val))
+        ret, rs, _ = c.mon_command({"prefix": "config set",
+                                    "name": "osd_recovery_max_active",
+                                    "value": "7"})
+        assert ret == 0, rs
+        deadline = _t.monotonic() + 15
+        while _t.monotonic() < deadline:
+            if all(o.conf["osd_recovery_max_active"] == 7
+                   for o in c.osds.values() if o is not None):
+                break
+            _t.sleep(0.2)
+        assert all(o.conf["osd_recovery_max_active"] == 7
+                   for o in c.osds.values() if o is not None), \
+            "config override did not reach the daemons"
+        assert seen and seen[-1] == 7, "observer did not fire"
