@@ -44,7 +44,157 @@ def _data_soid(bucket: str, key: str) -> str:
     return f"rgw.data.{len(bucket)}.{bucket}.{key}"
 
 
-class RGWService:
+def _mp_index_oid(bucket: str) -> str:
+    return f"rgw.mp.{len(bucket)}.{bucket}"
+
+
+def _part_soid(bucket: str, upload_id: str, num: int) -> str:
+    return f"rgw.part.{len(bucket)}.{bucket}.{upload_id}.{num:05d}"
+
+
+class MultipartMixin:
+    """Multipart operations (reference rgw_multi.cc).  Every part is
+    its OWN omap row (``<upload_id>.part.<n>``): per-key mutations are
+    atomic at the OSD, so concurrent part uploads — the normal
+    multipart pattern — cannot lose each other (a read-modify-write
+    of one JSON record would)."""
+
+    def initiate_multipart(self, bucket: str, key: str,
+                           content_type: str = "binary/octet-stream",
+                           meta: Optional[Dict[str, str]] = None
+                           ) -> str:
+        self._check_bucket(bucket)
+        if not key:
+            raise RGWError(400, "InvalidArgument", "empty key")
+        import secrets as _secrets
+        upload_id = _secrets.token_hex(16)
+        rec = {"key": key, "content_type": content_type,
+               "meta": meta or {}, "started": time.time()}
+        self.ioctx.omap_set(_mp_index_oid(bucket),
+                            {upload_id: json.dumps(rec).encode()})
+        return upload_id
+
+    def _mp_get(self, bucket: str, upload_id: str,
+                key: Optional[str] = None) -> dict:
+        try:
+            raw = self.ioctx.omap_get_by_key(_mp_index_oid(bucket),
+                                             upload_id)
+        except RadosError:
+            raw = None
+        if raw is None:
+            raise RGWError(404, "NoSuchUpload", upload_id)
+        rec = json.loads(raw.decode())
+        if key is not None and rec["key"] != key:
+            # completing/uploading under a different key must not
+            # silently write the object there (S3: NoSuchUpload)
+            raise RGWError(404, "NoSuchUpload",
+                           f"{upload_id} is for {rec['key']!r}")
+        return rec
+
+    def _mp_parts(self, bucket: str, upload_id: str
+                  ) -> Dict[int, dict]:
+        try:
+            omap = self.ioctx.omap_get(_mp_index_oid(bucket))
+        except RadosError:
+            return {}
+        prefix = f"{upload_id}.part."
+        return {int(k[len(prefix):]): json.loads(v.decode())
+                for k, v in omap.items() if k.startswith(prefix)}
+
+    def upload_part(self, bucket: str, key: str, upload_id: str,
+                    part_num: int, data: bytes) -> str:
+        if not 1 <= part_num <= 10000:
+            raise RGWError(400, "InvalidPartNumber", str(part_num))
+        self._mp_get(bucket, upload_id, key)
+        etag = hashlib.md5(data).hexdigest()
+        soid = _part_soid(bucket, upload_id, part_num)
+        self.striper.write(soid, data)
+        self.striper.truncate(soid, len(data))
+        self.ioctx.omap_set(_mp_index_oid(bucket), {
+            f"{upload_id}.part.{part_num}": json.dumps(
+                {"etag": etag, "size": len(data),
+                 "mtime": time.time()}).encode()})
+        return etag
+
+    def list_parts(self, bucket: str, upload_id: str) -> List[dict]:
+        self._mp_get(bucket, upload_id)
+        return [{"part": n, **p} for n, p in
+                sorted(self._mp_parts(bucket, upload_id).items())]
+
+    def list_multipart_uploads(self, bucket: str) -> List[dict]:
+        self._check_bucket(bucket)
+        try:
+            omap = self.ioctx.omap_get(_mp_index_oid(bucket))
+        except RadosError:
+            return []
+        out = []
+        for uid, raw in sorted(omap.items()):
+            if ".part." in uid:
+                continue
+            rec = json.loads(raw.decode())
+            out.append({"upload_id": uid, "key": rec["key"],
+                        "started": rec["started"]})
+        return out
+
+    def complete_multipart(self, bucket: str, key: str,
+                           upload_id: str,
+                           parts: List[Tuple[int, str]]) -> str:
+        """Assemble the final object from the client's ordered part
+        list (reference RGWCompleteMultipart: validates every part's
+        ETag, concatenates, S3 multipart ETag = md5(part-md5s)-N)."""
+        rec = self._mp_get(bucket, upload_id, key)
+        have_parts = self._mp_parts(bucket, upload_id)
+        if not parts:
+            raise RGWError(400, "MalformedXML", "no parts")
+        last = 0
+        md5s = b""
+        total = 0
+        for num, etag in parts:
+            if num <= last:
+                raise RGWError(400, "InvalidPartOrder", str(num))
+            last = num
+            have = have_parts.get(num)
+            if have is None or have["etag"] != etag.strip('"'):
+                raise RGWError(400, "InvalidPart", str(num))
+            md5s += bytes.fromhex(have["etag"])
+            total += have["size"]
+        final_etag = (hashlib.md5(md5s).hexdigest()
+                      + f"-{len(parts)}")
+        soid = _data_soid(bucket, key)
+        off = 0
+        for num, _ in parts:
+            data = self.striper.read(_part_soid(bucket, upload_id,
+                                                num))
+            self.striper.write(soid, data, off)
+            off += len(data)
+        self.striper.truncate(soid, total)
+        entry = {"size": total, "etag": final_etag,
+                 "mtime": time.time(),
+                 "content_type": rec["content_type"],
+                 "meta": rec["meta"]}
+        self.ioctx.omap_set(_index_oid(bucket),
+                            {key: json.dumps(entry).encode()})
+        self._mp_cleanup(bucket, upload_id, rec)
+        return final_etag
+
+    def abort_multipart(self, bucket: str, upload_id: str) -> None:
+        rec = self._mp_get(bucket, upload_id)
+        self._mp_cleanup(bucket, upload_id, rec)
+
+    def _mp_cleanup(self, bucket: str, upload_id: str,
+                    rec: dict) -> None:
+        parts = self._mp_parts(bucket, upload_id)
+        for n in parts:
+            try:
+                self.striper.remove(_part_soid(bucket, upload_id, n))
+            except RadosError:
+                pass
+        self.ioctx.omap_rm_keys(
+            _mp_index_oid(bucket),
+            [upload_id] + [f"{upload_id}.part.{n}" for n in parts])
+
+
+class RGWService(MultipartMixin):
     """Bucket/object operations (reference RGWRados)."""
 
     def __init__(self, ioctx: IoCtx):

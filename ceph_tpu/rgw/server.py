@@ -3,9 +3,11 @@
 Python-native equivalent of the reference's beast/civetweb frontend +
 REST dispatch (reference ``src/rgw/rgw_rest_s3.cc``): path-style
 routes (``/bucket``, ``/bucket/key``), ListAllMyBuckets /
-ListObjects XML, ETag/Content-Type headers, Range reads, S3-style XML
-error bodies.  No signature auth (the reference supports anonymous
-access too); single-site.
+ListObjects XML, ETag/Content-Type headers, Range reads, multipart
+upload (initiate/part/complete/abort/list — reference rgw_multi.cc),
+S3-style XML error bodies, and optional AWS SigV4 authentication
+(``auth_enabled``; anonymous mode remains for dev parity with the
+reference's anonymous access).  Single-site.
 """
 from __future__ import annotations
 
@@ -27,9 +29,15 @@ def _iso(ts: float) -> str:
 class RGWServer:
     """HTTP server hosting one RGWService (reference RGWFrontend)."""
 
-    def __init__(self, ioctx, addr: Tuple[str, int] = ("127.0.0.1", 0)):
+    def __init__(self, ioctx, addr: Tuple[str, int] = ("127.0.0.1", 0),
+                 auth_enabled: bool = False):
+        from .auth import SigV4Verifier, UserStore
         self.service = RGWService(ioctx)
+        self.users = UserStore(ioctx)
+        self.verifier = SigV4Verifier(self.users)
+        self.auth_enabled = auth_enabled
         svc = self.service
+        gw = self
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -41,8 +49,8 @@ class RGWServer:
                 bucket = urllib.parse.unquote(parts[0])
                 key = urllib.parse.unquote(parts[1]) \
                     if len(parts) > 1 else ""
-                q = {k: v[0] for k, v in
-                     urllib.parse.parse_qs(parsed.query).items()}
+                q = {k: v[0] for k, v in urllib.parse.parse_qs(
+                    parsed.query, keep_blank_values=True).items()}
                 return bucket, key, q
 
             def _send(self, status: int, body: bytes = b"",
@@ -66,22 +74,108 @@ class RGWServer:
                 n = int(self.headers.get("Content-Length", 0))
                 return self.rfile.read(n) if n else b""
 
+            def _auth(self, body: bytes) -> None:
+                """SigV4 check when enabled (reference rgw::auth)."""
+                if not gw.auth_enabled:
+                    return
+                parsed = urllib.parse.urlparse(self.path)
+                gw.verifier.verify(
+                    self.command, parsed.path, parsed.query,
+                    dict(self.headers.items()), body)
+
             # --------------------------------------------------- verbs
             def do_GET(self):          # noqa: N802
                 bucket, key, q = self._split()
                 try:
+                    self._auth(b"")
                     if not bucket:
                         self._list_buckets()
+                    elif not key and "uploads" in q:
+                        self._list_uploads(bucket)
                     elif not key:
                         self._list_objects(bucket, q)
+                    elif "uploadId" in q:
+                        self._list_parts(bucket, q["uploadId"])
                     else:
                         self._get_object(bucket, key)
                 except RGWError as e:
                     self._error(e)
 
+            def do_POST(self):         # noqa: N802
+                bucket, key, q = self._split()
+                body = self._body()
+                try:
+                    self._auth(body)
+                    if key and "uploads" in q:
+                        uid = svc.initiate_multipart(
+                            bucket, key,
+                            content_type=self.headers.get(
+                                "Content-Type",
+                                "binary/octet-stream"))
+                        xml = (f"<?xml version='1.0'?>"
+                               f"<InitiateMultipartUploadResult>"
+                               f"<Bucket>{escape(bucket)}</Bucket>"
+                               f"<Key>{escape(key)}</Key>"
+                               f"<UploadId>{uid}</UploadId>"
+                               f"</InitiateMultipartUploadResult>")
+                        self._send(200, xml.encode())
+                    elif key and "uploadId" in q:
+                        self._complete_upload(bucket, key,
+                                              q["uploadId"], body)
+                    else:
+                        raise RGWError(400, "InvalidRequest",
+                                       self.path)
+                except RGWError as e:
+                    self._error(e)
+
+            def _complete_upload(self, bucket, key, upload_id,
+                                 body: bytes):
+                # CompleteMultipartUpload XML: ordered Part/
+                # PartNumber/ETag rows (reference RGWCompleteMultipart)
+                import re as _re
+                parts = []
+                for m in _re.finditer(
+                        r"<Part>.*?<PartNumber>(\d+)</PartNumber>"
+                        r".*?<ETag>\"?([a-f0-9-]+)\"?</ETag>.*?"
+                        r"</Part>", body.decode(), _re.S):
+                    parts.append((int(m.group(1)), m.group(2)))
+                etag = svc.complete_multipart(bucket, key, upload_id,
+                                              parts)
+                xml = (f"<?xml version='1.0'?>"
+                       f"<CompleteMultipartUploadResult>"
+                       f"<Bucket>{escape(bucket)}</Bucket>"
+                       f"<Key>{escape(key)}</Key>"
+                       f"<ETag>\"{etag}\"</ETag>"
+                       f"</CompleteMultipartUploadResult>")
+                self._send(200, xml.encode())
+
+            def _list_uploads(self, bucket):
+                rows = "".join(
+                    f"<Upload><Key>{escape(u['key'])}</Key>"
+                    f"<UploadId>{u['upload_id']}</UploadId>"
+                    f"<Initiated>{_iso(u['started'])}</Initiated>"
+                    f"</Upload>"
+                    for u in svc.list_multipart_uploads(bucket))
+                xml = (f"<?xml version='1.0'?>"
+                       f"<ListMultipartUploadsResult>"
+                       f"<Bucket>{escape(bucket)}</Bucket>{rows}"
+                       f"</ListMultipartUploadsResult>")
+                self._send(200, xml.encode())
+
+            def _list_parts(self, bucket, upload_id):
+                rows = "".join(
+                    f"<Part><PartNumber>{p['part']}</PartNumber>"
+                    f"<ETag>\"{p['etag']}\"</ETag>"
+                    f"<Size>{p['size']}</Size></Part>"
+                    for p in svc.list_parts(bucket, upload_id))
+                xml = (f"<?xml version='1.0'?><ListPartsResult>"
+                       f"{rows}</ListPartsResult>")
+                self._send(200, xml.encode())
+
             def do_HEAD(self):         # noqa: N802
                 bucket, key, _ = self._split()
                 try:
+                    self._auth(b"")
                     head = svc.head_object(bucket, key)
                     self.send_response(200)
                     self.send_header("Content-Length",
@@ -96,13 +190,20 @@ class RGWServer:
                     self.end_headers()
 
             def do_PUT(self):          # noqa: N802
-                bucket, key, _ = self._split()
+                bucket, key, q = self._split()
                 # always drain the body first: leaving it unread
                 # desyncs the keep-alive connection (the next request
                 # line would parse from leftover body bytes)
                 body = self._body()
                 try:
-                    if not key:
+                    self._auth(body)
+                    if key and "uploadId" in q and "partNumber" in q:
+                        etag = svc.upload_part(
+                            bucket, key, q["uploadId"],
+                            int(q["partNumber"]), body)
+                        self._send(200,
+                                   headers={"ETag": f'"{etag}"'})
+                    elif not key:
                         svc.create_bucket(bucket)
                         self._send(200)
                     else:
@@ -116,8 +217,13 @@ class RGWServer:
                     self._error(e)
 
             def do_DELETE(self):       # noqa: N802
-                bucket, key, _ = self._split()
+                bucket, key, q = self._split()
                 try:
+                    self._auth(b"")
+                    if key and "uploadId" in q:
+                        svc.abort_multipart(bucket, q["uploadId"])
+                        self._send(204)
+                        return
                     if not key:
                         svc.delete_bucket(bucket)
                     else:

@@ -281,3 +281,183 @@ def test_rgw_http_frontend(cl):
         assert req("DELETE", "/web").status == 204
     finally:
         srv.shutdown()
+
+
+# ----------------------------------------------------- multipart + auth
+
+def test_rgw_multipart_upload(cl):
+    """Initiate -> parts -> list -> complete over HTTP (reference
+    rgw_multi.cc): final bytes = concatenation, ETag = md5(md5s)-N."""
+    import hashlib
+    io = cl.rados().open_ioctx("clsp")
+    srv = RGWServer(io).start()
+    try:
+        host, port = srv.addr
+        base = f"http://{host}:{port}"
+
+        def req(method, path, data=None, headers=None):
+            r = urllib.request.Request(base + path, data=data,
+                                       method=method,
+                                       headers=headers or {})
+            return urllib.request.urlopen(r, timeout=10)
+
+        req("PUT", "/mp")
+        xml = req("POST", "/mp/big.bin?uploads", data=b"").read()
+        upload_id = xml.decode().split("<UploadId>")[1].split(
+            "<")[0]
+        parts = [os.urandom(70_000), os.urandom(50_000),
+                 os.urandom(30_000)]
+        etags = []
+        for i, p in enumerate(parts, 1):
+            r = req("PUT",
+                    f"/mp/big.bin?uploadId={upload_id}"
+                    f"&partNumber={i}", data=p)
+            etags.append(r.headers["ETag"].strip('"'))
+        lp = req("GET",
+                 f"/mp/big.bin?uploadId={upload_id}").read().decode()
+        assert all(f"<PartNumber>{i}</PartNumber>" in lp
+                   for i in (1, 2, 3))
+        cx = "".join(
+            f"<Part><PartNumber>{i}</PartNumber>"
+            f"<ETag>{e}</ETag></Part>"
+            for i, e in enumerate(etags, 1))
+        r = req("POST", f"/mp/big.bin?uploadId={upload_id}",
+                data=(f"<CompleteMultipartUpload>{cx}"
+                      f"</CompleteMultipartUpload>").encode())
+        want_etag = hashlib.md5(
+            b"".join(bytes.fromhex(e) for e in etags)).hexdigest() \
+            + "-3"
+        assert want_etag in r.read().decode()
+        got = req("GET", "/mp/big.bin").read()
+        assert got == b"".join(parts)
+        # upload record cleaned up
+        ul = req("GET", "/mp?uploads").read().decode()
+        assert upload_id not in ul
+
+        # abort removes everything
+        xml = req("POST", "/mp/gone.bin?uploads", data=b"").read()
+        uid2 = xml.decode().split("<UploadId>")[1].split("<")[0]
+        req("PUT", f"/mp/gone.bin?uploadId={uid2}&partNumber=1",
+            data=b"x" * 1000)
+        req("DELETE", f"/mp/gone.bin?uploadId={uid2}")
+        with pytest.raises(urllib.error.HTTPError):
+            req("GET", "/mp/gone.bin")
+    finally:
+        srv.shutdown()
+
+
+def test_rgw_sigv4_auth(cl):
+    """SigV4 end-to-end: signed requests pass, unsigned/forged fail
+    (reference rgw_auth_s3.cc verification)."""
+    import http.client
+
+    from ceph_tpu.rgw.auth import UserStore, sign_request
+    io = cl.rados().open_ioctx("clsp")
+    users = UserStore(io)
+    user = users.create_user("alice", "Alice")
+    srv = RGWServer(io, auth_enabled=True).start()
+    try:
+        host, port = srv.addr
+
+        def signed(method, path_q, body=b"", secret=None,
+                   access=None):
+            path, _, query = path_q.partition("?")
+            import hashlib as _h
+            payload_hash = _h.sha256(body).hexdigest()
+            hdrs = sign_request(
+                method, path, query, {}, payload_hash,
+                access or user["access_key"],
+                secret or user["secret_key"])
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request(method, path_q, body=body, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, data
+
+        # unsigned: denied
+        conn = http.client.HTTPConnection(host, port, timeout=10)
+        conn.request("PUT", "/secure")
+        assert conn.getresponse().status == 403
+        conn.close()
+        # signed: bucket + object round trip
+        assert signed("PUT", "/secure")[0] == 200
+        body = os.urandom(10_000)
+        assert signed("PUT", "/secure/obj", body)[0] == 200
+        status, got = signed("GET", "/secure/obj")
+        assert status == 200 and got == body
+        # wrong secret: SignatureDoesNotMatch
+        status, err = signed("GET", "/secure/obj",
+                             secret="not-the-secret")
+        assert status == 403 and b"SignatureDoesNotMatch" in err
+        # unknown access key
+        status, err = signed("GET", "/secure/obj",
+                             access="AKDOESNOTEXIST000")
+        assert status == 403 and b"InvalidAccessKeyId" in err
+    finally:
+        srv.shutdown()
+
+
+def test_rgw_sigv4_encoded_key_path(cl):
+    """Keys needing percent-encoding sign over the exact on-wire
+    path — no double-encoding server-side."""
+    import hashlib
+    import http.client
+
+    from ceph_tpu.rgw.auth import UserStore, sign_request
+    io = cl.rados().open_ioctx("clsp")
+    users = UserStore(io)
+    user = users.get_user("alice") or users.create_user("alice")
+    srv = RGWServer(io, auth_enabled=True).start()
+    try:
+        host, port = srv.addr
+
+        def signed(method, path, body=b""):
+            ph = hashlib.sha256(body).hexdigest()
+            hdrs = sign_request(method, path, "", {}, ph,
+                                user["access_key"],
+                                user["secret_key"])
+            conn = http.client.HTTPConnection(host, port, timeout=10)
+            conn.request(method, path, body=body, headers=hdrs)
+            resp = conn.getresponse()
+            data = resp.read()
+            conn.close()
+            return resp.status, data
+
+        assert signed("PUT", "/enc")[0] == 200
+        body = b"spaced out"
+        assert signed("PUT", "/enc/my%20file.txt", body)[0] == 200
+        status, got = signed("GET", "/enc/my%20file.txt")
+        assert status == 200 and got == body
+    finally:
+        srv.shutdown()
+
+
+def test_rgw_concurrent_part_uploads(rgw):
+    """Parallel part PUTs must not lose each other (per-part omap
+    rows, not a read-modify-write record)."""
+    import threading
+    rgw.create_bucket("cmp")
+    uid = rgw.initiate_multipart("cmp", "par.bin")
+    datas = {i: os.urandom(10_000 + i) for i in range(1, 5)}
+    errs = []
+
+    def put(i):
+        try:
+            rgw.upload_part("cmp", "par.bin", uid, i, datas[i])
+        except Exception as e:
+            errs.append(e)
+    ts = [threading.Thread(target=put, args=(i,)) for i in datas]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs
+    parts = rgw.list_parts("cmp", uid)
+    assert [p["part"] for p in parts] == [1, 2, 3, 4]
+    etag = rgw.complete_multipart(
+        "cmp", "par.bin", uid,
+        [(p["part"], p["etag"]) for p in parts])
+    assert etag.endswith("-4")
+    head, data = rgw.get_object("cmp", "par.bin")
+    assert data == b"".join(datas[i] for i in (1, 2, 3, 4))
