@@ -1,0 +1,224 @@
+"""CephFS client speaking to the MDS daemon.
+
+Python-native equivalent of the reference's fs client (reference
+``src/client/Client.cc``): metadata ops go to the MDS over the
+messenger; file DATA is striped directly to the data pool's OSDs
+(reference Client file IO through the Objecter — the MDS never sees
+data bytes).  Write-capability handling mirrors MClientCaps:
+
+* ``open(path, "w")`` grants an exclusive cap: writes stream to the
+  OSDs while size/mtime buffer locally;
+* an ``MMDSCapRecall`` push (another client wants the file) flushes
+  the buffered size back and degrades the handle to sync-through
+  (every later write updates the MDS immediately);
+* ``close()`` releases the cap with a final flush.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..client.rados import Rados, RadosError
+from ..client.striper import Layout, StripedIoCtx
+from ..msg.messages import MMDSCapRecall, MMDSOp
+from ..msg.messenger import Connection, Dispatcher
+from ..utils.config import Config
+from .filesystem import FSError, _data_soid
+
+
+class MDSClient(Dispatcher):
+    """Filesystem handle bound to one MDS + the data pool."""
+
+    def __init__(self, rados: Rados, mds_addr: Tuple[str, int],
+                 data_pool: str):
+        self.rados = rados
+        self.mds_addr = mds_addr
+        self.name = rados.msgr.name
+        self.lock = threading.RLock()
+        self._next_tid = 0
+        self._pending: Dict[int, threading.Event] = {}
+        self._replies: Dict[int, object] = {}
+        self._handles: Dict[int, "FileHandle"] = {}   # ino -> capped
+        data = rados.open_ioctx(data_pool)
+        # same layout as FileSystem so library-mode and daemon-mode
+        # interoperate on the same pools
+        self.striper = StripedIoCtx(
+            data, Layout(stripe_unit=64 << 10, stripe_count=1,
+                         object_size=4 << 20))
+        rados.msgr.add_dispatcher(self)
+        self._conn = rados.msgr.connect_to(mds_addr, lossless=False)
+
+    # -- transport -----------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        from ..msg.messages import MMDSOpReply
+        if isinstance(msg, MMDSOpReply):
+            with self.lock:
+                self._replies[msg.tid] = msg
+                ev = self._pending.pop(msg.tid, None)
+            if ev:
+                ev.set()
+            return True
+        if isinstance(msg, MMDSCapRecall):
+            threading.Thread(target=self._recalled,
+                             args=(msg.ino, msg.cap_id),
+                             daemon=True).start()
+            return True
+        return False
+
+    def _recalled(self, ino: int, cap_id: int) -> None:
+        # a recall can race the open reply (cap granted, handle not
+        # yet registered): wait briefly for the handle so its
+        # buffered size flushes instead of being dropped
+        import time as _t
+        fh = None
+        deadline = _t.monotonic() + 1.0
+        while _t.monotonic() < deadline:
+            with self.lock:
+                fh = self._handles.get(ino)
+            if fh is not None:
+                break
+            _t.sleep(0.02)
+        if fh is not None:
+            fh._flush_and_drop_cap()
+        else:
+            self.request("cap_release", {"ino": ino,
+                                         "cap_id": cap_id})
+
+    def request(self, op: str, args: dict,
+                timeout: float = 30.0) -> dict:
+        with self.lock:
+            self._next_tid += 1
+            tid = self._next_tid
+            ev = threading.Event()
+            self._pending[tid] = ev
+        self._conn.send_message(MMDSOp(client=self.name, tid=tid,
+                                       op=op, args=args))
+        if not ev.wait(timeout):
+            raise FSError(110, f"mds op {op} timed out")
+        reply = self._replies.pop(tid)
+        if reply.result < 0:
+            raise FSError(-reply.result, f"{op}: {reply.result}")
+        return reply.out
+
+    # -- namespace API (reference Client_*) ----------------------------
+    def mkdir(self, path: str) -> int:
+        return self.request("mkdir", {"path": path})["ino"]
+
+    def listdir(self, path: str = "/") -> List[dict]:
+        return self.request("listdir", {"path": path})["entries"]
+
+    def stat(self, path: str) -> dict:
+        return self.request("stat", {"path": path})
+
+    def exists(self, path: str) -> bool:
+        try:
+            self.stat(path)
+            return True
+        except FSError:
+            return False
+
+    def unlink(self, path: str) -> None:
+        self.request("unlink", {"path": path})
+
+    def rmdir(self, path: str) -> None:
+        self.request("rmdir", {"path": path})
+
+    def rename(self, old: str, new: str) -> None:
+        self.request("rename", {"old": old, "new": new})
+
+    def truncate(self, path: str, size: int) -> None:
+        self.request("truncate", {"path": path, "size": size})
+
+    def open(self, path: str, mode: str = "r") -> "FileHandle":
+        out = self.request("open", {"path": path, "mode": mode})
+        fh = FileHandle(self, path, out["ino"], mode,
+                        out.get("cap_id"), out["size"])
+        if mode == "w":
+            with self.lock:
+                self._handles[out["ino"]] = fh
+        return fh
+
+    # convenience (parity with FileSystem)
+    def write_file(self, path: str, data: bytes,
+                   offset: int = 0) -> None:
+        fh = self.open(path, "w")
+        try:
+            fh.write(data, offset)
+        finally:
+            fh.close()
+
+    def read_file(self, path: str, length: int = 0,
+                  offset: int = 0) -> bytes:
+        fh = self.open(path, "r")
+        try:
+            return fh.read(length, offset)
+        finally:
+            fh.close()
+
+
+class FileHandle:
+    """One open file (reference Fh + CapRef)."""
+
+    def __init__(self, client: MDSClient, path: str, ino: int,
+                 mode: str, cap_id: Optional[int], size: int):
+        self.client = client
+        self.path = path
+        self.ino = ino
+        self.mode = mode
+        self.cap_id = cap_id         # None = no cap (sync-through)
+        self.size = size
+        self._lock = threading.RLock()
+        self._dirty = False
+
+    # -- data path: straight to the OSDs -------------------------------
+    def write(self, data: bytes, offset: Optional[int] = None) -> int:
+        if self.mode != "w":
+            raise FSError(9, "not open for write")
+        with self._lock:
+            off = self.size if offset is None else offset
+            self.client.striper.write(_data_soid(self.ino), data, off)
+            new_size = max(self.size, off + len(data))
+            if self.cap_id is not None:
+                # capped: buffer the size locally (flushed on
+                # recall/close) — the CephFS fast path
+                self.size = new_size
+                self._dirty = True
+            else:
+                # sync-through after a recall
+                out = self.client.request(
+                    "setattr", {"path": self.path, "size": new_size,
+                                "grow_only": True})
+                self.size = out["size"]
+        return len(data)
+
+    def read(self, length: int = 0, offset: int = 0) -> bytes:
+        with self._lock:
+            size = self.size
+        if self.cap_id is None and self.mode != "w":
+            size = self.client.stat(self.path)["size"]
+        if size == 0 or offset >= size:
+            return b""
+        want = size - offset if length == 0 \
+            else min(length, size - offset)
+        try:
+            return self.client.striper.read(_data_soid(self.ino),
+                                            want, offset)
+        except RadosError:
+            return b""
+
+    # -- caps -----------------------------------------------------------
+    def _flush_and_drop_cap(self) -> None:
+        with self._lock:
+            if self.cap_id is None:
+                return
+            args = {"ino": self.ino, "cap_id": self.cap_id}
+            if self._dirty:
+                args["size"] = self.size
+            self.cap_id = None
+            self._dirty = False
+        self.client.request("cap_release", args)
+        with self.client.lock:
+            self.client._handles.pop(self.ino, None)
+
+    def close(self) -> None:
+        self._flush_and_drop_cap()

@@ -1,0 +1,1 @@
+from .daemon import MDSDaemon  # noqa: F401

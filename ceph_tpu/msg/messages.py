@@ -1059,3 +1059,83 @@ class MWatchNotify(Message):
         return cls(oid=d.str(), pool=d.i64(), cookie=d.u64(),
                    notify_id=d.u64(), payload=d.bytes(),
                    notifier=d.str())
+
+
+# ---------------------------------------------------------------------------
+# MDS (reference messages/MClientRequest.h / MClientReply.h /
+# MClientCaps.h collapsed to op-tagged frames)
+# ---------------------------------------------------------------------------
+
+@register
+class MMDSOp(Message):
+    """Client -> MDS metadata operation (reference MClientRequest):
+    ``op`` names the handler (mkdir, create, open, stat, listdir,
+    unlink, rmdir, rename, setattr, cap_release, truncate...), args
+    ride as a JSON dict (control-plane rates)."""
+    TYPE = 45
+
+    def __init__(self, client: str = "", tid: int = 0, op: str = "",
+                 args: Optional[dict] = None):
+        super().__init__()
+        self.client = client
+        self.tid = tid
+        self.op = op
+        self.args = args or {}
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.client).u64(self.tid).str(self.op)
+        e.bytes(_enc_json(self.args))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MMDSOp":
+        d = Decoder(buf)
+        return cls(client=d.str(), tid=d.u64(), op=d.str(),
+                   args=_dec_json(d.bytes()))
+
+
+@register
+class MMDSOpReply(Message):
+    """MDS -> client reply (reference MClientReply)."""
+    TYPE = 46
+
+    def __init__(self, tid: int = 0, result: int = 0,
+                 out: Optional[dict] = None):
+        super().__init__()
+        self.tid = tid
+        self.result = result         # 0 or -errno
+        self.out = out or {}
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.u64(self.tid).i32(self.result)
+        e.bytes(_enc_json(self.out))
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MMDSOpReply":
+        d = Decoder(buf)
+        return cls(tid=d.u64(), result=d.i32(),
+                   out=_dec_json(d.bytes()))
+
+
+@register
+class MMDSCapRecall(Message):
+    """MDS -> client push: give back the write capability on ``ino``
+    (reference MClientCaps CAP_OP_REVOKE).  The client answers with a
+    ``cap_release`` MMDSOp carrying its buffered size/mtime."""
+    TYPE = 47
+
+    def __init__(self, ino: int = 0, cap_id: int = 0):
+        super().__init__()
+        self.ino = ino
+        self.cap_id = cap_id
+
+    def encode_payload(self) -> bytes:
+        return Encoder().u64(self.ino).u64(self.cap_id).build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MMDSCapRecall":
+        d = Decoder(buf)
+        return cls(ino=d.u64(), cap_id=d.u64())

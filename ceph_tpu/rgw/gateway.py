@@ -241,10 +241,17 @@ class RGWService(MultipartMixin):
         self._check_bucket(bucket)
         if self.ioctx.omap_get(_index_oid(bucket)):
             raise RGWError(409, "BucketNotEmpty", bucket)
-        try:
-            self.ioctx.remove(_index_oid(bucket))
-        except RadosError:
-            pass
+        if self.list_multipart_uploads(bucket):
+            # S3: in-progress uploads must be aborted first; deleting
+            # around them would orphan part objects and resurrect the
+            # uploads if the name is recreated
+            raise RGWError(409, "BucketNotEmpty",
+                           f"{bucket}: multipart uploads in progress")
+        for oid in (_index_oid(bucket), _mp_index_oid(bucket)):
+            try:
+                self.ioctx.remove(oid)
+            except RadosError:
+                pass
         self.ioctx.omap_rm_keys(BUCKETS_DIR, [bucket])
 
     # -- objects (reference RGWRados::Object::Write/Read) --------------

@@ -134,10 +134,14 @@ class RGWServer:
                 # PartNumber/ETag rows (reference RGWCompleteMultipart)
                 import re as _re
                 parts = []
+                try:
+                    text = body.decode()
+                except UnicodeDecodeError:
+                    raise RGWError(400, "MalformedXML", "not utf-8")
                 for m in _re.finditer(
                         r"<Part>.*?<PartNumber>(\d+)</PartNumber>"
                         r".*?<ETag>\"?([a-f0-9-]+)\"?</ETag>.*?"
-                        r"</Part>", body.decode(), _re.S):
+                        r"</Part>", text, _re.S):
                     parts.append((int(m.group(1)), m.group(2)))
                 etag = svc.complete_multipart(bucket, key, upload_id,
                                               parts)
@@ -198,9 +202,13 @@ class RGWServer:
                 try:
                     self._auth(body)
                     if key and "uploadId" in q and "partNumber" in q:
+                        try:
+                            pnum = int(q["partNumber"])
+                        except ValueError:
+                            raise RGWError(400, "InvalidArgument",
+                                           q["partNumber"])
                         etag = svc.upload_part(
-                            bucket, key, q["uploadId"],
-                            int(q["partNumber"]), body)
+                            bucket, key, q["uploadId"], pnum, body)
                         self._send(200,
                                    headers={"ETag": f'"{etag}"'})
                     elif not key:

@@ -92,6 +92,10 @@ def sample_messages():
                   pn=3),
         M.MWatchNotify(oid="hdr", pool=2, cookie=5, notify_id=9,
                        payload=b"ping", notifier="client.77"),
+        M.MMDSOp(client="client.9", tid=4, op="mkdir",
+                 args={"path": "/a/b"}),
+        M.MMDSOpReply(tid=4, result=0, out={"ino": 7}),
+        M.MMDSCapRecall(ino=7, cap_id=3),
     ]
 
 
