@@ -419,6 +419,12 @@ class IoCtx:
                                          data=indata)])
         return reply.out_data[0] if reply.out_data else b""
 
+    def dup(self) -> "IoCtx":
+        """A sibling handle on the same pool with INDEPENDENT snap
+        state (snap context / read snap) — librados ioctx duplication
+        semantics; cheap (shares the Rados client)."""
+        return IoCtx(self.rados, self.pool_id, self.pool_name)
+
     # -- snapshots (reference librados snap API) ---------------------------
     def set_snap_context(self, seq: int, snaps: List[int]) -> None:
         """Selfmanaged SnapContext for subsequent writes (reference

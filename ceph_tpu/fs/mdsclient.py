@@ -94,6 +94,9 @@ class MDSClient(Dispatcher):
         self._conn.send_message(MMDSOp(client=self.name, tid=tid,
                                        op=op, args=args))
         if not ev.wait(timeout):
+            with self.lock:
+                self._pending.pop(tid, None)
+                self._replies.pop(tid, None)
             raise FSError(110, f"mds op {op} timed out")
         reply = self._replies.pop(tid)
         if reply.result < 0:

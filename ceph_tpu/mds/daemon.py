@@ -215,8 +215,10 @@ class MDSDaemon(Dispatcher):
         return self._next_cap
 
     def _needs_recall(self, ino: int, client: str) -> bool:
-        cap = self.caps.get(ino)
-        return cap is not None and cap.client != client
+        """ANY live cap must flush before a coherence-point op — the
+        holder's own stat included (write-then-stat visibility), and
+        a re-open recalls the prior handle cleanly."""
+        return self.caps.get(ino) is not None
 
     def _start_recall(self, ino: int, msg, conn) -> None:
         """Park the request; ask the holder to flush+drop."""
@@ -249,11 +251,15 @@ class MDSDaemon(Dispatcher):
                 or cap.cap_id != args.get("cap_id"):
             return
         if "size" in args:
-            node = self.fs._read_inode(ino)
-            self._journal({"op": "setattr", "ino": ino,
-                           "type": node["type"],
-                           "size": int(args["size"]),
-                           "mode": node.get("mode", 0o644)})
+            try:
+                node = self.fs._read_inode(ino)
+            except FSError:
+                node = None          # unlinked under the cap: drop
+            if node is not None:
+                self._journal({"op": "setattr", "ino": ino,
+                               "type": node["type"],
+                               "size": int(args["size"]),
+                               "mode": node.get("mode", 0o644)})
         self._revoke(ino)
 
     def _tick_loop(self) -> None:
@@ -301,7 +307,7 @@ class MDSDaemon(Dispatcher):
                 self._reply(conn, msg)
                 return
             if msg.op in ("open", "stat", "truncate", "setattr",
-                          "unlink", "rename"):
+                          "unlink", "rename", "listdir"):
                 # coherence point: these must observe (or take over)
                 # any writer's buffered attributes — including the
                 # namespace ops that destroy the target
@@ -349,6 +355,10 @@ class MDSDaemon(Dispatcher):
                         raise FSError(21, a["path"])
                     else:
                         ino = ent["ino"]
+                    if ino in self.caps:
+                        # raced grant (parked re-entry): recall first
+                        self._start_recall(ino, msg, conn)
+                        return
                     cap_id = self._grant_cap(ino, msg.client, conn)
                     node = fs._read_inode(ino)
                     self._reply(conn, msg, out={
@@ -375,7 +385,8 @@ class MDSDaemon(Dispatcher):
                     raise FSError(21, a["path"])
                 self._journal({"op": "unlink", "parent": parent,
                                "name": name, "ino": ent["ino"]})
-                self.caps.pop(ent["ino"], None)
+                if ent["ino"] in self.caps:
+                    self._revoke(ent["ino"])
                 self._reply(conn, msg)
             elif msg.op == "rmdir":
                 parent, name = fs._resolve_parent(a["path"])
@@ -446,4 +457,6 @@ class MDSDaemon(Dispatcher):
                        "nname": nname, "ino": ent["ino"],
                        "type": ent["type"],
                        "unlink_ino": unlink_ino})
+        if unlink_ino is not None and unlink_ino in self.caps:
+            self._revoke(unlink_ino)
         self._reply(conn, msg)

@@ -6,21 +6,24 @@ objects of ``2^order`` bytes (reference rbd_header.<id> +
 rbd_data.<id>.<objectno>, ImageCtx::get_object_name), with snapshots
 and copy-on-write clones.
 
-Where the reference builds snapshots on RADOS self-managed snaps
-(librados snap contexts resolved inside the OSD), this implementation
-keeps the OSD snapshot-free and does **generation-based client-side
-COW**: every snapshot bumps the image generation; data object
-``<img>.g<gen>.<objno>`` holds object ``objno``'s content as of
-generation ``gen``.  Writes land in the current generation (copying
-the newest older generation forward first — COW); reads resolve each
-object to its newest generation ≤ the view's generation.  A clone
-records (parent image, snap); unwritten extents fall through to the
-parent's snapshot view exactly like the reference's parent overlap
-reads (librbd/io/ReadResult parent fallback), and ``flatten`` copies
-the parent data in and severs the link.
+Snapshots build on RADOS **selfmanaged snaps** exactly like the
+reference (librbd snapshots ARE librados snap contexts resolved
+inside the OSD): ``snap_create`` allocates a pool snap id and the
+image's writes carry a SnapContext of its live snap ids, so the OSD
+clones objects copy-on-write; snapshot reads set the read snap;
+``snap_rollback`` rolls each data object back through the OSD's
+rollback op; ``snap_rm`` releases the id and the OSD trimmer reclaims
+the clones.  (An earlier iteration of this file implemented private
+generation-based COW client-side; that predated the framework's RADOS
+snapshot machinery.)
+
+A clone records (parent image, snap); unwritten extents fall through
+to the parent's snapshot view exactly like the reference's parent
+overlap reads (librbd/io/ReadResult parent fallback), and ``flatten``
+copies the parent data in and severs the link.
 
 Header: ``rbd_header.<name>`` holds a JSON body (works on EC pools,
-which have no omap) with size/order/generation/snaps/parent.
+which have no omap) with size/order/snaps/parent.
 """
 from __future__ import annotations
 
@@ -42,8 +45,8 @@ def _header_oid(name: str) -> str:
     return f"rbd_header.{name}"
 
 
-def _data_oid(name: str, gen: int, objectno: int) -> str:
-    return f"rbd_data.{name}.g{gen}.{objectno:016x}"
+def _data_oid(name: str, objectno: int) -> str:
+    return f"rbd_data.{name}.{objectno:016x}"
 
 
 class RBD:
@@ -74,9 +77,8 @@ class RBD:
         names = self._dir()
         if name in names:
             raise RadosError(17, f"image {name!r} exists")  # EEXIST
-        header = {"size": size, "order": order, "gen": 0,
-                  "snap_seq": 0, "snaps": {}, "parent": None,
-                  "hwm": size}   # high-water size: bounds object scans
+        header = {"size": size, "order": order, "snaps": {},
+                  "parent": None}
         self.ioctx.write_full(_header_oid(name),
                               json.dumps(header).encode())
         self._dir_update(names + [name])
@@ -102,9 +104,8 @@ class RBD:
         if child_name in names:
             raise RadosError(17, f"image {child_name!r} exists")
         header = {"size": snap["size"], "order": parent.header["order"],
-                  "gen": 0, "snap_seq": 0, "snaps": {},
-                  "parent": {"image": parent_name, "snap": snap_name},
-                  "hwm": snap["size"]}
+                  "snaps": {},
+                  "parent": {"image": parent_name, "snap": snap_name}}
         self.ioctx.write_full(_header_oid(child_name),
                               json.dumps(header).encode())
         self._dir_update(names + [child_name])
@@ -124,17 +125,22 @@ class RBD:
 
 class Image:
     """One open image (reference librbd::Image / ImageCtx).
-    ``snap_name`` opens a read-only snapshot view."""
+    ``snap_name`` opens a read-only snapshot view.
+
+    Every image holds its OWN IoCtx (``dup``) so its SnapContext —
+    derived from the header's live snaps, exactly the reference's
+    ImageCtx::snapc — never races other images on the pool."""
 
     def __init__(self, ioctx: IoCtx, name: str,
                  snap_name: Optional[str] = None):
-        self.ioctx = ioctx
+        self.ioctx = ioctx.dup()
         self.name = name
         self.snap_name = snap_name
         self.header = self._load_header()
         if snap_name is not None and \
                 snap_name not in self.header["snaps"]:
             raise RadosError(2, f"no snap {snap_name!r}")
+        self._apply_snap_state()
 
     # -- header --------------------------------------------------------
     def _load_header(self) -> Dict:
@@ -147,6 +153,18 @@ class Image:
     def _save_header(self) -> None:
         self.ioctx.write_full(_header_oid(self.name),
                               json.dumps(self.header).encode())
+
+    def _apply_snap_state(self) -> None:
+        """Install the image's write SnapContext + read snap on its
+        private ioctx (reference ImageCtx::snapc / snap_id)."""
+        sids = sorted((s["id"] for s in
+                       self.header["snaps"].values()), reverse=True)
+        self.ioctx.set_snap_context(sids[0] if sids else 0, sids)
+        if self.snap_name is not None:
+            self.ioctx.snap_set_read(
+                self.header["snaps"][self.snap_name]["id"])
+        else:
+            self.ioctx.snap_set_read(0)
 
     @property
     def object_size(self) -> int:
@@ -165,22 +183,19 @@ class Image:
                 "snapshot_count": len(self.header["snaps"]),
                 "parent": self.header.get("parent")}
 
-    # -- object resolution ---------------------------------------------
-    def _view_gen(self) -> int:
-        if self.snap_name is not None:
-            return self.header["snaps"][self.snap_name]["gen"]
-        return self.header["gen"]
+    def _n_objs(self, size: Optional[int] = None) -> int:
+        s = self.header["size"] if size is None else size
+        return (s + self.object_size - 1) // self.object_size
 
-    def _read_object(self, objectno: int, gen_limit: int) -> bytes:
-        """Newest generation <= gen_limit holding this object; falls
-        through to the parent snapshot view when cloned (reference
-        parent overlap read)."""
-        for gen in range(gen_limit, -1, -1):
-            try:
-                return self.ioctx.read(
-                    _data_oid(self.name, gen, objectno))
-            except RadosError:
-                continue
+    # -- object resolution ---------------------------------------------
+    def _read_object(self, objectno: int) -> bytes:
+        """This view's content of one data object; falls through to
+        the parent snapshot view when cloned and the child object does
+        not exist at this view (reference parent overlap read)."""
+        try:
+            return self.ioctx.read(_data_oid(self.name, objectno))
+        except RadosError:
+            pass
         parent = self.header.get("parent")
         if parent is not None:
             try:
@@ -188,14 +203,19 @@ class Image:
                              snap_name=parent["snap"])
             except RadosError:
                 return b""
-            # parent may use a different order; translate extents
             off = objectno * self.object_size
-            plen = min(self.object_size,
-                       max(0, pimg.size() - off))
+            plen = min(self.object_size, max(0, pimg.size() - off))
             if plen <= 0:
                 return b""
             return pimg.read(off, plen)
         return b""
+
+    def _object_exists(self, objectno: int) -> bool:
+        try:
+            self.ioctx.stat(_data_oid(self.name, objectno))
+            return True
+        except RadosError:
+            return False
 
     # -- IO ------------------------------------------------------------
     def read(self, offset: int, length: int) -> bytes:
@@ -205,13 +225,12 @@ class Image:
         length = min(length, size - offset)
         out = bytearray(length)
         osize = self.object_size
-        gen = self._view_gen()
         pos = offset
         while pos < offset + length:
             objectno = pos // osize
             o_off = pos % osize
             run = min(osize - o_off, offset + length - pos)
-            data = self._read_object(objectno, gen)
+            data = self._read_object(objectno)
             chunk = data[o_off:o_off + run]
             out[pos - offset:pos - offset + len(chunk)] = chunk
             pos += run
@@ -224,108 +243,74 @@ class Image:
         if offset + len(data) > size:
             raise RadosError(27, "write past image end")  # EFBIG
         osize = self.object_size
-        gen = self.header["gen"]
         pos = offset
         while pos < offset + len(data):
             objectno = pos // osize
             o_off = pos % osize
             run = min(osize - o_off, offset + len(data) - pos)
-            oid = _data_oid(self.name, gen, objectno)
-            if not self._object_exists(oid):
-                # COW: promote the newest older generation (or parent
-                # content) into the current generation first
-                base = self._read_object(objectno, gen - 1) \
-                    if gen > 0 or self.header.get("parent") else b""
+            oid = _data_oid(self.name, objectno)
+            if self.header.get("parent") is not None \
+                    and not self._object_exists(objectno):
+                # clone COW: promote the parent's content first
+                base = self._read_object(objectno)
                 if base:
                     self.ioctx.write_full(oid, base)
-            self.ioctx.write(oid, data[pos - offset:pos - offset + run],
-                             o_off)
+            # snapshot COW happens INSIDE the OSD: the write carries
+            # the image's SnapContext and the object clones itself
+            self.ioctx.write(oid, data[pos - offset:pos - offset
+                                       + run], o_off)
             pos += run
-
-    def _object_exists(self, oid: str) -> bool:
-        try:
-            self.ioctx.stat(oid)
-            return True
-        except RadosError:
-            return False
-
-    def _underlying_holds(self, objectno: int, gen: int) -> bool:
-        """Would a read of this object at head still find content
-        below ``gen`` (an older generation, or the clone parent)?
-        Stat/header-only — no data transfer."""
-        if any(self._object_exists(_data_oid(self.name, g, objectno))
-               for g in range(gen - 1, -1, -1)):
-            return True
-        parent = self.header.get("parent")
-        if parent is None:
-            return False
-        psize = getattr(self, "_parent_size_cache", None)
-        if psize is None:
-            try:
-                psize = Image(self.ioctx, parent["image"],
-                              snap_name=parent["snap"]).size()
-            except RadosError:
-                psize = 0
-            self._parent_size_cache = psize
-        return objectno * self.object_size < psize
 
     def resize(self, new_size: int) -> None:
         if self.snap_name is not None:
             raise RadosError(30, "snapshot views are read-only")
         old = self.header["size"]
         self.header["size"] = new_size
-        self.header["hwm"] = max(self._hwm(), new_size)
         self._save_header()
         if new_size < old:
-            # Drop whole current-gen objects past the end; older
-            # generations keep their data for snapshots, so where an
-            # older gen (or a clone parent) still holds content, leave
-            # an empty tombstone at the current gen — otherwise a
-            # later grow would re-expose the stale bytes instead of
-            # zeros.
+            # truncates/removes carry the snap context too, so
+            # snapshot views keep their bytes (OSD-side clones) while
+            # the head sheds them; a later grow re-exposes zeros.
+            # CLONES need whiteouts: removing a never-written child
+            # object is a no-op and the parent fallthrough would
+            # re-expose the parent's bytes after a grow — an empty
+            # head object blocks it.
             osize = self.object_size
-            gen = self.header["gen"]
+            parent = self.header.get("parent")
             first_gone = (new_size + osize - 1) // osize
-            for objectno in range(first_gone,
-                                  (old + osize - 1) // osize):
-                oid = _data_oid(self.name, gen, objectno)
+            for objectno in range(first_gone, self._n_objs(old)):
+                oid = _data_oid(self.name, objectno)
                 try:
                     self.ioctx.remove(oid)
                 except RadosError:
                     pass
-                if self._underlying_holds(objectno, gen):
-                    self.ioctx.write_full(oid, b"")
+                if parent is not None:
+                    self.ioctx.write_full(oid, b"")   # whiteout
             if new_size % osize:
-                # boundary object: truncate in place when it exists at
-                # the current generation (metadata-only); otherwise
-                # promote a clamped copy of the resolved content
-                # (current gen is always strictly newer than every
-                # snap gen, so this never corrupts a snapshot view)
                 objectno = new_size // osize
-                oid = _data_oid(self.name, gen, objectno)
-                if self._object_exists(oid):
+                oid = _data_oid(self.name, objectno)
+                if self._object_exists(objectno):
                     try:
                         self.ioctx.truncate(oid, new_size % osize)
                     except RadosError:
                         pass
-                elif self._underlying_holds(objectno, gen):
-                    data = self._read_object(objectno, gen)
-                    if len(data) > new_size % osize:
-                        self.ioctx.write_full(
-                            oid, data[:new_size % osize])
+                elif parent is not None:
+                    # materialize the clamped parent content so the
+                    # tail past new_size reads zeros after a grow
+                    data = self._read_object(objectno)
+                    self.ioctx.write_full(oid,
+                                          data[:new_size % osize])
 
-    # -- snapshots (reference librbd snap_create/rollback/remove) ------
+    # -- snapshots (reference librbd snap_create/rollback/remove on
+    # selfmanaged snaps) ----------------------------------------------
     def snap_create(self, snap_name: str) -> None:
         if snap_name in self.header["snaps"]:
             raise RadosError(17, f"snap {snap_name!r} exists")
-        self.header["snap_seq"] += 1
+        sid = self.ioctx.selfmanaged_snap_create()
         self.header["snaps"][snap_name] = {
-            "id": self.header["snap_seq"],
-            "gen": self.header["gen"],
-            "size": self.header["size"],
-        }
-        self.header["gen"] += 1        # writes COW from here on
+            "id": sid, "size": self.header["size"]}
         self._save_header()
+        self._apply_snap_state()
 
     def snap_list(self) -> List[Dict]:
         return [{"name": n, **meta} for n, meta in
@@ -333,100 +318,35 @@ class Image:
                        key=lambda kv: kv[1]["id"])]
 
     def snap_rm(self, snap_name: str) -> None:
-        if snap_name not in self.header["snaps"]:
+        snap = self.header["snaps"].get(snap_name)
+        if snap is None:
             raise RadosError(2, f"no snap {snap_name!r}")
         children = RBD(self.ioctx).children(self.name, snap_name)
         if children:
             raise RadosError(16, f"snap in use by clones {children}")
         del self.header["snaps"][snap_name]
         self._save_header()
-        self._gc_generations()
+        self._apply_snap_state()
+        # release the id: the OSD snap trimmer reclaims the clones
+        self.ioctx.selfmanaged_snap_remove(snap["id"])
 
     def snap_rollback(self, snap_name: str) -> None:
-        """Make the head view equal the snapshot (reference
-        snap_rollback): bump the generation and promote the snap's
-        objects into it."""
+        """Roll every data object back to the snapshot through the
+        OSD's rollback op (reference librbd snap_rollback ->
+        rados selfmanaged_snap_rollback per object)."""
         snap = self.header["snaps"].get(snap_name)
         if snap is None:
             raise RadosError(2, f"no snap {snap_name!r}")
-        src_gen = snap["gen"]
         old_size = self.header["size"]
-        self.header["gen"] += 1
-        new_gen = self.header["gen"]
         self.header["size"] = snap["size"]
-        osize = self.object_size
-        # Cover every object either view may have touched.  An object
-        # written after the snapshot must come back as the snap's
-        # content — or, where the snap view is empty, as an explicit
-        # empty object at the new generation: a tombstone that stops
-        # _read_object falling through to the intermediate (post-snap)
-        # generations.  Objects no intermediate generation touched
-        # already resolve to the snap's content through <=src_gen, so
-        # a sparse or unchanged image rolls back in O(dirty objects),
-        # not O(image size).
-        max_objs = (max(snap["size"], old_size) + osize - 1) // osize
+        max_objs = self._n_objs(max(snap["size"], old_size))
         for objectno in range(max_objs):
-            keep = max(0, min(osize, snap["size"] - objectno * osize))
-            dirty = any(
-                self._object_exists(_data_oid(self.name, g, objectno))
-                for g in range(src_gen + 1, new_gen))
-            if dirty:
-                data = self._read_object(objectno, src_gen)[:keep] \
-                    if keep else b""
-                self.ioctx.write_full(
-                    _data_oid(self.name, new_gen, objectno), data)
-            elif keep == 0:
-                # wholly past the snap's size: a stat-only probe
-                # decides whether a tombstone is needed at all
-                if self._underlying_holds(objectno, src_gen + 1):
-                    self.ioctx.write_full(
-                        _data_oid(self.name, new_gen, objectno), b"")
-            elif keep < osize:
-                # boundary object, clean: promote a clamped copy so a
-                # later grow re-exposes zeros, not stale bytes
-                data = self._read_object(objectno, src_gen)
-                if len(data) > keep:
-                    self.ioctx.write_full(
-                        _data_oid(self.name, new_gen, objectno),
-                        data[:keep])
+            try:
+                self.ioctx.selfmanaged_snap_rollback(
+                    _data_oid(self.name, objectno), snap["id"])
+            except RadosError:
+                pass
         self._save_header()
-
-    def _hwm(self) -> int:
-        """Largest size this image has ever had: tombstones from
-        shrinks can sit past the current and snap sizes, so cleanup
-        scans must cover the high-water mark."""
-        return max([self.header.get("hwm", 0), self.header["size"]] +
-                   [s["size"] for s in self.header["snaps"].values()])
-
-    def _live_gens(self) -> List[int]:
-        gens = {self.header["gen"]}
-        gens.update(s["gen"] for s in self.header["snaps"].values())
-        return sorted(gens)
-
-    def _gc_generations(self) -> None:
-        """Remove data objects of generations no view can reach.
-        An unreachable gen g's objects are first folded into the next
-        live gen if it lacks them (they are its COW base)."""
-        live = self._live_gens()
-        max_objs = (self._hwm() + self.object_size - 1) \
-            // self.object_size
-        for gen in range(self.header["gen"] + 1):
-            if gen in live:
-                continue
-            nxt = next((g for g in live if g > gen), None)
-            for objectno in range(max_objs):
-                oid = _data_oid(self.name, gen, objectno)
-                if not self._object_exists(oid):
-                    continue
-                if nxt is not None:
-                    noid = _data_oid(self.name, nxt, objectno)
-                    if not self._object_exists(noid):
-                        self.ioctx.write_full(
-                            noid, self.ioctx.read(oid))
-                try:
-                    self.ioctx.remove(oid)
-                except RadosError:
-                    pass
 
     # -- clones --------------------------------------------------------
     def flatten(self) -> None:
@@ -435,27 +355,22 @@ class Image:
         parent = self.header.get("parent")
         if parent is None:
             return
-        osize = self.object_size
-        gen = self.header["gen"]
-        n_objs = (self.header["size"] + osize - 1) // osize
-        for objectno in range(n_objs):
-            oid = _data_oid(self.name, gen, objectno)
-            if self._object_exists(oid):
+        for objectno in range(self._n_objs()):
+            if self._object_exists(objectno):
                 continue
-            data = self._read_object(objectno, gen)
+            data = self._read_object(objectno)
             if data:
-                self.ioctx.write_full(oid, data)
+                self.ioctx.write_full(_data_oid(self.name, objectno),
+                                      data)
         self.header["parent"] = None
         self._save_header()
 
     # -- maintenance ---------------------------------------------------
     def _remove_all_data(self) -> None:
-        osize = self.object_size
-        n_objs = (self._hwm() + osize - 1) // osize
-        for gen in range(self.header["gen"] + 1):
-            for objectno in range(n_objs):
-                try:
-                    self.ioctx.remove(_data_oid(self.name, gen,
-                                                objectno))
-                except RadosError:
-                    pass
+        # no live snaps by contract (RBD.remove refuses otherwise),
+        # so plain removes reclaim everything
+        for objectno in range(self._n_objs()):
+            try:
+                self.ioctx.remove(_data_oid(self.name, objectno))
+            except RadosError:
+                pass

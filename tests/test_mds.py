@@ -146,3 +146,26 @@ def test_dead_holder_recall_times_out(cl, mds):
     assert time.monotonic() - t0 < 10
     # unflushed size may be lost, but the namespace is consistent
     assert st["size"] in (0, 100)
+
+
+def test_own_write_then_stat_visibility(cl, mds):
+    """A client that writes through an open capped handle and then
+    stats the PATH must see its own size (the stat recalls even the
+    caller's own cap — write-then-stat visibility)."""
+    fs = client(cl, mds)
+    fh = fs.open("/self.bin", "w")
+    fh.write(b"q" * 12_345)
+    assert fs.stat("/self.bin")["size"] == 12_345
+    fh.close()
+
+
+def test_same_client_reopen_flushes_prior_handle(cl, mds):
+    fs = client(cl, mds)
+    f1 = fs.open("/re.bin", "w")
+    f1.write(b"1" * 2000)
+    f2 = fs.open("/re.bin", "w")       # recalls f1's cap
+    assert f2.size == 2000
+    f2.write(b"2" * 1000, 2000)
+    f2.close()
+    f1.close()                         # stale handle: harmless
+    assert fs.stat("/re.bin")["size"] == 3000

@@ -218,3 +218,24 @@ def test_rbd_cli_roundtrip(cl, tmp_path, capsys):
     import json
     info = json.loads(capsys.readouterr().out)
     assert info["parent"]["image"] == "cliimg"
+
+
+def test_clone_shrink_grow_exposes_zeros(io):
+    """Shrinking a clone below parent-backed extents and growing back
+    must read zeros there, not the parent's bytes (whiteouts block
+    the parent fallthrough)."""
+    rbd = RBD(io)
+    rbd.create("cpar", 64 << 10, order=ORDER)
+    parent = Image(io, "cpar")
+    base = os.urandom(64 << 10)
+    parent.write(0, base)
+    parent.snap_create("g")
+    rbd.clone("cpar", "g", "cshrink")
+    ch = Image(io, "cshrink")
+    assert ch.read(0, 64 << 10) == base
+    ch.resize(20 << 10)                # mid-object shrink
+    ch.resize(64 << 10)
+    got = Image(io, "cshrink").read(0, 64 << 10)
+    assert got[:20 << 10] == base[:20 << 10]
+    assert got[20 << 10:] == b"\0" * (44 << 10), \
+        "parent bytes re-exposed after clone shrink+grow"
