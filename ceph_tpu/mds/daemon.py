@@ -307,7 +307,7 @@ class MDSDaemon(Dispatcher):
                 self._reply(conn, msg)
                 return
             if msg.op in ("open", "stat", "truncate", "setattr",
-                          "unlink", "rename", "listdir"):
+                          "unlink", "rename"):
                 # coherence point: these must observe (or take over)
                 # any writer's buffered attributes — including the
                 # namespace ops that destroy the target

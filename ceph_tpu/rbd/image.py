@@ -78,7 +78,7 @@ class RBD:
         if name in names:
             raise RadosError(17, f"image {name!r} exists")  # EEXIST
         header = {"size": size, "order": order, "snaps": {},
-                  "parent": None}
+                  "parent": None, "hwm": size}
         self.ioctx.write_full(_header_oid(name),
                               json.dumps(header).encode())
         self._dir_update(names + [name])
@@ -105,7 +105,8 @@ class RBD:
             raise RadosError(17, f"image {child_name!r} exists")
         header = {"size": snap["size"], "order": parent.header["order"],
                   "snaps": {},
-                  "parent": {"image": parent_name, "snap": snap_name}}
+                  "parent": {"image": parent_name, "snap": snap_name},
+                  "hwm": snap["size"]}
         self.ioctx.write_full(_header_oid(child_name),
                               json.dumps(header).encode())
         self._dir_update(names + [child_name])
@@ -266,6 +267,10 @@ class Image:
             raise RadosError(30, "snapshot views are read-only")
         old = self.header["size"]
         self.header["size"] = new_size
+        # high-water mark: whiteouts from clone shrinks can sit past
+        # the current size; removal must scan that far
+        self.header["hwm"] = max(self.header.get("hwm", 0), old,
+                                 new_size)
         self._save_header()
         if new_size < old:
             # truncates/removes carry the snap context too, so
@@ -368,8 +373,10 @@ class Image:
     # -- maintenance ---------------------------------------------------
     def _remove_all_data(self) -> None:
         # no live snaps by contract (RBD.remove refuses otherwise),
-        # so plain removes reclaim everything
-        for objectno in range(self._n_objs()):
+        # so plain removes reclaim everything; scan to the high-water
+        # size so shrink-era whiteouts go too
+        hwm = max(self.header.get("hwm", 0), self.header["size"])
+        for objectno in range(self._n_objs(hwm)):
             try:
                 self.ioctx.remove(_data_oid(self.name, objectno))
             except RadosError:

@@ -239,3 +239,21 @@ def test_clone_shrink_grow_exposes_zeros(io):
     assert got[:20 << 10] == base[:20 << 10]
     assert got[20 << 10:] == b"\0" * (44 << 10), \
         "parent bytes re-exposed after clone shrink+grow"
+
+
+def test_clone_shrink_remove_leaks_nothing(io):
+    """Whiteouts written past the shrunk size must be reclaimed when
+    the image is removed (high-water-mark scan)."""
+    rbd = RBD(io)
+    rbd.create("lkp", 96 << 10, order=ORDER)
+    parent = Image(io, "lkp")
+    parent.write(0, os.urandom(96 << 10))
+    parent.snap_create("g")
+    rbd.clone("lkp", "g", "lkc")
+    ch = Image(io, "lkc")
+    ch.resize(16 << 10)                # whiteouts past 16 KiB
+    rbd.remove("lkc")
+    left = [o for o in io.list_objects() if "lkc" in o]
+    assert not left, f"leaked: {left}"
+    Image(io, "lkp").snap_rm("g")
+    rbd.remove("lkp")

@@ -307,3 +307,56 @@ def test_objectstore_tool_ec_shard_objects(tmp_path, capsys):
                 assert dump["size"] > 0
                 found = True
     assert found, "no EC shard objects listed"
+
+
+def test_cephadm_bootstrap_and_orch():
+    """cephadm-style spec bootstrap + orch ls/ps + daemon stop/start
+    + osd scale-up (reference cephadm bootstrap / `ceph orch`)."""
+    from ceph_tpu.tools.cephadm import CephAdm
+    adm = CephAdm({"osd": {"count": 2},
+                   "rgw": {"count": 1},
+                   "mds": {"count": 1}}).bootstrap()
+    try:
+        services = {s["service"]: s["running"] for s in adm.orch_ls()}
+        assert services["mon"] == 1 and services["osd"] == 2
+        assert services["rgw"] == 1 and services["mds"] == 1
+        daemons = {d["daemon"]: d for d in adm.orch_ps()}
+        assert daemons["osd.0"]["status"] == "running"
+        assert daemons["mds.a"]["addr"] is not None
+
+        # the deployed services actually serve
+        import urllib.request
+        host, port = adm.services["rgw.x"].addr
+        urllib.request.urlopen(f"http://{host}:{port}/", timeout=10)
+        from ceph_tpu.fs.mdsclient import MDSClient
+        fsc = MDSClient(adm.cluster.rados(),
+                        adm.services["mds.a"].my_addr, "fsdata")
+        fsc.mkdir("/adm")
+        assert [e["name"] for e in fsc.listdir("/")] == ["adm"]
+
+        # daemon management + scale-up
+        adm.daemon_stop("osd.1")
+        assert {d["daemon"]: d["status"] for d in adm.orch_ps()}[
+            "osd.1"] == "stopped"
+        adm.daemon_start("osd.1")
+        assert adm.orch_apply_osd(3) == 1
+        services = {s["service"]: s["running"] for s in adm.orch_ls()}
+        assert services["osd"] == 3
+    finally:
+        adm.shutdown()
+
+
+def test_cephadm_service_restart():
+    from ceph_tpu.tools.cephadm import CephAdm
+    adm = CephAdm({"osd": {"count": 2}, "rgw": {"count": 1}}
+                  ).bootstrap()
+    try:
+        adm.daemon_stop("rgw.x")
+        assert {d["daemon"]: d["status"] for d in adm.orch_ps()}[
+            "rgw.x"] == "stopped"
+        adm.daemon_start("rgw.x")
+        import urllib.request
+        host, port = adm.services["rgw.x"].addr
+        urllib.request.urlopen(f"http://{host}:{port}/", timeout=10)
+    finally:
+        adm.shutdown()
