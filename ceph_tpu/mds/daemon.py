@@ -43,6 +43,7 @@ from ..fs.filesystem import (DIR_TYPE, FILE_TYPE, FSError, FileSystem,
 from ..msg.messages import MMDSCapRecall, MMDSOp, MMDSOpReply
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..utils.config import Config, default_config
+from ..utils.lockdep import make_lock
 from ..utils.log import Dout
 
 JOURNAL_OID = "mds.journal"          # reference MDLog journal objects
@@ -69,7 +70,7 @@ class MDSDaemon(Dispatcher):
         self.name = name
         self.conf = conf or default_config()
         self.log = Dout("mds", f"{name} ")
-        self.lock = threading.RLock()
+        self.lock = make_lock("mds")
         self.rados = Rados(mon_addr, conf=self.conf).connect()
         self.meta = self.rados.open_ioctx(meta_pool)
         data = self.rados.open_ioctx(data_pool) if data_pool \

@@ -50,6 +50,7 @@ from ..osd.osdmap import (Incremental, OSDMap, PGid, PGPool,
                           POOL_TYPE_ERASURE, POOL_TYPE_REPLICATED)
 from ..store.kv import KeyValueDB, LogDB, MemDB, WriteBatch
 from ..utils.config import Config, default_config
+from ..utils.lockdep import make_lock
 from ..utils.log import Dout
 
 DEFAULT_STRIPE_UNIT = 4096      # reference osd_pool_erasure_code_stripe_unit
@@ -106,7 +107,7 @@ class Monitor(Dispatcher):
         self.rank = rank
         self.conf = conf or default_config()
         self.log = Dout("mon", f"{name} ")
-        self.lock = threading.RLock()
+        self.lock = make_lock("mon")
         self.store = MonitorDBStore(data_path)
         self.osdmap = OSDMap()
         self.ec_registry = ec_registry.instance()

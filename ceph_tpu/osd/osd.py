@@ -48,6 +48,7 @@ from ..msg.messages import (MCommand, MCommandReply, MOSDECSubOpRead,
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..store.objectstore import ObjectStore
 from ..utils.config import Config, default_config
+from ..utils.lockdep import make_lock
 from ..utils.log import Dout
 from .osdmap import OSDMap, PGid
 from .pg import PG, STATE_ACTIVE, STATE_PEERING
@@ -116,9 +117,9 @@ class OSD(Dispatcher):
         self.log = Dout("osd", f"osd.{whoami} ")
         self.ec_registry = ec_registry.instance()
         self.osdmap = OSDMap()
-        self.map_lock = threading.RLock()
+        self.map_lock = make_lock("osd.map")
         self.pgs: Dict[PGid, PG] = {}
-        self.pg_lock = threading.RLock()
+        self.pg_lock = make_lock("osd.pgs")
         self.service = OSDService(self)
         self.msgr = Messenger(f"osd.{whoami}", conf=self.conf)
         self.my_addr = self.msgr.bind(addr)

@@ -82,7 +82,7 @@ class PG:
         self.service = service
         self.pgid = pgid
         self.pool = pool
-        self.lock = threading.RLock()
+        self.lock = make_lock("pg")
         self.state = STATE_INACTIVE
         self.up: List[Optional[int]] = []
         self.acting: List[Optional[int]] = []

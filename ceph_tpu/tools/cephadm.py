@@ -20,8 +20,9 @@ surface is what's mirrored, not the container runtime).
 
 CLI::
 
-    python -m ceph_tpu.tools.cephadm bootstrap --spec spec.json
-    python -m ceph_tpu.tools.cephadm orch ls
+    python -m ceph_tpu.tools.cephadm bootstrap --spec spec.json --shell
+    # then, at the orch> prompt: orch ls | orch ps |
+    #   daemon stop osd.1 | daemon start osd.1 | orch apply osd 4
 """
 from __future__ import annotations
 
@@ -83,7 +84,7 @@ class CephAdm:
             from ..mgr.manager import Manager
 
             def mk_mgr():
-                return Manager(self.cluster.mon_addr,
+                return Manager(self.cluster.client_mon_addrs(),
                                conf=self.cluster.conf).start()
             self._factories["mgr.x"] = mk_mgr
             self.services["mgr.x"] = mk_mgr()
@@ -109,7 +110,8 @@ class CephAdm:
             from ..mds import MDSDaemon
 
             def mk_mds():
-                return MDSDaemon(self.cluster.mon_addr, meta, data,
+                return MDSDaemon(self.cluster.client_mon_addrs(), meta,
+                                 data,
                                  conf=self.cluster.conf).start()
             self._factories["mds.a"] = mk_mds
             self.services["mds.a"] = mk_mds()
@@ -186,8 +188,9 @@ class CephAdm:
         """Scale the OSD service up (reference `ceph orch apply osd`);
         -> number of new daemons."""
         started = 0
-        while len([o for o in self.cluster.osds.values()
-                   if o is not None]) < count:
+        # declarative: count DEPLOYED daemons (a stopped daemon is
+        # still deployed — replacing it would over-provision CRUSH)
+        while len(self.cluster.osds) < count:
             new_id = max(self.cluster.osds, default=-1) + 1
             self.cluster.start_osd(new_id)
             self.cluster.wait_for_osd_up(new_id, 60)
@@ -204,6 +207,8 @@ def main(argv=None) -> int:
     b.add_argument("--data-dir", default="")
     b.add_argument("--seconds", type=float, default=5.0,
                    help="keep the cluster up this long (demo mode)")
+    b.add_argument("--shell", action="store_true",
+                   help="interactive orch shell on stdin")
     ns = p.parse_args(argv)
     if ns.cmd == "bootstrap":
         spec = json.loads(open(ns.spec).read()) if ns.spec else {}
@@ -211,11 +216,46 @@ def main(argv=None) -> int:
         try:
             print(json.dumps({"services": adm.orch_ls(),
                               "daemons": adm.orch_ps()}, indent=1))
-            time.sleep(ns.seconds)
+            if ns.shell:
+                _shell(adm)
+            else:
+                time.sleep(ns.seconds)
         finally:
             adm.shutdown()
         return 0
     return 2
+
+
+def _shell(adm: CephAdm, stdin=None) -> None:
+    """`ceph orch`-verb REPL over a live deployment."""
+    stdin = stdin or sys.stdin
+    sys.stdout.write("orch> ")
+    sys.stdout.flush()
+    for line in stdin:
+        words = line.split()
+        try:
+            if words[:2] == ["orch", "ls"]:
+                print(json.dumps(adm.orch_ls()))
+            elif words[:2] == ["orch", "ps"]:
+                print(json.dumps(adm.orch_ps()))
+            elif words[:2] == ["daemon", "stop"]:
+                adm.daemon_stop(words[2])
+                print("stopped", words[2])
+            elif words[:2] == ["daemon", "start"]:
+                adm.daemon_start(words[2])
+                print("started", words[2])
+            elif words[:3][:2] == ["orch", "apply"] and \
+                    words[2] == "osd":
+                print("started", adm.orch_apply_osd(int(words[3])))
+            elif words == ["exit"] or words == ["quit"]:
+                return
+            elif words:
+                print("? orch ls|ps, daemon stop|start <name>, "
+                      "orch apply osd <n>, exit")
+        except Exception as e:       # keep the shell alive
+            print(f"error: {e!r}")
+        sys.stdout.write("orch> ")
+        sys.stdout.flush()
 
 
 if __name__ == "__main__":

@@ -1,0 +1,86 @@
+"""Lock-order checker tests (reference common/lockdep.cc behavior:
+inversions reported WITHOUT the deadlock having to fire)."""
+import os
+
+import pytest
+
+from ceph_tpu.utils import lockdep
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_on():
+    was = lockdep.enabled()
+    lockdep.enable(True)
+    lockdep.reset()
+    yield
+    lockdep.enable(was)
+    lockdep.reset()
+
+
+def test_inversion_detected_without_deadlock():
+    a = lockdep.DebugRLock("A")
+    b = lockdep.DebugRLock("B")
+    with a:
+        with b:
+            pass
+    with b:                    # opposite order, single thread: no
+        with a:                # actual deadlock — still a finding
+            pass
+    v = lockdep.violations()
+    assert len(v) == 1
+    assert "B -> A" in v[0] and "A -> B" in v[0]
+
+
+def test_transitive_cycle_detected():
+    a = lockdep.DebugRLock("A")
+    b = lockdep.DebugRLock("B")
+    c = lockdep.DebugRLock("C")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with c:
+        with a:
+            pass               # A->B->C->A
+    assert lockdep.violations()
+
+
+def test_recursion_and_consistent_order_clean():
+    a = lockdep.DebugRLock("A")
+    b = lockdep.DebugRLock("B")
+    with a:
+        with a:                # recursion is fine
+            with b:
+                pass
+    with a:
+        with b:                # same order again: fine
+            pass
+    assert lockdep.violations() == []
+
+
+def test_cluster_io_runs_clean_under_lockdep():
+    """Live daemons with order checking on: basic replicated +
+    EC + snapshot IO must produce no inversion findings (the race-
+    detection tier the reference runs its lockdep builds for)."""
+    from ceph_tpu.cluster import Cluster
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("ld", "replicated", size=2)
+        io = c.rados().open_ioctx("ld")
+        io.write_full("x", b"1" * 10_000)
+        assert io.read("x") == b"1" * 10_000
+        s1 = io.selfmanaged_snap_create()
+        io.set_snap_context(s1, [s1])
+        io.write_full("x", b"2" * 5_000)
+        io.snap_set_read(s1)
+        assert io.read("x") == b"1" * 10_000
+        io.snap_set_read(0)
+        c.kill_osd(2, lose_data=True)
+        c.wait_for_osd_down(2)
+        c.revive_osd(2)
+        c.wait_for_osd_up(2)
+        c.wait_for_clean(60)
+    assert lockdep.violations() == [], lockdep.violations()
