@@ -44,6 +44,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..msg.messages import (MOSDOp, MOSDOpReply, MOSDPGLog, MOSDPGNotify,
                             MOSDPGQuery, OSDOp)
 from ..store.objectstore import GHObject, Transaction
+from ..utils.lockdep import make_lock
 from .backend import OI_ATTR, Mutation, ObjectInfo, build_pg_backend
 from .ecbackend import ECBackend
 from .osdmap import OSDMap, PGPool, PGid, POOL_TYPE_ERASURE
