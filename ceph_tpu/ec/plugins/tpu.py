@@ -33,9 +33,6 @@ from ..registry import ErasureCodePlugin
 from . import jerasure as jr
 
 _SHARED_BACKEND: JaxBackend = None
-# jitted benchmark chains, memoized so repeat calls reuse the compiled
-# executable instead of re-tracing (jit caches are per-wrapper)
-_CHAIN_CACHE: dict = {}
 
 
 def shared_backend() -> JaxBackend:
@@ -75,110 +72,27 @@ class TpuCodecMixin:
         """Non-blocking encode_batch: returns an AsyncBatch whose wait()
         yields parity [B, m, L].  Submitting the next batch before
         waiting overlaps transfers with MXU compute — the OSD write
-        pipeline's double-buffering entry point."""
+        pipeline's double-buffering entry point.  On a multi-device
+        host the batch is sharded (dp x sp) over the local mesh
+        (parallel/mesh.py ShardedEncoder) so the OSD batcher's
+        production dispatch rides every chip."""
         data = np.asarray(data, dtype=np.uint8)
         if data.ndim != 3 or data.shape[1] != self.k:
             raise ValueError(f"expected [batch, k={self.k}, L] input")
+        try:
+            from ...parallel.mesh import shared_encoder
+            enc = shared_encoder(self)
+            if enc is not None:
+                handle = enc.encode_async(data)
+                if handle is not None:
+                    return handle
+        except Exception:
+            pass                     # mesh trouble -> single-device path
         if self.core.gf8_encode_fast():
             return self.core.backend.apply_gf8_matrix_async(
                 self.core.coding_matrix, data)
         return self.core.backend.apply_bitmatrix_bytes_async(
             self.core.bitmatrix, data, self.w)
-
-    def encode_chain_device(self, dev_data, n: int):
-        """Run ``n`` dependency-chained encodes in ONE device program
-        (lax.fori_loop) and return a scalar tick.  The benchmark's
-        codec-boundary measurement: timing t(n2)-t(n1) isolates pure
-        on-chip encode time from dispatch/tunnel round trips, which
-        through a remote-TPU link are ~ms each and would otherwise be
-        the thing measured."""
-        import functools
-
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
-
-        core = self.core
-        use_fast = core.gf8_encode_fast()
-        if use_fast:
-            key = ("gf8", tuple(tuple(int(v) for v in row)
-                                for row in core.coding_matrix))
-        else:
-            key = ("bits", core.bitmatrix.tobytes(), core.w)
-        chain = _CHAIN_CACHE.get(key)
-        if chain is None:
-            from ...ops import jax_engine as je
-            if use_fast:
-                coeffs = key[1]
-            else:
-                Bdev = core.backend._device_matrix(core.bitmatrix)
-            w = core.w
-
-            @functools.partial(jax.jit, static_argnames=("n",))
-            def chain(d, n):
-                def body(i, carry):
-                    d0, tick = carry
-                    if use_fast:
-                        p = je._apply_gf8_xor(d0, coeffs)
-                    else:
-                        p = je._apply_byte_domain.__wrapped__(
-                            Bdev, d0, w)
-                    d0 = d0.at[0, 0, 0].set(
-                        p[0, 0, 0] ^ i.astype(p.dtype))
-                    return (d0, tick ^ p[0, 0, 0])
-                _, tick = lax.fori_loop(0, n, body,
-                                        (d, jnp.uint8(0)))
-                return tick
-
-            _CHAIN_CACHE[key] = chain
-        return chain(dev_data, n)
-
-    def decode_chain_device(self, dev_stack, n: int, chosen,
-                            data_erased):
-        """Benchmark analog of encode_chain_device for the DECODE
-        path: ``n`` dependency-chained reconstructions of
-        ``data_erased`` from the staged ``chosen`` chunk stack
-        ``[B, len(chosen), L]`` in one device program.  Decode rows
-        arrive as runtime arguments exactly like the OSD recovery
-        path (per-erasure-signature inverse, cached host-side like
-        ISA-L's table cache — reference
-        isa/ErasureCodeIsaTableCache.cc)."""
-        import functools
-
-        import jax
-        import jax.numpy as jnp
-        from jax import lax
-
-        core = self.core
-        rows_gf, rows_bits = core._decode_rows(tuple(chosen),
-                                               tuple(data_erased))
-        key = ("dec", rows_bits.tobytes(), core.w, core.layout,
-               core.packetsize)
-        chain = _CHAIN_CACHE.get(key)
-        if chain is None:
-            from ...ops import jax_engine as je
-            Bdev = core.backend._device_matrix(rows_bits)
-            w, layout, ps = core.w, core.layout, core.packetsize
-
-            @functools.partial(jax.jit, static_argnames=("n",))
-            def chain(d, n):
-                def body(i, carry):
-                    d0, tick = carry
-                    if layout == "byte":
-                        p = je._apply_byte_domain.__wrapped__(Bdev, d0,
-                                                              w)
-                    else:
-                        p = je._apply_packet_domain.__wrapped__(
-                            Bdev, d0, w, ps)
-                    d0 = d0.at[0, 0, 0].set(
-                        p[0, 0, 0] ^ i.astype(p.dtype))
-                    return (d0, tick ^ p[0, 0, 0])
-                _, tick = lax.fori_loop(0, n, body,
-                                        (d, jnp.uint8(0)))
-                return tick
-
-            _CHAIN_CACHE[key] = chain
-        return chain(dev_stack, n)
 
     def stage_batch(self, data: np.ndarray):
         """Transfer a stripe batch to device HBM ahead of encode."""
@@ -188,14 +102,38 @@ class TpuCodecMixin:
     def encode_batch_device(self, dev_data):
         """Device-resident encode: device array in, device array out (no
         host round trip) — the codec-kernel boundary.  w=8 byte-domain
-        codes ride the fused XOR/xtime chain (jax_engine
-        _apply_gf8_xor), others the bit-plane MXU path."""
+        codes ride the fused bit-plane MXU pallas kernel (jax_engine
+        gf8_fn routing), packet codes the static XOR-schedule pallas
+        kernel, others the bit-plane XLA path."""
         core = self.core
-        if core.gf8_encode_fast():
+        if core.layout == "byte" and core.w == 8 \
+                and core.coding_matrix is not None:
             return core.backend.apply_gf8_matrix_device(
                 core.coding_matrix, dev_data)
+        if core.layout == "packet":
+            return core.backend.packet_chain_fn(
+                core.bitmatrix, core.w, core.packetsize)(dev_data)
         return core.backend.apply_bitmatrix_bytes_device(
             core.bitmatrix, dev_data, self.w)
+
+    def decode_batch_device(self, dev_stack, chosen, data_erased):
+        """Device-resident per-erasure-signature decode: reconstruct
+        ``data_erased`` chunk ids from the staged ``chosen`` chunk
+        stack [B, k, L] (device array in/out).  Uses the same
+        signature-cached compiled kernels the OSD recovery path does
+        (jax_engine gf8_fn / packet_chain_fn — the compiled analog of
+        ISA-L's decode-table LRU, reference
+        isa/ErasureCodeIsaTableCache.cc:253-306)."""
+        core = self.core
+        rows_gf, rows_bits = core._decode_rows(tuple(chosen),
+                                               tuple(data_erased))
+        if core.layout == "byte" and core.w == 8 and rows_gf is not None:
+            return core.backend.gf8_fn(rows_gf)(dev_stack)
+        if core.layout == "packet":
+            return core.backend.packet_chain_fn(
+                rows_bits, core.w, core.packetsize)(dev_stack)
+        return core.backend.apply_bitmatrix_bytes_device(
+            rows_bits, dev_stack, core.w)
 
 
 class TpuReedSolomonVandermonde(TpuCodecMixin, jr.ReedSolomonVandermonde):

@@ -201,12 +201,28 @@ class CodecCore:
     def gf8_encode_fast(self) -> bool:
         """Single source of truth for the w=8 XOR-chain eligibility:
         byte-domain, a GF coding matrix in hand, and a backend whose
-        platform makes per-matrix static compilation worthwhile.
-        ENCODE ONLY — decode matrices vary per erasure signature and
-        must stay runtime arguments (no recompiles)."""
+        platform makes per-matrix static compilation worthwhile."""
         return (self.layout == "byte" and self.w == 8
                 and self.coding_matrix is not None
                 and hasattr(self.backend, "apply_gf8_matrix")
+                and self.backend.gf8_fast_path())
+
+    def gf8_decode_fast(self) -> bool:
+        """Decode twin of gf8_encode_fast: inverse rows vary per erasure
+        signature, but the signature set is tiny (C(k+m, <=m)) and a
+        rebuild hammers one signature, so per-signature compiled chains
+        behind the backend's ChainLRU beat the runtime-argument
+        bit-plane path (VERDICT r2: that gap was 64x)."""
+        return (self.layout == "byte" and self.w == 8
+                and self.coding_matrix is not None
+                and hasattr(self.backend, "apply_gf8_rows")
+                and self.backend.gf8_fast_path())
+
+    def packet_static_fast(self) -> bool:
+        """Packet-layout analog: static XOR schedules (smart-scheduling
+        style) compiled per bitmatrix, for encode and decode."""
+        return (self.layout == "packet"
+                and hasattr(self.backend, "apply_packet_xor")
                 and self.backend.gf8_fast_path())
 
     # -- encode -----------------------------------------------------------
@@ -214,6 +230,9 @@ class CodecCore:
         """data uint8 [..., k, L] -> parity uint8 [..., m, L]."""
         if data.shape[-2] != self.k:
             raise ValueError(f"expected {self.k} data chunks")
+        if data.shape[-1] == 0:      # empty object: parity is empty too
+            return np.zeros(data.shape[:-2] + (self.m, 0),
+                            dtype=np.uint8)
         if self.gf8_encode_fast():
             return self.backend.apply_gf8_matrix(self.coding_matrix,
                                                  data)
@@ -233,6 +252,9 @@ class CodecCore:
             if M is not None:
                 return self.backend.apply_matrix(M, data, self.w)
             return self._apply_bitmatrix_bytes(B, data)
+        if self.packet_static_fast():
+            return self.backend.apply_packet_xor(B, data, self.w,
+                                                 self.packetsize)
         if hasattr(self.backend, "apply_packet_chunks"):
             return self.backend.apply_packet_chunks(B, data, self.w,
                                                     self.packetsize)
@@ -270,6 +292,9 @@ class CodecCore:
         avail = sorted(present.keys())
         if len(avail) < self.k:
             raise ValueError("not enough chunks to decode")
+        if chunk_len == 0:           # empty object: all chunks empty
+            shape = next(iter(present.values())).shape
+            return {e: np.zeros(shape, dtype=np.uint8) for e in erased}
         chosen = avail[:self.k]
         out: dict[int, np.ndarray] = {}
         data_erased = [e for e in erased if e < self.k]
@@ -277,7 +302,10 @@ class CodecCore:
             rows_gf, rows_bits = self._decode_rows(tuple(chosen),
                                                    tuple(data_erased))
             stack = np.stack([present[i] for i in chosen], axis=-2)
-            dec = self._apply(rows_bits, rows_gf, stack)
+            if rows_gf is not None and self.gf8_decode_fast():
+                dec = self.backend.apply_gf8_rows(rows_gf, stack)
+            else:
+                dec = self._apply(rows_bits, rows_gf, stack)
             for idx, e in enumerate(data_erased):
                 out[e] = dec[..., idx, :]
         coding_erased = [e for e in erased if e >= self.k]
@@ -290,7 +318,10 @@ class CodecCore:
                  for e in coding_erased], axis=0)
             enc_rows_gf = None if self.coding_matrix is None else \
                 self.coding_matrix[[e - self.k for e in coding_erased]]
-            enc = self._apply(enc_rows_bits, enc_rows_gf, full)
+            if enc_rows_gf is not None and self.gf8_decode_fast():
+                enc = self.backend.apply_gf8_rows(enc_rows_gf, full)
+            else:
+                enc = self._apply(enc_rows_bits, enc_rows_gf, full)
             for idx, e in enumerate(coding_erased):
                 out[e] = enc[..., idx, :]
         return out

@@ -29,6 +29,8 @@ stripe batches from the PG write queue.
 from __future__ import annotations
 
 import functools
+import threading
+from collections import OrderedDict
 from typing import Optional
 
 import numpy as np
@@ -38,6 +40,34 @@ import jax.numpy as jnp
 
 # Lane-friendly length quantum: last dim tiles of 128 on TPU.
 LENGTH_QUANTUM = 128
+
+
+class ChainLRU:
+    """LRU of compiled per-signature chains — the moral equivalent of
+    ISA-L's decode-table cache (reference
+    isa/ErasureCodeIsaTableCache.cc:253-306): erasure signatures are few
+    (C(k+m, <=m)) and recovery hammers one signature for a whole rebuild,
+    so caching the compiled executable amortizes the one-time jit cost to
+    zero while the cap bounds compiled-program memory."""
+
+    def __init__(self, cap: int = 256):
+        self.cap = cap
+        self._d: OrderedDict = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get_or_build(self, key, builder):
+        # the lock also serializes builder(): concurrent first-users of
+        # one signature compile once, and eviction can never drop a key
+        # between another thread's insert and move_to_end
+        with self._lock:
+            hit = self._d.get(key)
+            if hit is None:
+                hit = builder()
+                self._d[key] = hit
+            self._d.move_to_end(key)
+            while len(self._d) > self.cap:
+                self._d.popitem(last=False)
+            return hit
 
 
 def _bits_of_bytes(x: jnp.ndarray) -> jnp.ndarray:
@@ -79,19 +109,17 @@ def _xtime(x: jnp.ndarray) -> jnp.ndarray:
     return ((x << 1) & jnp.uint8(0xFF)) ^ (hi * jnp.uint8(0x1D))
 
 
-@functools.partial(jax.jit, static_argnames=("coeffs",))
-def _apply_gf8_xor(data: jnp.ndarray, coeffs) -> jnp.ndarray:
-    """GF(2^8) matrix apply as a fused XOR/xtime chain — the TPU fast
-    path for byte-domain w=8 codes.
+def _gf8_chain(data: jnp.ndarray, coeffs) -> jnp.ndarray:
+    """GF(2^8) matrix apply as a fused XOR/xtime chain — the portable
+    byte-domain w=8 kernel (CPU fallback; on TPU the fused bit-plane
+    MXU pallas kernel wins — see _gf_mxu_pallas_fn and gf8_fn routing).
 
     Each constant multiply unrolls to xtime shifts + XORs on uint8
-    lanes (pure VPU, one fused elementwise kernel; XLA CSEs the shared
-    xtime powers of each data chunk across output rows).  HBM traffic
-    is ~(k+m)/k bytes per input byte, vs ~10x for the bit-plane MXU
-    path (8x int8 bit expansion + int32 accumulator) — measured ~14x
-    faster on v5e at 1 MiB stripes while remaining bit-exact with
-    jerasure.  ``coeffs`` is a static tuple-of-tuples [m][k], so each
-    coding matrix compiles once (per-pool constant)."""
+    lanes (one fused elementwise kernel; XLA CSEs the shared xtime
+    powers of each data chunk across output rows), bit-exact with
+    jerasure.  ``coeffs`` is a static tuple-of-tuples [rows][k]: coding
+    matrices are per-pool constants and decode inverse rows are cached
+    per erasure signature (ChainLRU), so each compiles once."""
     def gfmul_const(a: int, x):
         acc = None
         cur = x
@@ -113,6 +141,263 @@ def _apply_gf8_xor(data: jnp.ndarray, coeffs) -> jnp.ndarray:
         outs.append(acc if acc is not None
                     else jnp.zeros_like(data[..., 0, :]))
     return jnp.stack(outs, axis=-2)
+
+
+_apply_gf8_xor = functools.partial(jax.jit, static_argnames=("coeffs",))(
+    _gf8_chain)
+
+
+def build_xor_schedule(B: np.ndarray) -> tuple:
+    """Greedy delta schedule for a GF(2) bitmatrix: output row i is
+    either XOR-ed from scratch, or derived from an earlier output row
+    XOR the differing inputs — jerasure's 'smart scheduling' for the
+    cauchy/liberation families (reference ErasureCodeJerasure.cc:265
+    jerasure_smart_bitmatrix_to_schedule), recast as a static compile
+    schedule.  Entry = (prev_row_or_-1, (input cols to XOR...))."""
+    sets = [frozenset(np.nonzero(np.asarray(r))[0].tolist()) for r in B]
+    sched = []
+    for i, s in enumerate(sets):
+        best_j, best_cost = -1, len(s)
+        for j in range(i):
+            d = len(sets[j] ^ s) + 1
+            if d < best_cost:
+                best_cost, best_j = d, j
+        if best_j >= 0:
+            sched.append((best_j, tuple(sorted(sets[best_j] ^ s))))
+        else:
+            sched.append((-1, tuple(sorted(s))))
+    return tuple(sched)
+
+
+def _packet_xor_rows(pk: jnp.ndarray, schedule) -> jnp.ndarray:
+    """Apply an XOR schedule over packet rows: pk [..., C, ps] ->
+    [..., R, ps].  Pure uint8 XOR on the VPU — no bit expansion, no
+    int32 accumulator; bit-exact with the bitmatrix matmul."""
+    outs = []
+    for prev, cols in schedule:
+        acc = outs[prev] if prev >= 0 else None
+        for c in cols:
+            t = pk[..., c, :]
+            acc = t if acc is None else acc ^ t
+        if acc is None:
+            acc = jnp.zeros_like(pk[..., 0, :])
+        outs.append(acc)
+    return jnp.stack(outs, axis=-2)
+
+
+def _packet_chain(data: jnp.ndarray, schedule, w: int,
+                  packetsize: int) -> jnp.ndarray:
+    """data uint8 [batch, k, L] -> uint8 [batch, R/w, L] via a static
+    XOR schedule in packet layout (L = nw * w * packetsize)."""
+    batch, k, L = data.shape
+    sw = w * packetsize
+    nw = L // sw
+    x = data.reshape(batch, k, nw, w, packetsize)
+    x = jnp.transpose(x, (0, 2, 1, 3, 4)).reshape(batch, nw, k * w,
+                                                  packetsize)
+    out = _packet_xor_rows(x, schedule)  # [batch, nw, R, ps]
+    R = len(schedule)
+    m_out = R // w
+    out = out.reshape(batch, nw, m_out, w, packetsize)
+    out = jnp.transpose(out, (0, 2, 1, 3, 4))
+    return out.reshape(batch, m_out, nw * sw)
+
+
+def _packet_pallas_fn(schedule, w: int, packetsize: int,
+                      interpret: bool = False):
+    """Pallas packet-XOR kernel: one VMEM-resident [k, w, ps] super-word
+    block per grid step computes ALL schedule rows from a single HBM
+    read — the XLA elementwise path re-reads input rows per output,
+    ~fan-in x amplified; this kernel's traffic is read-once/write-once
+    (the decode bound the north star's rebuild MB/s metric lives on).
+    Returns fn: uint8 [batch, k, L] -> [batch, R/w, L]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R = len(schedule)
+    m_out = R // w
+    ps = packetsize
+
+    def fn(data):
+        batch, k, L = data.shape
+        sw = w * ps
+        nw = L // sw
+        xin = data.reshape(batch, k, nw, w, ps)
+
+        def kernel(in_ref, out_ref):
+            def get_row(c):
+                j, b = divmod(c, w)
+                return in_ref[0, j, 0, b, :]
+            outs = []
+            for prev, cols in schedule:
+                acc = outs[prev] if prev >= 0 else None
+                for c in cols:
+                    t = get_row(c)
+                    acc = t if acc is None else acc ^ t
+                if acc is None:
+                    acc = jnp.zeros((ps,), jnp.uint8)
+                outs.append(acc)
+            for r, v in enumerate(outs):
+                e, bp = divmod(r, w)
+                out_ref[0, e, 0, bp, :] = v
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(batch, nw),
+            in_specs=[pl.BlockSpec((1, k, 1, w, ps),
+                                   lambda b, i: (b, 0, i, 0, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, m_out, 1, w, ps),
+                                   lambda b, i: (b, 0, i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((batch, m_out, nw, w, ps),
+                                           jnp.uint8),
+            interpret=interpret,
+        )(xin)
+        return out.reshape(batch, m_out, L)
+    return fn
+
+
+def _pick_block_len(L: int, cap: int = 1 << 19) -> int:
+    """Largest 128-multiple divisor of L that is <= cap (VMEM budget)."""
+    best = 128
+    t = 128
+    while t <= min(L, cap):
+        if L % t == 0:
+            best = t
+        t *= 2
+    return best
+
+
+def _gf_mxu_pallas_fn(B: np.ndarray, k: int, w: int,
+                      interpret: bool = False):
+    """Fused bit-plane MXU kernel for byte-domain GF(2^w) codes:
+    uint8 [batch, k, L] -> uint8 [batch, R/w, L].
+
+    One VMEM-resident pass per block: extract bit-planes (wide [k, T]
+    compares), one int8 dot_general on the MXU (mod-2 via the int32
+    accumulator's low bit), pack parity bits back to bytes — no HBM
+    round trips for the 8x-inflated bit tensors that make the unfused
+    XLA path traffic-bound.  Honest fenced measurement on this device:
+    ~21 GiB/s vs ~7 GiB/s for the fused XOR/xtime chain and ~16 GiB/s
+    for the unfused bit-plane path (see bench.py's harness note).
+    Bit-exact with the CPU oracle; serves encode (per-pool coding
+    bitmatrix) and decode (per-erasure-signature inverse rows)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, KW = B.shape
+    m_out = R // w
+    # permute cols (c*w+j)->(j*k+c), rows (e*w+i)->(i*m_out+e) so the
+    # kernel extracts/packs whole [k, T] planes instead of skinny rows
+    colp = [c * w + j for j in range(w) for c in range(k)]
+    rowp = [e * w + i for i in range(w) for e in range(m_out)]
+    Bconst = jnp.asarray(B[np.ix_(rowp, colp)], dtype=jnp.int8)
+    TB = 16384
+
+    def fn(data):
+        batch, k_, L = data.shape
+        # pad to a 128-multiple so the block length always divides L
+        # (zeros are harmless: the code is GF-linear); callers that
+        # pre-pad (host entry points, stage()) hit the no-op branch
+        Lp = _round_up(max(L, 128), 128)
+        if Lp != L:
+            data = jnp.pad(data, ((0, 0), (0, 0), (0, Lp - L)))
+        Lb = _pick_block_len(Lp)
+        tb = min(TB, Lb)
+
+        def kernel(b_ref, in_ref, out_ref):
+            for t in range(Lb // tb):
+                x = in_ref[0, :, t * tb:(t + 1) * tb]       # [k, tb] u8
+                planes = [((x & jnp.uint8(1 << j)) != 0).astype(jnp.int8)
+                          for j in range(w)]
+                bits = jnp.concatenate(planes, axis=0)      # [w*k, tb]
+                pb = jax.lax.dot_general(
+                    b_ref[:, :], bits, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)       # [R, tb]
+                acc = None
+                for i in range(w):
+                    v = (pb[i * m_out:(i + 1) * m_out, :] & 1) << i
+                    acc = v if acc is None else acc | v
+                out_ref[0, :, t * tb:(t + 1) * tb] = acc.astype(jnp.uint8)
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(batch, Lp // Lb),
+            in_specs=[pl.BlockSpec((R, KW), lambda b, i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, k_, Lb), lambda b, i: (b, 0, i),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, m_out, Lb), lambda b, i: (b, 0, i),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((batch, m_out, Lp), jnp.uint8),
+            interpret=interpret,
+        )(Bconst, data)
+        return out[:, :, :L] if Lp != L else out
+    return fn
+
+
+def gf8_inner(rows: np.ndarray):
+    """Unjitted traceable kernel for a GF(2^8) row set [.., C, L] ->
+    [.., R, L]: the SINGLE source of w=8 kernel routing (fused MXU
+    pallas kernel on TPU, XOR/xtime elementwise chain elsewhere),
+    shared by JaxBackend.gf8_fn and the mesh data plane
+    (parallel/mesh.py sharded_encode_gf8_fn)."""
+    rows = np.asarray(rows, dtype=np.int64)
+    if pallas_gf_mxu_ok():
+        from .matrix import matrix_to_bitmatrix
+        return _gf_mxu_pallas_fn(matrix_to_bitmatrix(rows, 8),
+                                 rows.shape[1], 8)
+    coeffs = tuple(tuple(int(v) for v in row) for row in rows)
+    return functools.partial(_gf8_chain, coeffs=coeffs)
+
+
+_PALLAS_PROBE = {"ok": None, "mxu": None}
+
+
+def pallas_gf_mxu_ok() -> bool:
+    """One-time probe of the fused MXU kernel on this platform."""
+    try:
+        if jax.default_backend() != "tpu":
+            return False
+    except Exception:
+        return False
+    if _PALLAS_PROBE["mxu"] is None:
+        try:
+            from .matrix import (matrix_to_bitmatrix,
+                                 reed_sol_vandermonde_coding_matrix)
+            M = reed_sol_vandermonde_coding_matrix(2, 1, 8)
+            fn = jax.jit(_gf_mxu_pallas_fn(matrix_to_bitmatrix(M, 8), 2, 8))
+            x = np.arange(2 * 256, dtype=np.uint8).reshape(1, 2, 256)
+            from .engine import NumpyBackend
+            ref = NumpyBackend().apply_matrix(M, x, 8)
+            _PALLAS_PROBE["mxu"] = bool(
+                np.array_equal(np.asarray(fn(jnp.asarray(x))), ref))
+        except Exception:
+            _PALLAS_PROBE["mxu"] = False
+    return _PALLAS_PROBE["mxu"]
+
+
+def pallas_packet_ok(w: int, packetsize: int) -> bool:
+    """Whether the pallas packet kernel should serve this geometry:
+    TPU platform, lane-aligned packets, and a one-time smoke probe
+    (lowering through unusual plugin platforms may fail — fall back to
+    the XLA chain rather than crash the codec)."""
+    try:
+        if jax.default_backend() != "tpu" or packetsize % 128:
+            return False
+    except Exception:
+        return False
+    if _PALLAS_PROBE["ok"] is None:
+        try:
+            sched = tuple((-1, (c,)) for c in range(8))  # identity w=8
+            fn = jax.jit(_packet_pallas_fn(sched, 8, 128))
+            x = np.arange(8 * 128, dtype=np.uint8).reshape(1, 1, -1)
+            _PALLAS_PROBE["ok"] = bool(
+                np.array_equal(np.asarray(fn(jnp.asarray(x))), x))
+        except Exception:
+            _PALLAS_PROBE["ok"] = False
+    return _PALLAS_PROBE["ok"]
 
 
 def _matmul_mod2(B: jnp.ndarray, bits: jnp.ndarray) -> jnp.ndarray:
@@ -211,6 +496,7 @@ class JaxBackend:
     def __init__(self, bucket_shapes: bool = True):
         self.bucket_shapes = bucket_shapes
         self._dev_matrices: dict = {}
+        self._chain_lru = ChainLRU(256)
 
     def _device_matrix(self, B: np.ndarray) -> jnp.ndarray:
         key = (B.shape, B.tobytes())
@@ -248,10 +534,9 @@ class JaxBackend:
 
     def apply_gf8_matrix(self, M: np.ndarray, data: np.ndarray
                          ) -> np.ndarray:
-        """Byte-domain w=8 fast path: fused XOR/xtime chain (see
-        _apply_gf8_xor).  Encode's hot path — the coding matrix is a
-        per-pool constant, so the one-compile-per-matrix cost
-        amortizes to zero."""
+        """Byte-domain w=8 fast path (encode hot path; the coding
+        matrix is a per-pool constant so per-matrix compilation
+        amortizes to zero)."""
         if not self.gf8_fast_path():
             from .matrix import matrix_to_bitmatrix
             return self.apply_bitmatrix_bytes(
@@ -262,16 +547,79 @@ class JaxBackend:
         lead = data.shape[:-2]
         data = data.reshape((-1,) + data.shape[-2:])
         padded, batch, L = self._padded(data, LENGTH_QUANTUM)
-        coeffs = tuple(tuple(int(v) for v in row) for row in M)
-        out = _apply_gf8_xor(jnp.asarray(padded), coeffs)
+        out = self.gf8_fn(M)(jnp.asarray(padded))
         out = np.asarray(out)[:batch, :, :L]
         out = out.reshape(lead + out.shape[-2:])
         return out[0] if squeeze else out
 
     def apply_gf8_matrix_device(self, M: np.ndarray, dev_data):
-        """Device-resident XOR-chain apply (codec-kernel boundary)."""
-        coeffs = tuple(tuple(int(v) for v in row) for row in M)
-        return _apply_gf8_xor(dev_data, coeffs)
+        """Device-resident byte-domain apply (codec-kernel boundary)."""
+        return self.gf8_fn(M)(dev_data)
+
+    def gf8_fn(self, rows: np.ndarray):
+        """Best compiled kernel for an arbitrary GF(2^8) row set over
+        [.., C, L] byte chunks, LRU-cached per row set — per-pool
+        coding matrices AND per-erasure-signature decode rows (the
+        compiled analog of ISA-L's decode-table LRU).  Routing lives
+        in gf8_inner (shared with the mesh path)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        coeffs = tuple(tuple(int(v) for v in row) for row in rows)
+        return self._chain_lru.get_or_build(
+            ("gf8", coeffs), lambda: jax.jit(gf8_inner(rows)))
+
+    # back-compat alias (decode-rows naming)
+    gf8_chain_fn = gf8_fn
+
+    def apply_gf8_rows(self, rows: np.ndarray, data: np.ndarray
+                       ) -> np.ndarray:
+        """Decode-side twin of apply_gf8_matrix: apply per-signature
+        inverse rows via the signature-cached compiled kernel."""
+        if not self.gf8_fast_path():
+            from .matrix import matrix_to_bitmatrix
+            return self.apply_bitmatrix_bytes(
+                matrix_to_bitmatrix(np.asarray(rows, dtype=np.int64), 8),
+                data, 8)
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        lead = data.shape[:-2]
+        data = data.reshape((-1,) + data.shape[-2:])
+        padded, batch, L = self._padded(data, LENGTH_QUANTUM)
+        out = self.gf8_fn(rows)(jnp.asarray(padded))
+        out = np.asarray(out)[:batch, :, :L]
+        out = out.reshape(lead + out.shape[-2:])
+        return out[0] if squeeze else out
+
+    def packet_chain_fn(self, B: np.ndarray, w: int, packetsize: int):
+        """Compiled static XOR schedule for a packet-layout bitmatrix
+        (cauchy/liberation families), LRU-cached per matrix.  Returns a
+        jitted [batch, k, L] -> [batch, R/w, L] callable."""
+        key = ("pkt", B.shape, B.tobytes(), w, packetsize)
+
+        def build():
+            schedule = build_xor_schedule(B)
+            if pallas_packet_ok(w, packetsize):
+                return jax.jit(_packet_pallas_fn(schedule, w, packetsize))
+            return jax.jit(functools.partial(
+                _packet_chain, schedule=schedule, w=w,
+                packetsize=packetsize))
+        return self._chain_lru.get_or_build(key, build)
+
+    def apply_packet_xor(self, B: np.ndarray, data: np.ndarray, w: int,
+                         packetsize: int) -> np.ndarray:
+        """Static-schedule packet apply — used for both encode (coding
+        bitmatrix, per-pool constant) and decode (inverted rows, cached
+        per erasure signature) when the platform merits compilation."""
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        lead = data.shape[:-2]
+        data = data.reshape((-1,) + data.shape[-2:])
+        padded, batch, L = self._padded(data, w * packetsize)
+        out = self.packet_chain_fn(B, w, packetsize)(jnp.asarray(padded))
+        out = np.asarray(out)[:batch, :, :L]
+        out = out.reshape(lead + out.shape[-2:])
+        return out[0] if squeeze else out
 
     def apply_gf8_matrix_async(self, M: np.ndarray,
                                data: np.ndarray) -> "AsyncBatch":
@@ -288,8 +636,7 @@ class JaxBackend:
         data = data.reshape((-1,) + data.shape[-2:])
         padded, batch, L = self._padded(data, LENGTH_QUANTUM)
         dev = jax.device_put(padded)
-        coeffs = tuple(tuple(int(v) for v in row) for row in M)
-        out = _apply_gf8_xor(dev, coeffs)
+        out = self.gf8_fn(M)(dev)
         out.copy_to_host_async()
         return AsyncBatch(out, batch, L, lead)
 

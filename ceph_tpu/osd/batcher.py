@@ -310,7 +310,11 @@ class EncodeBatcher:
     def _dispatch_group(self, reqs: List[_Req]):
         """Issue one async device call for every request of one
         geometry; returns (arrs, async_handle) or None on dispatch
-        failure (completion falls back to per-request CPU encode)."""
+        failure (completion falls back to per-request CPU encode).
+        On a multi-device host the codec's encode_batch_async itself
+        shards (dp x sp) over the mesh (parallel/mesh.py
+        ShardedEncoder via the tpu plugin) so this production path
+        rides every local chip, not just chip 0."""
         try:
             sinfo = reqs[0].sinfo
             k = reqs[0].ec_impl.get_data_chunk_count()

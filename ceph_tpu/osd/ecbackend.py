@@ -34,9 +34,12 @@ PG before submit.
 Writes serialize through a strictly FIFO per-PG pipeline, exactly like
 the reference's in-order 3-queue state machine (ECBackend.cc:2151):
 sub-writes — and with them PG-log entries — always apply in submission
-order, which keeps every shard's log monotonic.  (The reference's
-ExtentCache lets overlapping RMW pipeline deeper; here the pipeline
-depth is 1, trading a little latency for simplicity.)
+order, which keeps every shard's log monotonic.  Overlapping RMW ops
+pipeline deeper than one: an in-flight extent overlay (the reference
+ExtentCache analog, ECBackend.cc:1891-1920; see ``_overlay`` below)
+lets a later op's RMW reads see earlier ops' not-yet-committed bytes,
+so multiple writes to one object proceed concurrently without
+read-your-own-write hazards.
 """
 from __future__ import annotations
 
