@@ -170,6 +170,34 @@ class Manager(Dispatcher):
                 self._collect_once()
             except Exception as e:
                 self.log.dout(5, f"collect failed: {e!r}")
+            try:
+                self._maybe_autoscale()
+            except Exception as e:
+                self.log.dout(5, f"autoscale failed: {e!r}")
+
+    def _maybe_autoscale(self) -> None:
+        """Apply pg_autoscaler recommendations when
+        ``mgr_pg_autoscale_mode = on`` (reference pg_autoscaler's
+        active mode issuing `osd pool set pg_num`): grow-only — PG
+        merges and EC-pool splits are not supported, so those
+        recommendations stay advisory."""
+        if self.conf["mgr_pg_autoscale_mode"] != "on":
+            return
+        with self.lock:
+            osdmap = self.osdmap
+        for rec in pg_autoscale_recommendations(osdmap):
+            pool = osdmap.pools.get(rec["pool_id"])
+            if pool is None or pool.is_erasure():
+                continue
+            if rec["target_pg_num"] > pool.pg_num:
+                ret, msg, _ = self.monc.command(
+                    {"prefix": "osd pool set", "pool": pool.name,
+                     "var": "pg_num",
+                     "val": str(rec["target_pg_num"])})
+                self.log.dout(
+                    1, f"autoscale {pool.name}: pg_num "
+                    f"{pool.pg_num} -> {rec['target_pg_num']} "
+                    f"(rc={ret} {msg})")
 
     def _collect_once(self) -> None:
         interval = self.conf["mgr_tick_interval"]

@@ -671,6 +671,7 @@ class Monitor(Dispatcher):
                 pool = PGPool(name=name, pool_id=pid,
                               type=POOL_TYPE_ERASURE, size=size,
                               min_size=min_size, pg_num=pg_num,
+                              created_pg_num=pg_num,
                               crush_rule=rule_id,
                               erasure_code_profile=prof_name,
                               stripe_width=stripe_width,
@@ -694,6 +695,7 @@ class Monitor(Dispatcher):
                 pool = PGPool(name=name, pool_id=pid,
                               type=POOL_TYPE_REPLICATED, size=size,
                               min_size=min_size, pg_num=pg_num,
+                              created_pg_num=pg_num,
                               crush_rule=rule_id)
                 inc = self._pending()
                 inc.new_pools[pid] = pool
@@ -721,7 +723,17 @@ class Monitor(Dispatcher):
             elif var == "min_size":
                 newpool.min_size = int(val)
             elif var == "pg_num":
-                newpool.pg_num = int(val)
+                # live pg_num growth -> OSD-side PG split (reference
+                # OSDMonitor.cc:8141 pg_num pool-set + OSD::split_pgs,
+                # osd/OSD.cc:8926).  Shrinking (PG merge) is not
+                # supported, matching the pre-Nautilus reference.
+                n = int(val)
+                if n < pool.pg_num:
+                    return (-22, "pg_num decrease (merge) not "
+                            "supported", {})
+                if n > 65536:
+                    return (-22, "pg_num too large", {})
+                newpool.pg_num = n
             else:
                 return (-22, f"unknown pool var {var}", {})
             inc = self._pending()

@@ -658,6 +658,32 @@ class MOSDPGQuery(Message):
 
 
 @register
+class MOSDPGRemove(Message):
+    """Child-PG primary -> split-stray holder: the child is clean on
+    its acting set; delete your stray copy (reference
+    messages/MOSDPGRemove.h, sent by the reference when strays are no
+    longer needed after peering)."""
+    TYPE = 96
+
+    def __init__(self, pgid: str = "", from_osd: int = -1,
+                 epoch: int = 0):
+        super().__init__()
+        self.pgid = pgid
+        self.from_osd = from_osd
+        self.epoch = epoch
+
+    def encode_payload(self) -> bytes:
+        e = Encoder()
+        e.str(self.pgid).i32(self.from_osd).u32(self.epoch)
+        return e.build()
+
+    @classmethod
+    def decode_payload(cls, buf: bytes) -> "MOSDPGRemove":
+        d = Decoder(buf)
+        return cls(pgid=d.str(), from_osd=d.i32(), epoch=d.u32())
+
+
+@register
 class MOSDPGNotify(Message):
     """Acting member -> primary: my info + full (bounded) log + my
     persistent missing set (reference messages/MOSDPGNotify.h carries
@@ -672,7 +698,11 @@ class MOSDPGNotify(Message):
     def __init__(self, pgid: str = "", shard: int = -1,
                  from_osd: int = -1, epoch: int = 0,
                  log: Optional[dict] = None,
-                 missing: Optional[dict] = None):
+                 missing: Optional[dict] = None,
+                 stray: bool = False,
+                 objects: Optional[dict] = None,
+                 stray_shard: int = -1,
+                 split_adopted: bool = False):
         super().__init__()
         self.pgid = pgid
         self.shard = shard           # replying shard position
@@ -680,12 +710,26 @@ class MOSDPGNotify(Message):
         self.epoch = epoch
         self.log = log or {}         # PGLog.to_dict()
         self.missing = missing or {}  # MissingSet.to_dict()
+        # split-stray self-notify (no reference message carries these:
+        # the reference's past_intervals machinery makes the primary
+        # query strays; here strays announce themselves — see
+        # PG.maybe_split / PG._notify_as_stray)
+        self.stray = stray
+        self.objects = objects or {}  # oid -> [epoch, v] (stray only)
+        self.stray_shard = stray_shard  # EC shard the stray holds
+        # True when this copy was produced by a parent PG's split
+        # (adopt_split): its content IS the ancestry's answer, so a
+        # child primary may activate on (0,0) heads without a stray
+        self.split_adopted = split_adopted
 
     def encode_payload(self) -> bytes:
         e = Encoder()
         e.str(self.pgid).i32(self.shard).i32(self.from_osd)
         e.u32(self.epoch).bytes(_enc_json(self.log))
         e.bytes(_enc_json(self.missing))
+        e.u8(1 if self.stray else 0)
+        e.bytes(_enc_json(self.objects)).i32(self.stray_shard)
+        e.u8(1 if self.split_adopted else 0)
         return e.build()
 
     @classmethod
@@ -693,7 +737,9 @@ class MOSDPGNotify(Message):
         d = Decoder(buf)
         return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
                    epoch=d.u32(), log=_dec_json(d.bytes()),
-                   missing=_dec_json(d.bytes()))
+                   missing=_dec_json(d.bytes()), stray=bool(d.u8()),
+                   objects=_dec_json(d.bytes()), stray_shard=d.i32(),
+                   split_adopted=bool(d.u8()))
 
 
 @register

@@ -567,9 +567,6 @@ class JaxBackend:
         return self._chain_lru.get_or_build(
             ("gf8", coeffs), lambda: jax.jit(gf8_inner(rows)))
 
-    # back-compat alias (decode-rows naming)
-    gf8_chain_fn = gf8_fn
-
     def apply_gf8_rows(self, rows: np.ndarray, data: np.ndarray
                        ) -> np.ndarray:
         """Decode-side twin of apply_gf8_matrix: apply per-signature

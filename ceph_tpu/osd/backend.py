@@ -147,6 +147,14 @@ class PGHost(abc.ABC):
     def send_shard(self, osd: int, msg: Message) -> None:
         """Ship a sub-op message to a peer OSD (cluster messenger)."""
 
+    def extra_recovery_sources(self, oid: str
+                               ) -> List[Tuple[int, int]]:
+        """Non-acting holders ((shard, osd) pairs) that can serve
+        ``oid`` during recovery — post-split strays and migrated-away
+        copies (reference MissingLoc tracks these via past intervals;
+        here the PG records them from stray notifies)."""
+        return []
+
     @abc.abstractmethod
     def prepare_log_txn(self, txn: Transaction,
                         log_entries: List[dict]) -> None:
@@ -255,8 +263,13 @@ class PGBackend(abc.ABC):
             return None
 
     def list_objects(self) -> List[str]:
-        return sorted({o.oid for o in
-                       self.host.store.collection_list(self.host.coll)})
+        try:
+            return sorted({o.oid for o in self.host.store.
+                           collection_list(self.host.coll)})
+        except FileNotFoundError:
+            # collection purged under us (stray removal racing a map
+            # advance): an empty listing, not a crash in the map pump
+            return []
 
 
 def build_pg_backend(host: PGHost, pool, ec_registry):

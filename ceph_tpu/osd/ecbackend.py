@@ -721,15 +721,26 @@ class ECBackend(PGBackend):
         return self.ec_impl
 
     def _min_read_shards(self, want: Set[int],
-                         exclude: Optional[Set[int]] = None
+                         exclude: Optional[Set[int]] = None,
+                         oid: Optional[str] = None
                          ) -> Optional[Dict[int, int]]:
         """Choose the minimum shard set for reconstruction (reference
         get_min_avail_to_read_shards, ECBackend.cc:1594): the codec's
         minimum_to_decode picks data shards when whole, parity fills
-        holes; LRC/SHEC/CLAY codecs pick their cheaper local sets."""
+        holes; LRC/SHEC/CLAY codecs pick their cheaper local sets.
+
+        Post-split, a chunk position whose acting holder lacks the
+        object may still be served by a stray (the parent's former
+        shard holder) — with ``oid`` given, strays fill such holes
+        (the reference reads from past-interval members the same
+        way)."""
         avail = {shard: osd for shard, osd in self.host.acting_shards()
                  if osd is not None
                  and not (exclude and shard in exclude)}
+        if oid is not None:
+            for shard, osd in self.host.extra_recovery_sources(oid):
+                if shard >= 0 and shard not in avail:
+                    avail[shard] = osd
         try:
             need = self.ec_impl.minimum_to_decode(want, set(avail))
         except IOError:
@@ -856,11 +867,29 @@ class ECBackend(PGBackend):
             self._recover_with_info(rec, info, attrs)
             return
         # primary's own shard lacks the object: fetch metadata from a
-        # surviving peer first (the reference's pull path)
+        # surviving peer first (the reference's pull path); post-split
+        # strays count as surviving holders — including our own
+        # physically-held source shard (mispositioned after an EC
+        # split), which we can read locally
         missing_shards = {s for s, _ in missing_on}
+        for s, o in self.host.extra_recovery_sources(oid):
+            if o == self.host.whoami and s >= 0:
+                try:
+                    attrs = self.host.store.getattrs(
+                        self.host.coll_of(s), GHObject(oid, s))
+                except FileNotFoundError:
+                    continue
+                if OI_ATTR in attrs:
+                    self._recover_with_info(
+                        rec, ObjectInfo.decode(attrs[OI_ATTR]), attrs)
+                    return
         peers = [(s, o) for s, o in self.host.acting_shards()
                  if o is not None and o != self.host.whoami
                  and s not in missing_shards]
+        for s, o in self.host.extra_recovery_sources(oid):
+            if s >= 0 and o != self.host.whoami and \
+                    all(o != po for _, po in peers):
+                peers.append((s, o))
         if not peers:
             del self.recovery_ops[oid]
             cb(-5)
@@ -908,7 +937,8 @@ class ECBackend(PGBackend):
         set and batch-decode the missing ones."""
         oid = rec.oid
         shards = self._min_read_shards(set(missing_shards),
-                                       exclude=missing_shards)
+                                       exclude=missing_shards,
+                                       oid=oid)
         if shards is None:
             self.recovery_ops.pop(oid, None)
             rec.cb(-5)

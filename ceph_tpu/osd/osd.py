@@ -43,6 +43,7 @@ from ..msg.messages import (MCommand, MCommandReply, MOSDECSubOpRead,
                             MOSDECSubOpWriteReply, MOSDMap, MOSDOp,
                             MOSDPGLog, MOSDPGNotify, MOSDPGPull,
                             MOSDPGPush, MOSDPGPushReply, MOSDPGQuery,
+                            MOSDPGRemove,
                             MOSDPing, MOSDRepOp, MOSDRepOpReply,
                             MOSDScrub, MRepScrub, MRepScrubMap)
 from ..msg.messenger import Connection, Dispatcher, Messenger
@@ -102,6 +103,17 @@ class OSDService:
 
     def kick_recovery(self, pg: Optional[PG] = None) -> None:
         self._osd.kick_recovery()
+
+    def ensure_pg(self, pgid) -> Optional[PG]:
+        """Get-or-create a local PG instance regardless of acting-set
+        membership (split children are created on the parent's holders
+        even when they are strays there)."""
+        return self._osd._ensure_pg(pgid, self._osd.osdmap)
+
+    def forget_pg(self, pgid) -> None:
+        """Drop a purged stray PG from the local registry."""
+        with self._osd.pg_lock:
+            self._osd.pgs.pop(pgid, None)
 
 
 class OSD(Dispatcher):
@@ -254,7 +266,10 @@ class OSD(Dispatcher):
 
     def _advance_pgs(self, osdmap: OSDMap) -> None:
         """Instantiate PGs mapped here and advance every hosted PG
-        (reference consume_map / handle_pg_create)."""
+        (reference consume_map / handle_pg_create).  Splits run before
+        interval handling so children hold their objects before their
+        peering starts (reference OSD::advance_pg split-then-peer
+        ordering, osd/OSD.cc:8926)."""
         for pool_id in list(osdmap.pools):
             for pgid in osdmap.pgs_for_pool(pool_id):
                 _, _, acting, _ = osdmap.pg_to_up_acting_osds(pgid)
@@ -263,7 +278,18 @@ class OSD(Dispatcher):
         with self.pg_lock:
             pgs = list(self.pgs.values())
         for pg in pgs:
-            pg.advance_map(osdmap)
+            try:
+                pg.maybe_split(osdmap)
+            except Exception as e:   # one sick PG must not wedge the
+                self.log.dout(1, f"split {pg.pgid} failed: {e!r}")
+        with self.pg_lock:
+            pgs = list(self.pgs.values())  # splits may add children
+        for pg in pgs:
+            try:
+                pg.advance_map(osdmap)
+            except Exception as e:   # map pump (all PGs starve if one
+                self.log.dout(1,     # advance raises)
+                              f"advance {pg.pgid} failed: {e!r}")
 
     def _ensure_pg(self, pgid: PGid, osdmap: OSDMap) -> Optional[PG]:
         with self.pg_lock:
@@ -328,6 +354,11 @@ class OSD(Dispatcher):
                 pg.handle_pg_notify(msg)
             else:
                 pg.handle_pg_log(msg)
+            return True
+        if isinstance(msg, MOSDPGRemove):
+            pg = self._lookup_pg(PGid.parse(msg.pgid), create=False)
+            if pg is not None:
+                pg.handle_pg_remove(msg)
             return True
         if isinstance(msg, (MOSDScrub, MRepScrub, MRepScrubMap)):
             pg = self._lookup_pg(PGid.parse(msg.pgid))
@@ -588,9 +619,26 @@ class OSD(Dispatcher):
         while not self._stop.wait(interval):
             self._send_pg_stats()
             self._retry_stuck_peering()
+            self._renotify_strays()
             self._maybe_schedule_scrub()
             self._maybe_trim_snaps()
             self._maybe_reboot()
+
+    def _renotify_strays(self) -> None:
+        """Stray copies (split children on the parent's holders,
+        migrated-away PGs) re-announce themselves until the primary
+        purges them — covers notifies lost to races or primary
+        failover."""
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        with self.map_lock:
+            osdmap = self.osdmap
+        for pg in pgs:
+            try:
+                if pg.is_stray():
+                    pg.maybe_notify_stray(osdmap)
+            except Exception:
+                pass
 
     def _maybe_trim_snaps(self) -> None:
         """Drive snap trimming on primary PGs (reference OSD ticks the

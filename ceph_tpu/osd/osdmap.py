@@ -83,6 +83,46 @@ def pg_num_mask(pg_num: int) -> int:
     return m - 1
 
 
+def pg_split_parent(seed: int) -> int:
+    """Structural split parent of a child PG seed: the seed with its
+    top bit cleared.  Algebraic consequence of ceph_stable_mod: for any
+    old/new pg_num pair with old <= seed < new, the objects that land
+    in child ``seed`` previously hashed to exactly this parent
+    (reference pg_t::parent_of / is_split, osd/osd_types.h)."""
+    assert seed > 0
+    return seed & ~(1 << (seed.bit_length() - 1))
+
+
+def pg_split_ancestors(seed: int, created_pg_num: int) -> List[int]:
+    """Ancestor chain of a split child down to (and including) the
+    first seed that existed at pool creation — the framework's
+    map-history-free stand-in for the reference's past_intervals: data
+    for a split child can only ever live with its structural
+    ancestors' holders."""
+    out = []
+    while seed >= max(created_pg_num, 1):
+        seed = pg_split_parent(seed)
+        out.append(seed)
+    return out
+
+
+def pg_split_source(seed: int, old_pg_num: int) -> int:
+    """The pre-growth PG (< old_pg_num) that holds the objects of
+    child ``seed``: walk the structural parent chain down below
+    old_pg_num."""
+    while seed >= old_pg_num:
+        seed = pg_split_parent(seed)
+    return seed
+
+
+def pg_split_children(seed: int, old_pg_num: int,
+                      new_pg_num: int) -> List[int]:
+    """Child seeds whose objects PG ``seed`` holds when pg_num grows
+    old -> new (reference pg_t::is_split, osd/osd_types.h)."""
+    return [c for c in range(old_pg_num, new_pg_num)
+            if pg_split_source(c, old_pg_num) == seed]
+
+
 @dataclass(frozen=True, order=True)
 class PGid:
     """(pool id, placement seed) — reference pg_t."""
@@ -119,6 +159,7 @@ class PGPool:
     size: int = 3
     min_size: int = 2
     pg_num: int = 32
+    created_pg_num: int = 0      # pg_num at pool creation (split anchor)
     crush_rule: int = 0
     erasure_code_profile: str = ""
     stripe_width: int = 0
@@ -306,6 +347,7 @@ class OSDMap:
             "pools": {str(p.pool_id): {
                 "name": p.name, "type": p.type, "size": p.size,
                 "min_size": p.min_size, "pg_num": p.pg_num,
+                "created_pg_num": p.created_pg_num,
                 "crush_rule": p.crush_rule,
                 "erasure_code_profile": p.erasure_code_profile,
                 "stripe_width": p.stripe_width,
@@ -333,7 +375,10 @@ class OSDMap:
         for pid, p in d["pools"].items():
             pool = PGPool(name=p["name"], pool_id=int(pid), type=p["type"],
                           size=p["size"], min_size=p["min_size"],
-                          pg_num=p["pg_num"], crush_rule=p["crush_rule"],
+                          pg_num=p["pg_num"],
+                          created_pg_num=p.get("created_pg_num",
+                                               p["pg_num"]),
+                          crush_rule=p["crush_rule"],
                           erasure_code_profile=p["erasure_code_profile"],
                           stripe_width=p["stripe_width"],
                           ec_overwrites=p.get("ec_overwrites", False),

@@ -42,7 +42,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ..msg.messages import (MOSDOp, MOSDOpReply, MOSDPGLog, MOSDPGNotify,
-                            MOSDPGQuery, OSDOp)
+                            MOSDPGQuery, MOSDPGRemove, OSDOp)
 from ..store.objectstore import GHObject, Transaction
 from ..utils.lockdep import make_lock
 from .backend import OI_ATTR, Mutation, ObjectInfo, build_pg_backend
@@ -54,6 +54,10 @@ from .pglog import (DELETE, MODIFY, Eversion, LogEntry, MissingSet,
 PGMETA_OID = "_pgmeta"          # reference pgmeta_oid
 LOG_KEY_PREFIX = "log."
 INFO_KEY = "info"
+SPLIT_KEY = "split_pgnum"       # pool pg_num this PG last split at
+STRAY_SHARD_KEY = "stray_shard"  # EC shard identity kept while stray
+SPLIT_SRC_KEY = "split_src"     # parent shard whose chunks we hold
+SPLIT_ADOPTED_KEY = "split_adopted"  # a local parent split fed us
 MISSING_KEY = "missing"         # persisted pg_missing_t (reference
                                 # PGLog write_log_and_missing)
 
@@ -125,6 +129,32 @@ class PG:
         self.watchers: Dict[str, Dict[Tuple[str, int], object]] = {}
         self._notifies: Dict[int, Dict] = {}
         self._next_notify_id = 0
+        # -- PG split (reference OSD::split_pgs, osd/OSD.cc:8926) ------
+        # pool pg_num this PG has split to; growth beyond it triggers
+        # maybe_split().  Fresh PGs start current; the persisted value
+        # (pgmeta) wins on restart so growth-while-down still splits.
+        self._last_split_pgnum = pool.created_pg_num or pool.pg_num
+        # stray side (we hold data for a PG whose acting set excludes
+        # us — split children start life this way on the parent's
+        # holders; the reference's past_intervals machinery is replaced
+        # by strays announcing themselves to the current primary):
+        self._stray_shard = -1       # EC shard identity we held
+        # EC split: the parent shard whose physical chunks this copy
+        # holds.  EC positions are NOT interchangeable (reference
+        # ecbackend.rst "Distinguished acting set positions"): a child
+        # acting member may hold parent-shard-s chunks while being
+        # assigned position j != s — its position data is then MISSING
+        # (audited on activation) while its s-chunks serve as a
+        # recovery source.
+        self._split_source_shard = -1
+        # True once a local parent split adopted this copy: its content
+        # (even empty) is the ancestry's authoritative answer for this
+        # child seed
+        self._split_adopted = False
+        # primary side: stray notifies (osd -> notify payload) and the
+        # object sets they can serve as recovery sources
+        self._stray_notifies: Dict[int, dict] = {}
+        self._stray_sources: Dict[int, Dict[str, Eversion]] = {}
         self.backend = build_pg_backend(self, pool, service.ec_registry)
         from .scrub import Scrubber
         self.scrubber = Scrubber(self)
@@ -149,7 +179,9 @@ class PG:
         for i, osd in enumerate(self.acting):
             if osd == self.whoami:
                 return i
-        return -1
+        # a split/migration stray keeps serving the shard it held when
+        # it left the acting set (collection + read identity)
+        return self._stray_shard
 
     @property
     def store(self):
@@ -244,7 +276,12 @@ class PG:
         import json as _json
         kvs = {INFO_KEY: self.log.encode(),
                MISSING_KEY: _json.dumps(
-                   self.missing.to_dict()).encode()}
+                   self.missing.to_dict()).encode(),
+               SPLIT_KEY: str(self._last_split_pgnum).encode(),
+               STRAY_SHARD_KEY: str(self._stray_shard).encode(),
+               SPLIT_SRC_KEY: str(self._split_source_shard).encode(),
+               SPLIT_ADOPTED_KEY:
+                   (b"1" if self._split_adopted else b"0")}
         txn.omap_setkeys(self.coll, self._meta_obj(), kvs)
 
     def _persist_pgmeta(self) -> None:
@@ -279,6 +316,281 @@ class PG:
                     if raw:
                         self.missing = MissingSet.from_dict(
                             _json.loads(raw.decode()))
+            raw = omap.get(SPLIT_KEY)
+            if raw:                  # persisted split anchor wins: a
+                self._last_split_pgnum = int(raw)  # restart must still
+            raw = omap.get(STRAY_SHARD_KEY)        # split past growth
+            if raw and int(raw) >= 0:
+                self._stray_shard = int(raw)
+            raw = omap.get(SPLIT_SRC_KEY)
+            if raw and int(raw) >= 0:
+                self._split_source_shard = int(raw)
+            raw = omap.get(SPLIT_ADOPTED_KEY)
+            if raw == b"1":
+                self._split_adopted = True
+
+    # ------------------------------------------------------------------
+    # PG split (reference OSDMonitor pg_num pool-set -> OSD::split_pgs,
+    # osd/OSD.cc:8926, PG.cc split_colls / PGLog::split_out_child)
+    # ------------------------------------------------------------------
+    def maybe_split(self, osdmap: OSDMap) -> None:
+        """If the pool's pg_num grew past our split anchor, rehash our
+        objects into the child PGs this seed feeds (created locally
+        even when we are not in a child's acting set — such children
+        are split STRAYS that announce themselves to the child's
+        primary and serve as recovery sources until purged).
+
+        Runs on every replica independently; all replicas move the
+        same oids and produce identical child logs (split_out keeps
+        head/tail), so child peering elections are trivial.  Idempotent
+        and anchored on the persisted split pg_num, so growth while an
+        OSD was down still splits on restart."""
+        pool = osdmap.get_pool(self.pgid.pool)
+        if pool is None:
+            return
+        new = pool.pg_num
+        with self.lock:
+            old = self._last_split_pgnum
+            if new <= old:
+                return
+            if self.pgid.seed >= old:
+                # we ARE a child of this growth (or fresh): just move
+                # the anchor forward
+                self._last_split_pgnum = new
+                self._persist_pgmeta()
+                return
+            from .osdmap import pg_split_children
+            children = pg_split_children(self.pgid.seed, old, new)
+
+            def rehash(oid: str) -> int:
+                # snapshot clones ride with their head, matching
+                # client targeting (head/clone colocation invariant)
+                return osdmap.object_locator_to_pg(
+                    oid.split("@", 1)[0], self.pgid.pool).seed
+
+            moves: Dict[int, List[str]] = {}
+            for oid in self.backend.list_objects():
+                if oid == PGMETA_OID:
+                    continue
+                target = rehash(oid)
+                if target != self.pgid.seed:
+                    moves.setdefault(target, []).append(oid)
+            # split the LOG by rehash too (covers deleted/missing oids
+            # that no longer exist as store objects)
+            entry_moves: Dict[int, set] = {c: set() for c in children}
+            for e in self.log.entries:
+                t = rehash(e.oid)
+                if t != self.pgid.seed and t in entry_moves:
+                    entry_moves[t].add(e.oid)
+            child_logs = {c: self.log.split_out(entry_moves[c])
+                          for c in children}
+            # child missing entries follow their objects
+            child_missing: Dict[int, Dict[str, tuple]] = {}
+            for oid in list(self.missing.items.keys()):
+                target = rehash(oid)
+                if target != self.pgid.seed:
+                    need, have = self.missing.items[oid]
+                    child_missing.setdefault(target, {})[oid] = (need,
+                                                                 have)
+                    self.missing.rm(oid)
+            shard = self.own_shard
+            my_head = self.log.last_update
+            self._last_split_pgnum = new
+            self._split_adopted = True   # we answered our own split
+            txn = Transaction()
+            for c, oids in moves.items():
+                ccoll = self._child_coll(c, shard)
+                for oid in oids:
+                    txn.collection_move_rename(
+                        self.coll, GHObject(oid, shard),
+                        ccoll, GHObject(oid, shard))
+            self._append_pgmeta_ops(txn)
+        # phase 2: create/update the children OUTSIDE our lock (no
+        # pg->pg lock nesting), then apply the object moves
+        for c in children:
+            child_pgid = PGid(self.pgid.pool, c)
+            child = self.service.ensure_pg(child_pgid)
+            if child is not None:
+                child.adopt_split(my_head, child_logs.get(c),
+                                  child_missing.get(c, {}), new, shard)
+        self.store.queue_transactions([txn])
+
+    def _child_coll(self, seed: int, shard: int) -> str:
+        base = f"{self.pgid.pool}.{seed:x}"
+        return base if shard < 0 else f"{base}s{shard}"
+
+    def adopt_split(self, parent_head, child_log, missing: Dict,
+                    split_pgnum: int, parent_shard: int) -> None:
+        """Child side of maybe_split (same OSD): adopt the parent's
+        log head (and its entries for our objects), inherit missing
+        entries for objects the parent shard itself lacked, and record
+        the shard identity in case we are a stray here."""
+        with self.lock:
+            if child_log is not None and \
+                    child_log.last_update > self.log.last_update:
+                self.log = child_log
+            elif parent_head > self.log.last_update:
+                self.log = PGLog.from_dict(
+                    {"last_update": list(parent_head),
+                     "tail": list(parent_head), "entries": []})
+            for oid, (need, have) in missing.items():
+                self.missing.add(oid, tuple(need),
+                                 tuple(have) if have else None)
+            self._last_split_pgnum = max(self._last_split_pgnum,
+                                         split_pgnum)
+            self._split_adopted = True
+            if parent_shard >= 0:
+                self._split_source_shard = parent_shard
+            if self.whoami not in [o for o in self.acting
+                                   if o is not None]:
+                self._stray_shard = parent_shard
+            self._persist_pgmeta()
+
+    # -- stray side ----------------------------------------------------
+    def is_stray(self) -> bool:
+        with self.lock:
+            return self.whoami not in [o for o in self.acting
+                                       if o is not None]
+
+    def maybe_notify_stray(self, osdmap: OSDMap) -> None:
+        """Announce our data to the PG's current primary (reference
+        strays notify the primary via past-interval queries; here the
+        stray speaks first).  Called on map advance and from the OSD
+        tick until the primary purges us."""
+        with self.lock:
+            if self.whoami in [o for o in self.acting if o is not None]:
+                return
+            _, _, acting, primary = osdmap.pg_to_up_acting_osds(
+                self.pgid)
+            if primary is None or primary == self.whoami:
+                return
+            # advertise what we can physically SERVE: on-disk objects
+            # only (our own missing set covers log-adopted objects we
+            # never recovered — offering those would send recovery to
+            # a holder with no data, review finding r3)
+            auth = self._authoritative_objects()
+            objects = {oid: list(auth.get(oid, (0, 0)))
+                       for oid in self.backend.list_objects()
+                       if oid != PGMETA_OID}
+            msg = MOSDPGNotify(
+                pgid=str(self.pgid), shard=-1, from_osd=self.whoami,
+                epoch=osdmap.epoch, log=self.log.to_dict(),
+                missing=self.missing.to_dict(), stray=True,
+                objects=objects, stray_shard=self._stray_shard,
+                split_adopted=self._split_adopted)
+        self.service.send_osd(primary, msg)
+
+    def handle_pg_remove(self, msg) -> None:
+        """The current primary no longer needs our stray copy: delete
+        it (reference MOSDPGRemove -> PG removal)."""
+        with self.lock:
+            osdmap = self.service.get_osdmap()
+            _, _, acting, primary = osdmap.pg_to_up_acting_osds(
+                self.pgid)
+            if msg.from_osd != primary:
+                return               # stale sender
+            if self.whoami in [o for o in acting if o is not None]:
+                return               # we're acting: never self-delete
+            txn = Transaction()
+            if self.pool.is_erasure():
+                for s in range(self.pool.size):
+                    if self.store.collection_exists(self.coll_of(s)):
+                        txn.remove_collection(self.coll_of(s))
+            else:
+                if self.store.collection_exists(self.coll_of(-1)):
+                    txn.remove_collection(self.coll_of(-1))
+            self.store.queue_transactions([txn])
+            self.state = STATE_INACTIVE
+            self.log = PGLog()
+            self.missing = MissingSet()
+        self.service.forget_pg(self.pgid)
+
+    def _audit_split_shard(self, osdmap: OSDMap) -> None:
+        """EC child acting member after a split: our physical chunks
+        came from parent shard ``_split_source_shard``, but our acting
+        POSITION may differ — position data we don't physically hold
+        is missing (recoverable by decode), while the chunks we do
+        hold are advertised to the primary as a shard-qualified
+        source.  Idempotent (existence-checked), so re-running on
+        every interval is safe and converges to a no-op once recovery
+        lands our position's chunks."""
+        own = self.own_shard
+        if own < 0:
+            return
+        audited = 0
+        for oid, ver in self._authoritative_objects().items():
+            obj = GHObject(oid, own)
+            if not self.store.exists(self.coll_of(own), obj):
+                if not self.missing.is_missing(oid):
+                    self.missing.add(oid, ver, None)
+                    audited += 1
+        if audited:
+            self._persist_pgmeta()
+        src = self._split_source_shard
+        if src == own:
+            return                   # lucky position match: data home
+        objects = {}
+        try:
+            for oid in self.store.collection_list(self.coll_of(src)):
+                name = oid.oid if hasattr(oid, "oid") else str(oid)
+                if name != PGMETA_OID:
+                    objects[name] = None
+        except FileNotFoundError:
+            return
+        if not objects:
+            return
+        versions = self._authoritative_objects()
+        objects = {o: list(versions.get(o, (0, 0)))
+                   for o in objects}
+        _, _, _, primary = osdmap.pg_to_up_acting_osds(self.pgid)
+        if primary is None:
+            return
+        msg = MOSDPGNotify(
+            pgid=str(self.pgid), shard=-1, from_osd=self.whoami,
+            epoch=osdmap.epoch, log=self.log.to_dict(),
+            missing=self.missing.to_dict(), stray=True,
+            objects=objects, stray_shard=src,
+            split_adopted=self._split_adopted)
+        if primary == self.whoami:
+            self._handle_stray_notify(msg)
+        else:
+            self.service.send_osd(primary, msg)
+
+    # -- primary side --------------------------------------------------
+    def extra_recovery_sources(self, oid: str):
+        """Stray holders that can serve ``oid`` (shard, osd) — extends
+        the backends' acting-set source selection during post-split
+        recovery."""
+        out = []
+        for osd, objs in self._stray_sources.items():
+            if oid in objs:
+                nd = self._stray_notifies.get(osd, {})
+                out.append((nd.get("stray_shard", -1), osd))
+        return out
+
+    def _maybe_purge_strays(self) -> None:
+        """Once the acting set is whole, retire every stray copy
+        (reference: strays are removed after peering declares them
+        unneeded).  "Whole" means FULLY clean — no missing objects, no
+        acting-set holes, full pool size: purging while a position is
+        a hole would delete the only redundant copy and turn the next
+        failure into data loss."""
+        if not self.is_primary() or self.state != STATE_ACTIVE:
+            return
+        if self.num_missing() > 0:
+            return
+        alive = [o for o in self.acting if o is not None]
+        if None in self.acting or len(alive) < self.pool.size:
+            return
+        acting = {o for o in self.acting if o is not None}
+        for osd in list(self._stray_notifies):
+            if osd in acting:        # mispositioned acting member:
+                continue             # never remove, it IS the PG
+            self.service.send_osd(osd, MOSDPGRemove(
+                pgid=str(self.pgid), from_osd=self.whoami,
+                epoch=self.epoch))
+        self._stray_notifies.clear()
+        self._stray_sources.clear()
 
     # ------------------------------------------------------------------
     # map / interval handling (reference PG::handle_advance_map)
@@ -293,6 +605,7 @@ class PG:
                 osdmap.pg_to_up_acting_osds(self.pgid)
             if acting == self.acting and self.state != STATE_INACTIVE:
                 return                   # same interval
+            prev_shard = self.own_shard  # before acting changes
             self.up, self.acting = up, acting
             self.primary_osd = acting_p
             self.interval_start = osdmap.epoch
@@ -331,8 +644,18 @@ class PG:
                                         epoch=osdmap.epoch)
                     conn.send_message(reply)
             if self.whoami not in [o for o in acting if o is not None]:
+                if self._stray_shard < 0 and prev_shard >= 0:
+                    self._stray_shard = prev_shard  # keep EC identity
                 self.state = STATE_INACTIVE
+                # announce ourselves to the current primary — WITH data
+                # (recovery source) or EMPTY (the split-child gate needs
+                # an explicit "my ancestry holds nothing" answer or an
+                # empty child would wait forever)
+                self.maybe_notify_stray(osdmap)
                 return
+            self._stray_shard = -1       # back in the acting set
+            if self.pool.is_erasure() and self._split_source_shard >= 0:
+                self._audit_split_shard(osdmap)
             self.state = STATE_PEERING
             if self.is_primary():
                 self._start_peering()
@@ -348,7 +671,9 @@ class PG:
         """Query every other acting member (reference GetInfo)."""
         others = self._other_members()
         if not others:
-            self._activate()
+            # still routes through the election so the split-child
+            # gate and stray adoption apply even to 1-wide acting sets
+            self._choose_and_activate()
             return
         for shard, osd in others:
             self.service.send_osd(osd, MOSDPGQuery(
@@ -362,26 +687,135 @@ class PG:
                 pgid=str(self.pgid), shard=msg.shard,
                 from_osd=self.whoami, epoch=self.epoch,
                 log=self.log.to_dict(),
-                missing=self.missing.to_dict()))
+                missing=self.missing.to_dict(),
+                split_adopted=self._split_adopted))
 
     def handle_pg_notify(self, msg: MOSDPGNotify) -> None:
         with self.lock:
+            if getattr(msg, "stray", False):
+                self._handle_stray_notify(msg)
+                return
             if not self.is_primary() or self.state != STATE_PEERING:
                 return
-            self._peer_notifies[msg.shard] = {"log": msg.log,
-                                              "missing": msg.missing}
+            self._peer_notifies[msg.shard] = {
+                "log": msg.log, "missing": msg.missing,
+                "split_adopted": getattr(msg, "split_adopted", False)}
             wanted = {s for s, _ in self._other_members()}
             if wanted <= set(self._peer_notifies):
                 self._choose_and_activate()
 
+    def _handle_stray_notify(self, msg: MOSDPGNotify) -> None:
+        """A non-acting holder announced data for this PG (split child
+        stray or migrated-away copy).  Record it as an election
+        candidate + recovery source; purge it once we're whole."""
+        if not self.is_primary():
+            return
+        self._stray_notifies[msg.from_osd] = {
+            "log": msg.log, "missing": msg.missing,
+            "objects": msg.objects, "stray_shard": msg.stray_shard,
+            "split_adopted": getattr(msg, "split_adopted", False)}
+        self._stray_sources[msg.from_osd] = {
+            oid: tuple(v) for oid, v in msg.objects.items()}
+        # an ACTING member can send these too (EC split: mispositioned
+        # chunks — see _audit_split_shard): fold its self-reported
+        # missing into peer_missing so recovery pushes reach it even
+        # when its audit raced our peering round
+        for shard, osd_a in enumerate(self.acting):
+            if osd_a == msg.from_osd and osd_a != self.whoami:
+                ms = self.peer_missing.get(shard) or MissingSet()
+                for oid, ent in MissingSet.from_dict(
+                        msg.missing).items.items():
+                    if not ms.is_missing(oid):
+                        ms.add(oid, tuple(ent[0]),
+                               tuple(ent[1]) if ent[1] else None)
+                self.peer_missing[shard] = ms
+        if self.state == STATE_PEERING:
+            wanted = {s for s, _ in self._other_members()}
+            if wanted <= set(self._peer_notifies):
+                self._choose_and_activate()
+            return
+        if self.state != STATE_ACTIVE:
+            return
+        stray_head = tuple(msg.log.get("last_update", (0, 0)))
+        if stray_head > self.log.last_update:
+            # the stray is AHEAD of the elected authority (e.g. an old
+            # primary resurfacing): re-run peering to fold it in —
+            # terminates because the next election adopts its head
+            self.state = STATE_PEERING
+            self._peer_notifies.clear()
+            self._start_peering()
+        elif self.num_missing() == 0:
+            self._maybe_purge_strays()
+        else:
+            self.service.kick_recovery(self)
+
+    def _adopt_stray_objects(self, osd: int, head) -> None:
+        """Backfill-style adoption of a stray's authoritative object
+        set (mirrors the replica side of handle_pg_log's backfill
+        path): our log restarts at the stray's head and every object
+        we lack at its version becomes missing, recoverable from the
+        stray via extra_recovery_sources."""
+        objs = self._stray_sources.get(osd, {})
+        for oid in self.backend.list_objects():
+            if oid == PGMETA_OID:
+                continue
+            if oid not in objs:
+                obj = GHObject(oid, self.own_shard)
+                txn = Transaction()
+                txn.remove(self.coll, obj)
+                self.store.queue_transactions([txn])
+        for oid, ver in objs.items():
+            oi = self.backend.get_object_info(oid)
+            local = oi.version if oi is not None else None
+            if local != ver:
+                self.missing.add(oid, ver, local)
+        self.log = PGLog.from_dict(
+            {"last_update": list(head), "tail": list(head),
+             "entries": []})
+        self._persist_pgmeta()
+
     def _choose_and_activate(self) -> None:
         """Pick the authoritative log; adopt it if a peer is ahead
-        (reference GetLog); then activate (reference Activate)."""
+        (reference GetLog); then activate (reference Activate).
+        Split-children and migrated-away strays participate in the
+        election; a child seed refuses to activate empty before its
+        ancestry has spoken (the past-intervals stand-in)."""
         best_shard, best_head = None, self.log.last_update
         for shard, nd in self._peer_notifies.items():
             head = tuple(nd["log"]["last_update"])
             if head > best_head:
                 best_shard, best_head = shard, head
+        best_stray, best_stray_head = None, (0, 0)
+        for osd, nd in self._stray_notifies.items():
+            head = tuple(nd["log"]["last_update"])
+            if head > best_stray_head:
+                best_stray, best_stray_head = osd, head
+        created = self.pool.created_pg_num or self.pool.pg_num
+        # only an ANCESTRY-DERIVED answer lifts the gate: a copy fed
+        # by a local parent split (split_adopted, even when empty), or
+        # a stray that actually carries history — a random fresh empty
+        # copy answering would let a child activate empty while the
+        # real data sits with a slower holder
+        answered = (self._split_adopted
+                    or any(nd.get("split_adopted")
+                           for nd in self._peer_notifies.values())
+                    or any(nd.get("split_adopted")
+                           or tuple(nd["log"]["last_update"]) > (0, 0)
+                           for nd in self._stray_notifies.values()))
+        if (self.pgid.seed >= created and best_head == (0, 0)
+                and not answered):
+            # we are a split child and NOBODY in the acting set has
+            # data yet: activating now could present an empty PG while
+            # the parent's holders still have our objects.  Stay in
+            # PEERING; strays self-notify (and re-notify on the OSD
+            # tick) until one arrives.
+            return
+        if best_stray is not None and best_stray_head > best_head:
+            self._adopt_stray_objects(best_stray, best_stray_head)
+            # _activate's per-peer pass sees our fresh log with tail =
+            # head, so every behind peer takes the backfill path
+            self._activate()
+            return
         if best_shard is not None:
             peer = PGLog.from_dict(self._peer_notifies[best_shard]["log"])
             self.log.merge_authoritative(
@@ -590,6 +1024,16 @@ class PG:
             # like the reference reserving internal namespaces.
             self._reply(conn, msg, -22, [])
             return
+        if not oid.startswith(".pgls."):
+            # misdirected op (client targeted us from a pre-split map):
+            # bounce so it refreshes and re-targets the child PG
+            # (reference PrimaryLogPG::do_op "misdirected op" check)
+            target = self.service.get_osdmap().object_locator_to_pg(
+                oid, self.pgid.pool)
+            if target.seed != self.pgid.seed:
+                self._client_ops.pop((msg.client, msg.tid), None)
+                self._reply(conn, msg, -108, [])
+                return
         if has_write and self.scrubber.write_blocked():
             # scrub snapshots must describe one committed state; new
             # writes wait for the round (reference write blocking on
@@ -1352,6 +1796,8 @@ class PG:
             if waiting:
                 for m, c in waiting:
                     self._do_op(m, c)
+            if self.num_missing() == 0:
+                self._maybe_purge_strays()
             self.service.kick_recovery(self)
 
     # ------------------------------------------------------------------

@@ -210,6 +210,24 @@ class PGLog:
             self.last_update = e.version
         self._trim()
 
+    def split_out(self, moved: "set") -> "PGLog":
+        """PG split (reference PGLog::split_out_child): return a child
+        log holding this log's entries for ``moved`` oids and strip
+        them here.  Both logs keep the SAME head/tail so every replica
+        of the parent produces identical child logs, making child
+        peering elections trivial, and reqid dup-detection for recent
+        writes to moved objects survives the split."""
+        child = PGLog(self.max_entries)
+        child.last_update = self.last_update
+        child.tail = self.tail
+        child.entries = [e for e in self.entries if e.oid in moved]
+        for e in child.entries:
+            if e.reqid is not None:
+                child.reqids[e.reqid] = e.version
+                self.reqids.pop(e.reqid, None)
+        self.entries = [e for e in self.entries if e.oid not in moved]
+        return child
+
     def object_versions(self) -> Dict[str, Eversion]:
         """Latest in-log version per live object (deletes excluded)."""
         out: Dict[str, Eversion] = {}

@@ -210,6 +210,11 @@ class ReplicatedBackend(PGBackend):
             holders = [(s, o) for s, o in self.host.acting_shards()
                        if o is not None and o != self.host.whoami
                        and o not in missing_osds]
+            # post-split strays / migrated-away copies can serve too
+            for s, o in self.host.extra_recovery_sources(oid):
+                if o != self.host.whoami and o not in missing_osds \
+                        and all(o != ho for _, ho in holders):
+                    holders.append((s, o))
             if not holders:
                 self._pull_attempts.pop(oid, None)
                 cb(-5)                   # nobody has it

@@ -110,7 +110,8 @@ def sharded_encode_fn(mesh: Mesh, w: int):
     return jax.jit(fn)
 
 
-def sharded_encode_gf8_fn(mesh: Mesh, coding_matrix: np.ndarray):
+def sharded_encode_gf8_fn(mesh: Mesh, coding_matrix: np.ndarray,
+                          with_digest: bool = True):
     """Sharded w=8 fast path: the per-shard kernel is the SAME one the
     single-chip backend routes to (fused bit-plane MXU pallas kernel on
     TPU, XOR/xtime chain elsewhere — ops.jax_engine.gf8_fn routing)
@@ -120,6 +121,15 @@ def sharded_encode_gf8_fn(mesh: Mesh, coding_matrix: np.ndarray):
     like the single-chip fast path."""
     from ..ops import jax_engine as je
     inner = je.gf8_inner(coding_matrix)
+
+    if not with_digest:
+        # production path (ShardedEncoder): no collective at all —
+        # the integrity digest (and its two psums) is a scrub/dryrun
+        # feature, not a per-write cost
+        fn = shard_map(inner, mesh=mesh,
+                       in_specs=(P("dp", None, "sp"),),
+                       out_specs=P("dp", None, "sp"))
+        return jax.jit(fn)
 
     def local_encode(data):
         parity = inner(data)
@@ -185,7 +195,8 @@ class ShardedEncoder:
         self.mesh = mesh
         self.dp = mesh.shape["dp"]
         self.sp = mesh.shape["sp"]
-        self._fn = sharded_encode_gf8_fn(mesh, coding_matrix)
+        self._fn = sharded_encode_gf8_fn(mesh, coding_matrix,
+                                         with_digest=False)
 
     def encode_async(self, data: np.ndarray) -> Optional[_ShardedAsync]:
         B, k, L = data.shape
@@ -195,7 +206,7 @@ class ShardedEncoder:
         if Bp != B:
             data = np.concatenate(
                 [data, np.zeros((Bp - B, k, L), np.uint8)], axis=0)
-        parity, _digest = self._fn(shard_batch(self.mesh, data))
+        parity = self._fn(shard_batch(self.mesh, data))
         return _ShardedAsync(parity, B, L)
 
 

@@ -265,12 +265,18 @@ class Thrasher:
     """Random OSD kill/revive/out/in loop (reference thrashosds.py)."""
 
     def __init__(self, cluster, seed: int = 0, min_alive: int = 2,
-                 interval: float = 4.5, lose_data_prob: float = 0.3):
+                 interval: float = 4.5, lose_data_prob: float = 0.3,
+                 pggrow_pool: Optional[str] = None,
+                 pggrow_max: int = 32):
         self.cluster = cluster
         self.rng = random.Random(seed)
         self.min_alive = min_alive
         self.interval = interval
         self.lose_data_prob = lose_data_prob
+        # pggrow (reference thrashosds.py pggrow/morepggrow): grow the
+        # pool's pg_num mid-workload, forcing live PG splits
+        self.pggrow_pool = pggrow_pool
+        self.pggrow_max = pggrow_max
         self.down: List[int] = []
         self.actions: List[str] = []
         self._stop = threading.Event()
@@ -282,6 +288,21 @@ class Thrasher:
 
     def _act(self) -> None:
         alive = self._alive()
+        if self.pggrow_pool and self.rng.random() < 0.25:
+            pool = self.cluster.osds[alive[0]].osdmap.get_pool(
+                self.cluster.osds[alive[0]].osdmap.pool_name_to_id[
+                    self.pggrow_pool]) if alive else None
+            if pool is not None and pool.pg_num < self.pggrow_max:
+                new = min(self.pggrow_max,
+                          pool.pg_num + self.rng.choice((1, 2, 4)))
+                ret, _, _ = self.cluster.mon_command(
+                    {"prefix": "osd pool set",
+                     "pool": self.pggrow_pool, "var": "pg_num",
+                     "val": str(new)})
+                if ret == 0:
+                    self.actions.append(f"pggrow {self.pggrow_pool} "
+                                        f"-> {new}")
+                return
         # revive when at the floor or by coin flip
         if self.down and (len(alive) <= self.min_alive
                           or self.rng.random() < 0.5):
@@ -339,7 +360,7 @@ class Thrasher:
 
 
 def run_thrash(n_osds: int, seconds: float, pool_type: str,
-               seed: int, out=sys.stdout) -> int:
+               seed: int, out=sys.stdout, pggrow: bool = False) -> int:
     from ..cluster import Cluster
     with Cluster(n_osds=n_osds) as cluster:
         for i in range(n_osds):
@@ -365,7 +386,9 @@ def run_thrash(n_osds: int, seconds: float, pool_type: str,
         thrasher = Thrasher(cluster, seed=seed,
                             min_alive=max(2, n_osds - 1
                                           if pool_type == "erasure"
-                                          else 2)).start()
+                                          else 2),
+                            pggrow_pool="tp" if pggrow
+                            else None).start()
         deadline = time.monotonic() + seconds
         while time.monotonic() < deadline:
             model.step()
@@ -389,8 +412,11 @@ def main(argv=None) -> int:
     p.add_argument("--pool-type", choices=("replicated", "erasure"),
                    default="replicated")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--pggrow", action="store_true",
+                   help="grow pg_num mid-workload (live PG splits)")
     ns = p.parse_args(argv)
-    return run_thrash(ns.osds, ns.seconds, ns.pool_type, ns.seed)
+    return run_thrash(ns.osds, ns.seconds, ns.pool_type, ns.seed,
+                      pggrow=ns.pggrow)
 
 
 if __name__ == "__main__":

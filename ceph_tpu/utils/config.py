@@ -158,6 +158,12 @@ def _opts() -> List[Option]:
         Option("mgr_tick_interval", float, 1.0, min=0.05,
                description="mgr perf-collection cadence "
                            "(reference mgr_tick_period)"),
+        Option("mgr_pg_autoscale_mode", str, "off",
+               enum_allowed=("off", "on"),
+               description="apply pg_autoscaler recommendations (grow "
+                           "only; reference pg_autoscale_mode — the "
+                           "reference defaults on, here off so test "
+                           "pools keep their explicit pg_num)"),
         Option("osd_deep_scrub_interval", float, 0.0, min=0.0,
                description="deep-scrub cadence when background scrub "
                            "is on (reference osd_deep_scrub_interval)"),

@@ -65,7 +65,10 @@ def sample_messages():
         M.MOSDPGQuery(pgid="1.3", shard=2, from_osd=0, epoch=11),
         M.MOSDPGNotify(pgid="1.3", shard=2, from_osd=4, epoch=11,
                        log={"head": [11, 7], "entries": []},
-                       missing={"o": {"need": [11, 7], "have": None}}),
+                       missing={"o": {"need": [11, 7], "have": None}},
+                       stray=True, objects={"o": [11, 7]},
+                       stray_shard=1),
+        M.MOSDPGRemove(pgid="1.9", from_osd=3, epoch=21),
         M.MOSDPGLog(pgid="1.3", shard=2, from_osd=0, epoch=11,
                     last_update=(11, 7),
                     entries=[{"op": "modify", "oid": "o"}],

@@ -1,0 +1,250 @@
+"""PG split (live pg_num growth) tests.
+
+Covers VERDICT r2 Missing #1: `osd pool set <pool> pg_num N` on a live
+cluster must rehash objects into child PGs on every holder (reference
+OSDMonitor.cc:8141 pg-num pool-set + OSD::split_pgs, osd/OSD.cc:8926),
+with the split strays serving peering/recovery until the children are
+clean on their CRUSH-computed acting sets, and clients re-targeting
+moved objects transparently.
+"""
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
+from ceph_tpu.osd.osdmap import (ceph_stable_mod, pg_num_mask,
+                                 pg_split_ancestors, pg_split_children,
+                                 pg_split_parent, pg_split_source)
+
+
+# ---------------------------------------------------------------------------
+# unit: split algebra
+# ---------------------------------------------------------------------------
+
+def test_split_parent_is_top_bit_clear():
+    assert pg_split_parent(1) == 0
+    assert pg_split_parent(5) == 1
+    assert pg_split_parent(12) == 4
+    assert pg_split_parent(20) == 4
+
+
+def test_split_children_partition_new_seeds():
+    """Every new seed belongs to exactly one pre-growth source PG."""
+    for old, new in ((4, 8), (4, 6), (12, 24), (3, 16)):
+        seen = []
+        for p in range(old):
+            seen += pg_split_children(p, old, new)
+        assert sorted(seen) == list(range(old, new))
+
+
+def test_split_children_match_stable_mod_movement():
+    """The object-movement ground truth: for any hash ps, the PG that
+    stable_mod maps it to post-growth must be either its pre-growth PG
+    or one of that PG's computed children."""
+    rng = np.random.default_rng(7)
+    for old, new in ((4, 8), (5, 7), (8, 32), (6, 11)):
+        kids = {p: set(pg_split_children(p, old, new))
+                for p in range(old)}
+        for ps in rng.integers(0, 1 << 32, 500, dtype=np.uint64):
+            ps = int(ps)
+            s_old = ceph_stable_mod(ps, old, pg_num_mask(old))
+            s_new = ceph_stable_mod(ps, new, pg_num_mask(new))
+            if s_new != s_old:
+                assert s_new in kids[s_old], (old, new, ps)
+            assert pg_split_source(s_new, old) == s_old
+
+
+def test_split_ancestors_chain():
+    assert pg_split_ancestors(13, 4) == [5, 1]
+    assert pg_split_ancestors(20, 4) == [4, 0]
+    assert pg_split_ancestors(2, 4) == []
+
+
+# ---------------------------------------------------------------------------
+# cluster: live growth
+# ---------------------------------------------------------------------------
+
+def _write_objects(io, n, size=8 << 10, seed=3):
+    rng = np.random.default_rng(seed)
+    blobs = {}
+    for i in range(n):
+        name = f"obj-{i}"
+        blob = rng.integers(0, 256, size, dtype=np.uint8).tobytes()
+        io.write_full(name, blob)
+        blobs[name] = blob
+    return blobs
+
+
+def test_replicated_pool_pg_num_grow_live():
+    """Grow pg_num mid-life on a replicated pool: every object must
+    stay readable (client re-targets to child PGs), the cluster must
+    reach active+clean at the new PG count, and stray copies must be
+    purged from the parents' holders."""
+    conf = make_conf()
+    with Cluster(n_osds=4, conf=conf) as c:
+        for i in range(4):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rp", "replicated", pg_num=4, size=2)
+        io = c.rados().open_ioctx("rp")
+        blobs = _write_objects(io, 24)
+        c.wait_for_clean(30)
+
+        rc, msg, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "rp", "var": "pg_num",
+             "val": "8"})
+        assert rc == 0, msg
+        c.wait_for_clean(60)
+
+        # every object readable at its (possibly new) PG
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
+        # pg stats now cover 8 PGs
+        _, _, health = c.mon_command({"prefix": "health"})
+        assert health.get("num_pgs", 0) >= 8
+
+        # objects actually moved: at least one child PG holds data
+        moved = 0
+        osdmap = None
+        for osd in c.osds.values():
+            if osd is None:
+                continue
+            osdmap = osd.osdmap
+            break
+        pool_id = osdmap.pool_name_to_id["rp"]
+        pool = osdmap.pools[pool_id]
+        for name in blobs:
+            if osdmap.object_locator_to_pg(name, pool_id).seed >= 4:
+                moved += 1
+        assert moved > 0, "growth 4->8 should re-home some objects"
+
+        # strays eventually purge: no OSD keeps a child PG it isn't
+        # acting for (allow the tick a few rounds)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            leftovers = []
+            for osd in c.osds.values():
+                if osd is None:
+                    continue
+                for pgid, pg in list(osd.pgs.items()):
+                    if pgid.pool != pool_id or pgid.seed < 4:
+                        continue
+                    acting = [o for o in pg.acting if o is not None]
+                    if osd.whoami not in acting and \
+                            pg.log.last_update > (0, 0):
+                        leftovers.append((osd.whoami, str(pgid)))
+            if not leftovers:
+                break
+            time.sleep(0.5)
+        assert not leftovers, f"unpurged strays: {leftovers}"
+
+
+def test_grow_then_write_then_grow_again():
+    """Multi-step growth with writes between steps (the pggrow thrash
+    shape): correctness must hold across repeated splits including
+    children-of-children."""
+    conf = make_conf()
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rp2", "replicated", pg_num=2, size=2)
+        io = c.rados().open_ioctx("rp2")
+        blobs = _write_objects(io, 10, seed=5)
+        for new in (4, 8):
+            rc, msg, _ = c.mon_command(
+                {"prefix": "osd pool set", "pool": "rp2",
+                 "var": "pg_num", "val": str(new)})
+            assert rc == 0, msg
+            c.wait_for_clean(60)
+            blobs.update(_write_objects(io, 6, seed=new))
+            for name, blob in blobs.items():
+                assert io.read(name, len(blob)) == blob, name
+
+
+def test_erasure_pool_pg_num_grow_live():
+    """EC pool live growth: chunk positions are NOT interchangeable,
+    so child recovery must read shard-qualified chunks from the
+    parents' holders (split strays) and push them to the child's
+    CRUSH-computed acting set."""
+    conf = make_conf()
+    with Cluster(n_osds=4, conf=conf) as c:
+        for i in range(4):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("sp21", plugin="jerasure", k="2", m="1")
+        c.create_pool("ep", "erasure", pg_num=2,
+                      erasure_code_profile="sp21")
+        io = c.rados().open_ioctx("ep")
+        blobs = _write_objects(io, 16, size=12 << 10, seed=13)
+        c.wait_for_clean(30)
+
+        rc, msg, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "ep", "var": "pg_num",
+             "val": "4"})
+        assert rc == 0, msg
+        c.wait_for_clean(90)
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
+        # degraded read after growth: kill one OSD, objects must still
+        # reconstruct (children re-peer + decode from survivors)
+        c.kill_osd(3)
+        c.wait_for_osd_down(3)
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
+
+
+def test_grow_before_any_write_activates_empty_children():
+    """Growth on a never-written pool: the split-child gate must accept
+    an explicit empty answer from the ancestry (empty strays notify
+    too) instead of waiting forever — and first writes land in the
+    children (review finding: empty-ancestor deadlock)."""
+    conf = make_conf()
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rp0", "replicated", pg_num=2, size=2)
+        rc, msg, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "rp0", "var": "pg_num",
+             "val": "8"})
+        assert rc == 0, msg
+        c.wait_for_clean(60)
+        io = c.rados().open_ioctx("rp0")
+        blobs = _write_objects(io, 12, seed=17)
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
+
+
+def test_pg_num_decrease_rejected():
+    conf = make_conf()
+    with Cluster(n_osds=3, conf=conf) as c:
+        c.create_pool("rp3", "replicated", pg_num=8)
+        rc, msg, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "rp3", "var": "pg_num",
+             "val": "4"})
+        assert rc == -22
+
+
+def test_split_survives_osd_restart():
+    """Growth while an OSD is down: the persisted split anchor makes
+    the restarted OSD split on its first map, and data recovers."""
+    conf = make_conf()
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rp4", "replicated", pg_num=2, size=2)
+        io = c.rados().open_ioctx("rp4")
+        blobs = _write_objects(io, 12, seed=9)
+        c.wait_for_clean(30)
+        c.kill_osd(0)
+        c.wait_for_osd_down(0)
+        rc, msg, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "rp4", "var": "pg_num",
+             "val": "4"})
+        assert rc == 0, msg
+        time.sleep(0.5)
+        c.revive_osd(0)
+        c.wait_for_osd_up(0)
+        c.wait_for_clean(90)
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
