@@ -570,6 +570,13 @@ class IoCtx:
             raise
         return reply.out_data[0] if reply.out_data else None
 
+    def copy_from(self, dst_oid: str, src_oid: str) -> None:
+        """Server-side object copy (reference CEPH_OSD_OP_COPY_FROM,
+        librados copy_from): the destination's primary fetches the
+        source — data, user xattrs and (replicated) omap — with no
+        client round trip for the payload."""
+        self._obj_op(dst_oid, [OSDOp("copy_from", name=src_oid)])
+
     def omap_get(self, oid: str) -> Dict[str, bytes]:
         reply = self._obj_op(oid, [OSDOp("omap_get")])
         return {k: v.encode("latin1")

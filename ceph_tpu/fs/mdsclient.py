@@ -29,10 +29,10 @@ from .filesystem import FSError, _data_soid
 class MDSClient(Dispatcher):
     """Filesystem handle bound to one MDS + the data pool."""
 
-    def __init__(self, rados: Rados, mds_addr: Tuple[str, int],
+    def __init__(self, rados: Rados,
+                 mds_addr: Optional[Tuple[str, int]],
                  data_pool: str):
         self.rados = rados
-        self.mds_addr = mds_addr
         self.name = rados.msgr.name
         self.lock = threading.RLock()
         self._next_tid = 0
@@ -46,7 +46,30 @@ class MDSClient(Dispatcher):
             data, Layout(stripe_unit=64 << 10, stripe_count=1,
                          object_size=4 << 20))
         rados.msgr.add_dispatcher(self)
+        # mds_addr=None resolves the active MDS through the monitor's
+        # MDSMap (reference Client consults the mdsmap; a fixed addr
+        # keeps solo/test deployments working)
+        self._fixed_addr = mds_addr is not None
+        if mds_addr is None:
+            mds_addr = self._resolve_active(timeout=15.0)
+        self.mds_addr = mds_addr
         self._conn = rados.msgr.connect_to(mds_addr, lossless=False)
+
+    def _resolve_active(self, timeout: float) -> Tuple[str, int]:
+        deadline = threading.TIMEOUT_MAX if timeout <= 0 else \
+            __import__("time").monotonic() + timeout
+        import time as _t
+        while True:
+            try:
+                ret, _, out = self.rados.mon_command(
+                    {"prefix": "mds getmap"}, timeout=5.0)
+                if ret == 0 and out.get("addr"):
+                    return tuple(out["addr"])
+            except Exception:
+                pass
+            if _t.monotonic() >= deadline:
+                raise FSError(110, "no active MDS")
+            _t.sleep(0.25)
 
     # -- transport -----------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg) -> bool:
@@ -86,22 +109,46 @@ class MDSClient(Dispatcher):
 
     def request(self, op: str, args: dict,
                 timeout: float = 30.0) -> dict:
+        """One MDS op, transparently resent across MDS failover: a
+        standby's ESTALE or a dead active's silence re-resolves the
+        MDSMap and retries (the daemon's journal-backed reqid table
+        makes retried mutations exactly-once)."""
+        import time as _t
         with self.lock:
             self._next_tid += 1
             tid = self._next_tid
-            ev = threading.Event()
-            self._pending[tid] = ev
-        self._conn.send_message(MMDSOp(client=self.name, tid=tid,
-                                       op=op, args=args))
-        if not ev.wait(timeout):
+        deadline = _t.monotonic() + timeout
+        # fixed-addr clients keep single-shot semantics (no failover)
+        attempt_wait = timeout if self._fixed_addr \
+            else min(5.0, timeout)
+        while True:
+            with self.lock:
+                ev = threading.Event()
+                self._pending[tid] = ev
+            self._conn.send_message(MMDSOp(client=self.name, tid=tid,
+                                           op=op, args=args))
+            got = ev.wait(attempt_wait)
             with self.lock:
                 self._pending.pop(tid, None)
-                self._replies.pop(tid, None)
-            raise FSError(110, f"mds op {op} timed out")
-        reply = self._replies.pop(tid)
-        if reply.result < 0:
-            raise FSError(-reply.result, f"{op}: {reply.result}")
-        return reply.out
+                reply = self._replies.pop(tid, None)
+            stale = got and reply is not None and reply.result == -116
+            if got and not stale:
+                if reply.result < 0:
+                    raise FSError(-reply.result,
+                                  f"{op}: {reply.result}")
+                return reply.out
+            # silent (MDS died?) or ESTALE (standby): re-resolve
+            if self._fixed_addr or _t.monotonic() >= deadline:
+                raise FSError(110, f"mds op {op} timed out")
+            try:
+                addr = self._resolve_active(
+                    timeout=max(0.5, deadline - _t.monotonic()))
+            except FSError:
+                raise FSError(110, f"mds op {op} timed out")
+            if addr != self.mds_addr or not self._conn.is_connected():
+                self.mds_addr = addr
+                self._conn = self.rados.msgr.connect_to(
+                    addr, lossless=False)
 
     # -- namespace API (reference Client_*) ----------------------------
     def mkdir(self, path: str) -> int:

@@ -86,6 +86,16 @@ class MDSDaemon(Dispatcher):
         # parked requests waiting on a recall: ino -> [(msg, conn)]
         self._waiting_recall: Dict[int, List[Tuple]] = {}
         self._recall_started: Dict[int, float] = {}
+        # exactly-once for retried client mutations (failover resend):
+        # (client, tid) -> reply out; rebuilt from the journal window
+        # on replay, so a new active can suppress duplicates too
+        self._reqids: Dict[Tuple[str, int], dict] = {}
+        # role (reference MDSMap states collapsed to active/standby):
+        # assigned by the monitor via beacons; True until told
+        # otherwise so solo deployments without mds-aware monitors
+        # keep working
+        self.active = True
+        self._last_beacon = 0.0
         self._replay_journal()
         self.msgr = Messenger(name, conf=self.conf)
         self.my_addr = self.msgr.bind(addr)
@@ -96,10 +106,45 @@ class MDSDaemon(Dispatcher):
                                         daemon=True)
 
     def start(self) -> "MDSDaemon":
+        self._send_beacon()              # learn our role BEFORE serving
         self.msgr.start()
         self._ticker.start()
-        self.log.dout(1, f"mds up at {self.my_addr}")
+        self.log.dout(1, f"mds up at {self.my_addr} "
+                      f"({'active' if self.active else 'standby'})")
         return self
+
+    # ------------------------------------------------------------------
+    # beacons + role (reference MDSMap/MDSMonitor + MDSRank states,
+    # collapsed to active|standby with replay-on-takeover)
+    # ------------------------------------------------------------------
+    def _send_beacon(self) -> None:
+        self._last_beacon = time.monotonic()
+        try:
+            ret, role, out = self.rados.mon_command(
+                {"prefix": "mds beacon", "name": self.name,
+                 "addr": list(self.my_addr)}, timeout=5.0)
+        except Exception:
+            return                       # mon unreachable: keep role
+        if ret != 0:
+            return                       # mds-unaware monitor: solo
+        want_active = out.get("role") == "active"
+        if want_active and not self.active:
+            with self.lock:
+                # TAKEOVER: adopt everything the dead active journaled
+                # (reference standby-replay + MDSRank rejoin collapsed
+                # to a fresh tail replay — the journal is small by the
+                # checkpoint cadence)
+                self._reqids.clear()
+                self._replay_journal()
+                self.active = True
+            self.log.dout(1, "promoted to active (journal adopted)")
+        elif not want_active and self.active:
+            with self.lock:
+                self.active = False
+                self.caps.clear()
+                self._waiting_recall.clear()
+                self._recall_started.clear()
+            self.log.dout(1, "demoted to standby")
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -130,6 +175,9 @@ class MDSDaemon(Dispatcher):
                 continue
             ent = json.loads(line.decode())
             self._seq = max(self._seq, ent["seq"])
+            if ent.get("reqid"):
+                self._reqids[tuple(ent["reqid"])] = \
+                    {"ino": ent["ino"]} if "ino" in ent else {}
             if ent["seq"] <= self._applied:
                 continue
             self._apply(ent)
@@ -140,9 +188,16 @@ class MDSDaemon(Dispatcher):
             self._checkpoint()
 
     def _journal(self, ent: dict) -> int:
-        """Append one record durably, then apply it (WAL order)."""
+        """Append one record durably, then apply it (WAL order).
+        Stamps the requesting client's reqid for duplicate
+        suppression across failovers."""
         self._seq += 1
         ent["seq"] = self._seq
+        reqid = getattr(self, "_cur_reqid", None)
+        if reqid is not None:
+            ent["reqid"] = list(reqid)
+            self._reqids[reqid] = \
+                {"ino": ent["ino"]} if "ino" in ent else {}
         self.meta.append(JOURNAL_OID,
                          json.dumps(ent).encode() + b"\n")
         self._apply(ent)
@@ -264,7 +319,10 @@ class MDSDaemon(Dispatcher):
         self._revoke(ino)
 
     def _tick_loop(self) -> None:
+        interval = self.conf["mds_beacon_interval"]
         while not self._stop.wait(0.25):
+            if time.monotonic() - self._last_beacon >= interval:
+                self._send_beacon()
             with self.lock:
                 now = time.monotonic()
                 stale = [ino for ino, t0 in
@@ -302,6 +360,18 @@ class MDSDaemon(Dispatcher):
     def _handle_op(self, msg: MMDSOp, conn) -> None:
         a = msg.args
         fs = self.fs
+        if not self.active:
+            # standby: the client must re-resolve the active MDS
+            # (reference CEPH_MDS_STATE checks -> ESTALE resends)
+            self._reply(conn, msg, -116)
+            return
+        hit = self._reqids.get((msg.client, msg.tid))
+        if hit is not None:
+            # duplicate of an already-journaled mutation (client
+            # resent across a failover): re-reply, don't re-execute
+            self._reply(conn, msg, 0, dict(hit))
+            return
+        self._cur_reqid = (msg.client, msg.tid)
         try:
             if msg.op == "cap_release":
                 self._cap_release(msg.client, a)
@@ -430,6 +500,10 @@ class MDSDaemon(Dispatcher):
             self._reply(conn, msg, result=-(e.errno or 5))
         except RadosError as e:
             self._reply(conn, msg, result=-(e.errno or 5))
+        finally:
+            # internal journal writers (recall-timeout revokes) must
+            # not inherit a client's reqid stamp
+            self._cur_reqid = None
 
     def _rename(self, msg, conn, old: str, new: str) -> None:
         fs = self.fs

@@ -119,6 +119,11 @@ class Monitor(Dispatcher):
         self.failure_reports: Dict[int, Dict[int, Tuple[float, float]]] = {}
         self.pg_stats: Dict[str, dict] = {}
         self.pg_stats_from: Dict[str, int] = {}
+        # MDSMap (reference mon/MDSMonitor.cc reduced to one active +
+        # standbys with beacon-grace failover); leader-local, persisted
+        self.mds_map: Dict = {"epoch": 0, "active": None,
+                              "addrs": {}, "standbys": []}
+        self._mds_beacons: Dict[str, float] = {}
         self._booted_addr: Dict[int, Tuple[str, int]] = {}
         self.msgr = Messenger(name, conf=self.conf)
         self.my_addr = self.msgr.bind(addr)
@@ -487,6 +492,7 @@ class Monitor(Dispatcher):
         self.quorum.tick()
         if not self.quorum.is_leader():
             return                       # map aging is the leader's job
+        self._mds_tick()
         down_out = self.conf["mon_osd_down_out_interval"]
         if down_out <= 0:
             return
@@ -702,6 +708,66 @@ class Monitor(Dispatcher):
                 self._commit(inc)
         return (0, f"pool '{name}' created", {"pool_id": pid})
 
+    def _cmd_mds_beacon(self, cmd: dict):
+        """MDS liveness + role assignment (reference MDSMonitor
+        beacon handling): first beacon wins active; later ones queue
+        as standbys; the tick promotes on beacon-grace expiry."""
+        name = cmd.get("name", "")
+        addr = tuple(cmd.get("addr", ())) or None
+        if not name or addr is None:
+            return (-22, "need name + addr", {})
+        with self.lock:
+            m = self.mds_map
+            self._mds_beacons[name] = time.monotonic()
+            changed = m["addrs"].get(name) != list(addr)
+            m["addrs"][name] = list(addr)
+            if m["active"] is None:
+                m["active"] = name
+                changed = True
+            if name != m["active"] and name not in m["standbys"]:
+                m["standbys"].append(name)
+                changed = True
+            if changed:
+                m["epoch"] += 1
+                self.store.put_raw("mdsmap", m)
+            role = "active" if m["active"] == name else "standby"
+            return (0, role, {"role": role, "epoch": m["epoch"]})
+
+    def _cmd_mds_getmap(self, cmd: dict):
+        with self.lock:
+            m = self.mds_map
+            return (0, "", {
+                "epoch": m["epoch"], "active": m["active"],
+                "addr": m["addrs"].get(m["active"]),
+                "standbys": list(m["standbys"])})
+
+    def _mds_tick(self) -> None:
+        """Fail over a beacon-silent active MDS to the freshest
+        standby (reference MDSMonitor::tick beacon grace)."""
+        grace = self.conf["mds_beacon_grace"]
+        now = time.monotonic()
+        with self.lock:
+            m = self.mds_map
+            changed = False
+            for name in list(m["standbys"]):
+                if now - self._mds_beacons.get(name, 0) > grace:
+                    m["standbys"].remove(name)
+                    m["addrs"].pop(name, None)
+                    changed = True
+            active = m["active"]
+            if active is not None and \
+                    now - self._mds_beacons.get(active, 0) > grace:
+                m["addrs"].pop(active, None)
+                m["active"] = m["standbys"].pop(0) \
+                    if m["standbys"] else None
+                self.log.dout(1, f"mds {active} beacon-silent "
+                              f"> {grace}s: active -> "
+                              f"{m['active']}")
+                changed = True
+            if changed:
+                m["epoch"] += 1
+                self.store.put_raw("mdsmap", m)
+
     def _cmd_pool_set(self, cmd: dict):
         """osd pool set <pool> <var> <val> (reference
         OSDMonitor::prepare_command_pool_set); the variable the EC
@@ -718,6 +784,12 @@ class Monitor(Dispatcher):
                     return (-22, "pool is not erasure", {})
                 newpool.ec_overwrites = val.lower() in ("1", "true",
                                                         "yes")
+            elif var == "fast_read":
+                if not pool.is_erasure():
+                    return (-22, "fast_read is an erasure-pool "
+                            "option", {})
+                newpool.fast_read = val.lower() in ("1", "true",
+                                                    "yes")
             elif var == "size":
                 newpool.size = int(val)
             elif var == "min_size":
@@ -1034,6 +1106,8 @@ class Monitor(Dispatcher):
         "osd erasure-code-profile rm": _cmd_profile_rm,
         "osd pool create": _cmd_pool_create,
         "osd pool set": _cmd_pool_set,
+        "mds beacon": _cmd_mds_beacon,
+        "mds getmap": _cmd_mds_getmap,
         "osd pool delete": _cmd_pool_delete,
         "osd pool ls": _cmd_pool_ls,
         "osd pool selfmanaged-snap create": _cmd_snap_create,

@@ -103,7 +103,7 @@ class _ReadOp:
                  cb: Callable[[Dict[int, bytes], Dict[int, int]], None],
                  tried: Optional[Set[int]] = None,
                  ranges: Optional[Dict[int, List[Tuple[int, int]]]]
-                 = None):
+                 = None, need: Optional[int] = None):
         self.tid = tid
         self.oid = oid
         self.chunk_off = chunk_off
@@ -114,6 +114,11 @@ class _ReadOp:
         self.errors: Dict[int, int] = {}
         self.tried: Set[int] = tried or set(want_shards)
         self.cb = cb                         # (shard->bytes, shard->err)
+        # fast_read (reference ECBackend.cc:1043,2173 fast_read /
+        # send_all_remaining_reads): when set, the op completes as soon
+        # as ``need`` shards answered successfully — the remaining
+        # (slow/dead) shards' replies are dropped as stragglers
+        self.need = need
 
 
 class _RecoveryOp:
@@ -684,14 +689,30 @@ class ECBackend(PGBackend):
         chunk_len = self.sinfo.aligned_logical_offset_to_chunk_offset(
             astart + alen) - chunk_off
 
-        shards = self._min_read_shards(set(range(self.k)))
+        # fast_read pools fan the read to EVERY available shard and
+        # reconstruct from the first k answers, trading bandwidth for
+        # tail latency (reference ECBackend.cc:1043 fast_read,
+        # osd_pool_default_ec_fast_read)
+        fast = bool(getattr(getattr(self.host, "pool", None),
+                            "fast_read", False))
+        need = None
+        if fast:
+            shards = {s: o for s, o in self.host.acting_shards()
+                      if o is not None}
+            if len(shards) < self.k:
+                shards = None
+            else:
+                need = self.k
+        else:
+            shards = self._min_read_shards(set(range(self.k)))
         if shards is None:
             cb(-5, b"")                  # -EIO: not enough shards up
             return
+        min_needed = need if need is not None else len(shards)
 
         def reads_done(received: Dict[int, bytes],
                        errors: Dict[int, int]) -> None:
-            if errors or len(received) < len(shards):
+            if errors or len(received) < min_needed:
                 cb(-5, b"")
                 return
             try:
@@ -704,7 +725,8 @@ class ECBackend(PGBackend):
             lo = offset - astart
             cb(0, data[lo:lo + length])
 
-        self._start_read(oid, chunk_off, chunk_len, shards, reads_done)
+        self._start_read(oid, chunk_off, chunk_len, shards, reads_done,
+                         need=need)
 
     def _decode_impl(self, nbytes: int):
         """Decode through the CPU twin when the OSD batcher's learned
@@ -753,9 +775,9 @@ class ECBackend(PGBackend):
                                  None],
                     tried: Optional[Set[int]] = None,
                     ranges: Optional[Dict[int, List[Tuple[int, int]]]]
-                    = None) -> None:
+                    = None, need: Optional[int] = None) -> None:
         rop = _ReadOp(self.new_tid(), oid, chunk_off, chunk_len,
-                      dict(shards), cb, tried, ranges)
+                      dict(shards), cb, tried, ranges, need)
         self.in_flight_reads[rop.tid] = rop
         for shard, osd in shards.items():
             extents = rop.ranges.get(shard,
@@ -819,6 +841,12 @@ class ECBackend(PGBackend):
             rop.errors[shard] = err
         else:
             rop.received[shard] = data
+        if rop.need is not None and len(rop.received) >= rop.need:
+            # fast_read: enough shards to reconstruct — don't wait for
+            # stragglers (their late replies hit the tid-gone guard)
+            del self.in_flight_reads[rop.tid]
+            rop.cb(rop.received, {})
+            return
         if len(rop.received) + len(rop.errors) < len(rop.want_shards):
             return
         del self.in_flight_reads[rop.tid]

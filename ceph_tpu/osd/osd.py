@@ -104,6 +104,9 @@ class OSDService:
     def kick_recovery(self, pg: Optional[PG] = None) -> None:
         self._osd.kick_recovery()
 
+    def objecter_ioctx(self, pool_id: int):
+        return self._osd.objecter_ioctx(pool_id)
+
     def ensure_pg(self, pgid) -> Optional[PG]:
         """Get-or-create a local PG instance regardless of acting-set
         membership (split children are created on the parent's holders
@@ -138,6 +141,10 @@ class OSD(Dispatcher):
         self.msgr.add_dispatcher(self)
         self.monc = MonClient(self.msgr, mon_addr,
                               map_cb=self._on_map_published)
+        self._mon_addr = mon_addr
+        self._int_client = None          # lazy internal objecter
+                                         # (copy_from, cache tiering)
+        self._int_client_lock = threading.Lock()
         # sharded op queue (reference op_shardedwq, OSD.h:1287) with
         # mClock-style QoS per shard (reference osd/scheduler/): the
         # client/recovery/scrub classes stop sharing a plain FIFO
@@ -220,6 +227,11 @@ class OSD(Dispatcher):
         self._recovery_kick.set()
         for q in self._shard_queues:
             q.close()
+        if self._int_client is not None:
+            try:
+                self._int_client.shutdown()
+            except Exception:
+                pass
         self.msgr.shutdown()
         for t in self._workers + self._threads:
             t.join(timeout=5)
@@ -530,6 +542,22 @@ class OSD(Dispatcher):
             return
         self.msgr.connect_to(addr, lossless=True,
                              peer_name=f"osd.{osd}").send_message(msg)
+
+    def objecter_ioctx(self, pool_id: int):
+        """IoCtx on the OSD's own internal client (the reference
+        OSD's objecter, used by copy-from and cache tiering —
+        reference ceph_osd.cc objecter messenger + PrimaryLogPG
+        do_copy_from)."""
+        with self.map_lock:
+            pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            raise KeyError(f"no pool {pool_id}")
+        with self._int_client_lock:
+            if self._int_client is None:
+                from ..client.rados import Rados
+                self._int_client = Rados(self._mon_addr,
+                                         conf=self.conf).connect()
+        return self._int_client.open_ioctx(pool.name)
 
     # ------------------------------------------------------------------
     # heartbeats (reference OSD.cc:5079-5632)

@@ -164,6 +164,8 @@ class PGPool:
     erasure_code_profile: str = ""
     stripe_width: int = 0
     ec_overwrites: bool = False   # allows_ecoverwrites, osd_types.h:1600
+    fast_read: bool = False       # EC read-all-reconstruct-first-k
+                                  # (reference pg_pool_t FLAG_EC_FAST_READ)
     # snapshots (reference pg_pool_t snap fields, osd/osd_types.h):
     snap_seq: int = 0                  # newest allocated snap id
     removed_snaps: List[int] = field(default_factory=list)
@@ -352,6 +354,7 @@ class OSDMap:
                 "erasure_code_profile": p.erasure_code_profile,
                 "stripe_width": p.stripe_width,
                 "ec_overwrites": p.ec_overwrites,
+                "fast_read": p.fast_read,
                 "snap_seq": p.snap_seq,
                 "removed_snaps": p.removed_snaps,
                 "pool_snaps": p.pool_snaps}
@@ -382,6 +385,7 @@ class OSDMap:
                           erasure_code_profile=p["erasure_code_profile"],
                           stripe_width=p["stripe_width"],
                           ec_overwrites=p.get("ec_overwrites", False),
+                          fast_read=p.get("fast_read", False),
                           snap_seq=p.get("snap_seq", 0),
                           removed_snaps=list(p.get("removed_snaps", [])),
                           pool_snaps=dict(p.get("pool_snaps", {})))

@@ -67,7 +67,7 @@ STATE_ACTIVE = "active"
 
 WRITE_OPS = {"write", "writefull", "append", "create", "delete",
              "truncate", "setxattr", "rmxattr", "omap_set", "omap_rm",
-             "omap_clear", "call", "rollback"}
+             "omap_clear", "call", "rollback", "copy_from"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
             "omap_get_by_key", "pgls", "list_snaps",
             "watch", "unwatch", "notify", "notify_ack",
@@ -1047,6 +1047,9 @@ class PG:
             self.service.kick_recovery(self)
             return
         if has_write:
+            if any(op.op == "copy_from" for op in msg.ops):
+                self._start_copy_from(msg, conn)
+                return
             if oid in self.inflight_writes and \
                     not self._can_pipeline(msg, oid):
                 self.waiting_for_obj.setdefault(oid, deque()).append(
@@ -1093,6 +1096,55 @@ class PG:
         v = max(self._last_assigned[1], self.log.last_update[1]) + 1
         self._last_assigned = (self.epoch, v)
         return self._last_assigned
+
+    def _start_copy_from(self, msg: MOSDOp, conn) -> None:
+        """CEPH_OSD_OP_COPY_FROM (reference PrimaryLogPG.cc:2816
+        do_copy_from): the primary fetches the SOURCE object — possibly
+        homed in another PG — through the OSD's internal objecter, then
+        folds it into this op as a full replace (data + user xattrs +
+        omap on replicated pools).  The fetch runs off the PG lock;
+        the op re-enters the normal write path when it lands, so dup
+        detection/snapshots/EC rules all apply unchanged."""
+        src = next(op for op in msg.ops if op.op == "copy_from")
+        src_oid = src.name
+        pool_id = self.pgid.pool
+        replicated = not self.pool.is_erasure()
+
+        def fetch() -> None:
+            try:
+                io = self.service.objecter_ioctx(pool_id)
+                data = io.read(src_oid)
+                attrs = io.getxattrs(src_oid)
+                omap = io.omap_get(src_oid) if replicated else {}
+            except Exception as e:
+                code = getattr(e, "errno", 0) or 5
+                with self.lock:
+                    self._client_ops.pop((msg.client, msg.tid), None)
+                    self._reply(conn, msg, -code, [])
+                return
+            with self.lock:
+                if not self.is_primary() or self.state != STATE_ACTIVE:
+                    self._client_ops.pop((msg.client, msg.tid), None)
+                    self._reply(conn, msg, -108, [])
+                    return
+                new_ops: List[OSDOp] = []
+                for op in msg.ops:
+                    if op.op != "copy_from":
+                        new_ops.append(op)
+                        continue
+                    new_ops.append(OSDOp("writefull", 0, len(data),
+                                         data))
+                    for k, v in attrs.items():
+                        new_ops.append(OSDOp("setxattr", data=v,
+                                             name=k))
+                    for k, v in omap.items():
+                        new_ops.append(OSDOp("omap_set", data=v,
+                                             name=k))
+                msg.ops = new_ops
+                self._do_op(msg, conn)
+
+        threading.Thread(target=fetch, name="copy-from",
+                         daemon=True).start()
 
     def _do_write(self, msg: MOSDOp, conn) -> None:
         # dup detection: a resend of an already-committed op must not

@@ -38,12 +38,13 @@ class RadosModel:
     """Random ops + expected-state tracking (reference RadosModel.h)."""
 
     OPS = ("write", "append", "writefull", "truncate", "delete",
-           "setxattr", "read")
+           "setxattr", "read", "copy_from")
     # EC pools without ec_overwrites reject overwrites/truncate
     # (EOPNOTSUPP, like the reference) — restrict to the append-only
     # vocabulary there (reference thrash-erasure-code workloads
     # likewise use append-style ops)
-    EC_OPS = ("append", "writefull", "delete", "setxattr", "read")
+    EC_OPS = ("append", "writefull", "delete", "setxattr", "read",
+              "copy_from")
     # snapshot vocabulary (reference qa/.../thrash-erasure-code
     # workloads/ec-rados-plugin=*.yaml: snap_create/snap_remove/
     # rollback in the op mix); valid on both pool types
@@ -117,6 +118,16 @@ class RadosModel:
                 self.ioctx.remove(oid)
                 self.expect.pop(oid, None)
                 self.expect_attrs.pop(oid, None)
+            elif op == "copy_from":
+                # server-side copy (reference ec-rados workloads run
+                # copy_from in their 4000-op mixes)
+                src = self.rng.choice(self.names)
+                if self.expect.get(src) is None:
+                    return
+                self.ioctx.copy_from(oid, src)
+                self.expect[oid] = bytearray(self.expect[src])
+                self.expect_attrs[oid] = dict(
+                    self.expect_attrs.get(src, {}))
             elif op == "setxattr":
                 if cur is None:
                     return

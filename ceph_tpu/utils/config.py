@@ -158,6 +158,12 @@ def _opts() -> List[Option]:
         Option("mgr_tick_interval", float, 1.0, min=0.05,
                description="mgr perf-collection cadence "
                            "(reference mgr_tick_period)"),
+        Option("mds_beacon_interval", float, 1.0, min=0.05,
+               description="MDS -> mon beacon cadence "
+                           "(reference mds_beacon_interval)"),
+        Option("mds_beacon_grace", float, 4.0, min=0.1,
+               description="beacon-silent MDS is failed over after "
+                           "this (reference mds_beacon_grace)"),
         Option("mgr_pg_autoscale_mode", str, "off",
                enum_allowed=("off", "on"),
                description="apply pg_autoscaler recommendations (grow "

@@ -279,3 +279,45 @@ def test_central_config_propagates_to_daemons():
                    for o in c.osds.values() if o is not None), \
             "config override did not reach the daemons"
         assert seen and seen[-1] == 7, "observer did not fire"
+
+
+def test_copy_from_server_side():
+    """CEPH_OSD_OP_COPY_FROM (reference PrimaryLogPG.cc:2816): the
+    destination primary fetches the source server-side — data, user
+    xattrs, omap — across PGs, on replicated and EC pools."""
+    import os as _os
+
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.cluster import Cluster, test_config
+    with Cluster(n_osds=3, conf=test_config()) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("cfp", "replicated", size=2)
+        io = c.rados().open_ioctx("cfp")
+        payload = _os.urandom(100_000)
+        io.write_full("src", payload)
+        io.setxattr("src", "user.tag", b"v1")
+        io.omap_set("src", {"k1": b"a", "k2": b"b"})
+        io.copy_from("dst", "src")
+        assert io.read("dst") == payload
+        assert io.getxattr("dst", "user.tag") == b"v1"
+        assert io.omap_get("dst") == {"k1": b"a", "k2": b"b"}
+        # overwrite semantics: copy replaces prior content fully
+        io.write_full("dst2", b"x" * 200_000)
+        io.copy_from("dst2", "src")
+        assert io.read("dst2") == payload
+        # missing source -> ENOENT
+        try:
+            io.copy_from("dst3", "nosuch")
+            raise AssertionError("copy_from of missing src succeeded")
+        except RadosError as e:
+            assert e.errno == 2
+        # EC pool: data + xattrs (omap is ENOTSUP there, skipped)
+        c.create_ec_profile("cfe", plugin="jerasure", k="2", m="1")
+        c.create_pool("cfep", "erasure", erasure_code_profile="cfe")
+        ioe = c.rados().open_ioctx("cfep")
+        ioe.write_full("esrc", payload)
+        ioe.setxattr("esrc", "user.t", b"e1")
+        ioe.copy_from("edst", "esrc")
+        assert ioe.read("edst") == payload
+        assert ioe.getxattr("edst", "user.t") == b"e1"

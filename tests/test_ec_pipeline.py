@@ -117,3 +117,55 @@ def test_barrier_ops_serialize_with_pipeline(cl):
     for comp in comps:
         assert comp.wait(60) == 0
     assert io.read("bar") == b"C" * 1000
+
+
+def test_fast_read_survives_undetected_dead_shard():
+    """fast_read pools (reference ECBackend.cc:1043) fan reads to all
+    shards and reconstruct from the first k — a freshly dead OSD that
+    heartbeats have NOT yet flagged must not stall reads for the whole
+    failure-detection grace."""
+    import time as _t
+
+    from ceph_tpu.cluster import Cluster, test_config
+    with Cluster(n_osds=4, conf=test_config()) as c:
+        for i in range(4):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("fr", plugin="jerasure", k="2", m="2")
+        c.create_pool("frp", "erasure", erasure_code_profile="fr")
+        rc, msg, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "frp",
+             "var": "fast_read", "val": "true"})
+        assert rc == 0, msg
+        io = c.rados(timeout=20).open_ioctx("frp")
+        import os as _os
+        blob = _os.urandom(16384)
+        io.write_full("fr0", blob)
+        c.wait_for_clean(30)
+        # find fr0's PG and kill a NON-primary member abruptly
+        osd0 = next(o for o in c.osds.values() if o is not None)
+        osdmap = osd0.osdmap
+        pool_id = osdmap.pool_name_to_id["frp"]
+        pgid = osdmap.object_locator_to_pg("fr0", pool_id)
+        _, _, acting, primary = osdmap.pg_to_up_acting_osds(pgid)
+        victim = next(o for o in acting
+                      if o is not None and o != primary)
+        c.kill_osd(victim)
+        # read IMMEDIATELY, before heartbeats notice: fast_read
+        # reconstructs from the first k answers instead of waiting on
+        # the dead shard for the whole grace period
+        t0 = _t.monotonic()
+        assert io.read("fr0", len(blob)) == blob
+        elapsed = _t.monotonic() - t0
+        grace = c.conf["osd_heartbeat_grace"]
+        assert elapsed < grace, \
+            f"fast_read read took {elapsed:.1f}s >= grace {grace}s"
+
+
+def test_fast_read_rejected_on_replicated_pool():
+    from ceph_tpu.cluster import Cluster, test_config
+    with Cluster(n_osds=3, conf=test_config()) as c:
+        c.create_pool("rp", "replicated")
+        rc, _, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "rp",
+             "var": "fast_read", "val": "true"})
+        assert rc == -22

@@ -169,3 +169,39 @@ def test_same_client_reopen_flushes_prior_handle(cl, mds):
     f2.close()
     f1.close()                         # stale handle: harmless
     assert fs.stat("/re.bin")["size"] == 3000
+
+
+def test_mds_standby_failover():
+    """Kill the active MDS with a standby registered: the monitor's
+    beacon grace promotes the standby, which adopts the journal; a
+    client resolving through the MDSMap completes in-flight and new
+    ops with no namespace tears (VERDICT r2 #7; reference MDSMonitor
+    beacon failover + MDSRank replay)."""
+    from ceph_tpu.cluster import test_config as _mc
+    conf = _mc(mds_beacon_interval=0.2, mds_beacon_grace=1.2)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("fom", "replicated", size=2)
+        c.create_pool("fod", "replicated", size=2)
+        a = MDSDaemon(c.mon_addr, "fom", "fod", conf=conf,
+                      name="mds.a").start()
+        b = MDSDaemon(c.mon_addr, "fom", "fod", conf=conf,
+                      name="mds.b").start()
+        assert a.active and not b.active
+        fs = MDSClient(c.rados(), None, "fod")   # mdsmap-resolved
+        fs.mkdir("/fo")
+        data = os.urandom(120_000)
+        fs.write_file("/fo/x.bin", data)
+
+        a.shutdown()                     # beacons stop; no handoff
+        # new ops must complete via the promoted standby (the client
+        # retries + re-resolves internally)
+        fs.mkdir("/fo/after")
+        assert fs.read_file("/fo/x.bin") == data
+        fs.write_file("/fo/after/y.bin", b"post-failover")
+        assert fs.read_file("/fo/after/y.bin") == b"post-failover"
+        assert b.active, "standby was not promoted"
+        names = {e["name"] for e in fs.listdir("/fo")}
+        assert names == {"x.bin", "after"}, names
+        b.shutdown()
