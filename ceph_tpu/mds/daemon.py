@@ -96,6 +96,11 @@ class MDSDaemon(Dispatcher):
         # keep working
         self.active = True
         self._last_beacon = 0.0
+        # mdsmap epoch we last held a role at: stamps every journal
+        # append (cls_fence guard) so a deposed active's writes are
+        # rejected atomically inside the OSD — the reference fences
+        # via OSDMap blocklist before promoting a standby
+        self._epoch = 0
         self._replay_journal()
         self.msgr = Messenger(name, conf=self.conf)
         self.my_addr = self.msgr.bind(addr)
@@ -127,24 +132,48 @@ class MDSDaemon(Dispatcher):
             return                       # mon unreachable: keep role
         if ret != 0:
             return                       # mds-unaware monitor: solo
+        if not getattr(self, "_role_initialized", False):
+            # a monitor IS assigning roles: our constructor's
+            # solo-friendly active=True must not short-circuit the
+            # promotion branch — a replacement process started over a
+            # live zombie (same name, wedged original) has to take the
+            # full fence+replay takeover path, or neither process ever
+            # raises the fence and both append at epoch 0
+            self._role_initialized = True
+            with self.lock:
+                self.active = False
         want_active = out.get("role") == "active"
+        try:
+            new_epoch = int(out.get("epoch", 0))
+        except (TypeError, ValueError):
+            new_epoch = 0
         if want_active and not self.active:
             with self.lock:
-                # TAKEOVER: adopt everything the dead active journaled
-                # (reference standby-replay + MDSRank rejoin collapsed
-                # to a fresh tail replay — the journal is small by the
-                # checkpoint cadence)
+                # TAKEOVER: adopt the epoch ONLY here, under the lock
+                # — a zombie must never learn the successor's epoch
+                # (adopting it on a standby reply would let an
+                # in-flight append slip past the fence stamped with
+                # the new epoch before the demotion branch runs).
+                # Then fence FIRST — raising the journal fence to our
+                # epoch atomically rejects any in-flight append from
+                # the deposed active (it was assigned at an older
+                # epoch), so the replay below observes the journal's
+                # final state.  Only then adopt what the dead active
+                # journaled (reference standby-replay + MDSRank rejoin
+                # collapsed to a fresh tail replay — the journal is
+                # small by the checkpoint cadence).
+                self._epoch = max(self._epoch, new_epoch)
+                if not self._fence_journal():
+                    return               # stale/unreachable: next
+                                         # beacon retries promotion
                 self._reqids.clear()
                 self._replay_journal()
                 self.active = True
-            self.log.dout(1, "promoted to active (journal adopted)")
+            self.log.dout(1, "promoted to active (journal fenced at "
+                          f"e{self._epoch}, adopted)")
         elif not want_active and self.active:
             with self.lock:
-                self.active = False
-                self.caps.clear()
-                self._waiting_recall.clear()
-                self._recall_started.clear()
-            self.log.dout(1, "demoted to standby")
+                self._demote("monitor reassigned active")
 
     def shutdown(self) -> None:
         self._stop.set()
@@ -185,21 +214,99 @@ class MDSDaemon(Dispatcher):
         self._applied = self._seq
         if replayed:
             self.log.dout(1, f"journal replayed {replayed} entries")
-            self._checkpoint()
+            try:
+                self._checkpoint()
+            except RadosError as e:
+                if e.errno != 116:
+                    raise
+                # fenced out mid-replay (we restarted with a stale
+                # epoch while a successor holds the fence): the
+                # replayed applies were idempotent no-ops; stay
+                # standby and leave the journal to the real active
+
+    def _demote(self, why: str) -> None:
+        """Drop the active role and every bit of active-only state
+        (used by both the beacon demotion and the fenced-out path —
+        they must never diverge)."""
+        self.active = False
+        self.caps.clear()
+        self._waiting_recall.clear()
+        self._recall_started.clear()
+        self.log.dout(1, f"demoted to standby: {why}")
+
+    def _fence_journal(self) -> bool:
+        """Raise the fence on the journal AND its head watermark to
+        our mdsmap epoch (cls_fence); True on success.  ENOTSUP
+        (cls-less pool, e.g. EC meta) keeps the pre-fencing behavior
+        rather than bricking the filesystem."""
+        try:
+            payload = json.dumps({"epoch": self._epoch}).encode()
+            self.meta.exec_cls(JOURNAL_OID, "fence", "set", payload)
+            self.meta.exec_cls(JOURNAL_HEAD, "fence", "set", payload)
+            return True
+        except RadosError as e:
+            if e.errno == 95:            # EOPNOTSUPP: unfenced pool
+                self._fence_unsupported = True
+                return True
+            self.log.dout(1, f"journal fence at e{self._epoch} "
+                          f"refused: {e}")
+            return False
+        except Exception as e:
+            self.log.dout(1, f"journal fence unreachable: {e}")
+            return False
+
+    def _guarded(self, oid: str, method: str, plain, **req) -> None:
+        """One epoch-guarded journal mutation.  A fence raised past us
+        (a standby was promoted while we still thought we were active)
+        rejects the op inside the OSD: demote on the spot and fail the
+        client op ESTALE so it re-resolves the active."""
+        if getattr(self, "_fence_unsupported", False):
+            plain()                      # latched: skip the doomed RPC
+            return
+        try:
+            self.meta.exec_cls(
+                oid, "fence", method,
+                json.dumps(dict(req, epoch=self._epoch)).encode())
+            return
+        except RadosError as e:
+            if e.errno == 95:            # EOPNOTSUPP: unfenced pool —
+                # latch it so later mutations skip the wasted round
+                # trip (the pool's type cannot change under us)
+                self._fence_unsupported = True
+                plain()
+                return
+            if e.errno != 1:             # not a fence rejection
+                raise
+        self._demote("journal op fenced out (a standby was promoted "
+                     "over us)")
+        raise RadosError(116, "fenced: no longer the active mds")
+
+    def _fenced_append(self, line: bytes) -> None:
+        self._guarded(JOURNAL_OID, "guarded_append",
+                      lambda: self.meta.append(JOURNAL_OID, line),
+                      data=line.decode("utf-8"))
 
     def _journal(self, ent: dict) -> int:
-        """Append one record durably, then apply it (WAL order).
-        Stamps the requesting client's reqid for duplicate
-        suppression across failovers."""
+        """Append one record durably (epoch-fenced), then apply it
+        (WAL order).  Stamps the requesting client's reqid for
+        duplicate suppression across failovers."""
         self._seq += 1
         ent["seq"] = self._seq
         reqid = getattr(self, "_cur_reqid", None)
         if reqid is not None:
             ent["reqid"] = list(reqid)
+        try:
+            self._fenced_append(json.dumps(ent).encode() + b"\n")
+        except RadosError as e:
+            if e.errno == 116:           # fence rejection: DEFINITELY
+                self._seq -= 1           # not committed — reuse seq
+            # anything else (timeout, connection loss) is
+            # indeterminate: the append may yet commit, so the seq is
+            # burned — two different records must never share one
+            raise
+        if reqid is not None:
             self._reqids[reqid] = \
                 {"ino": ent["ino"]} if "ino" in ent else {}
-        self.meta.append(JOURNAL_OID,
-                         json.dumps(ent).encode() + b"\n")
         self._apply(ent)
         self._applied = ent["seq"]
         self._since_checkpoint += 1
@@ -209,14 +316,23 @@ class MDSDaemon(Dispatcher):
 
     def _checkpoint(self) -> None:
         """Backing store has absorbed everything applied: record the
-        watermark and trim the journal (sole writer, so truncate is
-        race-free — reference MDLog trim)."""
-        self.meta.write_full(JOURNAL_HEAD, json.dumps(
-            {"applied": self._applied}).encode())
+        watermark and trim the journal — both epoch-guarded, or a
+        zombie's checkpoint would regress the successor's watermark
+        and its trim would erase the successor's entries (reference
+        MDLog trim, safe there because the old active is blocklisted
+        before promotion)."""
+        head = json.dumps({"applied": self._applied})
+        self._guarded(JOURNAL_HEAD, "guarded_write_full",
+                      lambda: self.meta.write_full(JOURNAL_HEAD,
+                                                   head.encode()),
+                      data=head)
         try:
-            self.meta.truncate(JOURNAL_OID, 0)
-        except RadosError:
-            pass
+            self._guarded(JOURNAL_OID, "guarded_truncate",
+                          lambda: self.meta.truncate(JOURNAL_OID, 0),
+                          size=0)
+        except RadosError as e:
+            if e.errno != 2:             # ENOENT: nothing to trim
+                raise
         self._since_checkpoint = 0
 
     def _apply(self, ent: dict) -> None:

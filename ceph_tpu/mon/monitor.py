@@ -170,6 +170,18 @@ class Monitor(Dispatcher):
     # lifecycle
     # ------------------------------------------------------------------
     def _load_or_bootstrap(self) -> None:
+        # MDSMap survives a monitor restart (reference MDSMonitor's
+        # paxos-persisted FSMap): without this the first beacon after
+        # restart would win active regardless of prior assignment, and
+        # the epoch would restart at 0, mis-ordering maps at clients.
+        # Beacon grace baselines restart at "now" so known daemons get
+        # a full grace window to re-beacon before failover.
+        saved_mds = self.store.get_raw("mdsmap")
+        if saved_mds:
+            self.mds_map = saved_mds
+            now = time.monotonic()
+            for name in self.mds_map.get("addrs", {}):
+                self._mds_beacons[name] = now
         last = self.store.last_epoch()
         if last:
             self.osdmap = OSDMap.from_wire_dict(self.store.get_map(last))

@@ -154,6 +154,9 @@ class MethodContext:
     def create(self) -> None:
         self._mut.create = True
 
+    def truncate(self, size: int) -> None:
+        self._mut.truncate = size
+
     def remove(self) -> None:
         self._mut.delete = True
 
@@ -202,4 +205,4 @@ def dispatch_call(pg, oid: str, spec: str, indata: bytes,
 
 
 # ship the built-in classes (reference src/cls/ is linked in-tree too)
-from . import cls_lock, cls_version  # noqa: E402,F401
+from . import cls_fence, cls_lock, cls_version  # noqa: E402,F401

@@ -54,20 +54,47 @@ class ChainLRU:
         self.cap = cap
         self._d: OrderedDict = OrderedDict()
         self._lock = threading.Lock()
+        # per-key in-progress markers: builder() is a full jit
+        # trace+compile (seconds), so it must run OUTSIDE the lock —
+        # one compile per key, but compiles of DIFFERENT signatures
+        # (other pools/geometries) proceed concurrently instead of
+        # serializing every first-use behind one lock
+        self._building: dict = {}
 
     def get_or_build(self, key, builder):
-        # the lock also serializes builder(): concurrent first-users of
-        # one signature compile once, and eviction can never drop a key
-        # between another thread's insert and move_to_end
-        with self._lock:
-            hit = self._d.get(key)
-            if hit is None:
-                hit = builder()
-                self._d[key] = hit
-            self._d.move_to_end(key)
-            while len(self._d) > self.cap:
-                self._d.popitem(last=False)
-            return hit
+        while True:
+            with self._lock:
+                hit = self._d.get(key)
+                if hit is not None:
+                    self._d.move_to_end(key)
+                    return hit
+                ev = self._building.get(key)
+                if ev is None:
+                    ev = threading.Event()
+                    self._building[key] = ev
+                    owner = True
+                else:
+                    owner = False
+            if not owner:
+                # another thread compiles this signature; wait and
+                # re-check (it may have failed — then we take over)
+                ev.wait()
+                continue
+            try:
+                val = builder()
+            except BaseException:
+                with self._lock:
+                    self._building.pop(key, None)
+                ev.set()
+                raise
+            with self._lock:
+                self._d[key] = val
+                self._d.move_to_end(key)
+                while len(self._d) > self.cap:
+                    self._d.popitem(last=False)
+                self._building.pop(key, None)
+            ev.set()
+            return val
 
 
 def _bits_of_bytes(x: jnp.ndarray) -> jnp.ndarray:

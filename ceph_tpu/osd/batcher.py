@@ -80,6 +80,7 @@ class EncodeBatcher:
 
     _cpu_bps: Dict[Tuple, float] = {}        # per geometry, shared
     _min_device_bytes: float = 0.0           # learned crossover, shared
+    _warmed: set = set()                     # geometries prewarmed
 
     def __init__(self, conf=None, perf=None):
         def get(k, d):
@@ -142,11 +143,56 @@ class EncodeBatcher:
         if stopped:
             cb(ecutil.encode(sinfo, ec_impl, data))
 
-    def stop(self) -> None:
+    def prewarm(self, ec_impl, sinfo: ecutil.StripeInfo) -> None:
+        """Pay the pool geometry's one-time costs at backend-build
+        time instead of on the first client op (the reference pays GF
+        table setup at plugin load — jerasure_init.cc:37, preloaded at
+        global_init.cc:600): measure the CPU twin's rate for the
+        crossover router, and compile the device kernels for the
+        batch shapes the coalescer dispatches.  Background thread —
+        OSD boot is not stalled; a first op racing the warm simply
+        shares the in-progress compile (ChainLRU in-progress marker).
+        Once per geometry process-wide."""
+        if not hasattr(ec_impl, "encode_batch_async"):
+            return
+        key = _geometry_key(ec_impl, sinfo)
+        with self._cond:
+            if key in EncodeBatcher._warmed:
+                return
+            EncodeBatcher._warmed.add(key)
+
+        def work():
+            try:
+                probe = _Req(ec_impl, sinfo,
+                             b"\0" * (sinfo.stripe_width * 8),
+                             lambda _c: None)
+                self._cpu_rate(key, probe)
+                import jax
+                if jax.default_backend() == "cpu":
+                    return       # cold compile is a device-tunnel
+                                 # property; CPU fallback compiles in
+                                 # milliseconds on first use
+                k = ec_impl.get_data_chunk_count()
+                for nb in sorted({max(1, self.max_stripes),
+                                  max(1, self.max_stripes // 2)}):
+                    if self._stop:
+                        return
+                    z = np.zeros((nb, k, sinfo.chunk_size),
+                                 dtype=np.uint8)
+                    ec_impl.encode_batch_async(z).wait()
+            except Exception:
+                pass             # warms are best-effort
+        threading.Thread(target=work, name="ec-prewarm",
+                         daemon=True).start()
+
+    def stop(self, drain: float = 30.0) -> None:
+        """Stop the collector, draining in-flight device work first
+        (up to ``drain`` seconds) so no continuation lands after the
+        caller unmounts the store.  Idle batchers return instantly."""
         with self._cond:
             self._stop = True
             self._cond.notify()
-        self._thread.join(timeout=5)
+        self._thread.join(timeout=max(drain, 0.1))
 
     # -- collector -------------------------------------------------------
     def _run(self) -> None:
@@ -192,8 +238,7 @@ class EncodeBatcher:
                                              learn=(len(groups)
                                                     == 1))
                 except Exception:
-                    import traceback
-                    traceback.print_exc()
+                    self._cb_error()
 
     def _route_to_cpu(self, key: Tuple, reqs: List[_Req]) -> bool:
         """True when the learned crossover says this batch is too
@@ -209,12 +254,23 @@ class EncodeBatcher:
         self._probe_tick = getattr(self, "_probe_tick", 0) + 1
         return self._probe_tick % 16 != 0
 
+    def _cb_error(self) -> None:
+        """Report a continuation/encode failure.  During shutdown the
+        op is already dead (teardown races deliver into an unmounting
+        OSD — e.g. 'store not mounted'), so stay quiet rather than
+        spraying tracebacks over the console and bench output."""
+        if self._stop:
+            return
+        import traceback
+        traceback.print_exc()
+
     @classmethod
     def reset_learning(cls) -> None:
         """Forget the shared crossover/rates (tests; ops can call it
         after a hardware change)."""
         cls._min_device_bytes = 0.0
         cls._cpu_bps = {}
+        cls._warmed = set()
 
     def _cpu_rate(self, key: Tuple, req: _Req) -> float:
         """CPU twin throughput for this geometry, measured once on
@@ -233,16 +289,14 @@ class EncodeBatcher:
             try:
                 chunks = self._cpu_encode(r)
             except Exception:
-                import traceback
-                traceback.print_exc()
+                self._cb_error()
                 chunks = None
             self.reqs_total += 1
             self.cpu_reqs += 1
             try:
                 r.cb(chunks)
             except Exception:
-                import traceback
-                traceback.print_exc()
+                self._cb_error()
 
     def _learn_crossover(self, reqs: List[_Req],
                          dev_time: float) -> None:
@@ -322,8 +376,17 @@ class EncodeBatcher:
                 r.nstripes, k, sinfo.chunk_size) for r in reqs]
             batch = np.concatenate(arrs, axis=0) \
                 if len(arrs) > 1 else arrs[0]
-            return (arrs, reqs[0].ec_impl.encode_batch_async(batch),
-                    time.monotonic())
+            # tile oversized batches at max_stripes: bounds per-call
+            # device memory AND caps the largest compiled batch shape
+            # at bucket(max_stripes) — the shape prewarm() compiles —
+            # so a burst can never hit a never-seen (slow-compiling)
+            # shape mid-benchmark.  All tiles dispatch before any
+            # wait: h2d/MXU/d2h still overlap tile-to-tile.
+            tile = max(1, self.max_stripes)
+            handles = [
+                reqs[0].ec_impl.encode_batch_async(batch[i:i + tile])
+                for i in range(0, batch.shape[0], tile)]
+            return (arrs, handles, time.monotonic())
         except Exception:
             return None
 
@@ -334,9 +397,11 @@ class EncodeBatcher:
         parity = None
         dev_time = None
         if handle is not None:
-            arrs, async_batch, t_dispatch = handle
+            arrs, async_tiles, t_dispatch = handle
             try:
-                parity = async_batch.wait()
+                parts = [t.wait() for t in async_tiles]
+                parity = parts[0] if len(parts) == 1 \
+                    else np.concatenate(parts, axis=0)
                 dev_time = time.monotonic() - t_dispatch
             except Exception:
                 parity = None
@@ -350,14 +415,12 @@ class EncodeBatcher:
                 try:
                     chunks = self._cpu_encode(r)
                 except Exception:
-                    import traceback
-                    traceback.print_exc()
+                    self._cb_error()
                     chunks = None
                 try:
                     r.cb(chunks)
                 except Exception:
-                    import traceback
-                    traceback.print_exc()
+                    self._cb_error()
             return
         if dev_time is not None and self.adaptive_cpu and learn:
             self._learn_crossover(reqs, dev_time)
@@ -384,5 +447,4 @@ class EncodeBatcher:
                 r.cb(out)
             except Exception:
                 # a failing continuation affects only its own op
-                import traceback
-                traceback.print_exc()
+                self._cb_error()

@@ -167,6 +167,17 @@ class ECBackend(PGBackend):
         self.subchunk_repairs = 0        # CLAY repairs taken
         self.repair_read_bytes = 0       # bytes those repairs read
         self.repair_whole_bytes = 0      # what whole-chunk would read
+        # pay the pool geometry's one-time costs (device kernel
+        # compile + the crossover router's CPU-rate probe) NOW, in the
+        # background, instead of on the first client op — the
+        # reference pays GF table setup at plugin load
+        # (jerasure_init.cc:37, preloaded at global_init.cc:600)
+        batcher = getattr(host, "encode_batcher", None)
+        if batcher is not None:
+            try:
+                batcher.prewarm(ec_impl, self.sinfo)
+            except Exception:
+                pass
 
     # ------------------------------------------------------------------
     # write path (reference submit_transaction -> start_rmw -> check_ops)

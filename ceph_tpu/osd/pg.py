@@ -45,6 +45,7 @@ from ..msg.messages import (MOSDOp, MOSDOpReply, MOSDPGLog, MOSDPGNotify,
                             MOSDPGQuery, MOSDPGRemove, OSDOp)
 from ..store.objectstore import GHObject, Transaction
 from ..utils.lockdep import make_lock
+from ..utils.log import Dout
 from .backend import OI_ATTR, Mutation, ObjectInfo, build_pg_backend
 from .ecbackend import ECBackend
 from .osdmap import OSDMap, PGPool, PGid, POOL_TYPE_ERASURE
@@ -66,7 +67,8 @@ STATE_PEERING = "peering"
 STATE_ACTIVE = "active"
 
 WRITE_OPS = {"write", "writefull", "append", "create", "delete",
-             "truncate", "setxattr", "rmxattr", "omap_set", "omap_rm",
+             "truncate", "setxattr", "rmxattr", "rmxattrs",
+             "omap_set", "omap_rm",
              "omap_clear", "call", "rollback", "copy_from"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
             "omap_get_by_key", "pgls", "list_snaps",
@@ -361,6 +363,17 @@ class PG:
                 return
             from .osdmap import pg_split_children
             children = pg_split_children(self.pgid.seed, old, new)
+        # create the children OUTSIDE our lock (ensure_pg may take
+        # other PG locks — no pg->pg nesting); their collections must
+        # exist before the move transaction below lands
+        child_pgs = []
+        for c in children:
+            child = self.service.ensure_pg(PGid(self.pgid.pool, c))
+            if child is not None:
+                child_pgs.append((c, child))
+        with self.lock:
+            if self._last_split_pgnum != old:
+                return               # raced a concurrent map advance
 
             def rehash(oid: str) -> int:
                 # snapshot clones ride with their head, matching
@@ -375,6 +388,16 @@ class PG:
                 target = rehash(oid)
                 if target != self.pgid.seed:
                     moves.setdefault(target, []).append(oid)
+            # snapshot BEFORE the destructive in-place work below
+            # (split_out strips log entries/reqids, missing.rm drops
+            # tracking): if the move txn fails — e.g. a replica-op
+            # delete raced the object listing — EVERYTHING rolls back
+            # so the next map advance retries the split instead of
+            # stranding parent data with a half-stripped log
+            import copy
+            log_snapshot = copy.deepcopy(self.log)
+            missing_snapshot = copy.deepcopy(self.missing)
+            prev_adopted = self._split_adopted
             # split the LOG by rehash too (covers deleted/missing oids
             # that no longer exist as store objects)
             entry_moves: Dict[int, set] = {c: set() for c in children}
@@ -405,15 +428,24 @@ class PG:
                         self.coll, GHObject(oid, shard),
                         ccoll, GHObject(oid, shard))
             self._append_pgmeta_ops(txn)
-        # phase 2: create/update the children OUTSIDE our lock (no
-        # pg->pg lock nesting), then apply the object moves
-        for c in children:
-            child_pgid = PGid(self.pgid.pool, c)
-            child = self.service.ensure_pg(child_pgid)
-            if child is not None:
-                child.adopt_split(my_head, child_logs.get(c),
-                                  child_missing.get(c, {}), new, shard)
-        self.store.queue_transactions([txn])
+            # apply UNDER the lock: no client write can interleave
+            # between the in-memory split and its durable txn, so the
+            # rollback above can never clobber a concurrent append
+            try:
+                self.store.queue_transactions([txn])
+            except Exception as e:
+                self.log = log_snapshot
+                self.missing = missing_snapshot
+                self._last_split_pgnum = old
+                self._split_adopted = prev_adopted
+                Dout("osd").dwarn(
+                    "pg %s split %d->%d move txn failed (%r); split "
+                    "state rolled back, will retry on next map "
+                    "advance", self.pgid, old, new, e)
+                return
+        for c, child in child_pgs:
+            child.adopt_split(my_head, child_logs.get(c),
+                              child_missing.get(c, {}), new, shard)
 
     def _child_coll(self, seed: int, shard: int) -> str:
         base = f"{self.pgid.pool}.{seed:x}"
@@ -1127,11 +1159,22 @@ class PG:
                     self._client_ops.pop((msg.client, msg.tid), None)
                     self._reply(conn, msg, -108, [])
                     return
+                # the result must be an EXACT copy: pre-existing
+                # destination xattrs/omap keys absent from the source
+                # must not survive (reference CEPH_OSD_OP_COPY_FROM
+                # replaces the object wholesale).  The clearing ops
+                # resolve at EXECUTION time ("rmxattrs" enumerates the
+                # dest's attrs in _do_write) — this op may yet park
+                # behind an in-flight write whose attrs must also be
+                # cleared, so a name list computed here would be stale.
                 new_ops: List[OSDOp] = []
                 for op in msg.ops:
                     if op.op != "copy_from":
                         new_ops.append(op)
                         continue
+                    new_ops.append(OSDOp("rmxattrs"))
+                    if replicated:
+                        new_ops.append(OSDOp("omap_clear"))
                     new_ops.append(OSDOp("writefull", 0, len(data),
                                          data))
                     for k, v in attrs.items():
@@ -1207,6 +1250,19 @@ class PG:
                 mut.attrs[op.name] = op.data
             elif o == "rmxattr":
                 mut.attrs[op.name] = None
+            elif o == "rmxattrs":
+                # clear ALL user xattrs, resolved at EXECUTION time
+                # (copy_from's exact-copy clearing: resolving earlier
+                # — at fetch completion — would miss attrs written by
+                # ops this one parked behind)
+                try:
+                    cur = self.store.getattrs(
+                        self.coll, GHObject(msg.oid, self.own_shard))
+                except FileNotFoundError:
+                    cur = {}
+                for name in cur:
+                    if name.startswith("u_"):
+                        mut.attrs.setdefault(name[2:], None)
             elif o in ("omap_set", "omap_rm", "omap_clear"):
                 if ec:
                     err = -95            # ENOTSUP on EC pools

@@ -254,3 +254,80 @@ def test_cluster_concurrent_writes_coalesce():
         assert calls < reqs
         for i in range(16):
             assert io.read(f"o{i}") == blob
+
+
+def test_oversized_group_tiles_at_max_stripes(codec):
+    """A dispatch group larger than ec_tpu_batch_stripes is tiled into
+    multiple device calls (bounded per-call memory + a bounded compile
+    shape set), and the reassembled chunks stay bit-exact."""
+    b = make_batcher(ec_tpu_batch_stripes=4,
+                     ec_tpu_queue_window_us=30_000)
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        d1 = os.urandom(7 * 8192)        # 7 stripes > 4-stripe tile
+        d2 = os.urandom(3 * 8192)
+        got = {}
+        done = threading.Event()
+
+        def cb(tag):
+            def _cb(chunks):
+                got[tag] = chunks
+                if len(got) == 2:
+                    done.set()
+            return _cb
+
+        b.submit(codec, sinfo, d1, cb("a"))
+        b.submit(codec, sinfo, d2, cb("b"))
+        assert done.wait(30)
+        assert got["a"] == ecutil.encode(sinfo, codec, d1)
+        assert got["b"] == ecutil.encode(sinfo, codec, d2)
+    finally:
+        b.stop()
+
+
+def test_prewarm_measures_cpu_rate_ahead_of_ops(codec):
+    """prewarm() at EC-backend build fills the crossover router's CPU
+    rate for the geometry BEFORE any client op, and is once-per-
+    geometry process-wide (VERDICT r3 next #1a)."""
+    from ceph_tpu.osd.batcher import _geometry_key
+    b = make_batcher()
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        key = _geometry_key(codec, sinfo)
+        assert key not in EncodeBatcher._cpu_bps
+        b.prewarm(codec, sinfo)
+        deadline = time.time() + 20
+        while key not in EncodeBatcher._cpu_bps \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert EncodeBatcher._cpu_bps.get(key, 0) > 0, \
+            "prewarm did not measure the CPU twin rate"
+        assert key in EncodeBatcher._warmed
+        # second prewarm is a no-op (already warmed)
+        b.prewarm(codec, sinfo)
+    finally:
+        b.stop()
+
+
+def test_stop_drains_inflight_work(codec):
+    """stop() must not return while a device call + continuation are
+    still in flight — OSD shutdown unmounts the store right after, and
+    a late continuation would land in an unmounted store (the r3
+    driver's teardown crash)."""
+    b = make_batcher(ec_tpu_queue_window_us=1000)
+    sinfo = ecutil.StripeInfo(2, 8192)
+    done = threading.Event()
+    orig = codec.encode_batch_async
+
+    def slow(data):
+        time.sleep(0.8)              # a cold compile / busy device
+        return orig(data)
+    codec.encode_batch_async = slow
+    try:
+        b.submit(codec, sinfo, os.urandom(8192), lambda _c: done.set())
+        time.sleep(0.2)              # collector picks the group up
+        b.stop()
+        assert done.is_set(), \
+            "stop() returned before the in-flight continuation ran"
+    finally:
+        del codec.encode_batch_async

@@ -302,10 +302,21 @@ def test_copy_from_server_side():
         assert io.read("dst") == payload
         assert io.getxattr("dst", "user.tag") == b"v1"
         assert io.omap_get("dst") == {"k1": b"a", "k2": b"b"}
-        # overwrite semantics: copy replaces prior content fully
+        # overwrite semantics: copy replaces prior content fully —
+        # INCLUDING pre-existing xattrs/omap keys the source lacks
+        # (ADVICE r3 #3: the result is an exact copy, no stale keys)
         io.write_full("dst2", b"x" * 200_000)
+        io.setxattr("dst2", "stale.attr", b"old")
+        io.omap_set("dst2", {"stalekey": b"old"})
         io.copy_from("dst2", "src")
         assert io.read("dst2") == payload
+        assert io.omap_get("dst2") == {"k1": b"a", "k2": b"b"}
+        try:
+            io.getxattr("dst2", "stale.attr")
+            raise AssertionError("stale xattr survived copy_from")
+        except RadosError:
+            pass
+        assert io.getxattr("dst2", "user.tag") == b"v1"
         # missing source -> ENOENT
         try:
             io.copy_from("dst3", "nosuch")

@@ -205,3 +205,131 @@ def test_mds_standby_failover():
         names = {e["name"] for e in fs.listdir("/fo")}
         assert names == {"x.bin", "after"}, names
         b.shutdown()
+
+
+def test_mdsmap_survives_monitor_restart():
+    """The MDSMap is monitor state (reference MDSMonitor's paxos-
+    persisted FSMap): a monitor restart must come back with the same
+    active assignment and a non-regressing epoch, not reset to epoch 0
+    where the first beacon would steal active (ADVICE r3 #4)."""
+    import tempfile
+
+    from ceph_tpu.cluster import test_config as _mc
+    conf = _mc(mds_beacon_interval=0.2, mds_beacon_grace=30)
+    with tempfile.TemporaryDirectory() as td, \
+            Cluster(n_osds=3, conf=conf, data_dir=td) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("mrm", "replicated", size=2)
+        a = MDSDaemon(c.mon_addr, "mrm", conf=conf,
+                      name="mds.a").start()
+        b = MDSDaemon(c.mon_addr, "mrm", conf=conf,
+                      name="mds.b").start()
+        assert a.active and not b.active
+        ret, _, out = c.mon_command({"prefix": "mds getmap"})
+        assert ret == 0 and out["active"] == "mds.a"
+        epoch_before = out["epoch"]
+
+        c.kill_mon(0)
+        c.revive_mon(0)
+        ret, _, out = c.mon_command({"prefix": "mds getmap"})
+        assert ret == 0
+        assert out["active"] == "mds.a", \
+            "monitor restart lost the active MDS assignment"
+        assert out["epoch"] >= epoch_before
+        # a later-registering daemon still must NOT steal active
+        bb = MDSDaemon(c.mon_addr, "mrm", conf=conf,
+                       name="mds.c").start()
+        time.sleep(0.3)
+        ret, _, out = c.mon_command({"prefix": "mds getmap"})
+        assert out["active"] == "mds.a"
+        for d in (a, b, bb):
+            d.shutdown()
+
+
+def test_zombie_active_is_fenced():
+    """A beacon-silent active that KEEPS RUNNING (partition / long GC
+    pause — exactly the failover trigger) must not interleave journal
+    appends with the promoted standby: the promotion raises the
+    cls_fence epoch on the journal object, so the zombie's next
+    mutation is rejected inside the OSD and it demotes itself
+    (ADVICE r3 #1; reference blocklists the old active's addr via the
+    OSDMap before promoting)."""
+    from ceph_tpu.cluster import test_config as _mc
+    conf = _mc(mds_beacon_interval=0.2, mds_beacon_grace=1.2)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("zfm", "replicated", size=2)
+        c.create_pool("zfd", "replicated", size=2)
+        a = MDSDaemon(c.mon_addr, "zfm", "zfd", conf=conf,
+                      name="mds.a").start()
+        b = MDSDaemon(c.mon_addr, "zfm", "zfd", conf=conf,
+                      name="mds.b").start()
+        assert a.active and not b.active
+        fs_a = MDSClient(c.rados(), a.my_addr, "zfd")  # pinned to a
+        fs_a.mkdir("/pre")
+
+        # partition a from the monitor only: beacons stop, but a still
+        # believes it is active and can still reach the OSDs
+        a._send_beacon = lambda: None
+        deadline = time.time() + 10
+        while not b.active and time.time() < deadline:
+            time.sleep(0.1)
+        assert b.active, "standby was not promoted"
+
+        # the zombie's mutation must be fenced out, not applied
+        with pytest.raises(FSError):
+            fs_a.mkdir("/zombie-dir")
+        assert not a.active, "fenced active did not demote itself"
+
+        # namespace integrity: the promoted active never sees the
+        # zombie's rejected mutation, and keeps serving
+        fs = MDSClient(c.rados(), None, "zfd")
+        fs.mkdir("/post")
+        names = {e["name"] for e in fs.listdir("/")}
+        assert "zombie-dir" not in names
+        assert {"pre", "post"} <= names
+        a.shutdown()
+        b.shutdown()
+
+
+def test_zombie_checkpoint_is_fenced():
+    """The zombie's CHECKPOINT (watermark write + journal trim) must
+    be fenced like its appends — an unguarded trim would erase the
+    successor's journal entries and a stale watermark write would
+    regress the applied-through seq."""
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.cluster import test_config as _mc
+    from ceph_tpu.mds.daemon import JOURNAL_OID
+    conf = _mc(mds_beacon_interval=0.2, mds_beacon_grace=1.2)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("zcm", "replicated", size=2)
+        a = MDSDaemon(c.mon_addr, "zcm", conf=conf,
+                      name="mds.a").start()
+        b = MDSDaemon(c.mon_addr, "zcm", conf=conf,
+                      name="mds.b").start()
+        assert a.active and not b.active
+        a._send_beacon = lambda: None    # partition a from the mon
+        deadline = time.time() + 10
+        while not b.active and time.time() < deadline:
+            time.sleep(0.1)
+        assert b.active
+
+        # the promoted active journals a mutation
+        fs = MDSClient(c.rados(), None, "zcm")
+        fs.mkdir("/survives")
+        io = c.rados().open_ioctx("zcm")
+        journal_before = io.read(JOURNAL_OID)
+        assert b"survives" in journal_before
+
+        # the zombie tries to checkpoint: fenced + demoted, and the
+        # successor's journal entries remain intact
+        with pytest.raises(RadosError):
+            a._checkpoint()
+        assert not a.active
+        assert io.read(JOURNAL_OID) == journal_before
+        a.shutdown()
+        b.shutdown()

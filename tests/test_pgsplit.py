@@ -248,3 +248,48 @@ def test_split_survives_osd_restart():
         c.wait_for_clean(90)
         for name, blob in blobs.items():
             assert io.read(name, len(blob)) == blob, name
+
+
+def test_split_retries_after_failed_move_txn():
+    """A failed object-move transaction must NOT strand the split:
+    the in-memory anchor rolls back so the next map advance retries
+    (ADVICE r3 #2 — previously the anchor advanced first, the failure
+    was swallowed, and parent data was stranded forever)."""
+    conf = make_conf()
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rp5", "replicated", pg_num=2, size=2)
+        io = c.rados().open_ioctx("rp5")
+        blobs = _write_objects(io, 12, seed=11)
+        c.wait_for_clean(30)
+
+        # every OSD's first move txn fails (as if a replica op raced
+        # the object listing); subsequent txns go through
+        for osd in c.osds.values():
+            store = osd.store
+            orig = store.queue_transactions
+            state = {"failed": False}
+
+            def wrapper(txns, _orig=orig, _state=state):
+                if not _state["failed"] and any(
+                        op[0] == "coll_move_rename"
+                        for t in txns for op in t.ops):
+                    _state["failed"] = True
+                    raise RuntimeError("injected: move txn lost a race")
+                return _orig(txns)
+            store.queue_transactions = wrapper
+
+        rc, msg, _ = c.mon_command(
+            {"prefix": "osd pool set", "pool": "rp5", "var": "pg_num",
+             "val": "4"})
+        assert rc == 0, msg
+        # the first split attempt fails on every OSD; the retry (next
+        # map advance — pg stats / tick publishes keep epochs moving)
+        # must complete it.  Nudge an epoch in case none is in flight.
+        time.sleep(0.5)
+        c.mon_command({"prefix": "osd pool set", "pool": "rp5",
+                       "var": "pg_num", "val": "4"})
+        c.wait_for_clean(90)
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
