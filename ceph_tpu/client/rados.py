@@ -60,6 +60,11 @@ class Completion:
 
     def wait(self, timeout: Optional[float] = None) -> int:
         if not self._ev.wait(timeout):
+            # vacate the objecter's inflight window (a timed-out op
+            # left in place would permanently shrink the
+            # objecter_inflight_ops/bytes window until the whole
+            # client wedged)
+            self._objecter.cancel(self.tid)
             raise TimeoutError(f"op tid={self.tid} timed out")
         return self.result
 
@@ -77,6 +82,8 @@ class _InflightOp:
         self.ops = ops
         self.completion = completion
         self.pgid_seed = pgid_seed     # explicit PG target (pgls)
+        self.is_write = False          # tier routing (write_tier)
+        self.bypass_tier = False       # IGNORE_OVERLAY (internal IO)
         self.target_osd: Optional[int] = None
         self.sent_epoch = 0
         self.trace_id = 0
@@ -98,6 +105,14 @@ class Objecter(Dispatcher):
         self.map_ready = threading.Event()
         self._next_tid = 0
         self.inflight: Dict[int, _InflightOp] = {}
+        # client op/byte windows (reference objecter_inflight_ops /
+        # objecter_inflight_op_bytes throttles, osdc/Objecter.cc
+        # op_throttle_*): submit blocks while the window is full
+        self._max_inflight = self.conf["objecter_inflight_ops"]
+        self._max_inflight_bytes = \
+            self.conf["objecter_inflight_op_bytes"]
+        self._inflight_bytes = 0
+        self._window = threading.Condition(self.lock)
         # lingering registrations (reference Objecter linger ops):
         # re-sent whenever the target moves — the watch machinery
         self.lingers: Dict[int, _InflightOp] = {}
@@ -137,26 +152,53 @@ class Objecter(Dispatcher):
     # ------------------------------------------------------------------
     def submit(self, pool: int, oid: str, ops: List[OSDOp],
                pgid_seed: Optional[int] = None,
+               bypass_tier: bool = False,
                trace_id: int = 0,
                snapc: Tuple[int, List[int]] = (0, []),
                snapid: int = 0) -> Completion:
+        from ..osd.pg import WRITE_OPS
+        is_write = any(o.op in WRITE_OPS for o in ops)
+        nbytes = sum(len(o.data) for o in ops if o.data)
         with self.lock:
+            while self.inflight and (
+                    len(self.inflight) >= self._max_inflight
+                    or self._inflight_bytes + nbytes
+                    > self._max_inflight_bytes):
+                self._window.wait(1.0)
             self._next_tid += 1
             tid = self._next_tid
             completion = Completion(self, tid)
             op = _InflightOp(tid, pool, oid, ops, completion,
                              pgid_seed=pgid_seed)
+            op.nbytes = nbytes
+            op.is_write = is_write
+            op.bypass_tier = bypass_tier
             op.trace_id = trace_id
             op.snapc = snapc
             op.snapid = snapid
             self.inflight[tid] = op
+            self._inflight_bytes += nbytes
         self._send_op(op)
         return completion
+
+    def _route_pool(self, osdmap: OSDMap, op: _InflightOp) -> int:
+        """Cache-tier overlay routing (reference Objecter::
+        _calc_target honoring pg_pool_t read_tier/write_tier,
+        osdc/Objecter.cc:2766): ops on a base pool with an overlay go
+        to the tier pool; the tier's PGs promote/serve/flush."""
+        pool = osdmap.pools.get(op.pool)
+        if pool is None or op.pgid_seed is not None or \
+                getattr(op, "bypass_tier", False):
+            return op.pool
+        if op.is_write:
+            return pool.write_tier if pool.write_tier >= 0 else op.pool
+        return pool.read_tier if pool.read_tier >= 0 else op.pool
 
     def _pgid_of(self, osdmap: OSDMap, op: _InflightOp) -> PGid:
         if op.pgid_seed is not None:
             return PGid(op.pool, op.pgid_seed)
-        return osdmap.object_locator_to_pg(op.oid, op.pool)
+        routed = self._route_pool(osdmap, op)
+        return osdmap.object_locator_to_pg(op.oid, routed)
 
     def _target_of(self, op: _InflightOp) -> Optional[int]:
         with self.lock:
@@ -191,14 +233,26 @@ class Objecter(Dispatcher):
             self._osd_conns[primary] = conn
         conn.send_message(MOSDOp(
             client=self.msgr.name, tid=op.tid, epoch=osdmap.epoch,
-            pool=op.pool, oid=op.oid, ops=op.ops,
+            pool=self._route_pool(osdmap, op), oid=op.oid, ops=op.ops,
             pgid_seed=pgid.seed, trace_id=op.trace_id,
             snap_seq=op.snapc[0], snaps=list(op.snapc[1]),
             snapid=op.snapid))
 
+    def cancel(self, tid: int) -> None:
+        """Drop a timed-out/abandoned op from the window (reference
+        Objecter::op_cancel).  A reply that already raced in wins."""
+        with self.lock:
+            self._retire(tid)
+
+    def _retire(self, tid: int) -> None:
+        op = self.inflight.pop(tid, None)
+        if op is not None:
+            self._inflight_bytes -= getattr(op, "nbytes", 0)
+            self._window.notify_all()
+
     def _fail_op(self, op: _InflightOp, result: int) -> None:
         with self.lock:
-            self.inflight.pop(op.tid, None)
+            self._retire(op.tid)
         op.completion._complete(MOSDOpReply(tid=op.tid, result=result))
 
     # ------------------------------------------------------------------
@@ -236,7 +290,7 @@ class Objecter(Dispatcher):
             threading.Timer(0.05, self._send_op, args=(op,)).start()
             return True
         with self.lock:
-            self.inflight.pop(msg.tid, None)
+            self._retire(msg.tid)
         op.completion._complete(msg)
         return True
 
@@ -334,6 +388,10 @@ class IoCtx:
         # selfmanaged write SnapContext; None = derive from pool snaps
         # (reference librados snapc handling, IoCtxImpl snapc member)
         self._snapc: Optional[Tuple[int, List[int]]] = None
+        # tier-overlay bypass (reference CEPH_OSD_FLAG_IGNORE_OVERLAY):
+        # the OSD's internal promote/flush IO must hit the BASE pool
+        # directly or it would loop through its own cache redirect
+        self._bypass_tier = False
         self._read_snap = 0            # snap_set_read target (0 = head)
         self._watch_lingers: Dict[Tuple[str, int], int] = {}
 
@@ -366,7 +424,8 @@ class IoCtx:
             trace_id=span.trace_id if span else 0,
             snapc=self._write_snapc() if is_write else (0, []),
             snapid=0 if (is_write or head_pinned)
-            else self._read_snap)
+            else self._read_snap,
+            bypass_tier=self._bypass_tier)
         try:
             res = c.wait(timeout)
         finally:
@@ -409,6 +468,16 @@ class IoCtx:
 
     def omap_rm_keys(self, oid: str, keys: List[str]) -> None:
         self._obj_op(oid, [OSDOp("omap_rm", name=k) for k in keys])
+
+    def cache_flush(self, oid: str) -> None:
+        """Force a dirty tier object back to the base pool (reference
+        CEPH_OSD_OP_CACHE_FLUSH; address the CACHE pool directly)."""
+        self._obj_op(oid, [OSDOp("cache_flush")])
+
+    def cache_evict(self, oid: str) -> None:
+        """Drop a clean object from the cache tier (reference
+        CEPH_OSD_OP_CACHE_EVICT)."""
+        self._obj_op(oid, [OSDOp("cache_evict")])
 
     def exec_cls(self, oid: str, cls: str, method: str,
                  indata: bytes = b"") -> bytes:
@@ -628,17 +697,22 @@ class Rados:
 
     def __init__(self, mon_addr: Tuple[str, int],
                  conf: Optional[Config] = None,
-                 op_timeout: float = 30.0):
+                 op_timeout: Optional[float] = None):
         import secrets
         n = secrets.randbits(48)
         self.conf = conf or default_config()
+        if op_timeout is None:
+            # reference rados_osd_op_timeout; its 0-means-never is a
+            # hang in tests, so 0 falls back to the library default
+            op_timeout = self.conf["rados_osd_op_timeout"] or 30.0
         self.op_timeout = op_timeout
         self.tracer = None
         if self.conf["rados_tracing"]:
             from ..utils.tracer import Tracer
             self.tracer = Tracer(
                 "client", enabled=True,
-                sample_every=self.conf["trace_sample_every"])
+                sample_every=self.conf["trace_sample_every"],
+                keep=self.conf["trace_keep_spans"])
         self.msgr = Messenger(f"client.{n}", conf=self.conf)
         self.monc = MonClient(self.msgr, mon_addr,
                               map_cb=self._on_map)
@@ -664,8 +738,11 @@ class Rados:
         self.shutdown()
 
     # ------------------------------------------------------------------
-    def mon_command(self, cmd: dict, timeout: float = 30.0
+    def mon_command(self, cmd: dict,
+                    timeout: Optional[float] = None
                     ) -> Tuple[int, str, dict]:
+        if timeout is None:              # reference rados_mon_op_timeout
+            timeout = self.conf["rados_mon_op_timeout"]
         return self.monc.command(cmd, timeout)
 
     def open_ioctx(self, pool_name: str) -> IoCtx:

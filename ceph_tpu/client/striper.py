@@ -130,7 +130,18 @@ class StripedIoCtx:
 
     def __init__(self, ioctx: IoCtx, layout: Optional[Layout] = None):
         self.ioctx = ioctx
-        self.default_layout = layout or Layout()
+        if layout is None:
+            try:
+                conf = ioctx.rados.conf   # the CLUSTER's config, not
+                # the process-global default (per-cluster overrides
+                # must be honored)
+                layout = Layout(
+                    stripe_unit=conf["fs_default_stripe_unit"],
+                    stripe_count=conf["fs_default_stripe_count"],
+                    object_size=conf["fs_default_object_size"])
+            except Exception:
+                layout = Layout()
+        self.default_layout = layout
 
     # -- metadata ------------------------------------------------------
     def _meta_oid(self, soid: str) -> str:
@@ -160,6 +171,15 @@ class StripedIoCtx:
                             json.dumps(layout.dump()).encode())
 
     # -- data ----------------------------------------------------------
+    def _check_file_size(self, end: int) -> None:
+        try:
+            limit = self.ioctx.rados.conf["mds_max_file_size"]
+        except Exception:
+            return
+        if end > limit:
+            raise ValueError(
+                f"write past mds_max_file_size ({end} > {limit})")
+
     def write(self, soid: str, data: bytes, offset: int = 0,
               layout: Optional[Layout] = None) -> None:
         """Scatter one logical write across the objects it touches
@@ -171,6 +191,7 @@ class StripedIoCtx:
                 raise
             layout = layout or self.default_layout
             size = 0
+        self._check_file_size(offset + len(data))
         completions = []
         for ext in file_to_extents(soid, layout, offset, len(data)):
             buf = b"".join(
@@ -229,6 +250,7 @@ class StripedIoCtx:
         RadosStriperImpl::trunc): drop whole objects past the end,
         truncate the boundary object, update the size xattr."""
         size, layout = self._load_meta(soid)
+        self._check_file_size(new_size)
         if new_size >= size:
             self._store_meta(soid, new_size, layout)
             return

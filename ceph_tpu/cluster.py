@@ -41,6 +41,10 @@ def test_config(**overrides) -> Config:
         # threads for seconds; a tight grace fabricates OSD failures
         "osd_heartbeat_grace": 3.0,
         "mon_tick_interval": 0.2,
+        "osd_tick_interval": 0.2,
+        # the reference's ssd-tuned recovery concurrency (10) thrashes
+        # a single-core test host; pin the classic 3
+        "osd_recovery_max_active": 3,
         "mon_osd_down_out_interval": 3.0,
         "osd_pool_default_pg_num": 8,
     }
@@ -56,11 +60,16 @@ class Cluster:
                  conf: Optional[Config] = None,
                  n_mons: int = 1,
                  with_mgr: bool = False,
-                 store_kind: str = "file"):
+                 store_kind: Optional[str] = None):
         self.n_osds = n_osds
         self.n_mons = n_mons
         self.with_mgr = with_mgr
-        self.store_kind = store_kind     # file | block (with data_dir)
+        # file | block (with data_dir); default from osd_objectstore
+        # (reference osd_objectstore picks the ObjectStore backend)
+        conf0 = conf or test_config()
+        self.store_kind = store_kind if store_kind is not None else (
+            "file" if conf0["osd_objectstore"] == "memstore"
+            else conf0["osd_objectstore"])
         self.mgr = None
         self.data_dir = data_dir
         self.conf = conf or test_config()
@@ -83,7 +92,8 @@ class Cluster:
                     "store_kind='block' needs a data_dir (a durable "
                     "backend silently downgraded to MemStore would "
                     "lose data)")
-            store = MemStore()
+            store = MemStore(
+                max_bytes=self.conf["memstore_max_bytes"])
             store.mkfs()
         else:
             path = os.path.join(self.data_dir, f"osd.{osd_id}")
@@ -91,7 +101,8 @@ class Cluster:
                 from .store.blockstore import BlockStore
                 store = BlockStore(path)
             else:
-                store = FileStore(path)
+                store = FileStore(path,
+                                  fsync=self.conf["filestore_fsync"])
             if not os.path.exists(os.path.join(path, "meta.kv")):
                 store.mkfs()
         return store
@@ -277,7 +288,7 @@ class Cluster:
             h = self.health()
             if h.get("all_clean"):
                 return time.monotonic() - t0
-            time.sleep(0.1)
+            time.sleep(self.conf["client_retry_interval"])
         raise TimeoutError(
             f"cluster not clean after {timeout}s: {self.health()}")
 
@@ -289,7 +300,7 @@ class Cluster:
                 for o in out.get("osds", []):
                     if o["osd"] == osd_id and o["up"]:
                         return
-            time.sleep(0.1)
+            time.sleep(self.conf["client_retry_interval"])
         raise TimeoutError(f"osd.{osd_id} not up after {timeout}s")
 
     def wait_for_osd_down(self, osd_id: int,
@@ -301,5 +312,5 @@ class Cluster:
                 for o in out.get("osds", []):
                     if o["osd"] == osd_id and not o["up"]:
                         return
-            time.sleep(0.1)
+            time.sleep(self.conf["client_retry_interval"])
         raise TimeoutError(f"osd.{osd_id} still up after {timeout}s")

@@ -120,11 +120,21 @@ class _Registry:
     def supported(self) -> List[str]:
         return sorted(self._by_name)
 
-    def create(self, name: str) -> Compressor:
+    def create(self, name: str, conf=None) -> Compressor:
         cls = self._by_name.get(name)
         if cls is None:
             raise KeyError(f"no compressor {name!r} "
                            f"(have {self.supported()})")
+        if name == "zlib":
+            # reference compressor_zlib_level (from the caller's conf
+            # so per-cluster overrides apply; global default otherwise)
+            try:
+                if conf is None:
+                    from ..utils.config import default_config
+                    conf = default_config()
+                return cls(level=conf["compressor_zlib_level"])
+            except Exception:
+                pass
         return cls()
 
     def create_by_id(self, numeric_id: int) -> Compressor:

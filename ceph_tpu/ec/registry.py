@@ -102,10 +102,52 @@ class ErasureCodePluginRegistry:
         return instance
 
     def preload(self, names: str) -> None:
-        """Preload a comma-separated plugin list (reference :184-200)."""
-        for name in filter(None, (n.strip() for n in names.split(","))):
+        """Preload a comma/space-separated plugin list (reference
+        :184-200)."""
+        for name in filter(None,
+                           (n.strip() for n in
+                            names.replace(",", " ").split())):
             if self.get(name) is None:
                 self.load(name)
+
+    def preload_from_conf(self, conf) -> None:
+        """Daemon-start preload (reference global_init.cc:600 preloads
+        osd_erasure_code_plugins; erasure_code_dir names the
+        out-of-tree plugin directory).  Missing optional plugins are
+        skipped, as the reference logs-and-continues."""
+        try:
+            self.preload(conf["osd_erasure_code_plugins"])
+        except KeyError:
+            pass
+        ext_dir = conf["erasure_code_dir"]
+        if ext_dir:
+            self.load_dir(ext_dir)
+
+    def load_dir(self, path: str) -> None:
+        """The dlopen analog for out-of-tree plugins: import every
+        ``ec_plugin_*.py`` in ``path`` and run its
+        __erasure_code_init__ (reference load() scanning
+        libec_<name>.so under erasure_code_dir)."""
+        import importlib.util
+        import os
+        if not os.path.isdir(path):
+            return
+        for fn in sorted(os.listdir(path)):
+            if not (fn.startswith("ec_plugin_") and fn.endswith(".py")):
+                continue
+            spec = importlib.util.spec_from_file_location(
+                fn[:-3], os.path.join(path, fn))
+            if spec is None or spec.loader is None:
+                continue
+            mod = importlib.util.module_from_spec(spec)
+            try:
+                spec.loader.exec_module(mod)
+                entry = getattr(mod, "__erasure_code_init__", None)
+                if entry is not None:
+                    entry(self)
+            except Exception:
+                continue             # a broken plugin must not block
+                                     # the rest (broken-plugin tests)
 
 
 def instance() -> ErasureCodePluginRegistry:

@@ -63,10 +63,20 @@ class FileSystem:
                  layout: Optional[Layout] = None):
         self.meta = meta
         self.data = data or meta
-        self.striper = StripedIoCtx(
-            self.data, layout or Layout(stripe_unit=64 << 10,
-                                        stripe_count=1,
-                                        object_size=4 << 20))
+        if layout is None:
+            # fs_default_* options (reference fs_types default layout;
+            # stripe_count stays 1 here — the daemonless library mode
+            # keeps objects self-contained per stripe unit)
+            try:
+                conf = meta.rados.conf   # the cluster's config
+            except AttributeError:
+                from ..utils.config import default_config
+                conf = default_config()
+            layout = Layout(
+                stripe_unit=conf["fs_default_stripe_unit"],
+                stripe_count=1,
+                object_size=conf["fs_default_object_size"])
+        self.striper = StripedIoCtx(self.data, layout)
         self._ensure_root()
 
     # -- bootstrap -----------------------------------------------------

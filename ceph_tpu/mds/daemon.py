@@ -48,8 +48,8 @@ from ..utils.log import Dout
 
 JOURNAL_OID = "mds.journal"          # reference MDLog journal objects
 JOURNAL_HEAD = "mds.journal.head"    # checkpoint: applied-through seq
-CHECKPOINT_EVERY = 64                # ops between journal trims
-RECALL_TIMEOUT = 2.0                 # s before a recall is forced
+# journal trim cadence + forced-recall timeout come from conf
+# (mds_journal_checkpoint_interval / mds_recall_timeout)
 
 
 class _Cap:
@@ -96,6 +96,9 @@ class MDSDaemon(Dispatcher):
         # keep working
         self.active = True
         self._last_beacon = 0.0
+        self._checkpoint_every = \
+            self.conf["mds_journal_checkpoint_interval"]
+        self._recall_timeout = self.conf["mds_recall_timeout"]
         # mdsmap epoch we last held a role at: stamps every journal
         # append (cls_fence guard) so a deposed active's writes are
         # rejected atomically inside the OSD — the reference fences
@@ -310,7 +313,7 @@ class MDSDaemon(Dispatcher):
         self._apply(ent)
         self._applied = ent["seq"]
         self._since_checkpoint += 1
-        if self._since_checkpoint >= CHECKPOINT_EVERY:
+        if self._since_checkpoint >= self._checkpoint_every:
             self._checkpoint()
         return ent["seq"]
 
@@ -443,7 +446,7 @@ class MDSDaemon(Dispatcher):
                 now = time.monotonic()
                 stale = [ino for ino, t0 in
                          self._recall_started.items()
-                         if now - t0 > RECALL_TIMEOUT]
+                         if now - t0 > self._recall_timeout]
                 for ino in stale:
                     self.log.dout(1, f"recall timeout ino {ino}")
                     self._revoke(ino)

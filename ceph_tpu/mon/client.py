@@ -224,6 +224,8 @@ class MonClient(Dispatcher):
                         failed_for=failed_for, epoch=epoch))
 
     def send_pg_stats(self, from_osd: int, epoch: int,
-                      pg_stats: Dict[str, dict]) -> None:
+                      pg_stats: Dict[str, dict],
+                      osd_stat: Optional[dict] = None) -> None:
         self._mon_conn().send_message(
-            MPGStats(from_osd=from_osd, epoch=epoch, pg_stats=pg_stats))
+            MPGStats(from_osd=from_osd, epoch=epoch, pg_stats=pg_stats,
+                     osd_stat=osd_stat))

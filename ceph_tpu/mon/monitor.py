@@ -62,15 +62,26 @@ class MonitorDBStore:
     full OSDMap per epoch under ``osdmap.<epoch>``, plus the latest
     committed epoch pointer — a monitor restart resumes from here."""
 
-    def __init__(self, path: str = ""):
-        self.db: KeyValueDB = LogDB(os.path.join(path, "mon.db")) \
+    def __init__(self, path: str = "", compact_on_open: bool = False,
+                 compact_factor: int = 4):
+        self.db: KeyValueDB = LogDB(os.path.join(path, "mon.db"),
+                                    compact_factor=compact_factor) \
             if path else MemDB()
         self.db.open()
+        if compact_on_open and hasattr(self.db, "compact"):
+            self.db.compact()        # reference mon_compact_on_start
 
-    def put_map(self, epoch: int, wire: dict) -> None:
+    def put_map(self, epoch: int, wire: dict,
+                keep_epochs: int = 500) -> None:
+        """Persist one epoch and trim history older than
+        ``keep_epochs`` (reference mon_min_osdmap_epochs + PaxosService
+        trim) so a long-lived monitor's store stays bounded."""
         batch = WriteBatch()
         batch.set(f"osdmap.{epoch:010d}", json.dumps(wire).encode())
         batch.set("osdmap.last", str(epoch).encode())
+        stale = epoch - keep_epochs
+        if stale > 0 and self.db.get(f"osdmap.{stale:010d}"):
+            batch.rm(f"osdmap.{stale:010d}")
         self.db.submit(batch, sync=True)
 
     def last_epoch(self) -> int:
@@ -108,7 +119,10 @@ class Monitor(Dispatcher):
         self.conf = conf or default_config()
         self.log = Dout("mon", f"{name} ")
         self.lock = make_lock("mon")
-        self.store = MonitorDBStore(data_path)
+        self.store = MonitorDBStore(
+            data_path,
+            compact_on_open=self.conf["mon_compact_on_start"],
+            compact_factor=self.conf["kv_compact_factor"])
         self.osdmap = OSDMap()
         self.ec_registry = ec_registry.instance()
         # subscribers: conn -> next epoch wanted (reference
@@ -119,6 +133,8 @@ class Monitor(Dispatcher):
         self.failure_reports: Dict[int, Dict[int, Tuple[float, float]]] = {}
         self.pg_stats: Dict[str, dict] = {}
         self.pg_stats_from: Dict[str, int] = {}
+        self.osd_stats: Dict[int, dict] = {}     # osd -> osd_stat_t
+        self._data_path = data_path
         # MDSMap (reference mon/MDSMonitor.cc reduced to one active +
         # standbys with beacon-grace failover); leader-local, persisted
         self.mds_map: Dict = {"epoch": 0, "active": None,
@@ -246,7 +262,9 @@ class Monitor(Dispatcher):
                     raise Monitor.NoQuorum(
                         "no quorum majority, map change rejected")
             self.osdmap = candidate
-            self.store.put_map(epoch, wire)
+            self.store.put_map(
+                epoch, wire,
+                keep_epochs=self.conf["mon_min_osdmap_epochs"])
             self._persist_keyring()
             targets = [(conn, since) for conn, since in self.subs.items()
                        if since <= epoch]
@@ -274,7 +292,9 @@ class Monitor(Dispatcher):
             if version <= self.osdmap.epoch:
                 return
             self.osdmap = OSDMap.from_wire_dict(wire)
-            self.store.put_map(version, wire)
+            self.store.put_map(
+                version, wire,
+                keep_epochs=self.conf["mon_min_osdmap_epochs"])
             targets = [(conn, since) for conn, since in self.subs.items()
                        if since <= version]
             for conn, _ in targets:
@@ -375,6 +395,11 @@ class Monitor(Dispatcher):
             self._booted_addr[osd] = addr
             inc = self._pending()
             inc.new_up[osd] = addr
+            if info is not None and info.weight == 0 and \
+                    self.conf["mon_osd_auto_mark_in"]:
+                # a booting OSD that was auto-marked out comes back in
+                # (reference mon_osd_auto_mark_booting_in semantics)
+                inc.new_weight[osd] = 0x10000
             crush = self.osdmap.crush
             if f"osd.{osd}" not in crush.name_ids:
                 # auto-create the crush item under a per-OSD host
@@ -438,6 +463,8 @@ class Monitor(Dispatcher):
     # ------------------------------------------------------------------
     def _handle_pg_stats(self, conn: Connection, msg: MPGStats) -> None:
         with self.lock:
+            if msg.osd_stat:
+                self.osd_stats[msg.from_osd] = msg.osd_stat
             for pgid, stat in msg.pg_stats.items():
                 old = self.pg_stats.get(pgid)
                 if old is not None and old.get("_epoch", 0) > msg.epoch:
@@ -481,7 +508,40 @@ class Monitor(Dispatcher):
             status = "HEALTH_WARN"
         else:
             status = "HEALTH_WARN"
+        # fullness health (reference OSD_FULL/OSD_NEARFULL checks,
+        # mon_osd_full_ratio / mon_osd_nearfull_ratio)
+        full, nearfull = [], []
+        for osd, st in self.osd_stats.items():
+            kb = st.get("kb", 0)
+            if not kb:
+                continue
+            ratio = st.get("kb_used", 0) / kb
+            if ratio >= self.conf["mon_osd_full_ratio"]:
+                full.append(osd)
+            elif ratio >= self.conf["mon_osd_nearfull_ratio"]:
+                nearfull.append(osd)
+        checks = {}
+        if full:
+            checks["OSD_FULL"] = sorted(full)
+            status = "HEALTH_ERR"
+        if nearfull:
+            checks["OSD_NEARFULL"] = sorted(nearfull)
+            if status == "HEALTH_OK":
+                status = "HEALTH_WARN"
+        # mon data dir free space (reference mon_data_avail_warn)
+        warn_pct = self.conf["mon_data_avail_warn"]
+        if self._data_path and warn_pct:
+            try:
+                st = os.statvfs(self._data_path)
+                avail_pct = 100 * st.f_bavail // max(st.f_blocks, 1)
+                if avail_pct < warn_pct:
+                    checks["MON_DISK_LOW"] = avail_pct
+                    if status == "HEALTH_OK":
+                        status = "HEALTH_WARN"
+            except OSError:
+                pass
         return {"status": status, "num_pgs": expected,
+                "checks": checks,
                 "num_pgs_reported": known, "pg_states": states,
                 "num_scrub_errors": scrub_errors,
                 "all_clean": expected > 0 and known >= expected
@@ -511,8 +571,18 @@ class Monitor(Dispatcher):
         inc = None
         with self.lock:
             now_epoch = self.osdmap.epoch
+            n_total = len(self.osdmap.osds)
+            n_in = sum(1 for i in self.osdmap.osds.values()
+                       if i.weight > 0)
             for osd, info in self.osdmap.osds.items():
                 if info.up or info.weight == 0:
+                    continue
+                # reference mon_osd_min_in_ratio: never auto-out past
+                # the point where too little of the cluster remains in
+                # (n_in tracks the outs THIS tick would make, so one
+                # batch can't cross the floor)
+                if n_total and (n_in - 1) / n_total < \
+                        self.conf["mon_osd_min_in_ratio"]:
                     continue
                 # age by epochs-as-time: down_at records the epoch; use
                 # wall time via _down_since bookkeeping instead
@@ -523,6 +593,7 @@ class Monitor(Dispatcher):
                     if inc is None:
                         inc = self._pending()
                     inc.new_weight[osd] = 0
+                    n_in -= 1
                     self.log.dout(1, f"osd.{osd} down > {down_out}s:"
                                   f" marking out")
             for osd in list(self._down_since):
@@ -651,10 +722,44 @@ class Monitor(Dispatcher):
             if self.osdmap.get_pool(name) is not None:
                 return (0, f"pool {name} exists", {})
             pid = self.osdmap._next_pool_id
+        # the framework's placement IS hashpspool placement; the
+        # legacy pre-hashpspool hashing was never implemented, so
+        # turning the default flag off is an explicit unsupported
+        if not self.conf["osd_pool_default_flag_hashpspool"]:
+            return (-95, "non-hashpspool placement is not "
+                         "supported", {})
+        # pgp_num decoupling (placement subsetting) is likewise not
+        # implemented: a default differing from pg_num must fail
+        # loudly, not silently place with pg_num
+        pgp_default = self.conf["osd_pool_default_pgp_num"]
+        if pgp_default and pgp_default != pg_num:
+            return (-95, "pgp_num != pg_num is not supported", {})
+        # reference mon_max_pg_per_osd pool-creation guard; counts PG
+        # INSTANCES (pg_num x size) on both sides, so a wide pool
+        # can't slip under the limit by its bare pg_num
+        def _pg_guard(new_size: int):
+            with self.lock:
+                n_osds = max(1, sum(1 for i in
+                                    self.osdmap.osds.values() if i.up))
+                total = sum(p.pg_num * p.size
+                            for p in self.osdmap.pools.values())
+            limit = self.conf["mon_max_pg_per_osd"] * n_osds
+            if total + pg_num * new_size > limit:
+                return (-34, f"pool would push pg-instance count past "
+                             f"mon_max_pg_per_osd ({limit})", {})
+            return None
         if pool_type == POOL_TYPE_ERASURE:
             prof_name = cmd.get("erasure_code_profile", "default")
             with self.lock:
                 prof = self.osdmap.erasure_code_profiles.get(prof_name)
+            if prof is None and prof_name == "default":
+                # reference osd_pool_default_erasure_code_profile:
+                # an unregistered 'default' comes from config
+                prof = dict(
+                    kv.split("=", 1) for kv in
+                    self.conf[
+                        "osd_pool_default_erasure_code_profile"
+                    ].split())
             if prof is None:
                 return (-2, f"no erasure profile {prof_name}", {})
             check = dict(prof)
@@ -665,12 +770,16 @@ class Monitor(Dispatcher):
                 return (-22, f"profile {prof_name} invalid: {e}", {})
             k = ec.get_data_chunk_count()
             size = ec.get_chunk_count()
+            guard = _pg_guard(size)
+            if guard is not None:
+                return guard
             m = size - k
             # reference: EC min_size = k + min(1, m) (can't serve
             # writes below k shards; one spare before inactivity)
             min_size = k + (1 if m >= 2 else 0)
-            stripe_unit = int(prof.get("stripe_unit",
-                                       DEFAULT_STRIPE_UNIT))
+            stripe_unit = int(prof.get(
+                "stripe_unit",
+                self.conf["osd_pool_erasure_code_stripe_unit"]))
             stripe_width = k * stripe_unit
             rule_name = cmd.get("rule", f"ecrule_{prof_name}")
             failure_domain = prof.get("crush-failure-domain", "host")
@@ -693,21 +802,31 @@ class Monitor(Dispatcher):
                               crush_rule=rule_id,
                               erasure_code_profile=prof_name,
                               stripe_width=stripe_width,
-                              ec_overwrites=False)
+                              ec_overwrites=False,
+                              fast_read=self.conf[
+                                  "osd_pool_default_ec_fast_read"])
                 inc = self._pending()
                 inc.new_crush = crush
                 inc.new_pools[pid] = pool
                 self._commit(inc)
         else:
             size = int(cmd.get("size", self.conf["osd_pool_default_size"]))
+            if size == 1 and not self.conf["mon_allow_pool_size_one"]:
+                return (-1, "pool size 1 forbidden by "
+                            "mon_allow_pool_size_one=false", {})
+            guard = _pg_guard(size)
+            if guard is not None:
+                return guard
             min_size = int(cmd.get("min_size") or
                            self.conf["osd_pool_default_min_size"] or
                            max(1, size - size // 2))
             with self.lock:
                 crush = self.osdmap.crush
+                default_rule = self.conf[
+                    "osd_pool_default_crush_rule"] or "replicated_rule"
                 try:
                     rule_id = crush.rule_id(cmd.get("rule",
-                                                    "replicated_rule"))
+                                                    default_rule))
                 except KeyError:
                     return (-2, "no such crush rule", {})
                 pool = PGPool(name=name, pool_id=pid,
@@ -756,7 +875,8 @@ class Monitor(Dispatcher):
     def _mds_tick(self) -> None:
         """Fail over a beacon-silent active MDS to the freshest
         standby (reference MDSMonitor::tick beacon grace)."""
-        grace = self.conf["mds_beacon_grace"]
+        grace = self.conf["mds_beacon_grace"] * \
+            self.conf["mon_mds_beacon_grace_factor"]
         now = time.monotonic()
         with self.lock:
             m = self.mds_map
@@ -818,12 +938,126 @@ class Monitor(Dispatcher):
                 if n > 65536:
                     return (-22, "pg_num too large", {})
                 newpool.pg_num = n
+            elif var == "target_max_objects":
+                newpool.target_max_objects = int(val)
+            elif var == "target_max_bytes":
+                newpool.target_max_bytes = int(val)
+            elif var == "cache_target_dirty_ratio":
+                newpool.cache_target_dirty_ratio = float(val)
             else:
                 return (-22, f"unknown pool var {var}", {})
             inc = self._pending()
             inc.new_pools[pool.pool_id] = newpool
             self._commit(inc)
         return (0, "set", {})
+
+    # ------------------------------------------------------------------
+    # cache tiering control plane (reference OSDMonitor "osd tier *"
+    # commands -> pg_pool_t tier_of/read_tier/write_tier/cache_mode,
+    # consumed by PrimaryLogPG::maybe_handle_cache_detail,
+    # PrimaryLogPG.cc:2700)
+    # ------------------------------------------------------------------
+    def _two_pools(self, cmd: dict):
+        base = self.osdmap.get_pool(cmd.get("pool", ""))
+        tier = self.osdmap.get_pool(cmd.get("tierpool", ""))
+        if base is None or tier is None:
+            return None, None, (-2, "no such pool", {})
+        return base, tier, None
+
+    def _cmd_tier_add(self, cmd: dict):
+        with self.lock:
+            base, tier, err = self._two_pools(cmd)
+            if err:
+                return err
+            if tier.is_tier():
+                return (-22, f"{tier.name} is already a tier", {})
+            if tier.has_tiers() or base.is_tier():
+                return (-22, "nested tiers are not supported", {})
+            if tier.is_erasure():
+                return (-22, "an erasure pool cannot be a cache tier "
+                        "(omap/promote need replicated)", {})
+            import copy as _copy
+            newtier = _copy.deepcopy(tier)
+            newtier.tier_of = base.pool_id
+            inc = self._pending()
+            inc.new_pools[tier.pool_id] = newtier
+            self._commit(inc)
+        return (0, f"pool {tier.name} is now a tier of {base.name}", {})
+
+    def _cmd_tier_cache_mode(self, cmd: dict):
+        mode = cmd.get("mode", "")
+        if mode not in ("none", "writeback", "readonly"):
+            return (-22, f"bad cache mode {mode!r}", {})
+        with self.lock:
+            tier = self.osdmap.get_pool(cmd.get("tierpool", ""))
+            if tier is None:
+                return (-2, "no such pool", {})
+            if not tier.is_tier():
+                return (-22, f"{tier.name} is not a tier", {})
+            import copy as _copy
+            newtier = _copy.deepcopy(tier)
+            newtier.cache_mode = mode
+            inc = self._pending()
+            inc.new_pools[tier.pool_id] = newtier
+            self._commit(inc)
+        return (0, f"cache mode {mode}", {})
+
+    def _cmd_tier_set_overlay(self, cmd: dict):
+        with self.lock:
+            base, tier, err = self._two_pools(cmd)
+            if err:
+                return err
+            if tier.tier_of != base.pool_id:
+                return (-22, f"{tier.name} is not a tier of "
+                        f"{base.name}", {})
+            if tier.cache_mode == "none":
+                return (-22, "set a cache-mode first", {})
+            import copy as _copy
+            newbase = _copy.deepcopy(base)
+            newbase.read_tier = tier.pool_id
+            # a readonly tier serves READS only: writes must keep
+            # going to the base directly (routing them into the tier
+            # would make the base pool permanently unwritable)
+            newbase.write_tier = tier.pool_id \
+                if tier.cache_mode == "writeback" else -1
+            inc = self._pending()
+            inc.new_pools[base.pool_id] = newbase
+            self._commit(inc)
+        return (0, f"overlay for {base.name} is {tier.name}", {})
+
+    def _cmd_tier_remove_overlay(self, cmd: dict):
+        with self.lock:
+            base = self.osdmap.get_pool(cmd.get("pool", ""))
+            if base is None:
+                return (-2, "no such pool", {})
+            import copy as _copy
+            newbase = _copy.deepcopy(base)
+            newbase.read_tier = -1
+            newbase.write_tier = -1
+            inc = self._pending()
+            inc.new_pools[base.pool_id] = newbase
+            self._commit(inc)
+        return (0, f"overlay for {base.name} removed", {})
+
+    def _cmd_tier_remove(self, cmd: dict):
+        with self.lock:
+            base, tier, err = self._two_pools(cmd)
+            if err:
+                return err
+            if tier.tier_of != base.pool_id:
+                return (-22, f"{tier.name} is not a tier of "
+                        f"{base.name}", {})
+            if base.read_tier == tier.pool_id or \
+                    base.write_tier == tier.pool_id:
+                return (-16, "remove the overlay first", {})  # EBUSY
+            import copy as _copy
+            newtier = _copy.deepcopy(tier)
+            newtier.tier_of = -1
+            newtier.cache_mode = "none"
+            inc = self._pending()
+            inc.new_pools[tier.pool_id] = newtier
+            self._commit(inc)
+        return (0, f"pool {tier.name} is no longer a tier", {})
 
     def _cmd_snap_create(self, cmd: dict):
         """osd pool selfmanaged-snap create <pool> -> new snap id
@@ -901,6 +1135,10 @@ class Monitor(Dispatcher):
             return (0, f"removed pool snap {name}", {})
 
     def _cmd_pool_delete(self, cmd: dict):
+        if not self.conf["mon_allow_pool_delete"]:
+            # reference mon_allow_pool_delete guard
+            return (-1, "pool deletion is disabled; set "
+                        "mon_allow_pool_delete = true", {})
         with self.lock:
             pool = self.osdmap.get_pool(cmd["pool"])
             if pool is None:
@@ -1121,6 +1359,11 @@ class Monitor(Dispatcher):
         "mds beacon": _cmd_mds_beacon,
         "mds getmap": _cmd_mds_getmap,
         "osd pool delete": _cmd_pool_delete,
+        "osd tier add": _cmd_tier_add,
+        "osd tier cache-mode": _cmd_tier_cache_mode,
+        "osd tier set-overlay": _cmd_tier_set_overlay,
+        "osd tier remove-overlay": _cmd_tier_remove_overlay,
+        "osd tier remove": _cmd_tier_remove,
         "osd pool ls": _cmd_pool_ls,
         "osd pool selfmanaged-snap create": _cmd_snap_create,
         "osd pool selfmanaged-snap rm": _cmd_snap_rm,

@@ -296,7 +296,8 @@ class QuorumService:
                 self.leader = msg.from_rank
                 self.quorum = set(msg.quorum)
                 self._lease_expiry = time.monotonic() + \
-                    self.mon.conf["mon_lease"]
+                    self.mon.conf["mon_lease"] - \
+                    self.mon.conf["mon_clock_drift_allowed"]
         if contest:
             self.start_election()
             return
@@ -447,7 +448,8 @@ class QuorumService:
         with self.mon.lock:
             if msg.from_rank == self.leader:
                 self._lease_expiry = time.monotonic() + \
-                    self.mon.conf["mon_lease"]
+                    self.mon.conf["mon_lease"] - \
+                    self.mon.conf["mon_clock_drift_allowed"]
         if msg.last_committed > self.mon.osdmap.epoch:
             self._send(msg.from_rank, MMonMon(
                 op="sync_req", from_rank=self.rank,
@@ -458,6 +460,12 @@ class QuorumService:
             return
         now = time.monotonic()
         if self.is_leader():
+            # pace lease/commit broadcasts (reference
+            # paxos_propose_interval batches proposal traffic)
+            min_gap = self.mon.conf["paxos_propose_interval"]
+            if now - getattr(self, "_last_lease_tx", 0.0) < min_gap:
+                return
+            self._last_lease_tx = now
             self._broadcast(MMonMon(
                 op="lease", from_rank=self.rank,
                 epoch=self.election_epoch,

@@ -67,7 +67,8 @@ COMPRESSED_FLAG = 0x8000
 
 
 def encode_frame(msg: Message, compressor=None,
-                 compress_min: int = 4096) -> bytes:
+                 compress_min: int = 4096,
+                 crc_data: bool = True) -> bytes:
     payload = msg.encode_payload()
     mtype = msg.TYPE
     if compressor is not None and len(payload) >= compress_min:
@@ -79,7 +80,10 @@ def encode_frame(msg: Message, compressor=None,
             payload = bytes([compressor.numeric_id]) + comp
             mtype |= COMPRESSED_FLAG
     head = _PREAMBLE.pack(FRAME_MAGIC, mtype, msg.seq, len(payload))
-    crc = zlib.crc32(payload, zlib.crc32(head))
+    # reference ms_crc_data: a 0 sentinel skips the payload checksum
+    # (secure mode's AEAD already authenticates; crc is then pure
+    # overhead) — receivers accept the sentinel unconditionally
+    crc = zlib.crc32(payload, zlib.crc32(head)) if crc_data else 0
     return head + payload + _CRC.pack(crc)
 
 
@@ -98,10 +102,11 @@ CRC_LEN = _CRC.size
 def decode_frame_body(mtype: int, seq: int, head: bytes, payload: bytes,
                       crc_bytes: bytes) -> Message:
     (crc,) = _CRC.unpack(crc_bytes)
-    actual = zlib.crc32(payload, zlib.crc32(head))
-    if crc != actual:
-        raise DecodeError(
-            f"payload crc mismatch: {crc:#x} != {actual:#x}")
+    if crc != 0:                         # 0 = sender ran ms_crc_data=false
+        actual = zlib.crc32(payload, zlib.crc32(head))
+        if crc != actual:
+            raise DecodeError(
+                f"payload crc mismatch: {crc:#x} != {actual:#x}")
     if mtype & COMPRESSED_FLAG:
         mtype &= ~COMPRESSED_FLAG
         if not payload:

@@ -533,23 +533,27 @@ class MOSDPing(Message):
     PING_REPLY = 1
 
     def __init__(self, op: int = PING, from_osd: int = -1,
-                 epoch: int = 0, stamp: float = 0.0):
+                 epoch: int = 0, stamp: float = 0.0,
+                 padding: str = ""):
         super().__init__()
         self.op = op
         self.from_osd = from_osd
         self.epoch = epoch
         self.stamp = stamp           # echoed for RTT accounting
+        self.padding = padding       # osd_heartbeat_min_size filler
+                                     # (exposes MTU blackholes)
 
     def encode_payload(self) -> bytes:
         e = Encoder()
         e.u8(self.op).i32(self.from_osd).u32(self.epoch).f64(self.stamp)
+        e.str(self.padding)
         return e.build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDPing":
         d = Decoder(buf)
         return cls(op=d.u8(), from_osd=d.i32(), epoch=d.u32(),
-                   stamp=d.f64())
+                   stamp=d.f64(), padding=d.str())
 
 
 @register
@@ -796,23 +800,27 @@ class MPGStats(Message):
     TYPE = 83
 
     def __init__(self, from_osd: int = -1, epoch: int = 0,
-                 pg_stats: Optional[Dict[str, dict]] = None):
+                 pg_stats: Optional[Dict[str, dict]] = None,
+                 osd_stat: Optional[dict] = None):
         super().__init__()
         self.from_osd = from_osd
         self.epoch = epoch
         self.pg_stats = pg_stats or {}   # pgid -> stat dict
+        self.osd_stat = osd_stat or {}   # osd_stat_t: store usage
 
     def encode_payload(self) -> bytes:
         e = Encoder()
         e.i32(self.from_osd).u32(self.epoch)
         e.bytes(_enc_json(self.pg_stats))
+        e.bytes(_enc_json(self.osd_stat))
         return e.build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MPGStats":
         d = Decoder(buf)
         return cls(from_osd=d.i32(), epoch=d.u32(),
-                   pg_stats=_dec_json(d.bytes()))
+                   pg_stats=_dec_json(d.bytes()),
+                   osd_stat=_dec_json(d.bytes()))
 
 
 # ---------------------------------------------------------------------------

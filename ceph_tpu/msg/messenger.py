@@ -381,7 +381,8 @@ class Connection:
                         raise ConnectionError("injected socket failure")
                     sock.sendall(encode_frame(
                         msg, compressor=self.msgr.compressor,
-                        compress_min=self.msgr.compress_min))
+                        compress_min=self.msgr.compress_min,
+                        crc_data=self.msgr.conf["ms_crc_data"]))
                 except (OSError, ConnectionError):
                     self._socket_dead(sock, gen)
                     break
@@ -401,7 +402,12 @@ class Connection:
                     crc = _read_exact(sock, CRC_LEN)
                     msg = decode_frame_body(mtype, seq, head, payload,
                                             crc)
-                except (OSError, ConnectionError, DecodeError):
+                except (OSError, ConnectionError, DecodeError) as e:
+                    if isinstance(e, DecodeError) and \
+                            self.msgr.conf["ms_die_on_bad_msg"]:
+                        # reference ms_die_on_bad_msg: fail loudly in
+                        # debugging runs instead of resetting quietly
+                        raise
                     # dead or corrupt stream: kill the socket; a
                     # lossless session reconnects and resends
                     self._socket_dead(sock, gen)
@@ -469,11 +475,13 @@ class Messenger:
                     f"ms_compress_mode {mode!r}: wire compression "
                     f"supports zlib/bz2/lzma only")
             from ..compressor import registry as _creg
-            self.compressor = _creg().create(mode)
+            self.compressor = _creg().create(mode, conf=self.conf)
         # cluster auth (reference auth_cluster_required=cephx): a
         # shared-secret mutual challenge-response at session accept
-        self.auth_required = \
-            self.conf["auth_cluster_required"] == "cephx"
+        self.auth_required = "cephx" in (
+            self.conf["auth_cluster_required"],
+            self.conf["auth_service_required"],
+            self.conf["auth_client_required"])
         self.auth_key = self.conf["auth_key"].encode()
         if self.auth_required and not self.auth_key:
             raise ValueError(
@@ -500,8 +508,24 @@ class Messenger:
              ) -> Tuple[str, int]:
         sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        sock.bind(addr)
-        sock.listen(64)
+        if self.conf["ms_tcp_nodelay"]:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if addr[1] == 0 and self.conf["ms_bind_port_range_enabled"]:
+            # reference ms_bind_port_min/max: daemons bind inside the
+            # advertised range instead of an ephemeral port
+            lo = self.conf["ms_bind_port_min"]
+            hi = self.conf["ms_bind_port_max"]
+            for port in range(lo, hi + 1):
+                try:
+                    sock.bind((addr[0], port))
+                    break
+                except OSError:
+                    continue
+            else:
+                raise OSError(f"no free port in [{lo}, {hi}]")
+        else:
+            sock.bind(addr)
+        sock.listen(self.conf["ms_tcp_listen_backlog"])
         self.listen_sock = sock
         self.my_addr = sock.getsockname()
         return self.my_addr
@@ -577,6 +601,7 @@ class Messenger:
 
     def _reconnect(self, conn: Connection) -> None:
         retry = self.conf["ms_connection_retry_interval"]
+        max_backoff = self.conf["ms_max_backoff"]
         try:
             while True:
                 with self.lock:
@@ -591,6 +616,10 @@ class Messenger:
                                                     timeout=5.0)
                     sock.setsockopt(socket.IPPROTO_TCP,
                                     socket.TCP_NODELAY, 1)
+                    rcvbuf = self.conf["ms_tcp_rcvbuf"]
+                    if rcvbuf:
+                        sock.setsockopt(socket.SOL_SOCKET,
+                                        socket.SO_RCVBUF, rcvbuf)
                     _send_banner(sock, self.name, self.nonce, in_seq,
                                  conn.lossless)
                     if self.auth_required:
@@ -630,6 +659,10 @@ class Messenger:
                                 winner.send_message(m)
                             return
                     time.sleep(retry)
+                    # exponential backoff to ms_max_backoff (reference
+                    # ms_initial_backoff/ms_max_backoff): a dead peer
+                    # must not eat CPU in a tight redial loop
+                    retry = min(retry * 2, max_backoff)
                     continue
                 with self.lock:
                     self.conns_by_name[peer_name] = conn
@@ -650,6 +683,13 @@ class Messenger:
         while True:
             try:
                 sock, _ = self.listen_sock.accept()
+                if self.conf["ms_tcp_nodelay"]:
+                    sock.setsockopt(socket.IPPROTO_TCP,
+                                    socket.TCP_NODELAY, 1)
+                rcvbuf = self.conf["ms_tcp_rcvbuf"]
+                if rcvbuf:
+                    sock.setsockopt(socket.SOL_SOCKET,
+                                    socket.SO_RCVBUF, rcvbuf)
             except OSError:
                 return                 # shut down
             threading.Thread(target=self._handle_accept, args=(sock,),

@@ -99,6 +99,9 @@ class EncodeBatcher:
         # the CPU twin; the threshold doubles when a device call loses
         # to the predicted CPU time and halves when it wins big.
         self.adaptive_cpu = get("ec_tpu_fallback_cpu", True)
+        self.probe_interval = get("ec_tpu_crossover_probe_interval", 16)
+        self.crossover_min = get("ec_tpu_crossover_min_bytes", 64 << 10)
+        self.prewarm_enabled = get("osd_ec_prewarm", True)
         self.cpu_reqs = 0                        # routed to CPU twin
         self.perf = perf
         self._cond = threading.Condition()
@@ -153,7 +156,8 @@ class EncodeBatcher:
         OSD boot is not stalled; a first op racing the warm simply
         shares the in-progress compile (ChainLRU in-progress marker).
         Once per geometry process-wide."""
-        if not hasattr(ec_impl, "encode_batch_async"):
+        if not self.prewarm_enabled or \
+                not hasattr(ec_impl, "encode_batch_async"):
             return
         key = _geometry_key(ec_impl, sinfo)
         with self._cond:
@@ -252,7 +256,7 @@ class EncodeBatcher:
         # device anyway so the threshold can come back down when the
         # link/device recovers
         self._probe_tick = getattr(self, "_probe_tick", 0) + 1
-        return self._probe_tick % 16 != 0
+        return self._probe_tick % self.probe_interval != 0
 
     def _cb_error(self) -> None:
         """Report a continuation/encode failure.  During shutdown the
@@ -317,7 +321,7 @@ class EncodeBatcher:
                 # a convergence loop)
                 EncodeBatcher._min_device_bytes = max(
                     self._min_device_bytes,
-                    dev_time * cpu_rate / 2, 64 << 10)
+                    dev_time * cpu_rate / 2, self.crossover_min)
             elif dev_time < cpu_pred / 2 and \
                     self._min_device_bytes > 0:
                 EncodeBatcher._min_device_bytes = min(

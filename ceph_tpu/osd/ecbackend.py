@@ -43,6 +43,7 @@ read-your-own-write hazards.
 """
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
@@ -972,8 +973,11 @@ class ECBackend(PGBackend):
     def _recover_whole(self, rec: _RecoveryOp,
                        attrs: Dict[str, bytes], shard_len: int,
                        missing_shards: Set[int]) -> None:
-        """Generic recovery: read whole chunks from the minimum shard
-        set and batch-decode the missing ones."""
+        """Generic recovery: stream chunk windows from the minimum
+        shard set and batch-decode the missing ones.  The window is
+        osd_recovery_chunk_size logical bytes (reference
+        get_recovery_chunk_size, ECBackend.h:206) so one huge object
+        can't hold k shards' worth of its bytes in memory at once."""
         oid = rec.oid
         shards = self._min_read_shards(set(missing_shards),
                                        exclude=missing_shards,
@@ -982,6 +986,20 @@ class ECBackend(PGBackend):
             self.recovery_ops.pop(oid, None)
             rec.cb(-5)
             return
+        try:
+            logical = self.host.conf["osd_recovery_chunk_size"]
+        except (AttributeError, KeyError):
+            logical = 8 << 20
+        win = max(self.sinfo.chunk_size,
+                  self.sinfo.object_size_to_shard_size(logical))
+        win -= win % self.sinfo.chunk_size
+        pieces: Dict[int, List[bytes]] = {s: [] for s in missing_shards}
+        state = {"off": 0}
+
+        def read_next() -> None:
+            length = min(win, shard_len - state["off"])
+            self._start_read(oid, state["off"], length, shards,
+                             reads_done)
 
         def reads_done(received: Dict[int, bytes],
                        errors: Dict[int, int]) -> None:
@@ -1000,9 +1018,17 @@ class ECBackend(PGBackend):
                 self.recovery_ops.pop(oid, None)
                 rec.cb(-5)
                 return
-            self._push_recovered(rec, attrs, dec)
+            for s in missing_shards:
+                pieces[s].append(dec[s])
+            state["off"] += win
+            if state["off"] >= shard_len:
+                self._push_recovered(
+                    rec, attrs,
+                    {s: b"".join(pieces[s]) for s in missing_shards})
+            else:
+                read_next()
 
-        self._start_read(oid, 0, shard_len, shards, reads_done)
+        read_next()
 
     def _try_subchunk_repair(self, rec: _RecoveryOp,
                              attrs: Dict[str, bytes], shard_len: int,

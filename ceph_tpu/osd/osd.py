@@ -104,8 +104,8 @@ class OSDService:
     def kick_recovery(self, pg: Optional[PG] = None) -> None:
         self._osd.kick_recovery()
 
-    def objecter_ioctx(self, pool_id: int):
-        return self._osd.objecter_ioctx(pool_id)
+    def objecter_ioctx(self, pool_id: int, bypass_tier: bool = True):
+        return self._osd.objecter_ioctx(pool_id, bypass_tier)
 
     def ensure_pg(self, pgid) -> Optional[PG]:
         """Get-or-create a local PG instance regardless of acting-set
@@ -131,6 +131,7 @@ class OSD(Dispatcher):
         self.conf = conf or default_config()
         self.log = Dout("osd", f"osd.{whoami} ")
         self.ec_registry = ec_registry.instance()
+        self.ec_registry.preload_from_conf(self.conf)
         self.osdmap = OSDMap()
         self.map_lock = make_lock("osd.map")
         self.pgs: Dict[PGid, PG] = {}
@@ -192,10 +193,13 @@ class OSD(Dispatcher):
         from .batcher import EncodeBatcher
         self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf)
         self.op_tracker = OpTracker(
+            history_size=self.conf["osd_op_history_size"],
+            history_duration=self.conf["osd_op_history_duration"],
             slow_op_warn_threshold=self.conf["osd_op_complaint_time"])
         from ..utils.tracer import Tracer
         self.tracer = Tracer(f"osd.{whoami}",
-                             enabled=self.conf["osd_tracing"])
+                             enabled=self.conf["osd_tracing"],
+                             keep=self.conf["trace_keep_spans"])
 
     # ------------------------------------------------------------------
     # lifecycle (reference OSD::init)
@@ -223,7 +227,8 @@ class OSD(Dispatcher):
 
     def shutdown(self) -> None:
         self._stop.set()
-        self.encode_batcher.stop()
+        self.encode_batcher.stop(
+            drain=self.conf["osd_batcher_drain_timeout"])
         self._recovery_kick.set()
         for q in self._shard_queues:
             q.close()
@@ -408,12 +413,25 @@ class OSD(Dispatcher):
         self._shard_queues[self._shard_of_pg(pg)].enqueue(
             "recovery", pg)
 
+    def _tuned(self, base: str):
+        """hdd/ssd-tuned option resolution (reference dual-default
+        options): an EXPLICITLY SET base value wins — including an
+        explicit 0 (e.g. osd_recovery_sleep=0 to disable pacing) —
+        otherwise the store medium picks the _hdd/_ssd variant."""
+        v = self.conf[base]
+        if v or self.conf.is_overridden(base):
+            return v
+        medium = getattr(self.store, "medium", "ssd")
+        return self.conf[f"{base}_{medium}"]
+
     def _run_recovery_item(self, pg: PG) -> None:
         with pg.lock:
             pg._recovery_queued = False
         try:
-            started = pg.start_recovery_ops(
-                self.conf["osd_recovery_max_active"])
+            budget = min(self._tuned("osd_recovery_max_active"),
+                         max(1, self.conf[
+                             "osd_recovery_max_single_start"]))
+            started = pg.start_recovery_ops(budget)
         except Exception:
             import traceback
             traceback.print_exc()
@@ -423,7 +441,7 @@ class OSD(Dispatcher):
             with pg.lock:
                 more = pg.is_primary() and pg.num_missing() > 0
             if more:
-                sleep = self.conf["osd_recovery_sleep"]
+                sleep = self._tuned("osd_recovery_sleep")
                 if sleep:
                     # pace WITHOUT blocking the shard worker (a sleep
                     # here would stall queued client ops): defer the
@@ -543,11 +561,17 @@ class OSD(Dispatcher):
         self.msgr.connect_to(addr, lossless=True,
                              peer_name=f"osd.{osd}").send_message(msg)
 
-    def objecter_ioctx(self, pool_id: int):
+    def objecter_ioctx(self, pool_id: int, bypass_tier: bool = True):
         """IoCtx on the OSD's own internal client (the reference
         OSD's objecter, used by copy-from and cache tiering —
         reference ceph_osd.cc objecter messenger + PrimaryLogPG
-        do_copy_from)."""
+        do_copy_from).  ``bypass_tier``: internal promote/flush IO
+        must address the named pool DIRECTLY (reference
+        CEPH_OSD_FLAG_IGNORE_OVERLAY), or a tiered base pool's
+        redirect would bounce the promote right back into the cache
+        that issued it; a tiered copy_from's SOURCE fetch instead
+        wants the overlay (the source may live only in the base after
+        an evict — the read promotes it back)."""
         with self.map_lock:
             pool = self.osdmap.pools.get(pool_id)
         if pool is None:
@@ -557,15 +581,33 @@ class OSD(Dispatcher):
                 from ..client.rados import Rados
                 self._int_client = Rados(self._mon_addr,
                                          conf=self.conf).connect()
-        return self._int_client.open_ioctx(pool.name)
+        io = self._int_client.open_ioctx(pool.name)
+        io._bypass_tier = bypass_tier
+        return io
 
     # ------------------------------------------------------------------
     # heartbeats (reference OSD.cc:5079-5632)
     # ------------------------------------------------------------------
     def _hb_peers(self) -> List[int]:
+        """Up peers to ping.  Large clusters ping a ring neighborhood
+        of at least osd_heartbeat_min_peers instead of everyone
+        (reference maybe_update_heartbeat_peers, OSD.cc:5079 — crush-
+        adjacent plus padding to the minimum); every OSD still has
+        enough watchers for the monitor's reporter quorum."""
         with self.map_lock:
-            return [o for o, info in self.osdmap.osds.items()
-                    if info.up and o != self.whoami]
+            up = sorted(o for o, info in self.osdmap.osds.items()
+                        if info.up and o != self.whoami)
+        want = self.conf["osd_heartbeat_min_peers"]
+        if len(up) <= want:
+            return up
+        # ring neighborhood centered on our id: deterministic, and
+        # the union over all OSDs covers every peer both ways
+        import bisect
+        at = bisect.bisect_left(up, self.whoami)
+        half = (want + 1) // 2
+        sel = {up[(at + i) % len(up)] for i in range(1, half + 1)}
+        sel |= {up[(at - i) % len(up)] for i in range(1, half + 1)}
+        return sorted(sel)
 
     def _handle_ping(self, conn: Connection, msg: MOSDPing) -> None:
         if msg.op == MOSDPing.PING:
@@ -596,9 +638,11 @@ class OSD(Dispatcher):
                                 self.osdmap.epoch)
                         except Exception:
                             pass
+                pad = self.conf["osd_heartbeat_min_size"]
                 self.send_osd(peer, MOSDPing(
                     op=MOSDPing.PING, from_osd=self.whoami,
-                    epoch=self.osdmap.epoch, stamp=now))
+                    epoch=self.osdmap.epoch, stamp=now,
+                    padding="x" * pad))
             # forget peers no longer up (map took them out)
             up = set(self._hb_peers())
             for peer in list(self._hb_last_rx):
@@ -624,9 +668,21 @@ class OSD(Dispatcher):
                 return
             with self.pg_lock:
                 pgs = list(self.pgs.values())
+            # osd_max_backfills: bound the PGs QUEUED for recovery at
+            # once per daemon (reference backfill reservations) so one
+            # OSD's rebuild never floods every PG simultaneously.
+            # Only count transient queued state — an in-backend
+            # recovery op wedged on a dead peer must not eat a slot
+            # forever (its PG re-queues via the tick's stuck-retry)
+            slots = self.conf["osd_max_backfills"] * 4
+            active_recovering = sum(
+                1 for pg in pgs
+                if getattr(pg, "_recovery_queued", False))
             for pg in pgs:
                 if self._stop.is_set():
                     return
+                if active_recovering >= slots:
+                    break                # next kick continues
                 try:
                     with pg.lock:
                         need = pg.is_primary() and \
@@ -635,6 +691,7 @@ class OSD(Dispatcher):
                              or pg.waiting_for_degraded)
                     if need:
                         self.queue_recovery_item(pg)
+                        active_recovering += 1
                 except Exception:
                     import traceback
                     traceback.print_exc()
@@ -643,13 +700,21 @@ class OSD(Dispatcher):
     # tick: pg stats + stuck-peering retry
     # ------------------------------------------------------------------
     def _tick_loop(self) -> None:
-        interval = self.conf["mon_tick_interval"]
+        interval = self.conf["osd_tick_interval"]
+        last_report = 0.0
         while not self._stop.wait(interval):
-            self._send_pg_stats()
+            # osd_mon_report_interval throttles stat traffic on big
+            # clusters; 0 reports every tick (test default)
+            min_gap = self.conf["osd_mon_report_interval"]
+            if time.monotonic() - last_report >= min_gap:
+                last_report = time.monotonic()
+                self._send_pg_stats()
             self._retry_stuck_peering()
             self._renotify_strays()
             self._maybe_schedule_scrub()
             self._maybe_trim_snaps()
+            self._maybe_trim_pg_logs()
+            self._maybe_cache_agent()
             self._maybe_reboot()
 
     def _renotify_strays(self) -> None:
@@ -676,6 +741,38 @@ class OSD(Dispatcher):
         for pg in pgs:
             try:
                 pg.maybe_trim_snaps()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+
+    def _maybe_trim_pg_logs(self) -> None:
+        """Clean primaries trim their log to osd_min_pg_log_entries
+        (reference PeeringState::calc_trim_to: min while clean, max
+        while degraded — degraded PGs keep history for log-based
+        catch-up)."""
+        min_e = self.conf["osd_min_pg_log_entries"]
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            try:
+                with pg.lock:
+                    if pg.is_primary() and pg.state == STATE_ACTIVE \
+                            and pg.num_missing() == 0 \
+                            and not any(ms.items for ms in
+                                        pg.peer_missing.values()):
+                        pg.log.trim_to(min_e)
+            except Exception:
+                pass
+
+    def _maybe_cache_agent(self) -> None:
+        """Drive the cache-tier agent on primary tier-pool PGs
+        (reference OSD tick -> agent_work)."""
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        for pg in pgs:
+            try:
+                if pg.pool.is_tier():
+                    pg.cache_agent()
             except Exception:
                 import traceback
                 traceback.print_exc()
@@ -710,13 +807,50 @@ class OSD(Dispatcher):
                 pg.scrubber.kick()       # drain-wait retries
         if shallow <= 0:
             return
+        # reference osd_scrub_load_threshold: a loaded host defers
+        # background scrubbing entirely
+        load_cap = self.conf["osd_scrub_load_threshold"]
+        if load_cap > 0:
+            try:
+                import os as _os
+                if _os.getloadavg()[0] > load_cap:
+                    return
+            except OSError:
+                pass
+        # osd_max_scrubs bounds concurrent scrub rounds per daemon
+        # (reference osd_max_scrubs + scrub reservations)
+        budget = self.conf["osd_max_scrubs"] - sum(
+            1 for pg in pgs if pg.scrubber.active)
+        if self.conf["osd_scrub_sleep"] > 0:
+            # pacing (reference osd_scrub_sleep, applied between scrub
+            # chunks there): schedule at most one PG's round per tick
+            # — lock-free pacing, no sleeping under the PG lock
+            budget = min(budget, 1)
+        if not self.conf["osd_scrub_during_recovery"] and any(
+                pg.is_primary() and pg.num_missing() > 0
+                for pg in pgs):
+            # reference osd_scrub_during_recovery=false: recovery IO
+            # outranks background scrub on this daemon
+            return
+        # per-PG jittered cadence (reference osd_scrub_min_interval /
+        # osd_scrub_max_interval): a stable per-PG offset spreads
+        # rounds out instead of scrubbing every PG in one burst
+        smin = self.conf["osd_scrub_min_interval"]
+        smax = self.conf["osd_scrub_max_interval"]
         for pg in pgs:
+            if budget <= 0:
+                break
             with pg.lock:
                 if not pg.is_primary() or pg.state != STATE_ACTIVE \
                         or pg.scrubber.active:
                     continue
-                if now - pg.scrubber.last_scrub < shallow:
+                interval = shallow
+                if 0 < smin < smax:
+                    frac = (hash(str(pg.pgid)) & 0xFFFF) / 0xFFFF
+                    interval = smin + frac * (smax - smin)
+                if now - pg.scrubber.last_scrub < interval:
                     continue
+                budget -= 1
                 deep = deep_iv > 0 and \
                     now - pg.scrubber.last_deep_scrub >= deep_iv
                 # scrub-class work goes through the scheduler so it
@@ -747,10 +881,19 @@ class OSD(Dispatcher):
                     stats[str(pgid)] = pg.get_stats()
                 except Exception:
                     pass
-        if stats:
+        # osd_stat_t analog: store fullness feeds the monitor's
+        # OSD_FULL/OSD_NEARFULL health checks (mon_osd_full_ratio /
+        # mon_osd_nearfull_ratio); only capacity-capped stores report
+        osd_stat = {}
+        cap = getattr(self.store, "max_bytes", 0)
+        if cap:
+            osd_stat = {"kb": cap >> 10,
+                        "kb_used": getattr(self.store, "_data_bytes",
+                                           0) >> 10}
+        if stats or osd_stat:
             try:
                 self.monc.send_pg_stats(self.whoami, self.osdmap.epoch,
-                                        stats)
+                                        stats, osd_stat=osd_stat)
             except Exception:
                 pass
 

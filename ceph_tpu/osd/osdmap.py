@@ -170,9 +170,25 @@ class PGPool:
     snap_seq: int = 0                  # newest allocated snap id
     removed_snaps: List[int] = field(default_factory=list)
     pool_snaps: Dict[str, int] = field(default_factory=dict)  # name->id
+    # cache tiering (reference pg_pool_t tier fields, osd/osd_types.h:
+    # tier_of / read_tier / write_tier / cache_mode; applied by
+    # PrimaryLogPG::maybe_handle_cache_detail, PrimaryLogPG.cc:2700)
+    tier_of: int = -1                  # base pool this pool caches
+    read_tier: int = -1                # on the BASE pool: overlay tier
+    write_tier: int = -1
+    cache_mode: str = "none"           # none | writeback | readonly
+    target_max_objects: int = 0        # tier agent evict thresholds
+    target_max_bytes: int = 0
+    cache_target_dirty_ratio: float = 0.4
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE
+
+    def is_tier(self) -> bool:
+        return self.tier_of >= 0
+
+    def has_tiers(self) -> bool:
+        return self.read_tier >= 0 or self.write_tier >= 0
 
     def raw_pg_to_pps(self, seed: int) -> int:
         """Placement seed for CRUSH input (reference
@@ -303,11 +319,15 @@ class OSDMap:
         if inc.new_max_osd is not None:
             self.max_osd = inc.new_max_osd
         for osd, addr in inc.new_up.items():
+            brand_new = osd not in self.osds
             info = self.osds.setdefault(osd, OSDInfo())
             info.up = True
             info.addr = addr
             info.up_from = inc.epoch
-            if info.weight == 0:
+            if brand_new and info.weight == 0:
+                # first-ever boot starts in; a REJOINING out OSD's
+                # weight is the monitor's call (mon_osd_auto_mark_in
+                # rides inc.new_weight), not an automatic side effect
                 info.weight = 0x10000
             self.max_osd = max(self.max_osd, osd + 1)
         for osd in inc.new_down:
@@ -357,7 +377,14 @@ class OSDMap:
                 "fast_read": p.fast_read,
                 "snap_seq": p.snap_seq,
                 "removed_snaps": p.removed_snaps,
-                "pool_snaps": p.pool_snaps}
+                "pool_snaps": p.pool_snaps,
+                "tier_of": p.tier_of,
+                "read_tier": p.read_tier,
+                "write_tier": p.write_tier,
+                "cache_mode": p.cache_mode,
+                "target_max_objects": p.target_max_objects,
+                "target_max_bytes": p.target_max_bytes,
+                "cache_target_dirty_ratio": p.cache_target_dirty_ratio}
                 for p in self.pools.values()},
             "erasure_code_profiles": self.erasure_code_profiles,
             "cluster_config": dict(self.cluster_config),
@@ -388,7 +415,16 @@ class OSDMap:
                           fast_read=p.get("fast_read", False),
                           snap_seq=p.get("snap_seq", 0),
                           removed_snaps=list(p.get("removed_snaps", [])),
-                          pool_snaps=dict(p.get("pool_snaps", {})))
+                          pool_snaps=dict(p.get("pool_snaps", {})),
+                          tier_of=p.get("tier_of", -1),
+                          read_tier=p.get("read_tier", -1),
+                          write_tier=p.get("write_tier", -1),
+                          cache_mode=p.get("cache_mode", "none"),
+                          target_max_objects=p.get(
+                              "target_max_objects", 0),
+                          target_max_bytes=p.get("target_max_bytes", 0),
+                          cache_target_dirty_ratio=p.get(
+                              "cache_target_dirty_ratio", 0.4))
             m.pools[int(pid)] = pool
             m.pool_name_to_id[pool.name] = int(pid)
             m._next_pool_id = max(m._next_pool_id, int(pid) + 1)

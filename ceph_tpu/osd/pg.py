@@ -69,7 +69,8 @@ STATE_ACTIVE = "active"
 WRITE_OPS = {"write", "writefull", "append", "create", "delete",
              "truncate", "setxattr", "rmxattr", "rmxattrs",
              "omap_set", "omap_rm",
-             "omap_clear", "call", "rollback", "copy_from"}
+             "omap_clear", "call", "rollback", "copy_from",
+             "cache_flush", "cache_evict"}
 READ_OPS = {"read", "stat", "getxattr", "getxattrs", "omap_get",
             "omap_get_by_key", "pgls", "list_snaps",
             "watch", "unwatch", "notify", "notify_ack",
@@ -95,7 +96,11 @@ class PG:
         self.acting: List[Optional[int]] = []
         self.primary_osd: Optional[int] = None
         self.interval_start = 0          # epoch of last acting change
-        self.log = PGLog()
+        try:                             # reference osd_max_pg_log_entries
+            max_entries = service.conf["osd_max_pg_log_entries"]
+        except (AttributeError, KeyError):
+            max_entries = PGLog.DEFAULT_MAX_ENTRIES
+        self.log = PGLog(max_entries)
         self.missing = MissingSet()      # objects THIS shard lacks
         self.peer_missing: Dict[int, MissingSet] = {}
         self._peer_notifies: Dict[int, dict] = {}
@@ -125,6 +130,16 @@ class PG:
         # that raced a map epoch, so stale entries are requeued by the
         # OSD tick (the reference retries via peering-event machinery)
         self.recovering: Dict[str, float] = {}
+        # cache tiering (reference PrimaryLogPG cache machinery,
+        # PrimaryLogPG.cc:2700 maybe_handle_cache_detail): in-flight
+        # promotes (oid -> parked (msg, conn) waiters), objects being
+        # flushed to the base pool, and observability counters
+        self._promoting: Dict[str, List[Tuple]] = {}
+        self._flushing: Set[str] = set()
+        self._base_deleting: Set[str] = set()
+        self.cache_promotes = 0
+        self.cache_flushes = 0
+        self.cache_evicts = 0
         # watch/notify (reference osd/Watch.cc): primary-side watcher
         # registry, volatile — clients re-register through lingering
         # ops on every map change, so failover self-heals
@@ -188,6 +203,10 @@ class PG:
     @property
     def store(self):
         return self.service.store
+
+    @property
+    def conf(self):
+        return self.service.conf
 
     @property
     def epoch(self) -> int:
@@ -1048,6 +1067,13 @@ class PG:
     def _do_op(self, msg: MOSDOp, conn) -> None:
         has_write = any(self._op_is_write(op) for op in msg.ops)
         oid = msg.oid
+        # reference osd_client_message_size_cap: bound a single op's
+        # payload before any of it is staged
+        payload = sum(len(op.data) for op in msg.ops if op.data)
+        cap = self.conf["osd_client_message_size_cap"]
+        if cap and payload > cap:
+            self._reply(conn, msg, -90, [])      # EMSGSIZE
+            return
         if "@" in oid and not oid.startswith(".pgls."):
             # '@' is the snapshot-object namespace (oid@snap,
             # oid@snapdir): a client object named 'foo@10' would
@@ -1066,6 +1092,16 @@ class PG:
                 self._client_ops.pop((msg.client, msg.tid), None)
                 self._reply(conn, msg, -108, [])
                 return
+        if any(op.op in ("cache_flush", "cache_evict")
+               for op in msg.ops):
+            # explicit tier maintenance (reference
+            # CEPH_OSD_OP_CACHE_FLUSH/CACHE_EVICT): addressed AT the
+            # cache pool, never promoted
+            self._do_cache_op(msg, conn)
+            return
+        if not oid.startswith(".pgls.") and \
+                self._maybe_handle_cache(msg, conn, has_write):
+            return                       # parked / promoted / rejected
         if has_write and self.scrubber.write_blocked():
             # scrub snapshots must describe one committed state; new
             # writes wait for the round (reference write blocking on
@@ -1097,6 +1133,399 @@ class PG:
                 self.service.kick_recovery(self)
                 return
             self._do_reads(msg, conn)
+
+    # ------------------------------------------------------------------
+    # cache tiering (reference PrimaryLogPG::maybe_handle_cache_detail,
+    # PrimaryLogPG.cc:2700, called from do_op at :8084): this PG is the
+    # CACHE pool; misses promote from the base pool, writes are marked
+    # dirty for the tier agent to flush, deletes write through to the
+    # base (in place of the reference's whiteouts — simpler, same
+    # no-resurrection guarantee for the model checker)
+    # ------------------------------------------------------------------
+    CACHE_DIRTY_ATTR = "cache_dirty"     # user-ns xattr on dirty heads
+
+    def _maybe_handle_cache(self, msg: MOSDOp, conn,
+                            has_write: bool) -> bool:
+        """True when the op was consumed (parked, being promoted, or
+        rejected); False lets it continue down the normal path."""
+        pool = self.pool
+        if not pool.is_tier() or pool.cache_mode == "none":
+            return False
+        oid = msg.oid
+        if "@" in oid:
+            return False                 # snap namespace: no tiering
+        if pool.cache_mode == "readonly" and has_write:
+            self._reply(conn, msg, -30, [])      # EROFS
+            return True
+        if oid in self._flushing:
+            # a flush holds the object stable; ops resume when the
+            # clean-mark commits (its done callback drains the queue)
+            self.waiting_for_obj.setdefault(oid, deque()).append(
+                (msg, conn))
+            return True
+        if not getattr(msg, "_promote_checked", False) and \
+                self.backend.get_object_info(oid) is None and \
+                not self._is_degraded(oid) and \
+                oid not in self.inflight_writes:
+            # absent AND not merely unrecovered: a backfilling primary
+            # that promoted every locally-missing object would install
+            # stale base copies over acked cache state — degraded
+            # objects instead fall through to the recovery parking in
+            # _do_op (reference waits for recovery before promote)
+            self._start_promote(msg, conn)
+            return True
+        if pool.cache_mode == "writeback" and \
+                any(op.op == "delete" for op in msg.ops) and \
+                not getattr(msg, "_base_deleted", False):
+            self._start_base_delete(msg, conn)
+            return True
+        return False
+
+    def _do_cache_op(self, msg: MOSDOp, conn) -> None:
+        """cache_flush / cache_evict client ops (reference
+        CEPH_OSD_OP_CACHE_FLUSH/CACHE_EVICT in do_osd_ops): operator-
+        driven tier maintenance, e.g. `rados cache-flush-evict-all`."""
+        if not self.pool.is_tier():
+            self._reply(conn, msg, -22, [])
+            return
+        oid = msg.oid
+        if self.backend.get_object_info(oid) is None:
+            self._reply(conn, msg, -2, [])
+            return
+        try:
+            self.store.getattr(self.coll,
+                               GHObject(oid, self.own_shard),
+                               "u_" + self.CACHE_DIRTY_ATTR)
+            dirty = True
+        except (FileNotFoundError, KeyError):
+            dirty = False
+        if msg.ops[0].op == "cache_flush":
+            if not dirty:
+                self._reply(conn, msg, 0, [])
+                return
+            if not self._flush_object(oid):
+                self._reply(conn, msg, -16, [])      # EBUSY
+                return
+            # park; the flush's clean-mark re-runs us and the now-
+            # clean object answers 0
+            self.waiting_for_obj.setdefault(oid, deque()).append(
+                (msg, conn))
+        else:                            # cache_evict
+            if dirty:
+                self._reply(conn, msg, -16, [])      # flush first
+                return
+            ok = self._evict_object(oid)
+            self._reply(conn, msg, 0 if ok else -16, [])
+
+    def _cache_reenter(self, entries: List[Tuple]) -> None:
+        """Re-run ops after an async cache step (lock held); each is
+        stamped so the presence probe doesn't loop on objects that
+        exist nowhere.  One op's failure must not starve the rest —
+        a leaked waiter is a client op wedged until its timeout."""
+        for m, c in entries:
+            m._promote_checked = True
+            try:
+                self._do_op(m, c)
+            except Exception:
+                import traceback
+                traceback.print_exc()
+                try:
+                    self._client_ops.pop((m.client, m.tid), None)
+                    self._reply(c, m, -5, [])
+                except Exception:
+                    pass
+
+    def _start_promote(self, msg: MOSDOp, conn) -> None:
+        """Fetch the object from the base pool and install it in the
+        cache (a clean, replicated, logged internal write), then
+        re-run the op (reference promote_object)."""
+        oid = msg.oid
+        waiters = self._promoting.get(oid)
+        if waiters is not None:
+            waiters.append((msg, conn))
+            return
+        self._promoting[oid] = []
+        base_pool = self.pool.tier_of
+        base = self.service.get_osdmap().pools.get(base_pool)
+        base_has_omap = base is not None and not base.is_erasure()
+
+        def fetch() -> None:
+            data = attrs = None
+            omap = {}
+            err = 0
+            try:
+                io = self.service.objecter_ioctx(base_pool)
+                data = io.read(oid)
+                attrs = io.getxattrs(oid)
+                if base_has_omap:
+                    omap = io.omap_get(oid)
+            except Exception as e:
+                errno = getattr(e, "errno", 0) or 5
+                if errno != 2:
+                    err = errno          # base unreachable: fail ops
+                data = None
+            with self.lock:
+                waiting = self._promoting.pop(oid, [])
+                all_ops = [(msg, conn)] + waiting
+                if not self.is_primary() or \
+                        self.state != STATE_ACTIVE:
+                    # lost the PG mid-promote (thrash failover): a
+                    # non-primary install would fan out split-brain
+                    # sub-writes; bounce the clients to re-target
+                    for m, c in all_ops:
+                        self._client_ops.pop((m.client, m.tid), None)
+                        self._reply(c, m, -108, [])
+                    return
+                if err:
+                    for m, c in all_ops:
+                        self._client_ops.pop((m.client, m.tid), None)
+                        self._reply(c, m, -err, [])
+                    return
+                if data is None or \
+                        self.backend.get_object_info(oid) is not None \
+                        or oid in self.inflight_writes:
+                    # nothing to promote (absent in base too, or a
+                    # racing write created it): just re-run
+                    self._cache_reenter(all_ops)
+                    return
+                mut = Mutation()
+                mut.writes.append((0, data))
+                mut.truncate = len(data)
+                for k, v in attrs.items():
+                    if k != self.CACHE_DIRTY_ATTR:
+                        mut.attrs[k] = v
+                mut.omap_set.update(omap)
+                self.cache_promotes += 1
+                try:
+                    self._submit_internal(
+                        oid, mut,
+                        on_done=lambda res: self._cache_reenter(
+                            all_ops))
+                except Exception:
+                    # install failed outright: answer every waiter
+                    # rather than leaking them until client timeout
+                    for m, c in all_ops:
+                        self._client_ops.pop((m.client, m.tid), None)
+                        self._reply(c, m, -5, [])
+
+        threading.Thread(target=fetch, name="cache-promote",
+                         daemon=True).start()
+
+    def _start_base_delete(self, msg: MOSDOp, conn) -> None:
+        """Write-through delete: remove the base copy BEFORE the cache
+        delete is applied/acked, so a later miss can never resurrect a
+        deleted object from the base pool.  ``_base_deleting`` fences
+        the tier agent — a flush racing this window would rewrite the
+        base copy we just removed (resurrection via flush)."""
+        base_pool = self.pool.tier_of
+        self._base_deleting.add(msg.oid)
+
+        def run() -> None:
+            try:
+                self.service.objecter_ioctx(base_pool).remove(msg.oid)
+            except Exception as e:
+                if getattr(e, "errno", 0) != 2:
+                    with self.lock:
+                        self._base_deleting.discard(msg.oid)
+                        self._client_ops.pop((msg.client, msg.tid),
+                                             None)
+                        self._reply(conn, msg,
+                                    -(getattr(e, "errno", 0) or 5), [])
+                    return
+            msg._base_deleted = True
+            with self.lock:
+                msg._promote_checked = True
+                try:
+                    # the local delete submits inside _do_op, so the
+                    # object is inflight (flush-proof) before we lift
+                    # the fence
+                    self._do_op(msg, conn)
+                except RuntimeError:
+                    pass                 # teardown raced (store gone)
+                finally:
+                    self._base_deleting.discard(msg.oid)
+
+        threading.Thread(target=run, name="cache-basedel",
+                         daemon=True).start()
+
+    def cache_agent(self) -> Tuple[int, int]:
+        """One tier-agent pass (reference TierAgentState / agent_work):
+        flush dirty objects past the dirty ratio, evict clean ones
+        while the cache exceeds its targets; -> (flushed, evicted).
+        Runs from the OSD tick on the primary."""
+        pool = self.pool
+        if not pool.is_tier() or pool.cache_mode != "writeback":
+            return (0, 0)
+        with self.lock:
+            if not self.is_primary() or self.state != STATE_ACTIVE:
+                return (0, 0)
+            objs: List[Tuple[str, int, bool]] = []   # oid, size, dirty
+            for oid in self.backend.list_objects():
+                if oid == PGMETA_OID or "@" in oid:
+                    continue
+                if self._is_degraded(oid):
+                    continue             # local copy may be stale:
+                                         # recover first, then flush
+                info = self.backend.get_object_info(oid)
+                if info is None:
+                    continue
+                try:
+                    self.store.getattr(
+                        self.coll, GHObject(oid, self.own_shard),
+                        "u_" + self.CACHE_DIRTY_ATTR)
+                    dirty = True
+                except (FileNotFoundError, KeyError):
+                    dirty = False
+                objs.append((oid, info.size, dirty))
+            total = len(objs)
+            total_bytes = sum(s for _, s, _ in objs)
+            dirty_objs = [o for o in objs if o[2]]
+            # pool-wide targets scale to this PG's share (reference
+            # TierAgentState: agent targets divide by pg_num)
+            pg_num = max(1, pool.pg_num)
+            obj_target = pool.target_max_objects / pg_num \
+                if pool.target_max_objects else 0
+            byte_target = pool.target_max_bytes / pg_num \
+                if pool.target_max_bytes else 0
+            over_objs = obj_target and total > obj_target
+            over_bytes = byte_target and total_bytes > byte_target
+            over_dirty = dirty_objs and (
+                (obj_target and
+                 len(dirty_objs) > pool.cache_target_dirty_ratio
+                 * obj_target)
+                or over_objs or over_bytes)
+            flush_list = [o for o, _, d in objs if d][:4] \
+                if over_dirty else []
+            evict_budget = 0
+            if over_objs:
+                evict_budget = int(total - obj_target) + 1
+            if over_bytes:
+                evict_budget = max(evict_budget, 4)
+            evict_list = [o for o, _, d in objs
+                          if not d and o not in self.inflight_writes
+                          and o not in self._flushing][:evict_budget]
+        flushed = 0
+        for oid in flush_list:
+            if self._flush_object(oid):
+                flushed += 1
+        evicted = 0
+        for oid in evict_list:
+            if self._evict_object(oid):
+                evicted += 1
+        return (flushed, evicted)
+
+    def _flush_object(self, oid: str) -> bool:
+        """Write a dirty object back to the base pool, then mark it
+        clean (reference agent_maybe_flush / start_flush).  Ops on the
+        object park while the flush holds it stable."""
+        with self.lock:
+            if oid in self.inflight_writes or oid in self._flushing \
+                    or oid in self._promoting \
+                    or oid in self._base_deleting \
+                    or self._is_degraded(oid):
+                # a log-recovering primary's LOCAL copy can be stale —
+                # flushing it would overwrite the base with old bytes
+                # that a later evict+promote would resurrect
+                return False
+            obj = GHObject(oid, self.own_shard)
+            try:
+                data = self.store.read(self.coll, obj)
+                raw_attrs = self.store.getattrs(self.coll, obj)
+                omap = self.store.omap_get(self.coll, obj)
+            except FileNotFoundError:
+                return False
+            attrs = {k[2:]: v for k, v in raw_attrs.items()
+                     if k.startswith("u_")
+                     and k[2:] != self.CACHE_DIRTY_ATTR}
+            base = self.service.get_osdmap().pools.get(
+                self.pool.tier_of)
+            if omap and (base is None or base.is_erasure()):
+                # omap can't land on an EC base (ENOTSUP there): the
+                # object stays dirty in the cache — this is exactly
+                # how a cache tier gives an EC pool omap support
+                # (reference: omap-bearing objects pin in the tier)
+                return False
+            self._flushing.add(oid)
+        base_pool = self.pool.tier_of
+
+        def run() -> None:
+            try:
+                from ..msg.messages import OSDOp
+                io = self.service.objecter_ioctx(base_pool)
+                # ONE compound op: content + attr/omap replacement
+                # land atomically at the base PG — a flush interrupted
+                # by a kill can never leave the base with new content
+                # but missing xattrs (a later promote would serve the
+                # torn copy)
+                ops = [OSDOp("rmxattrs"),
+                       OSDOp("writefull", 0, len(data), data)]
+                for k, v in attrs.items():
+                    ops.append(OSDOp("setxattr", data=v, name=k))
+                if omap:
+                    ops.append(OSDOp("omap_clear"))
+                    for k, v in omap.items():
+                        ops.append(OSDOp("omap_set", data=v, name=k))
+                io._obj_op(oid, ops)
+            except Exception:
+                with self.lock:
+                    self._flushing.discard(oid)
+                    q = self.waiting_for_obj.pop(oid, None)
+                    if q:
+                        for m, c in q:
+                            self._do_op(m, c)
+                return
+            with self.lock:
+                mut = Mutation()
+                mut.attrs[self.CACHE_DIRTY_ATTR] = None
+                self.cache_flushes += 1
+
+                def done(res: int) -> None:
+                    self._flushing.discard(oid)
+                    q = self.waiting_for_obj.pop(oid, None)
+                    if q:
+                        for m, c in q:
+                            try:
+                                self._do_op(m, c)
+                            except Exception:
+                                import traceback
+                                traceback.print_exc()
+                try:
+                    self._submit_internal(oid, mut, on_done=done)
+                except Exception:
+                    done(-5)
+
+        threading.Thread(target=run, name="cache-flush",
+                         daemon=True).start()
+        return True
+
+    def _evict_object(self, oid: str) -> bool:
+        """Drop a CLEAN object from the cache (reference
+        agent_maybe_evict): the base pool holds it; the next miss
+        promotes it back.  Goes through _submit_internal directly, so
+        the write-through base delete never fires."""
+        with self.lock:
+            if oid in self.inflight_writes or oid in self._flushing \
+                    or oid in self._promoting \
+                    or self._is_degraded(oid):
+                return False
+            if self.backend.get_object_info(oid) is None:
+                return False
+            # re-check cleanliness UNDER THE LOCK: a client write may
+            # have re-dirtied the object after the agent's listing —
+            # evicting it would drop acked data and a later miss
+            # would promote the stale base copy
+            try:
+                self.store.getattr(self.coll,
+                                   GHObject(oid, self.own_shard),
+                                   "u_" + self.CACHE_DIRTY_ATTR)
+                return False             # dirty again: flush first
+            except (FileNotFoundError, KeyError):
+                pass
+            mut = Mutation()
+            mut.delete = True
+            self.cache_evicts += 1
+            self._submit_internal(oid, mut)
+        return True
 
     def _can_pipeline(self, msg: MOSDOp, oid: str) -> bool:
         """May this write run concurrently with in-flight writes on
@@ -1139,15 +1568,32 @@ class PG:
         detection/snapshots/EC rules all apply unchanged."""
         src = next(op for op in msg.ops if op.op == "copy_from")
         src_oid = src.name
-        pool_id = self.pgid.pool
+        # on a cache-tier pool the source resolves through the
+        # OVERLAY: it may live only in the base after an evict, and
+        # the overlay read promotes it back before serving
+        if self.pool.is_tier():
+            pool_id, bypass = self.pool.tier_of, False
+        else:
+            pool_id, bypass = self.pgid.pool, True
         replicated = not self.pool.is_erasure()
 
         def fetch() -> None:
             try:
-                io = self.service.objecter_ioctx(pool_id)
-                data = io.read(src_oid)
-                attrs = io.getxattrs(src_oid)
-                omap = io.omap_get(src_oid) if replicated else {}
+                io = self.service.objecter_ioctx(pool_id, bypass)
+                # ONE compound read: data+xattrs+omap snapshot the
+                # source atomically at its PG — separate ops would
+                # leave windows where a tier evict/promote (or any
+                # concurrent writer) changes the object between them
+                fetch_ops = [OSDOp("read"), OSDOp("getxattrs")]
+                if replicated:
+                    fetch_ops.append(OSDOp("omap_get"))
+                reply = io._obj_op(src_oid, fetch_ops)
+                data = reply.out_data[0]
+                attrs = {k: v.encode("latin1") for k, v in
+                         reply.extra.get("xattrs", {}).items()}
+                omap = {k: v.encode("latin1") for k, v in
+                        reply.extra.get("omap", {}).items()} \
+                    if replicated else {}
             except Exception as e:
                 code = getattr(e, "errno", 0) or 5
                 with self.lock:
@@ -1279,6 +1725,16 @@ class PG:
             else:
                 err = -95
                 break
+        # reference osd_max_object_size: reject objects growing past
+        # the cap (checked on the projected write extent)
+        if not err:
+            limit = self.conf["osd_max_object_size"]
+            projected = mut.truncate if mut.truncate is not None \
+                else cur_size
+            for off, data in mut.writes:
+                projected = max(projected, off + len(data))
+            if limit and projected > limit:
+                err = -27                # EFBIG
         if ec and not self.pool.ec_overwrites and not mut.delete \
                 and not full_replace \
                 and not mut.append_only_at(info.size if info else 0):
@@ -1286,6 +1742,11 @@ class PG:
         if err:
             self._reply(conn, msg, err, [])
             return
+        if self.pool.is_tier() and self.pool.cache_mode == "writeback" \
+                and not mut.delete:
+            # dirty marker for the tier agent's flush pass (reference
+            # object_info_t FLAG_DIRTY)
+            mut.attrs[self.CACHE_DIRTY_ATTR] = b"1"
 
         # -- snapshots (reference PrimaryLogPG::make_writeable) --------
         from .snaps import SnapContext, SnapSet, clone_oid, snapdir_oid
@@ -1633,7 +2094,9 @@ class PG:
         state = {"pending": pending, "acks": [], "msg": msg,
                  "conn": conn, "nops": len(msg.ops)}
         self._notifies[nid] = state
-        timeout = (op.offset or 5000) / 1000.0
+        timeout = (op.offset or
+                   self.conf["osd_default_notify_timeout"] * 1000) \
+            / 1000.0
         t = threading.Timer(timeout, self._notify_timeout, args=(nid,))
         t.daemon = True
         state["timer"] = t
@@ -1716,6 +2179,13 @@ class PG:
         from the OSD tick; idempotent, so a crash mid-trim just
         re-scans."""
         from .snaps import SS_ATTR, SnapSet, clone_oid, is_snap_oid
+        # reference osd_snap_trim_sleep: pace trim rounds so trimming
+        # never starves client IO (checked outside the PG lock —
+        # sleeping under it would do the starving)
+        pause = self.conf["osd_snap_trim_sleep"]
+        if pause > 0 and time.monotonic() - getattr(
+                self, "_last_snap_trim", 0.0) < pause:
+            return 0
         with self.lock:
             removed = set(self.pool.removed_snaps)
             if not self.is_primary() or self.state != STATE_ACTIVE \
@@ -1724,6 +2194,7 @@ class PG:
                 return 0
             if self.is_primary() and self.num_missing() > 0:
                 return 0                 # recover first, then trim
+            self._last_snap_trim = time.monotonic()
             submitted = 0
             skipped = False
             for oid in self.backend.list_objects():
@@ -1767,9 +2238,11 @@ class PG:
                 self._snaps_trimmed = removed
             return submitted
 
-    def _submit_internal(self, oid: str, mut: Mutation) -> None:
-        """Primary-internal mutation (snap trim): full log + replication
-        machinery, no client to answer."""
+    def _submit_internal(self, oid: str, mut: Mutation,
+                         on_done=None) -> None:
+        """Primary-internal mutation (snap trim, cache promote/flush/
+        evict): full log + replication machinery, no client to answer.
+        ``on_done(res)`` runs after local commit, under the PG lock."""
         info = self.backend.get_object_info(oid)
         version = self._next_version()
         self._trim_seq = getattr(self, "_trim_seq", 0) + 1
@@ -1786,6 +2259,12 @@ class PG:
             self._inflight_remove(oid)
             if oid not in self.inflight_writes:
                 self._pending_versions.pop(oid, None)
+            if on_done is not None:
+                try:
+                    on_done(res)
+                except Exception:
+                    import traceback
+                    traceback.print_exc()
             q = self.waiting_for_obj.get(oid)
             if q:
                 nmsg, nconn = q.popleft()

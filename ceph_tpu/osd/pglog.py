@@ -151,6 +151,15 @@ class PGLog:
             self.tail = self.entries[cut - 1].version
             self.entries = self.entries[cut:]
 
+    def trim_to(self, n: int) -> None:
+        """Trim to at most ``n`` entries — the clean-PG trim
+        (reference osd_min_pg_log_entries: a clean PG keeps only the
+        minimum; the max bound applies while degraded)."""
+        if len(self.entries) > n:
+            keep, self.max_entries = self.max_entries, n
+            self._trim()
+            self.max_entries = keep
+
     # -- peering primitives ----------------------------------------------
     def entries_since(self, v: Eversion) -> Optional[List[LogEntry]]:
         """Entries with version > v, or None if v < tail (log no longer

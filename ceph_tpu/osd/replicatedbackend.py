@@ -378,6 +378,8 @@ class ReplicatedBackend(PGBackend):
         out: Dict[str, dict] = {}
         store = self.host.store
         coll = self.host.coll
+        conf = getattr(self.host, "conf", None)
+        stride = conf["osd_deep_scrub_stride"] if conf else 512 << 10
         for obj in store.collection_list(coll):
             if obj.oid.startswith("_pgmeta"):
                 continue
@@ -387,7 +389,15 @@ class ReplicatedBackend(PGBackend):
                 info = self.get_object_info(obj.oid)
                 entry["oi_version"] = list(info.version) if info else None
                 if deep:
-                    entry["data_crc"] = crc32c(store.read(coll, obj))
+                    # stride-wise CRC: bounded read buffer on huge
+                    # objects (reference osd_deep_scrub_stride)
+                    dc = 0
+                    off = 0
+                    while off < st.size:
+                        dc = crc32c(store.read(coll, obj, off,
+                                               stride), dc)
+                        off += stride
+                    entry["data_crc"] = dc
                     oc = 0
                     omap = store.omap_get(coll, obj)
                     for k in sorted(omap):

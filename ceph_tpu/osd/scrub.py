@@ -180,7 +180,14 @@ class Scrubber:
         if inconsistent:
             logger.dwarn("%s scrub found %d errors on %d objects",
                          pg.pgid_str, self.errors, len(inconsistent))
-        if self.repair and inconsistent:
+        auto = False
+        try:
+            auto = bool(pg.conf["osd_scrub_auto_repair"])
+        except Exception:
+            pass
+        if (self.repair or auto) and inconsistent:
+            # reference osd_scrub_auto_repair: scrub-found errors go
+            # straight to repair without an operator `pg repair`
             self._repair(inconsistent)
         pg.requeue_scrub_waiters()
         pg.service.kick_recovery(pg)

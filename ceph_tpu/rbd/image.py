@@ -70,10 +70,22 @@ class RBD:
     def list(self) -> List[str]:
         return self._dir()
 
-    def create(self, name: str, size: int,
-               order: int = DEFAULT_ORDER) -> None:
+    def create(self, name: str, size: Optional[int] = None,
+               order: Optional[int] = None) -> None:
+        try:
+            conf = self.ioctx.rados.conf     # the cluster's config
+        except AttributeError:
+            from ..utils.config import default_config
+            conf = default_config()
+        if size is None:
+            size = conf["rbd_default_size"]
+        if order is None:                # reference rbd_default_order
+            order = conf["rbd_default_order"]
         if not 12 <= order <= 26:
             raise ValueError("order must be in [12, 26]")
+        if conf["rbd_validate_names"] and (
+                not name or any(c in name for c in "/@\0")):
+            raise ValueError(f"invalid image name {name!r}")
         names = self._dir()
         if name in names:
             raise RadosError(17, f"image {name!r} exists")  # EEXIST

@@ -103,7 +103,7 @@ class MultipartMixin:
 
     def upload_part(self, bucket: str, key: str, upload_id: str,
                     part_num: int, data: bytes) -> str:
-        if not 1 <= part_num <= 10000:
+        if not 1 <= part_num <= self._part_limit:
             raise RGWError(400, "InvalidPartNumber", str(part_num))
         self._mp_get(bucket, upload_id, key)
         etag = hashlib.md5(data).hexdigest()
@@ -199,6 +199,11 @@ class RGWService(MultipartMixin):
 
     def __init__(self, ioctx: IoCtx):
         self.ioctx = ioctx
+        from ..utils.config import default_config
+        conf = getattr(ioctx.rados, "conf", None) or default_config()
+        self._list_max = conf["rgw_list_max_keys"]
+        self._part_limit = conf["rgw_multipart_part_limit"]
+        self._max_put = conf["rgw_max_put_size"]
         self.striper = StripedIoCtx(
             ioctx, Layout(stripe_unit=CHUNK, stripe_count=1,
                           object_size=CHUNK))
@@ -261,6 +266,8 @@ class RGWService(MultipartMixin):
         self._check_bucket(bucket)
         if not key:
             raise RGWError(400, "InvalidArgument", "empty key")
+        if len(data) > self._max_put:
+            raise RGWError(400, "EntityTooLarge", key)
         etag = hashlib.md5(data).hexdigest()
         soid = _data_soid(bucket, key)
         self.striper.write(soid, data)
@@ -314,11 +321,13 @@ class RGWService(MultipartMixin):
         self.ioctx.omap_rm_keys(idx, [key])
 
     def list_objects(self, bucket: str, prefix: str = "",
-                     marker: str = "", max_keys: int = 1000,
+                     marker: str = "", max_keys: Optional[int] = None,
                      delimiter: str = "") -> dict:
         """S3 ListObjects semantics: sorted keys, prefix filter,
         marker resume, delimiter common-prefix rollup (reference
         cls_rgw bucket listing + RGWListBucket)."""
+        if max_keys is None:
+            max_keys = self._list_max    # reference rgw_max_listing_results
         self._check_bucket(bucket)
         omap = self.ioctx.omap_get(_index_oid(bucket))
         keys = sorted(k for k in omap

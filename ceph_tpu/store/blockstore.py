@@ -90,6 +90,7 @@ class _Extents:
 
 
 class BlockStore(ObjectStore):
+    medium = "hdd"
     """reference BlueStore, collapsed to its storage model."""
 
     def __init__(self, path: str):

@@ -105,8 +105,13 @@ def _fsync_path(path: str) -> None:
 class FileStore(ObjectStore):
     """Data files + LogDB metadata + journaled transactions."""
 
-    def __init__(self, path: str):
+    medium = "hdd"
+
+    def __init__(self, path: str, fsync: bool = True):
         self.path = path
+        # filestore_fsync: the per-txn data fsync is the machine-crash
+        # durability knob; process restarts replay the WAL either way
+        self.fsync = fsync
         self._lock = threading.RLock()
         self._db: Optional[LogDB] = None
         self._finisher: Optional[Finisher] = None
@@ -208,6 +213,8 @@ class FileStore(ObjectStore):
             fin.queue(fn)
 
     def _sync_dirty(self, ctx: _ApplyCtx) -> None:
+        if not self.fsync:
+            return
         for path in ctx.dirty_files:
             if os.path.exists(path):
                 _fsync_path(path)

@@ -241,6 +241,19 @@ class LogDB(KeyValueDB):
     def _live_bytes(self) -> int:
         return sum(len(k) + len(v) + 13 for k, v in self._data.items())
 
+    def compact(self) -> None:
+        """Force a rewrite-to-live compaction now (reference
+        KeyValueDB::compact; consumed by mon_compact_on_start).
+        Logs already near their live size (< 4 KiB of slack) skip."""
+        with self._lock:
+            save_at, save_f = self._compact_check_at, self.compact_factor
+            self._compact_check_at, self.compact_factor = 0, 0
+            try:
+                self._maybe_compact()
+            finally:
+                self._compact_check_at = max(save_at, self._log_bytes)
+                self.compact_factor = save_f
+
     def _maybe_compact(self) -> None:
         if self._log_bytes < self._compact_check_at:
             return

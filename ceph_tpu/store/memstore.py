@@ -38,12 +38,20 @@ class _Object:
 
 
 class MemStore(ObjectStore):
-    def __init__(self, path: str = "") -> None:
+    def __init__(self, path: str = "", max_bytes: int = 0) -> None:
         self.path = path          # unused; kept for ObjectStore symmetry
+        self.max_bytes = max_bytes   # reference memstore_device_bytes;
+        self._data_bytes = 0         # 0 = unlimited
         self._lock = threading.RLock()
         self._colls: Dict[str, Dict[GHObject, _Object]] = {}
         self._finisher: Optional[Finisher] = None
         self._mounted = False
+
+    def _grow(self, delta: int) -> None:
+        if delta > 0 and self.max_bytes and \
+                self._data_bytes + delta > self.max_bytes:
+            raise OSError(28, "memstore full")   # ENOSPC
+        self._data_bytes += delta
 
     # -- lifecycle ---------------------------------------------------------
     def mkfs(self) -> None:
@@ -124,6 +132,7 @@ class MemStore(ObjectStore):
             o = self._obj(coll, obj, create=True)
             end = offset + len(data)
             if len(o.data) < end:
+                self._grow(end - len(o.data))
                 o.data.extend(b"\x00" * (end - len(o.data)))
             o.data[offset:end] = data
         elif name == "zero":
@@ -131,21 +140,30 @@ class MemStore(ObjectStore):
             o = self._obj(coll, obj, create=True)
             end = offset + length
             if len(o.data) < end:
+                self._grow(end - len(o.data))
                 o.data.extend(b"\x00" * (end - len(o.data)))
             o.data[offset:end] = b"\x00" * length
         elif name == "truncate":
             _, coll, obj, size = op
             o = self._obj(coll, obj, create=True)
             if len(o.data) > size:
+                self._grow(size - len(o.data))
                 del o.data[size:]
             else:
+                self._grow(size - len(o.data))
                 o.data.extend(b"\x00" * (size - len(o.data)))
         elif name == "remove":
             _, coll, obj = op
-            self._coll(coll).pop(obj, None)
+            gone = self._coll(coll).pop(obj, None)
+            if gone is not None:
+                self._data_bytes -= len(gone.data)
         elif name == "clone":
             _, coll, src, dst = op
-            self._coll(coll)[dst] = self._obj(coll, src).clone()
+            prev = self._coll(coll).get(dst)
+            src_o = self._obj(coll, src)
+            self._grow(len(src_o.data)
+                       - (len(prev.data) if prev else 0))
+            self._coll(coll)[dst] = src_o.clone()
         elif name == "setattr":
             _, coll, obj, attr, value = op
             self._obj(coll, obj, create=True).xattrs[attr] = value
@@ -169,7 +187,10 @@ class MemStore(ObjectStore):
         elif name == "mkcoll":
             self._colls.setdefault(op[1], {})
         elif name == "rmcoll":
-            self._colls.pop(op[1], None)
+            dropped = self._colls.pop(op[1], None)
+            if dropped:
+                self._data_bytes -= sum(len(o.data)
+                                        for o in dropped.values())
         elif name == "coll_move_rename":
             _, src_coll, src, dst_coll, dst = op
             o = self._coll(src_coll).pop(src)

@@ -184,6 +184,7 @@ def main(argv: List[str] = None) -> int:
     s = sub.add_parser("getxattr"); s.add_argument("obj")
     s.add_argument("name")
     s = sub.add_parser("listxattr"); s.add_argument("obj")
+    sub.add_parser("cache-flush-evict-all")
     s = sub.add_parser("bench")
     s.add_argument("seconds", type=int)
     s.add_argument("mode", choices=("write", "seq", "rand"))
@@ -224,6 +225,19 @@ def main(argv: List[str] = None) -> int:
         elif ns.op == "listxattr":
             for k in sorted(ioctx.getxattrs(ns.obj)):
                 print(k)
+        elif ns.op == "cache-flush-evict-all":
+            # reference `rados -p <cachepool> cache-flush-evict-all`:
+            # drain the tier — flush every dirty object, then evict
+            from ..client.rados import RadosError
+            for name in ioctx.list_objects():
+                try:
+                    ioctx.cache_flush(name)
+                except RadosError:
+                    pass
+                try:
+                    ioctx.cache_evict(name)
+                except RadosError:
+                    pass
         elif ns.op == "bench":
             summary = bench(ioctx, ns.seconds, ns.mode,
                             block_size=ns.block_size,

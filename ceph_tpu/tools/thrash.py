@@ -314,6 +314,35 @@ class Thrasher:
                     self.actions.append(f"pggrow {self.pggrow_pool} "
                                         f"-> {new}")
                 return
+        # option thrash (reference thrashosds injecting config
+        # changes): flip runtime-tunable options through the central
+        # config; daemons apply them off the next map, exercising the
+        # observer/override machinery under load
+        if self.rng.random() < 0.15:
+            name, val = self.rng.choice((
+                ("osd_recovery_max_active", self.rng.choice(
+                    ("1", "3", "8"))),
+                ("osd_recovery_sleep", self.rng.choice(
+                    ("0", "0.01"))),
+                ("ec_tpu_batch_stripes", self.rng.choice(
+                    ("256", "1024", "4096"))),
+                ("osd_min_pg_log_entries", self.rng.choice(
+                    ("100", "1500"))),
+                ("osd_heartbeat_grace", self.rng.choice(
+                    ("4.0", "6.0"))),
+            ))
+            if self.rng.random() < 0.3:
+                ret, _, _ = self.cluster.mon_command(
+                    {"prefix": "config rm", "name": name})
+                if ret == 0:
+                    self.actions.append(f"config rm {name}")
+            else:
+                ret, _, _ = self.cluster.mon_command(
+                    {"prefix": "config set", "name": name,
+                     "value": val})
+                if ret == 0:
+                    self.actions.append(f"config set {name}={val}")
+            return
         # revive when at the floor or by coin flip
         if self.down and (len(alive) <= self.min_alive
                           or self.rng.random() < 0.5):
@@ -371,7 +400,8 @@ class Thrasher:
 
 
 def run_thrash(n_osds: int, seconds: float, pool_type: str,
-               seed: int, out=sys.stdout, pggrow: bool = False) -> int:
+               seed: int, out=sys.stdout, pggrow: bool = False,
+               tiered: bool = False) -> int:
     from ..cluster import Cluster
     with Cluster(n_osds=n_osds) as cluster:
         for i in range(n_osds):
@@ -384,6 +414,28 @@ def run_thrash(n_osds: int, seconds: float, pool_type: str,
         else:
             cluster.create_pool("tp", "replicated",
                                 size=min(3, n_osds))
+        if tiered:
+            # writeback cache tier over the workload pool with tight
+            # targets: the model runs against constant promote/flush/
+            # evict churn (reference thrash-erasure-code + cache
+            # tiering suites)
+            cluster.create_pool("tp-cache", "replicated",
+                                size=min(3, n_osds))
+            for prefix, extra in (
+                    ("osd tier add",
+                     {"pool": "tp", "tierpool": "tp-cache"}),
+                    ("osd tier cache-mode",
+                     {"tierpool": "tp-cache", "mode": "writeback"}),
+                    ("osd tier set-overlay",
+                     {"pool": "tp", "tierpool": "tp-cache"})):
+                ret, msg, _ = cluster.mon_command(
+                    dict({"prefix": prefix}, **extra))
+                assert ret == 0, f"{prefix}: {msg}"
+            for var, val in (("target_max_objects", "8"),
+                             ("cache_target_dirty_ratio", "0.2")):
+                cluster.mon_command(
+                    {"prefix": "osd pool set", "pool": "tp-cache",
+                     "var": var, "val": val})
         # ops on degraded objects legitimately wait for recovery that
         # relentless churn keeps restarting — the reference's thrash
         # runs don't bound op latency at all; integrity (verify_all)
@@ -393,7 +445,7 @@ def run_thrash(n_osds: int, seconds: float, pool_type: str,
         io = client.open_ioctx("tp")
         model = RadosModel(io, seed=seed,
                            ec_mode=pool_type == "erasure",
-                           snaps=True)
+                           snaps=not tiered)
         thrasher = Thrasher(cluster, seed=seed,
                             min_alive=max(2, n_osds - 1
                                           if pool_type == "erasure"
@@ -425,9 +477,12 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--pggrow", action="store_true",
                    help="grow pg_num mid-workload (live PG splits)")
+    p.add_argument("--tiered", action="store_true",
+                   help="run the workload through a writeback cache "
+                        "tier with promote/flush/evict churn")
     ns = p.parse_args(argv)
     return run_thrash(ns.osds, ns.seconds, ns.pool_type, ns.seed,
-                      pggrow=ns.pggrow)
+                      pggrow=ns.pggrow, tiered=ns.tiered)
 
 
 if __name__ == "__main__":

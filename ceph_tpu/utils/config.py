@@ -86,6 +86,7 @@ def _opts() -> List[Option]:
         Option("osd_op_num_shards", int, 5, min=1,
                description="sharded op queue shard count"),
         Option("osd_op_queue", str, "mclock_scheduler",
+               enum_allowed=("mclock_scheduler", "fifo"),
                description="op scheduler: mclock_scheduler or fifo "
                            "(reference osd_op_queue)"),
         # dmClock triples (reference osd_mclock_scheduler_*): res =
@@ -101,8 +102,10 @@ def _opts() -> List[Option]:
         Option("osd_mclock_scheduler_scrub_wgt", float, 5.0),
         Option("osd_mclock_scheduler_scrub_lim", float, 0.0),
         Option("osd_op_num_threads_per_shard", int, 1, min=1),
-        Option("osd_recovery_max_active", int, 3, min=1,
-               description="recovery ops in flight per OSD"),
+        Option("osd_recovery_max_active", int, 0, min=0,
+               description="recovery ops in flight per OSD; 0 = pick "
+                           "the hdd/ssd-tuned variant by store medium "
+                           "(reference dual-default scheme)"),
         # hdd/ssd-tuned variants (reference options.cc device-class
         # defaults; consumers pick by store medium)
         Option("osd_recovery_max_active_hdd", int, 3, min=1),
@@ -119,7 +122,10 @@ def _opts() -> List[Option]:
         Option("osd_client_message_size_cap", int, 500 << 20, min=0),
         Option("osd_heartbeat_min_peers", int, 10, min=1),
         Option("osd_deep_scrub_stride", int, 512 << 10, min=4096),
-        Option("osd_scrub_chunk_max", int, 25, min=1),
+        Option("osd_scrub_during_recovery", bool, False,
+               description="allow scheduling scrubs while this daemon "
+                           "has PGs recovering (reference "
+                           "osd_scrub_during_recovery)"),
         Option("osd_pool_default_flag_hashpspool", bool, True),
         Option("mon_max_pg_per_osd", int, 250, min=1,
                description="pool creation guard (reference "
@@ -215,6 +221,253 @@ def _opts() -> List[Option]:
         Option("log_to_stderr", bool, False),
         Option("log_file", str, ""),
         Option("debug_default_level", int, 1, min=0, max=30),
+        # per-subsystem debug levels (reference common/subsys.h table +
+        # debug_<subsys> options; -1 = inherit debug_default_level).
+        # Consumed by utils/log.py get_subsys_level.
+        Option("debug_ec", int, -1, min=-1, max=30),
+        Option("debug_osd", int, -1, min=-1, max=30),
+        Option("debug_mon", int, -1, min=-1, max=30),
+        Option("debug_msg", int, -1, min=-1, max=30),
+        Option("debug_crush", int, -1, min=-1, max=30),
+        Option("debug_store", int, -1, min=-1, max=30),
+        Option("debug_client", int, -1, min=-1, max=30),
+        Option("debug_tools", int, -1, min=-1, max=30),
+        Option("debug_tpu", int, -1, min=-1, max=30),
+        Option("debug_paxos", int, -1, min=-1, max=30),
+        Option("debug_heartbeat", int, -1, min=-1, max=30),
+        Option("debug_recovery", int, -1, min=-1, max=30),
+        Option("debug_scrub", int, -1, min=-1, max=30),
+        Option("debug_mds", int, -1, min=-1, max=30),
+        Option("debug_mgr", int, -1, min=-1, max=30),
+        Option("debug_rgw", int, -1, min=-1, max=30),
+        Option("debug_rbd", int, -1, min=-1, max=30),
+        Option("debug_fs", int, -1, min=-1, max=30),
+        Option("debug_objclass", int, -1, min=-1, max=30),
+        # -- osd: pg log / batcher / prewarm / scrub / snap trim ----------
+        Option("osd_min_pg_log_entries", int, 1500, min=10,
+               description="log entries kept while clean (reference "
+                           "osd_min_pg_log_entries)"),
+        Option("osd_max_pg_log_entries", int, 3000, min=10,
+               description="log trim bound (reference "
+                           "osd_max_pg_log_entries); PGLog trims to "
+                           "this"),
+        Option("osd_batcher_drain_timeout", float, 30.0, min=0.0,
+               description="seconds shutdown waits for in-flight "
+                           "batched encodes before unmounting the "
+                           "store"),
+        Option("osd_ec_prewarm", bool, True,
+               description="compile pool-geometry device kernels + "
+                           "probe the CPU twin at EC backend build "
+                           "(first-op cold-start killer)"),
+        Option("ec_tpu_crossover_probe_interval", int, 16, min=1,
+               description="1-in-N small batches probe the device so "
+                           "the learned crossover can recover"),
+        Option("ec_tpu_crossover_min_bytes", int, 64 << 10, min=0,
+               description="floor for the learned CPU/device "
+                           "crossover threshold"),
+        Option("osd_scrub_sleep", float, 0.0, min=0.0,
+               description="pause between scrub chunks (reference "
+                           "osd_scrub_sleep)"),
+        Option("osd_max_scrubs", int, 1, min=1,
+               description="concurrent scrubs per OSD (reference "
+                           "osd_max_scrubs)"),
+        Option("osd_snap_trim_sleep", float, 0.0, min=0.0,
+               description="pause between snap-trim rounds "
+                           "(reference osd_snap_trim_sleep)"),
+        Option("osd_pool_default_ec_fast_read", bool, False,
+               description="new EC pools read all shards and "
+                           "reconstruct from the first k (reference "
+                           "osd_pool_default_ec_fast_read)"),
+        Option("osd_pool_default_pgp_num", int, 0, min=0,
+               description="0 = follow pg_num (reference "
+                           "osd_pool_default_pgp_num)"),
+        Option("osd_mon_report_interval", float, 0.0, min=0.0,
+               description="min seconds between PG stat reports; 0 "
+                           "reports every tick (reference "
+                           "osd_mon_report_interval)"),
+        Option("osd_objectstore", str, "memstore",
+               enum_allowed=("memstore", "file", "block"),
+               description="backing store kind for new OSDs "
+                           "(reference osd_objectstore; consumed by "
+                           "vstart/cephadm provisioning)"),
+        # -- mds / fs -----------------------------------------------------
+        Option("mds_journal_checkpoint_interval", int, 64, min=1,
+               description="journaled ops between watermark+trim "
+                           "(reference mds_log_max_segments analog)"),
+        Option("mds_recall_timeout", float, 2.0, min=0.05,
+               description="seconds before an unanswered cap recall "
+                           "is forced (reference mds_recall_warning "
+                           "analog)"),
+        Option("fs_default_stripe_unit", int, 64 << 10, min=4096,
+               description="default file layout stripe unit "
+                           "(reference fs_types default layout)"),
+        Option("fs_default_stripe_count", int, 4, min=1,
+               description="default file layout stripe count"),
+        Option("fs_default_object_size", int, 4 << 20, min=4096,
+               description="default file layout object size"),
+        # -- rbd ----------------------------------------------------------
+        Option("rbd_default_order", int, 22, min=12, max=26,
+               description="new images use 2^order-byte objects "
+                           "(reference rbd_default_order)"),
+        Option("rbd_default_size", int, 1 << 30, min=1,
+               description="image size when the CLI gets none "
+                           "(reference create defaults)"),
+        # -- rgw ----------------------------------------------------------
+        Option("rgw_list_max_keys", int, 1000, min=1,
+               description="S3 ListObjects page cap (reference "
+                           "rgw_max_listing_results)"),
+        Option("rgw_multipart_part_limit", int, 10000, min=1,
+               description="max parts per multipart upload "
+                           "(reference rgw_multipart_part_upload_limit)"),
+        Option("rgw_max_put_size", int, 5 << 30, min=1,
+               description="largest single PUT (reference "
+                           "rgw_max_put_size)"),
+        # -- mon ----------------------------------------------------------
+        Option("mon_allow_pool_delete", bool, True,
+               description="refuse `osd pool delete` when false "
+                           "(reference mon_allow_pool_delete; the "
+                           "reference defaults false, here true so "
+                           "test teardown keeps working)"),
+        Option("mon_allow_pool_size_one", bool, True,
+               description="permit size=1 replicated pools "
+                           "(reference mon_allow_pool_size_one)"),
+        Option("mon_min_osdmap_epochs", int, 500, min=1,
+               description="full maps kept before trim (reference "
+                           "mon_min_osdmap_epochs)"),
+        Option("mon_mds_beacon_grace_factor", float, 1.0, min=0.1,
+               description="multiplier on mds_beacon_grace applied "
+                           "by the monitor (load tolerance)"),
+        # -- messenger ----------------------------------------------------
+        Option("ms_tcp_nodelay", bool, True,
+               description="disable Nagle on data sockets "
+                           "(reference ms_tcp_nodelay)"),
+        Option("ms_tcp_listen_backlog", int, 128, min=1,
+               description="accept queue depth (reference "
+                           "ms_tcp_listen_backlog)"),
+        Option("ms_max_backoff", float, 2.0, min=0.01,
+               description="reconnect backoff cap; retries double "
+                           "from ms_connection_retry_interval up to "
+                           "this (reference ms_max_backoff)"),
+        # -- stores -------------------------------------------------------
+        Option("memstore_max_bytes", int, 0, min=0,
+               description="per-store capacity cap, 0 unlimited "
+                           "(reference memstore_device_bytes); writes "
+                           "past it fail ENOSPC"),
+        Option("kv_compact_factor", int, 4, min=2,
+               description="LogDB compacts when the log exceeds this "
+                           "multiple of live data"),
+        Option("filestore_fsync", bool, False,
+               description="fsync the WAL before acking commits "
+                           "(durability vs test speed)"),
+        # -- client -------------------------------------------------------
+        Option("rados_mon_op_timeout", float, 30.0, min=0.1,
+               description="default mon_command timeout (reference "
+                           "rados_mon_op_timeout)"),
+        Option("client_retry_interval", float, 0.05, min=0.001,
+               description="client poll cadence while waiting on "
+                           "cluster state transitions"),
+        # -- compressor ---------------------------------------------------
+        Option("compressor_zlib_level", int, 5, min=1, max=9,
+               description="zlib compression level (reference "
+                           "compressor_zlib_level)"),
+        # -- osd: ticks / history / scrub cadence / watch-notify ----------
+        Option("osd_tick_interval", float, 0.5, min=0.05,
+               description="OSD housekeeping tick cadence (reference "
+                           "OSD::tick)"),
+        Option("osd_op_history_size", int, 20, min=0,
+               description="completed ops kept for dump_historic_ops "
+                           "(reference osd_op_history_size)"),
+        Option("osd_op_history_duration", float, 600.0, min=0.0,
+               description="seconds a completed op stays in the "
+                           "history (reference "
+                           "osd_op_history_duration)"),
+        Option("trace_keep_spans", int, 512, min=1,
+               description="finished spans retained per tracer"),
+        Option("osd_heartbeat_min_size", int, 0, min=0,
+               description="pad pings to at least this many bytes "
+                           "(reference osd_heartbeat_min_size — "
+                           "exposes MTU blackholes)"),
+        Option("osd_scrub_auto_repair", bool, False,
+               description="repair scrub-found inconsistencies "
+                           "automatically (reference "
+                           "osd_scrub_auto_repair)"),
+        Option("osd_scrub_min_interval", float, 0.0, min=0.0,
+               description="per-PG randomized scrub cadence lower "
+                           "bound; 0 = use osd_scrub_interval flat"),
+        Option("osd_scrub_max_interval", float, 0.0, min=0.0,
+               description="per-PG randomized scrub cadence upper "
+                           "bound"),
+        Option("osd_default_notify_timeout", float, 5.0, min=0.1,
+               description="watch/notify ack timeout when the client "
+                           "sends none (reference "
+                           "osd_default_notify_timeout)"),
+        Option("osd_pool_default_crush_rule", str, "",
+               description="rule for new replicated pools when the "
+                           "command names none ('' = replicated_rule; "
+                           "reference osd_pool_default_crush_rule)"),
+        # -- mon: boot / fullness / disk health ---------------------------
+        Option("mon_osd_auto_mark_in", bool, True,
+               description="booting OSDs that were auto-marked out "
+                           "come back in (reference "
+                           "mon_osd_auto_mark_booting_in)"),
+        Option("mon_osd_full_ratio", float, 0.95, min=0.0, max=1.0,
+               description="store usage above this is OSD_FULL health "
+                           "(reference mon_osd_full_ratio)"),
+        Option("mon_osd_nearfull_ratio", float, 0.85, min=0.0,
+               max=1.0,
+               description="store usage above this is OSD_NEARFULL "
+                           "health (reference mon_osd_nearfull_ratio)"),
+        Option("mon_data_avail_warn", int, 30, min=0, max=100,
+               description="warn when the mon data dir's filesystem "
+                           "has less free %% than this (reference "
+                           "mon_data_avail_warn)"),
+        # -- client throttles ---------------------------------------------
+        Option("objecter_inflight_op_bytes", int, 100 << 20, min=1,
+               description="client dirty-byte window (reference "
+                           "objecter_inflight_op_bytes)"),
+        # -- auth triple (reference auth_*_required) ----------------------
+        Option("auth_service_required", str, "none",
+               enum_allowed=("none", "cephx")),
+        Option("auth_client_required", str, "none",
+               enum_allowed=("none", "cephx")),
+        # -- messenger bind range -----------------------------------------
+        Option("ms_bind_port_min", int, 6800, min=1, max=65535,
+               description="daemon port range start when binding "
+                           "without an explicit port (reference "
+                           "ms_bind_port_min; 0-port test binds "
+                           "stay ephemeral unless set)"),
+        Option("ms_bind_port_max", int, 7300, min=1, max=65535),
+        Option("ms_bind_port_range_enabled", bool, False,
+               description="bind daemons inside "
+                           "[ms_bind_port_min, ms_bind_port_max] "
+                           "instead of ephemeral ports"),
+        # -- rbd ----------------------------------------------------------
+        Option("rbd_validate_names", bool, True,
+               description="reject image names with reserved "
+                           "characters (reference rbd_validate_pool)"),
+        Option("mon_compact_on_start", bool, False,
+               description="force a LogDB compaction when a monitor "
+                           "store opens (reference "
+                           "mon_compact_on_start)"),
+        Option("ms_die_on_bad_msg", bool, False,
+               description="raise on an undecodable frame instead of "
+                           "dropping it (reference ms_die_on_bad_msg; "
+                           "debugging aid)"),
+        Option("mds_max_file_size", int, 1 << 40, min=1,
+               description="largest file the striper will address "
+                           "(reference mds_max_file_size)"),
+        Option("ms_tcp_rcvbuf", int, 0, min=0,
+               description="SO_RCVBUF on data sockets; 0 = OS default "
+                           "(reference ms_tcp_rcvbuf)"),
+        Option("osd_pool_erasure_code_stripe_unit", int, 4096,
+               min=512,
+               description="default EC chunk size when the profile "
+                           "sets none (reference "
+                           "osd_pool_erasure_code_stripe_unit)"),
+        Option("osd_scrub_load_threshold", float, 0.0, min=0.0,
+               description="skip scheduling scrubs while 1-min load "
+                           "average exceeds this; 0 disables the "
+                           "check (reference osd_scrub_load_threshold)"),
     ]
 
 
@@ -255,6 +508,16 @@ class Config:
 
     def __getitem__(self, name: str) -> Any:
         return self.get(name)
+
+    def is_overridden(self, name: str) -> bool:
+        """True when any non-default layer sets the option — lets a
+        consumer distinguish an explicit 0 from the compiled default
+        (the hdd/ssd-tuned options' 0-means-auto convention)."""
+        with self._lock:
+            if name not in self.schema:
+                raise KeyError(f"unknown option {name!r}")
+            return any(name in self._values[src]
+                       for src in self.SOURCES if src != "default")
 
     def unset(self, name: str, source: str = "runtime") -> None:
         """Drop a layered override so the option falls back to the

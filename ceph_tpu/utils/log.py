@@ -25,6 +25,7 @@ from typing import Dict
 SUBSYSTEMS = (
     "ec", "osd", "mon", "msg", "crush", "store", "client", "tools",
     "tpu", "paxos", "heartbeat", "recovery", "scrub",
+    "mds", "mgr", "rgw", "rbd", "fs", "objclass",
 )
 
 _levels: Dict[str, int] = {}
@@ -38,11 +39,32 @@ def _ensure_root() -> None:
         return
     root = logging.getLogger("ceph_tpu")
     if not root.handlers:
-        handler = logging.StreamHandler(sys.stderr)
-        handler.setFormatter(logging.Formatter(
+        fmt = logging.Formatter(
             "%(asctime)s.%(msecs)03d %(name)s %(levelname).1s %(message)s",
-            datefmt="%H:%M:%S"))
-        root.addHandler(handler)
+            datefmt="%H:%M:%S")
+        # reference log_file / log_to_stderr: a configured file sink
+        # replaces stderr unless stderr is also requested; with
+        # neither set, stderr remains the fallback sink
+        log_file = ""
+        to_stderr = False
+        try:
+            from .config import default_config
+            conf = default_config()
+            log_file = conf["log_file"]
+            to_stderr = conf["log_to_stderr"]
+        except Exception:
+            pass
+        if log_file:
+            try:
+                fh = logging.FileHandler(log_file)
+                fh.setFormatter(fmt)
+                root.addHandler(fh)
+            except OSError:
+                to_stderr = True         # unwritable path: fall back
+        if to_stderr or not log_file:
+            handler = logging.StreamHandler(sys.stderr)
+            handler.setFormatter(fmt)
+            root.addHandler(handler)
         root.setLevel(logging.DEBUG)
         root.propagate = False
     _configured = True
@@ -60,7 +82,17 @@ def get_subsys_level(subsys: str) -> int:
             return _levels[subsys]
     try:
         from .config import default_config
-        return int(default_config().get("debug_default_level"))
+        conf = default_config()
+        # per-subsystem debug_<subsys> option wins when set (>= 0);
+        # -1 inherits the default level (reference debug_<subsys>
+        # options over common/subsys.h defaults)
+        try:
+            per = int(conf.get(f"debug_{subsys}"))
+            if per >= 0:
+                return per
+        except KeyError:
+            pass
+        return int(conf.get("debug_default_level"))
     except Exception:
         return 1
 

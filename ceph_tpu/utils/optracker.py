@@ -46,10 +46,12 @@ class TrackedOp:
 
 class OpTracker:
     def __init__(self, history_size: int = 20,
+                 history_duration: float = 600.0,
                  slow_op_warn_threshold: float = 30.0):
         self._lock = threading.Lock()
         self._in_flight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
+        self.history_duration = history_duration
         self.slow_op_warn_threshold = slow_op_warn_threshold
 
     def create(self, description: str) -> TrackedOp:
@@ -70,6 +72,13 @@ class OpTracker:
 
     def dump_historic_ops(self) -> List[Dict]:
         with self._lock:
+            # age out entries past osd_op_history_duration (reference
+            # OpTracker history_duration trimming)
+            if self.history_duration > 0:
+                cutoff = time.time() - self.history_duration
+                while self._history and \
+                        (self._history[0].done or 0) < cutoff:
+                    self._history.popleft()
             return [op.dump() for op in self._history]
 
     def slow_ops(self) -> List[Dict]:
