@@ -927,17 +927,68 @@ class Monitor(Dispatcher):
             elif var == "min_size":
                 newpool.min_size = int(val)
             elif var == "pg_num":
-                # live pg_num growth -> OSD-side PG split (reference
-                # OSDMonitor.cc:8141 pg_num pool-set + OSD::split_pgs,
-                # osd/OSD.cc:8926).  Shrinking (PG merge) is not
-                # supported, matching the pre-Nautilus reference.
+                # live pg_num growth -> OSD-side PG split; decrease ->
+                # PG merge, children folding back into their split
+                # parents (reference OSDMonitor pg_num(_pending) +
+                # OSD merge_pgs, osd/OSD.cc:329-422)
                 n = int(val)
-                if n < pool.pg_num:
-                    return (-22, "pg_num decrease (merge) not "
-                            "supported", {})
+                if n < 1:
+                    return (-22, "pg_num must be >= 1", {})
                 if n > 65536:
                     return (-22, "pg_num too large", {})
+                if n < pool.pg_num and pool.is_erasure():
+                    # EC merges need chunk-position migration the
+                    # collection-fold design doesn't cover yet (a
+                    # holder's chunks land at its CHILD acting
+                    # position); replicated merges are supported
+                    return (-95, "pg_num decrease on erasure pools "
+                            "is not supported yet", {})
+                if n < pool.pg_num:
+                    # merge only from a healthy baseline (the
+                    # reference's pg_num_pending holds the decrease
+                    # until sources and targets are ready): every
+                    # holder then rebases the child log onto an
+                    # identical parent log, keeping the merge
+                    # deterministic cluster-wide
+                    if n * 2 < pool.pg_num:
+                        # at most halving per step: one child per
+                        # parent, so no two holders ever rebase
+                        # DIFFERENT children onto the same parent
+                        # versions (the reference likewise merges
+                        # stepwise)
+                        return (-22, f"pg_num can at most halve per "
+                                f"step (>= {(pool.pg_num + 1) // 2})",
+                                {})
+                    health = self._health_summary_locked()
+                    all_up = all(i.up for i in
+                                 self.osdmap.osds.values())
+                    if not health.get("all_clean") or not all_up:
+                        return (-16, "pg_num decrease requires a "
+                                "clean cluster with all OSDs up", {})
+                    # every child's data must be reachable from its
+                    # parent's acting set (a child held ONLY by
+                    # strays would never enter the authoritative log
+                    # and the stray purge would drop the last copies)
+                    from ..osd.osdmap import pg_split_source
+                    for c_seed in range(n, pool.pg_num):
+                        t = pg_split_source(c_seed, n)
+                        _, _, c_act, _ = \
+                            self.osdmap.pg_to_up_acting_osds(
+                                PGid(pool.pool_id, c_seed))
+                        _, _, p_act, _ = \
+                            self.osdmap.pg_to_up_acting_osds(
+                                PGid(pool.pool_id, t))
+                        if not (set(o for o in c_act if o is not None)
+                                & set(o for o in p_act
+                                      if o is not None)):
+                            return (-16, f"child pg {c_seed:x} shares "
+                                    f"no OSD with parent {t:x}; "
+                                    f"reweight first", {})
                 newpool.pg_num = n
+                if n < pool.created_pg_num:
+                    # keep the stray/ancestor algebra sound when the
+                    # pool shrinks below its creation size
+                    newpool.created_pg_num = n
             elif var == "target_max_objects":
                 newpool.target_max_objects = int(val)
             elif var == "target_max_bytes":
@@ -947,6 +998,11 @@ class Monitor(Dispatcher):
             else:
                 return (-22, f"unknown pool var {var}", {})
             inc = self._pending()
+            if var == "pg_num":
+                # every holder rebases merge logs at THIS epoch, so a
+                # late merger (revived OSD) lands BEHIND the cluster
+                # and ordinary catch-up corrects it
+                newpool.pg_num_epoch = inc.epoch
             inc.new_pools[pool.pool_id] = newpool
             self._commit(inc)
         return (0, "set", {})

@@ -281,12 +281,126 @@ class OSD(Dispatcher):
         if (info is None or not info.up) and not self._stop.is_set():
             self.monc.send_boot(self.whoami, self.my_addr)
 
+    def _maybe_merge_collections(self, osdmap: OSDMap) -> None:
+        """PG merge — the inverse of maybe_split (reference OSD
+        merge tracking, osd/OSD.cc:329-422 + PG::merge_from): when a
+        pool's pg_num SHRANK, collections whose seed is at or past the
+        new pg_num fold their objects back into the split parent
+        (pg_split_source).  Deterministic on every replica — all
+        holders of a child move the same objects into the same parent
+        collections (sorted order, so multi-child merges append log
+        entries identically everywhere) — and the parent adopts the
+        child's log rebased onto its own; peering catches up holders
+        that had no child data.  EC chunks land at the holder's CHILD
+        shard position, which may differ from its parent position:
+        those serve as mispositioned recovery sources
+        (extra_recovery_sources) while log recovery reconstructs the
+        proper placement.  Runs on the STORE, not the PG objects, so
+        merges pending from shrink-while-down complete on restart."""
+        # cheap gate: scan the store only when some pool's pg_num
+        # actually DECREASED since the last map we processed (or on
+        # the first map after boot, covering shrink-while-down)
+        prev = getattr(self, "_prev_pool_pgnums", None)
+        cur = {pid: p.pg_num for pid, p in osdmap.pools.items()}
+        self._prev_pool_pgnums = cur
+        if prev is not None and all(
+                cur[pid] >= prev.get(pid, 0) for pid in cur):
+            return
+        import re as _re
+
+        from ..store.objectstore import GHObject, Transaction
+        from .osdmap import pg_split_source
+        from .pg import PGMETA_OID
+        from .pglog import MissingSet, PGLog
+        try:
+            colls = sorted(self.store.list_collections())
+        except Exception:
+            return
+        groups: Dict[Tuple[int, int], List[Tuple[str, int]]] = {}
+        for coll in colls:
+            m = _re.fullmatch(r"(\d+)\.([0-9a-f]+)(?:s(\d+))?", coll)
+            if not m:
+                continue
+            pool_id = int(m.group(1))
+            seed = int(m.group(2), 16)
+            shard = int(m.group(3)) if m.group(3) is not None else -1
+            pool = osdmap.pools.get(pool_id)
+            if pool is None or seed < pool.pg_num:
+                continue                 # pool gone (purge handles) or
+                                         # still a live PG
+            groups.setdefault((pool_id, seed), []).append((coll,
+                                                           shard))
+        import json as _json
+        for (pool_id, seed) in sorted(groups):
+            pool = osdmap.pools[pool_id]
+            tseed = pg_split_source(seed, pool.pg_num)
+            base = f"{pool_id}.{tseed:x}"
+            # the in-memory child PG dies first; late ops bounce to
+            # the client, which re-targets the parent off the new map.
+            # The object snapshot + move txn run UNDER the child's
+            # lock with the merged-away flag set, so no write can
+            # commit between the snapshot and the collection removal
+            # (an acked write must never be silently dropped)
+            with self.pg_lock:
+                child = self.pgs.pop(PGid(pool_id, seed), None)
+            import contextlib
+            child_guard = child.lock if child is not None \
+                else contextlib.nullcontext()
+            child_log = None
+            child_missing = None
+            merged_locs: Dict[str, int] = {}   # oid -> local shard
+            ok = True
+            with child_guard:
+                if child is not None:
+                    child._merged_away = True
+                txn = Transaction()
+                for coll, shard in sorted(groups[(pool_id, seed)]):
+                    tcoll = base if shard < 0 else f"{base}s{shard}"
+                    if child_log is None:
+                        try:
+                            omap = self.store.omap_get(
+                                coll, GHObject(PGMETA_OID, shard))
+                            raw = omap.get("info")
+                            if raw:
+                                child_log = PGLog.decode(raw)
+                            raw = omap.get("missing")
+                            if raw:
+                                child_missing = MissingSet.from_dict(
+                                    _json.loads(raw.decode()))
+                        except Exception:
+                            pass
+                    if not self.store.collection_exists(tcoll):
+                        txn.create_collection(tcoll)
+                    for obj in self.store.collection_list(coll):
+                        if obj.oid == PGMETA_OID:
+                            continue
+                        merged_locs.setdefault(obj.oid, shard)
+                        txn.collection_move_rename(coll, obj, tcoll,
+                                                   obj)
+                    txn.remove_collection(coll)
+                try:
+                    self.store.queue_transactions([txn])
+                except Exception as e:
+                    self.log.dout(1, f"merge of {pool_id}.{seed:x} -> "
+                                  f"{base} failed: {e!r}; retrying on "
+                                  f"the next map")
+                    ok = False
+            if not ok:
+                continue
+            parent = self._ensure_pg(PGid(pool_id, tseed), osdmap)
+            if parent is not None:
+                parent.adopt_merge(child_log, child_missing,
+                                   pool.pg_num, merged_locs,
+                                   merge_epoch=pool.pg_num_epoch)
+            self.log.dout(1, f"merged pg {pool_id}.{seed:x} -> {base}")
+
     def _advance_pgs(self, osdmap: OSDMap) -> None:
         """Instantiate PGs mapped here and advance every hosted PG
         (reference consume_map / handle_pg_create).  Splits run before
         interval handling so children hold their objects before their
         peering starts (reference OSD::advance_pg split-then-peer
         ordering, osd/OSD.cc:8926)."""
+        self._maybe_merge_collections(osdmap)
         for pool_id in list(osdmap.pools):
             for pgid in osdmap.pgs_for_pool(pool_id):
                 _, _, acting, _ = osdmap.pg_to_up_acting_osds(pgid)
@@ -730,6 +844,7 @@ class OSD(Dispatcher):
             try:
                 if pg.is_stray():
                     pg.maybe_notify_stray(osdmap)
+                pg.maybe_announce_merge(osdmap)
             except Exception:
                 pass
 

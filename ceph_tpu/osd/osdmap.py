@@ -173,6 +173,8 @@ class PGPool:
     # cache tiering (reference pg_pool_t tier fields, osd/osd_types.h:
     # tier_of / read_tier / write_tier / cache_mode; applied by
     # PrimaryLogPG::maybe_handle_cache_detail, PrimaryLogPG.cc:2700)
+    pg_num_epoch: int = 0              # epoch of the last pg_num
+                                       # change (merge rebase anchor)
     tier_of: int = -1                  # base pool this pool caches
     read_tier: int = -1                # on the BASE pool: overlay tier
     write_tier: int = -1
@@ -378,6 +380,7 @@ class OSDMap:
                 "snap_seq": p.snap_seq,
                 "removed_snaps": p.removed_snaps,
                 "pool_snaps": p.pool_snaps,
+                "pg_num_epoch": p.pg_num_epoch,
                 "tier_of": p.tier_of,
                 "read_tier": p.read_tier,
                 "write_tier": p.write_tier,
@@ -416,6 +419,7 @@ class OSDMap:
                           snap_seq=p.get("snap_seq", 0),
                           removed_snaps=list(p.get("removed_snaps", [])),
                           pool_snaps=dict(p.get("pool_snaps", {})),
+                          pg_num_epoch=p.get("pg_num_epoch", 0),
                           tier_of=p.get("tier_of", -1),
                           read_tier=p.get("read_tier", -1),
                           write_tier=p.get("write_tier", -1),

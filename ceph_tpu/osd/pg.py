@@ -372,6 +372,12 @@ class PG:
         new = pool.pg_num
         with self.lock:
             old = self._last_split_pgnum
+            if new < old:
+                # a merge shrank the pool under us: follow the anchor
+                # down (every OSD, child-holder or not) so future
+                # growth re-splits from the new baseline
+                self._last_split_pgnum = old = new
+                self._persist_pgmeta()
             if new <= old:
                 return
             if self.pgid.seed >= old:
@@ -496,6 +502,118 @@ class PG:
                                    if o is not None]:
                 self._stray_shard = parent_shard
             self._persist_pgmeta()
+
+    def adopt_merge(self, child_log, child_missing,
+                    merge_pgnum: int,
+                    merged_locs: Optional[Dict[str, int]] = None,
+                    merge_epoch: int = 0) -> None:
+        """Parent side of a PG merge (reference PG::merge_from): the
+        child's objects were just folded into our collection by the
+        OSD.  The child's log entries are REBASED onto our log with
+        fresh versions — deterministically (same child log + same
+        parent head on every holder), so merging replicas produce an
+        identical advanced log and ordinary peering/catch-up teaches
+        everyone else: a parent holder with no child collection (e.g.
+        the new primary) sees a peer with a newer head, elects its
+        log, and log-recovers the merged objects."""
+        with self.lock:
+            # the rebase epoch is pinned to the epoch the POOL shrank
+            # (passed from the map that carries the shrink — the PG's
+            # own pool snapshot may be stale here): every holder uses
+            # the same value no matter when it merges, so a late
+            # merger (down during the shrink) produces versions BEHIND
+            # the cluster's and gets corrected by catch-up instead of
+            # overriding fresher state
+            merge_epoch = merge_epoch or self.pool.pg_num_epoch \
+                or self.epoch
+            acting_here = self.whoami in [o for o in self.acting
+                                          if o is not None]
+            stray_here = not acting_here
+            if stray_here:
+                # a NON-acting holder must not rebase the child log
+                # into its (possibly empty) parent log: a fresh PG's
+                # (0,0) base would yield a high-epoch head carrying
+                # ONLY the child's history, win the next election, and
+                # backfill everyone else DOWN to two objects.  A stray
+                # just serves its folded data (stray sources).
+                child_log = None
+            seq = max(self.log.last_update[1],
+                      self._last_assigned[1])
+            if child_log is not None:
+                for e in child_log.entries:
+                    seq += 1
+                    v = (merge_epoch, seq)
+                    ne = LogEntry(e.op, e.oid, v,
+                                  prior_version=(0, 0),
+                                  reqid=e.reqid)
+                    self.log.entries.append(ne)
+                    if e.reqid is not None:
+                        self.log.reqids[e.reqid] = v
+                if child_log.entries:
+                    self.log.last_update = (merge_epoch, seq)
+                    self._last_assigned = (merge_epoch, seq)
+                # reqids of entries the child already trimmed still
+                # guard against very old client resends
+                for reqid, ver in child_log.reqids.items():
+                    self.log.reqids.setdefault(reqid, ver)
+            if child_missing is not None:
+                for oid, (need, have) in child_missing.items.items():
+                    self.missing.add(oid, tuple(need),
+                                     tuple(have) if have else None)
+            self._last_split_pgnum = min(self._last_split_pgnum,
+                                         merge_pgnum)
+            merged_locs = merged_locs or {}
+            # (EC pools never reach here: the monitor rejects their
+            # pg_num decrease — chunk-position migration across
+            # acting sets is not implemented)
+            if stray_here and merged_locs:
+                # we hold merged data without being in the parent's
+                # acting set: serve as a stray source until purged
+                # (same machinery as split strays)
+                shards = {s for s in merged_locs.values() if s >= 0}
+                if shards:
+                    self._stray_shard = sorted(shards)[0]
+            self._persist_pgmeta()
+            if self.is_primary():
+                # our log advanced: re-peer so activation pushes the
+                # rebased entries to every member (they mark missing
+                # and recovery fills them in)
+                self.state = STATE_PEERING
+                self._peer_notifies.clear()
+                self._start_peering()
+            else:
+                # tell the primary we are ahead: the stray-notify
+                # ACTIVE path re-peers when our head outruns its log
+                self._merge_notify_pending = True
+
+    def maybe_announce_merge(self, osdmap: OSDMap) -> None:
+        """Acting member after a merge: announce our advanced log to
+        the primary (the stray-notify handler's head comparison
+        triggers its re-peer).  Called from map advance + the OSD
+        tick until sent."""
+        with self.lock:
+            if not getattr(self, "_merge_notify_pending", False):
+                return
+            _, _, acting, primary = osdmap.pg_to_up_acting_osds(
+                self.pgid)
+            if primary is None or primary == self.whoami:
+                self._merge_notify_pending = False
+                return
+            auth = self._authoritative_objects()
+            objects = {oid: list(auth.get(oid, (0, 0)))
+                       for oid in self.backend.list_objects()
+                       if oid != PGMETA_OID}
+            msg = MOSDPGNotify(
+                pgid=str(self.pgid), shard=self.own_shard,
+                from_osd=self.whoami,
+                epoch=osdmap.epoch, log=self.log.to_dict(),
+                missing=self.missing.to_dict(), stray=True,
+                objects=objects,
+                stray_shard=self._stray_shard
+                if self._stray_shard >= 0 else self.own_shard,
+                split_adopted=self._split_adopted)
+            self._merge_notify_pending = False
+        self.service.send_osd(primary, msg)
 
     # -- stray side ----------------------------------------------------
     def is_stray(self) -> bool:
@@ -1022,6 +1140,11 @@ class PG:
     # ------------------------------------------------------------------
     def do_request(self, msg: MOSDOp, conn) -> None:
         with self.lock:
+            if getattr(self, "_merged_away", False):
+                # this PG was folded into its split parent (pg merge):
+                # the client refreshes its map and re-targets
+                self._reply(conn, msg, -108, [])
+                return
             if not self.is_primary():
                 # client raced a map change: reply with our epoch so it
                 # refreshes and resends (reference resend-on-new-map)

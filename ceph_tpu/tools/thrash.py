@@ -303,15 +303,28 @@ class Thrasher:
             pool = self.cluster.osds[alive[0]].osdmap.get_pool(
                 self.cluster.osds[alive[0]].osdmap.pool_name_to_id[
                     self.pggrow_pool]) if alive else None
-            if pool is not None and pool.pg_num < self.pggrow_max:
-                new = min(self.pggrow_max,
-                          pool.pg_num + self.rng.choice((1, 2, 4)))
+            if pool is not None:
+                # grow (live PG split) or shrink (live PG merge —
+                # reference thrashosds pggrow/pgnum shrink support;
+                # EC merges are rejected by the monitor, so shrink
+                # only replicated pools)
+                if pool.pg_num > 2 and not pool.is_erasure() \
+                        and self.rng.random() < 0.4:
+                    new = max(2, pool.pg_num
+                              - self.rng.choice((1, 2, 4)))
+                    verb = "pgshrink"
+                elif pool.pg_num < self.pggrow_max:
+                    new = min(self.pggrow_max,
+                              pool.pg_num + self.rng.choice((1, 2, 4)))
+                    verb = "pggrow"
+                else:
+                    return
                 ret, _, _ = self.cluster.mon_command(
                     {"prefix": "osd pool set",
                      "pool": self.pggrow_pool, "var": "pg_num",
                      "val": str(new)})
                 if ret == 0:
-                    self.actions.append(f"pggrow {self.pggrow_pool} "
+                    self.actions.append(f"{verb} {self.pggrow_pool} "
                                         f"-> {new}")
                 return
         # option thrash (reference thrashosds injecting config
