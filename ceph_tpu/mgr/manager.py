@@ -116,21 +116,32 @@ class Manager(Dispatcher):
         self._http: Optional[ThreadingHTTPServer] = None
         self._http_port = http_port
         self.http_addr: Optional[Tuple[str, int]] = None
+        # module host (reference PyModuleRegistry): modules are
+        # enabled/disabled at runtime via mgr_enabled_modules, which
+        # `ceph mgr module enable/disable` edits through the
+        # monitor's central config so every mgr converges
+        from .modules import ModuleHost
+        self.modules = ModuleHost(self)
+        self._health_cache: dict = {}
 
     # ------------------------------------------------------------------
     def start(self) -> "Manager":
         self.msgr.start()
         self.monc.subscribe_osdmap()
+        self.modules.reconcile(
+            self.conf["mgr_enabled_modules"].split())
         t = threading.Thread(target=self._collect_loop,
                              name="mgr-collect", daemon=True)
         t.start()
         self._threads.append(t)
         self._start_http()
-        self.log.dout(1, f"mgr up, metrics at {self.http_addr}")
+        self.log.dout(1, f"mgr up, metrics at {self.http_addr}, "
+                      f"modules {sorted(self.modules.active)}")
         return self
 
     def shutdown(self) -> None:
         self._stop.set()
+        self.modules.shutdown()
         if self._http is not None:
             self._http.shutdown()
             self._http.server_close()
@@ -141,8 +152,35 @@ class Manager(Dispatcher):
     def _on_map(self, wire: dict) -> None:
         newmap = OSDMap.from_wire_dict(wire)
         with self.lock:
-            if newmap.epoch > self.osdmap.epoch:
-                self.osdmap = newmap
+            if newmap.epoch <= self.osdmap.epoch:
+                return
+            self.osdmap = newmap
+        # central-config overrides ride the map (same contract as the
+        # OSD): this is how `ceph mgr module enable/disable` reaches
+        # every mgr — the edited mgr_enabled_modules lands here and
+        # the next reconcile applies it
+        applied = getattr(self, "_applied_overrides", {})
+        for name, raw in newmap.cluster_config.items():
+            try:
+                if str(self.conf.get(name)) != raw:
+                    self.conf.set(name, raw)
+                applied[name] = raw
+            except (KeyError, ValueError):
+                pass
+        for name in list(applied):
+            if name not in newmap.cluster_config:
+                try:
+                    self.conf.unset(name)
+                except KeyError:
+                    pass
+                del applied[name]
+        self._applied_overrides = applied
+        try:
+            self.modules.reconcile(
+                self.conf["mgr_enabled_modules"].split())
+            self.modules.notify_all("osd_map")
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # collection (reference MMgrReport flow, inverted to pull)
@@ -171,33 +209,38 @@ class Manager(Dispatcher):
             except Exception as e:
                 self.log.dout(5, f"collect failed: {e!r}")
             try:
-                self._maybe_autoscale()
+                # modules follow the central-config enabled set (the
+                # reference's MgrMap module list) and get a perf tick
+                self.modules.reconcile(
+                    self.conf["mgr_enabled_modules"].split())
+                ret, _, out = self.monc.command(
+                    {"prefix": "health"}, 5.0)
+                if ret == 0:
+                    with self.lock:
+                        self._health_cache = out
+                self.modules.notify_all("perf")
             except Exception as e:
-                self.log.dout(5, f"autoscale failed: {e!r}")
+                self.log.dout(5, f"module tick failed: {e!r}")
 
-    def _maybe_autoscale(self) -> None:
-        """Apply pg_autoscaler recommendations when
-        ``mgr_pg_autoscale_mode = on`` (reference pg_autoscaler's
-        active mode issuing `osd pool set pg_num`): grow-only — PG
-        merges and EC-pool splits are not supported, so those
-        recommendations stay advisory."""
-        if self.conf["mgr_pg_autoscale_mode"] != "on":
-            return
+    # -- MgrModule API backing (see modules/__init__.py) ----------------
+    def _module_osdmap(self) -> OSDMap:
         with self.lock:
-            osdmap = self.osdmap
-        for rec in pg_autoscale_recommendations(osdmap):
-            pool = osdmap.pools.get(rec["pool_id"])
-            if pool is None or pool.is_erasure():
-                continue
-            if rec["target_pg_num"] > pool.pg_num:
-                ret, msg, _ = self.monc.command(
-                    {"prefix": "osd pool set", "pool": pool.name,
-                     "var": "pg_num",
-                     "val": str(rec["target_pg_num"])})
-                self.log.dout(
-                    1, f"autoscale {pool.name}: pg_num "
-                    f"{pool.pg_num} -> {rec['target_pg_num']} "
-                    f"(rc={ret} {msg})")
+            return self.osdmap
+
+    def _module_get(self, what: str):
+        """Named state blobs for modules (reference ActivePyModules::
+        get_python)."""
+        with self.lock:
+            if what == "perf_counters":
+                return {k: v["perf"]
+                        for k, v in self.daemon_perf.items()}
+            if what == "osd_map":
+                return self.osdmap.to_wire_dict()
+            if what == "health":
+                return dict(self._health_cache)
+            if what == "config":
+                return self.conf.dump()
+        raise KeyError(f"unknown state blob {what!r}")
 
     def _collect_once(self) -> None:
         interval = self.conf["mgr_tick_interval"]
@@ -248,59 +291,29 @@ class Manager(Dispatcher):
     # prometheus exporter (reference pybind/mgr/prometheus)
     # ------------------------------------------------------------------
     def render_metrics(self) -> str:
-        """Prometheus text exposition of every aggregated counter."""
-        lines: List[str] = []
-        with self.lock:
-            perf = {k: v for k, v in self.daemon_perf.items()}
-            osdmap = self.osdmap
-        n_up = sum(1 for i in osdmap.osds.values() if i.up)
-        n_in = sum(1 for i in osdmap.osds.values() if i.weight > 0)
-        lines.append("# TYPE ceph_osd_up gauge")
-        lines.append(f"ceph_osd_up {n_up}")
-        lines.append("# TYPE ceph_osd_in gauge")
-        lines.append(f"ceph_osd_in {n_in}")
-        lines.append("# TYPE ceph_osdmap_epoch counter")
-        lines.append(f"ceph_osdmap_epoch {osdmap.epoch}")
-        lines.append("# TYPE ceph_pool_count gauge")
-        lines.append(f"ceph_pool_count {len(osdmap.pools)}")
-        # metric-major grouping: the exposition format requires all
-        # samples of one family to be contiguous under its # TYPE line
-        families: Dict[str, List[Tuple[str, float]]] = {}
-        for daemon in sorted(perf):
-            snap = perf[daemon]["perf"]
-            for subsys, counters in snap.items():
-                for cname, val in counters.items():
-                    metric = f"ceph_{subsys}_{cname}"
-                    if isinstance(val, dict):      # timeavg
-                        for part, sfx in (("sum", "total"),
-                                          ("avgcount", "count")):
-                            if part in val:
-                                families.setdefault(
-                                    f"{metric}_{sfx}", []).append(
-                                    (daemon, val[part]))
-                    elif isinstance(val, (int, float)):
-                        families.setdefault(metric, []).append(
-                            (daemon, val))
-        for metric in sorted(families):
-            lines.append(f"# TYPE {metric} counter")
-            for daemon, val in families[metric]:
-                lines.append(f'{metric}{{daemon="{daemon}"}} {val}')
-        return "\n".join(lines) + "\n"
+        """Prometheus text exposition (delegates to the prometheus
+        module\'s renderer; kept for library callers)."""
+        from .modules.prometheus import render
+        return render(self._module_osdmap(),
+                      self._module_get("perf_counters"))
 
     def _start_http(self) -> None:
         mgr = self
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 (stdlib API)
-                if self.path.rstrip("/") in ("", "/metrics"):
-                    body = mgr.render_metrics().encode()
-                    ctype = "text/plain; version=0.0.4"
-                elif self.path.rstrip("/") == "/status":
-                    body = json.dumps(mgr.status(), indent=2,
-                                      default=str).encode()
-                    ctype = "application/json"
-                else:
+                # every route comes from an enabled module (reference:
+                # prometheus/restful/dashboard each bring their own
+                # HTTP surface; here one frontend dispatches)
+                fn = mgr.modules.http_route(self.path.rstrip("/"))
+                if fn is None:
                     self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    ctype, body = fn()
+                except Exception:
+                    self.send_response(500)
                     self.end_headers()
                     return
                 self.send_response(200)

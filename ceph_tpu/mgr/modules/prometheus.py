@@ -1,0 +1,59 @@
+"""prometheus mgr module: /metrics text exposition.
+
+Reference analog: ``src/pybind/mgr/prometheus/module.py`` — every
+aggregated perf counter plus cluster gauges in the Prometheus text
+format, served through the mgr's HTTP frontend.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from . import MgrModule
+
+
+def render(osdmap, perf: Dict[str, dict]) -> str:
+    lines: List[str] = []
+    n_up = sum(1 for i in osdmap.osds.values() if i.up)
+    n_in = sum(1 for i in osdmap.osds.values() if i.weight > 0)
+    lines.append("# TYPE ceph_osd_up gauge")
+    lines.append(f"ceph_osd_up {n_up}")
+    lines.append("# TYPE ceph_osd_in gauge")
+    lines.append(f"ceph_osd_in {n_in}")
+    lines.append("# TYPE ceph_osdmap_epoch counter")
+    lines.append(f"ceph_osdmap_epoch {osdmap.epoch}")
+    lines.append("# TYPE ceph_pool_count gauge")
+    lines.append(f"ceph_pool_count {len(osdmap.pools)}")
+    # metric-major grouping: the exposition format requires all
+    # samples of one family to be contiguous under its # TYPE line
+    families: Dict[str, List[Tuple[str, float]]] = {}
+    for daemon in sorted(perf):
+        for subsys, counters in perf[daemon].items():
+            for cname, val in counters.items():
+                metric = f"ceph_{subsys}_{cname}"
+                if isinstance(val, dict):          # timeavg
+                    for part, sfx in (("sum", "total"),
+                                      ("avgcount", "count")):
+                        if part in val:
+                            families.setdefault(
+                                f"{metric}_{sfx}", []).append(
+                                (daemon, val[part]))
+                elif isinstance(val, (int, float)):
+                    families.setdefault(metric, []).append(
+                        (daemon, val))
+    for metric in sorted(families):
+        lines.append(f"# TYPE {metric} counter")
+        for daemon, val in families[metric]:
+            lines.append(f'{metric}{{daemon="{daemon}"}} {val}')
+    return "\n".join(lines) + "\n"
+
+
+class Module(MgrModule):
+    NAME = "prometheus"
+
+    def _metrics(self):
+        body = render(self.get_osdmap(),
+                      self.get("perf_counters")).encode()
+        return "text/plain; version=0.0.4", body
+
+    def http_routes(self):
+        return {"/metrics": self._metrics, "": self._metrics}

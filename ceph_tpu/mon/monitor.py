@@ -839,6 +839,49 @@ class Monitor(Dispatcher):
                 self._commit(inc)
         return (0, f"pool '{name}' created", {"pool_id": pid})
 
+    # ------------------------------------------------------------------
+    # mgr module control plane (reference MonCommands.h `mgr module
+    # enable|disable|ls` -> MgrMonitor editing the MgrMap's module
+    # list; here the list is the mgr_enabled_modules central-config
+    # option, so every mgr converges off the next map)
+    # ------------------------------------------------------------------
+    def _mgr_modules(self) -> list:
+        return self.conf["mgr_enabled_modules"].split()
+
+    def _set_mgr_modules(self, mods: list):
+        val = " ".join(mods)
+        self.conf.set("mgr_enabled_modules", val)
+        with self.lock:
+            inc = self._pending()
+            inc.new_config["mgr_enabled_modules"] = val
+            self._commit(inc)
+
+    def _cmd_mgr_module_enable(self, cmd: dict):
+        name = cmd.get("module", "")
+        from ..mgr.modules import discover
+        if name not in discover():
+            return (-2, f"no such module {name!r} "
+                    f"(available: {sorted(discover())})", {})
+        mods = self._mgr_modules()
+        if name in mods:
+            return (0, f"module {name} already enabled", {})
+        self._set_mgr_modules(mods + [name])
+        return (0, f"module {name} enabled", {})
+
+    def _cmd_mgr_module_disable(self, cmd: dict):
+        name = cmd.get("module", "")
+        mods = self._mgr_modules()
+        if name not in mods:
+            return (0, f"module {name} not enabled", {})
+        self._set_mgr_modules([m for m in mods if m != name])
+        return (0, f"module {name} disabled", {})
+
+    def _cmd_mgr_module_ls(self, cmd: dict):
+        from ..mgr.modules import discover
+        enabled = self._mgr_modules()
+        return (0, "", {"enabled": enabled,
+                        "available": sorted(discover())})
+
     def _cmd_mds_beacon(self, cmd: dict):
         """MDS liveness + role assignment (reference MDSMonitor
         beacon handling): first beacon wins active; later ones queue
@@ -1415,6 +1458,9 @@ class Monitor(Dispatcher):
         "mds beacon": _cmd_mds_beacon,
         "mds getmap": _cmd_mds_getmap,
         "osd pool delete": _cmd_pool_delete,
+        "mgr module enable": _cmd_mgr_module_enable,
+        "mgr module disable": _cmd_mgr_module_disable,
+        "mgr module ls": _cmd_mgr_module_ls,
         "osd tier add": _cmd_tier_add,
         "osd tier cache-mode": _cmd_tier_cache_mode,
         "osd tier set-overlay": _cmd_tier_set_overlay,

@@ -335,6 +335,29 @@ class OSD(Dispatcher):
             pool = osdmap.pools[pool_id]
             tseed = pg_split_source(seed, pool.pg_num)
             base = f"{pool_id}.{tseed:x}"
+            _, _, p_acting, _ = osdmap.pg_to_up_acting_osds(
+                PGid(pool_id, tseed))
+            if self.whoami not in [o for o in p_acting
+                                   if o is not None]:
+                # we hold child data but are NOT a parent acting
+                # member: the merge gate required a fully CLEAN
+                # cluster, so the acting set holds everything current
+                # — our copy may even be a STALE stray left by churn.
+                # Folding it could rebase stale history into the
+                # parent; drop it instead (the purge we would get
+                # anyway, just earlier).
+                with self.pg_lock:
+                    self.pgs.pop(PGid(pool_id, seed), None)
+                txn = Transaction()
+                for coll, _shard in sorted(groups[(pool_id, seed)]):
+                    txn.remove_collection(coll)
+                try:
+                    self.store.queue_transactions([txn])
+                except Exception:
+                    pass
+                self.log.dout(1, f"dropped non-acting child copy "
+                              f"{pool_id}.{seed:x} at merge")
+                continue
             # the in-memory child PG dies first; late ops bounce to
             # the client, which re-targets the parent off the new map.
             # The object snapshot + move txn run UNDER the child's

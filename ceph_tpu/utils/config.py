@@ -170,6 +170,12 @@ def _opts() -> List[Option]:
         Option("mds_beacon_grace", float, 4.0, min=0.1,
                description="beacon-silent MDS is failed over after "
                            "this (reference mds_beacon_grace)"),
+        Option("mgr_enabled_modules", str,
+               "prometheus restful balancer pg_autoscaler alerts",
+               description="mgr modules to run (reference MgrMap "
+                           "module list; edited by `ceph mgr module "
+                           "enable/disable` through the central "
+                           "config)"),
         Option("mgr_pg_autoscale_mode", str, "off",
                enum_allowed=("off", "on"),
                description="apply pg_autoscaler recommendations (grow "

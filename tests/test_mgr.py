@@ -159,3 +159,145 @@ def test_autoscaler_applies_when_on():
         c.wait_for_clean(60)
         for name, blob in blobs.items():
             assert io.read(name, len(blob)) == blob, name
+
+
+def test_module_host_enable_disable_runtime():
+    """`ceph mgr module enable/disable` edits the central config; the
+    running mgr reconciles its active module set off the next map
+    (VERDICT r3 Next #7: load/enable/disable at runtime, >= 3 modules
+    on the host)."""
+    import time as _t
+
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.mgr.manager import Manager
+    conf = test_config()
+    with Cluster(n_osds=2, conf=conf) as c:
+        for i in range(2):
+            c.wait_for_osd_up(i, 20)
+        mgr = Manager(c.mon_addr, conf=conf).start()
+        try:
+            assert len(mgr.modules.active) >= 3
+            assert "alerts" in mgr.modules.active
+            # disable at runtime through the monitor
+            ret, msg, _ = c.mon_command(
+                {"prefix": "mgr module disable", "module": "alerts"})
+            assert ret == 0, msg
+            deadline = _t.time() + 15
+            while "alerts" in mgr.modules.active and \
+                    _t.time() < deadline:
+                _t.sleep(0.2)
+            assert "alerts" not in mgr.modules.active
+            # ls reflects it
+            ret, _, out = c.mon_command({"prefix": "mgr module ls"})
+            assert ret == 0 and "alerts" not in out["enabled"]
+            assert "alerts" in out["available"]
+            # re-enable
+            ret, msg, _ = c.mon_command(
+                {"prefix": "mgr module enable", "module": "alerts"})
+            assert ret == 0, msg
+            deadline = _t.time() + 15
+            while "alerts" not in mgr.modules.active and \
+                    _t.time() < deadline:
+                _t.sleep(0.2)
+            assert "alerts" in mgr.modules.active
+            # unknown module is a clean error
+            ret, _, _ = c.mon_command(
+                {"prefix": "mgr module enable", "module": "nope"})
+            assert ret == -2
+        finally:
+            mgr.shutdown()
+
+
+def test_restful_endpoints_and_module_commands():
+    """The restful module's JSON API + module handle_command routing
+    (reference pybind/mgr/restful + `ceph mgr <module> ...`)."""
+    import json as _json
+    import urllib.request
+
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.mgr.manager import Manager
+    conf = test_config()
+    with Cluster(n_osds=2, conf=conf) as c:
+        for i in range(2):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("mrp", "replicated", size=2)
+        mgr = Manager(c.mon_addr, conf=conf).start()
+        try:
+            host, port = mgr.http_addr
+            osds = _json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/api/osd", timeout=5
+            ).read().decode())
+            assert {o["osd"] for o in osds} == {0, 1}
+            pools = _json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/api/pool", timeout=5
+            ).read().decode())
+            assert any(p["name"] == "mrp" for p in pools)
+            # health lands once the collect tick fetched it
+            import time as _t
+            deadline = _t.time() + 40
+            health = {}
+            while _t.time() < deadline:
+                health = _json.loads(urllib.request.urlopen(
+                    f"http://{host}:{port}/api/health", timeout=5
+                ).read().decode())
+                if health.get("status"):
+                    break
+                _t.sleep(0.3)
+            assert health.get("status", "").startswith("HEALTH")
+            # module commands through the host
+            rc, _, out = mgr.modules.handle_command(
+                "balancer", {"args": ["status"]})
+            assert rc == 0 and "pools" in out or out
+            rc, _, out = mgr.modules.handle_command(
+                "pg_autoscaler", {"args": []})
+            assert rc == 0 and "recommendations" in out
+            rc, msg, _ = mgr.modules.handle_command("nope", {})
+            assert rc == -2
+        finally:
+            mgr.shutdown()
+
+
+def test_alerts_module_records_health_transitions():
+    """The from-scratch `alerts` module (written purely against the
+    MgrModule API) journals health transitions: killing an OSD flips
+    health away from OK and the transition lands in its history."""
+    import time as _t
+
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.mgr.manager import Manager
+    conf = test_config()
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("alp", "replicated", size=3)
+        io = c.rados().open_ioctx("alp")
+        io.write_full("x", b"payload")
+        c.wait_for_clean(30)
+        mgr = Manager(c.mon_addr, conf=conf).start()
+        try:
+            # let the module see HEALTH_OK first
+            deadline = _t.time() + 20
+            alerts = {}
+            while _t.time() < deadline:
+                rc, _, alerts = mgr.modules.handle_command(
+                    "alerts", {"args": ["history"]})
+                if alerts.get("current") == "HEALTH_OK":
+                    break
+                _t.sleep(0.3)
+            assert alerts.get("current") == "HEALTH_OK", alerts
+            c.kill_osd(2)
+            c.wait_for_osd_down(2)
+            deadline = _t.time() + 30
+            while _t.time() < deadline:
+                rc, _, alerts = mgr.modules.handle_command(
+                    "alerts", {"args": ["history"]})
+                if alerts.get("current") not in (None, "HEALTH_OK"):
+                    break
+                _t.sleep(0.3)
+            assert alerts["current"] != "HEALTH_OK", alerts
+            transitions = [(a["from"], a["to"])
+                           for a in alerts["alerts"]]
+            assert any(f == "HEALTH_OK" for f, t in transitions
+                       if f is not None), transitions
+        finally:
+            mgr.shutdown()
