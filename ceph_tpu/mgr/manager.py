@@ -159,28 +159,16 @@ class Manager(Dispatcher):
         # OSD): this is how `ceph mgr module enable/disable` reaches
         # every mgr — the edited mgr_enabled_modules lands here and
         # the next reconcile applies it
-        applied = getattr(self, "_applied_overrides", {})
-        for name, raw in newmap.cluster_config.items():
-            try:
-                if str(self.conf.get(name)) != raw:
-                    self.conf.set(name, raw)
-                applied[name] = raw
-            except (KeyError, ValueError):
-                pass
-        for name in list(applied):
-            if name not in newmap.cluster_config:
-                try:
-                    self.conf.unset(name)
-                except KeyError:
-                    pass
-                del applied[name]
-        self._applied_overrides = applied
+        from ..utils.config import apply_cluster_config_overrides
+        self._applied_overrides = apply_cluster_config_overrides(
+            self.conf, newmap.cluster_config,
+            getattr(self, "_applied_overrides", {}))
         try:
             self.modules.reconcile(
                 self.conf["mgr_enabled_modules"].split())
             self.modules.notify_all("osd_map")
-        except Exception:
-            pass
+        except Exception as e:
+            self.log.dout(1, f"module reconcile on map failed: {e!r}")
 
     # ------------------------------------------------------------------
     # collection (reference MMgrReport flow, inverted to pull)
@@ -240,6 +228,8 @@ class Manager(Dispatcher):
                 return dict(self._health_cache)
             if what == "config":
                 return self.conf.dump()
+        if what == "status":
+            return self.status()
         raise KeyError(f"unknown state blob {what!r}")
 
     def _collect_once(self) -> None:

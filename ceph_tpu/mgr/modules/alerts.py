@@ -53,7 +53,7 @@ class Module(MgrModule):
             self._last_status = status
 
     def handle_command(self, cmd: dict):
-        arg = cmd.get("args", [""])[0]
+        arg = (cmd.get("args") or [""])[0]
         if arg in ("history", ""):
             return (0, "", {"alerts": list(self._history),
                             "current": self._last_status})

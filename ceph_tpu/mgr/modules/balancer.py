@@ -14,6 +14,6 @@ class Module(MgrModule):
     NAME = "balancer"
 
     def handle_command(self, cmd: dict):
-        if cmd.get("args", [""])[0] in ("status", ""):
+        if (cmd.get("args") or [""])[0] in ("status", ""):
             return (0, "", balancer_report(self.get_osdmap()))
         return (-22, "usage: ceph mgr balancer status", {})

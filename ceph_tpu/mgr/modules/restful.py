@@ -45,7 +45,7 @@ class Module(MgrModule):
         return _json(self.get("perf_counters"))
 
     def _status(self):
-        return _json(self._host.status())
+        return _json(self.get("status"))
 
     def http_routes(self):
         return {"/api/health": self._health,

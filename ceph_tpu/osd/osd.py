@@ -257,22 +257,10 @@ class OSD(Dispatcher):
         # central config overrides ride the map (reference
         # ConfigMonitor -> MConfig): apply changes, REVERT removals,
         # observers fire either way
-        applied = getattr(self, "_applied_overrides", {})
-        for name, raw in newmap.cluster_config.items():
-            try:
-                if str(self.conf.get(name)) != raw:
-                    self.conf.set(name, raw)
-                applied[name] = raw
-            except (KeyError, ValueError):
-                pass                 # unknown/bad option: skip
-        for name in list(applied):
-            if name not in newmap.cluster_config:
-                try:
-                    self.conf.unset(name)
-                except KeyError:
-                    pass
-                del applied[name]
-        self._applied_overrides = applied
+        from ..utils.config import apply_cluster_config_overrides
+        self._applied_overrides = apply_cluster_config_overrides(
+            self.conf, newmap.cluster_config,
+            getattr(self, "_applied_overrides", {}))
         self._advance_pgs(newmap)
         # if the monitor thinks we're down (e.g. spurious failure
         # reports) but we're alive, re-boot (reference OSD re-sends
@@ -345,16 +333,25 @@ class OSD(Dispatcher):
                 # — our copy may even be a STALE stray left by churn.
                 # Folding it could rebase stale history into the
                 # parent; drop it instead (the purge we would get
-                # anyway, just earlier).
+                # anyway, just earlier).  Quiesce like the fold path:
+                # a racing client op must bounce, not ack into a
+                # collection being removed.
                 with self.pg_lock:
-                    self.pgs.pop(PGid(pool_id, seed), None)
-                txn = Transaction()
-                for coll, _shard in sorted(groups[(pool_id, seed)]):
-                    txn.remove_collection(coll)
-                try:
-                    self.store.queue_transactions([txn])
-                except Exception:
-                    pass
+                    dropped = self.pgs.pop(PGid(pool_id, seed), None)
+                import contextlib as _ctx
+                guard = dropped.lock if dropped is not None \
+                    else _ctx.nullcontext()
+                with guard:
+                    if dropped is not None:
+                        dropped._merged_away = True
+                    txn = Transaction()
+                    for coll, _shard in sorted(
+                            groups[(pool_id, seed)]):
+                        txn.remove_collection(coll)
+                    try:
+                        self.store.queue_transactions([txn])
+                    except Exception:
+                        pass
                 self.log.dout(1, f"dropped non-acting child copy "
                               f"{pool_id}.{seed:x} at merge")
                 continue

@@ -575,6 +575,31 @@ class Config:
                     if self.get(name) != self.schema[name].default}
 
 
+def apply_cluster_config_overrides(conf: "Config",
+                                   cluster_config: Dict[str, str],
+                                   applied: Dict[str, str]
+                                   ) -> Dict[str, str]:
+    """Apply the monitor's central-config overrides that ride every
+    published map (reference ConfigMonitor -> MConfig): set changed
+    values, REVERT removals, return the updated applied-set.  Shared
+    by every daemon that consumes maps (OSD, mgr)."""
+    for name, raw in cluster_config.items():
+        try:
+            if str(conf.get(name)) != raw:
+                conf.set(name, raw)
+            applied[name] = raw
+        except (KeyError, ValueError):
+            pass                     # unknown/bad option: skip
+    for name in list(applied):
+        if name not in cluster_config:
+            try:
+                conf.unset(name)
+            except KeyError:
+                pass
+            del applied[name]
+    return applied
+
+
 _default: Optional[Config] = None
 _default_lock = threading.Lock()
 

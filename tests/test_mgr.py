@@ -33,17 +33,20 @@ def cl():
 
 
 def test_mgr_aggregates_daemon_perf(cl):
-    deadline = time.monotonic() + 15
+    deadline = time.monotonic() + 25
+    total_ops = 0
     while time.monotonic() < deadline:
         st = cl.mgr.status()
+        with cl.mgr.lock:
+            perf = dict(cl.mgr.daemon_perf)
         if len(st["daemons_reporting"]) == 3:
-            break
+            total_ops = sum(p["perf"]["osd"]["op"]
+                            for p in perf.values())
+            # snapshots are pulled per tick: wait until they COVER
+            # the fixture's ops, not merely until daemons reported
+            if total_ops >= 10:
+                break
         time.sleep(0.3)
-    else:
-        raise TimeoutError(f"mgr never heard all osds: {st}")
-    with cl.mgr.lock:
-        perf = dict(cl.mgr.daemon_perf)
-    total_ops = sum(p["perf"]["osd"]["op"] for p in perf.values())
     assert total_ops >= 10          # 5 writes + 5 reads landed somewhere
     one = next(iter(perf.values()))["perf"]["osd"]
     assert one["op_latency"]["avgcount"] == one["op"]
@@ -247,7 +250,8 @@ def test_restful_endpoints_and_module_commands():
             # module commands through the host
             rc, _, out = mgr.modules.handle_command(
                 "balancer", {"args": ["status"]})
-            assert rc == 0 and "pools" in out or out
+            assert rc == 0, out
+            assert out
             rc, _, out = mgr.modules.handle_command(
                 "pg_autoscaler", {"args": []})
             assert rc == 0 and "recommendations" in out

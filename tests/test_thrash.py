@@ -105,9 +105,12 @@ def test_thrash_grow_shrink_integrity():
             pytest.skip(f"cluster never clean enough to merge: {msg}")
         try:
             c.wait_for_clean(180)
-        except TimeoutError:
-            pass    # slow settle under suite load; integrity is the
-                    # assertion and the reads below exercise the merge
+        except TimeoutError as e:
+            print(f"WARNING: post-merge settle timed out under "
+                  f"load: {e}")
+        # weaker settle signal that must hold regardless of load: the
+        # shrink took effect on the map
+        assert osd0.osdmap.pools[pid].pg_num == new
         problems = model.verify_all()
         assert problems == [], (problems, thrasher.actions)
         # and the model keeps passing on the merged layout
