@@ -372,15 +372,27 @@ def machine_factor() -> float:
     return _MFACTOR
 
 
-def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1"):
-    """One 3-OSD vstart-style run: write MB/s + rebuild MB/s (+ the
+def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
+                 n_osds=3):
+    """One vstart-style run: write MB/s + rebuild MB/s (+ the
     primary-side batcher's coalescing counters)."""
     from ceph_tpu.cluster import Cluster, test_config
 
     f = machine_factor()
-    with Cluster(n_osds=3, conf=test_config()) as c:
-        for i in range(3):
-            c.wait_for_osd_up(i, 20 * f)
+    overrides = {}
+    if n_osds > 4:
+        # many daemons on few cores: slow the heartbeat chatter so
+        # scheduler starvation doesn't fabricate failures; widen the
+        # batcher window so concurrent big-object ops actually meet
+        # inside one device call (latency-for-batch, the coalescing
+        # thesis)
+        overrides = dict(osd_heartbeat_interval=0.5,
+                         osd_heartbeat_grace=6.0,
+                         osd_pool_default_pg_num=4,
+                         ec_tpu_queue_window_us=3000)
+    with Cluster(n_osds=n_osds, conf=test_config(**overrides)) as c:
+        for i in range(n_osds):
+            c.wait_for_osd_up(i, 30 * f)
         c.create_ec_profile("bench", plugin=plugin, k=k, m=m)
         c.create_pool("benchp", "erasure",
                       erasure_code_profile="bench")
@@ -407,10 +419,11 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1"):
                 stats["coalesced"] += b.reqs_coalesced
                 stats["cpu"] += b.cpu_reqs
         c.wait_for_clean(30 * f)
-        c.kill_osd(2, lose_data=True)
-        c.wait_for_osd_down(2)
-        c.revive_osd(2)
-        c.wait_for_osd_up(2, 10 * f)
+        victim = n_osds - 1
+        c.kill_osd(victim, lose_data=True)
+        c.wait_for_osd_down(victim, 30 * f)
+        c.revive_osd(victim)
+        c.wait_for_osd_up(victim, 15 * f)
         t0 = time.perf_counter()
         c.wait_for_clean(120 * f)
         rebuild_s = time.perf_counter() - t0
@@ -418,6 +431,27 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1"):
         # the rebuild recovers the warmup objects too: count them
         rebuilt_mb = (n_objs + 2) * obj_bytes / 2**20
         return total_mb / write_s, rebuilt_mb / rebuild_s, stats
+
+
+def bench_cluster_k8m4(n_objs=12, obj_bytes=8 << 20):
+    """Cluster-level TPU visibility run (VERDICT r3 Next #3): a k=8
+    m=4 pool with a deep aio queue of 8 MiB objects — 256 stripes per
+    op, many ops in flight — gives the cross-op batcher real batches
+    to coalesce where the 4 KiB-chunk k=2 m=1 BASELINE config (below)
+    is deliberately CPU-routed."""
+    w_tpu, r_tpu, st = _cluster_run("tpu", n_objs, obj_bytes,
+                                    k="8", m="4", n_osds=13)
+    w_cpu, r_cpu, _ = _cluster_run("jerasure", n_objs, obj_bytes,
+                                   k="8", m="4", n_osds=13)
+    emit(f"cluster write MB/s (13-OSD vstart, pool plugin=tpu k=8 "
+         f"m=4, {n_objs}x{obj_bytes >> 20} MiB concurrent writes; "
+         f"batcher: {st['reqs']} encode reqs -> {st['calls']} device "
+         f"calls, {st['coalesced']} coalesced, {st['cpu']} routed to "
+         f"cpu twin; baseline=plugin-jerasure {w_cpu:.1f} MB/s)",
+         w_tpu, "MB/s", w_tpu / w_cpu)
+    emit(f"OSD rebuild MB/s (k=8 m=4 pool, kill osd with data loss; "
+         f"baseline=plugin-jerasure {r_cpu:.1f} MB/s)",
+         r_tpu, "MB/s", r_tpu / r_cpu)
 
 
 def bench_cluster(n_objs=8, obj_bytes=4 << 20):
@@ -455,10 +489,18 @@ CONFIGS = {
 }
 
 
+# opt-in extras (not part of the driver's default sweep: 2x 13-daemon
+# cluster runs are too heavy to gate the round record on)
+EXTRA_CONFIGS = {
+    "cluster_k8m4": bench_cluster_k8m4,
+}
+CONFIGS_ALL = dict(CONFIGS, **EXTRA_CONFIGS)
+
+
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--only", choices=sorted(CONFIGS), default=None,
-                    help="run a single config")
+    ap.add_argument("--only", choices=sorted(CONFIGS_ALL),
+                    default=None, help="run a single config")
     ap.add_argument("--platform", default=None,
                     help="force a JAX platform (e.g. cpu)")
     args = ap.parse_args()
@@ -469,7 +511,7 @@ def main():
     names = [args.only] if args.only else list(CONFIGS)
     for name in names:
         try:
-            CONFIGS[name]()
+            CONFIGS_ALL[name]()
         except Exception as e:  # one failed config must not mute the rest
             if name == "headline":
                 raise

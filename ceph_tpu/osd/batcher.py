@@ -167,8 +167,13 @@ class EncodeBatcher:
 
         def work():
             try:
+                # the probe must be REPRESENTATIVE: a tiny buffer
+                # under-measures the CPU twin (per-stripe call
+                # overhead dominates), which makes device round trips
+                # look competitive and mis-routes real batches
+                nprobe = max(64, min(self.max_stripes, 256))
                 probe = _Req(ec_impl, sinfo,
-                             b"\0" * (sinfo.stripe_width * 8),
+                             b"\0" * (sinfo.stripe_width * nprobe),
                              lambda _c: None)
                 self._cpu_rate(key, probe)
                 import jax
@@ -183,7 +188,21 @@ class EncodeBatcher:
                         return
                     z = np.zeros((nb, k, sinfo.chunk_size),
                                  dtype=np.uint8)
+                    ec_impl.encode_batch_async(z).wait()  # compile
+                    # SEED the crossover from a second, POST-compile
+                    # call (timing the first would fold seconds of
+                    # jit into the estimate and misroute a healthy
+                    # device to the CPU twin): on a slow device link
+                    # the very first client op must already route to
+                    # the CPU twin instead of waiting out a doomed
+                    # round trip
+                    t0 = time.monotonic()
                     ec_impl.encode_batch_async(z).wait()
+                    warm_req = _Req(ec_impl, sinfo, z.tobytes(),
+                                    lambda _c: None)
+                    self._learn_crossover(
+                        [warm_req], time.monotonic() - t0,
+                        trust_win=False)
             except Exception:
                 pass             # warms are best-effort
         threading.Thread(target=work, name="ec-prewarm",
@@ -233,14 +252,17 @@ class EncodeBatcher:
                     if handle == "cpu":
                         self._complete_group_cpu(reqs)
                     else:
-                        # crossover learning only when this cycle has
-                        # exactly ONE group of any kind: other groups'
-                        # synchronous completions (CPU encodes, commit
-                        # fanout callbacks) would inflate dev_time and
-                        # ratchet the threshold up on a healthy device
+                        # loss-direction learning runs on EVERY
+                        # group (raising the threshold is safe even
+                        # when sibling completions inflate dev_time —
+                        # worst case we conservatively route small
+                        # batches to the CPU twin); the win direction
+                        # (lowering it) only trusts single-group
+                        # cycles
                         self._complete_group(reqs, handle,
-                                             learn=(len(groups)
-                                                    == 1))
+                                             learn=True,
+                                             trust_win=(len(groups)
+                                                        == 1))
                 except Exception:
                     self._cb_error()
 
@@ -303,7 +325,8 @@ class EncodeBatcher:
                 self._cb_error()
 
     def _learn_crossover(self, reqs: List[_Req],
-                         dev_time: float) -> None:
+                         dev_time: float,
+                         trust_win: bool = True) -> None:
         """Compare the measured device time against the CPU twin's
         predicted time for the same bytes and move the routing
         threshold: lost -> raise it past this batch size; won big ->
@@ -322,7 +345,7 @@ class EncodeBatcher:
                 EncodeBatcher._min_device_bytes = max(
                     self._min_device_bytes,
                     dev_time * cpu_rate / 2, self.crossover_min)
-            elif dev_time < cpu_pred / 2 and \
+            elif trust_win and dev_time < cpu_pred / 2 and \
                     self._min_device_bytes > 0:
                 EncodeBatcher._min_device_bytes = min(
                     self._min_device_bytes, total / 2)
@@ -395,7 +418,8 @@ class EncodeBatcher:
             return None
 
     def _complete_group(self, reqs: List[_Req], handle,
-                        learn: bool = True) -> None:
+                        learn: bool = True,
+                        trust_win: bool = True) -> None:
         k = reqs[0].ec_impl.get_data_chunk_count()
         m = reqs[0].ec_impl.get_coding_chunk_count()
         parity = None
@@ -427,7 +451,8 @@ class EncodeBatcher:
                     self._cb_error()
             return
         if dev_time is not None and self.adaptive_cpu and learn:
-            self._learn_crossover(reqs, dev_time)
+            self._learn_crossover(reqs, dev_time,
+                                  trust_win=trust_win)
         self.calls += 1
         self.reqs_total += len(reqs)
         nstripes = sum(r.nstripes for r in reqs)

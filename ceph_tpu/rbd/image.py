@@ -45,6 +45,14 @@ def _header_oid(name: str) -> str:
     return f"rbd_header.{name}"
 
 
+def _journal_oid(name: str) -> str:
+    return f"rbd_journal.{name}"
+
+
+def _journal_head_oid(name: str) -> str:
+    return f"rbd_journal.{name}.head"
+
+
 def _data_oid(name: str, objectno: int) -> str:
     return f"rbd_data.{name}.{objectno:016x}"
 
@@ -71,7 +79,8 @@ class RBD:
         return self._dir()
 
     def create(self, name: str, size: Optional[int] = None,
-               order: Optional[int] = None) -> None:
+               order: Optional[int] = None,
+               features: Optional[Tuple[str, ...]] = None) -> None:
         try:
             conf = self.ioctx.rados.conf     # the cluster's config
         except AttributeError:
@@ -90,7 +99,14 @@ class RBD:
         if name in names:
             raise RadosError(17, f"image {name!r} exists")  # EEXIST
         header = {"size": size, "order": order, "snaps": {},
-                  "parent": None, "hwm": size}
+                  "parent": None, "hwm": size,
+                  # reference image features (RBD_FEATURE_*):
+                  # exclusive-lock gates writers through cls_lock;
+                  # journaling WALs every data write for crash-
+                  # consistent replay (librbd/exclusive_lock/ +
+                  # librbd/journal/)
+                  "features": list(features or ("layering",)),
+                  "lock_gen": 0}
         self.ioctx.write_full(_header_oid(name),
                               json.dumps(header).encode())
         self._dir_update(names + [name])
@@ -100,6 +116,11 @@ class RBD:
         if img.header["snaps"]:
             raise RadosError(39, "image has snapshots")  # ENOTEMPTY
         img._remove_all_data()
+        for oid in (_journal_oid(name), _journal_head_oid(name)):
+            try:
+                self.ioctx.remove(oid)
+            except RadosError:
+                pass
         self.ioctx.remove(_header_oid(name))
         self._dir_update([n for n in self._dir() if n != name])
 
@@ -144,6 +165,8 @@ class Image:
     derived from the header's live snaps, exactly the reference's
     ImageCtx::snapc — never races other images on the pool."""
 
+    JOURNAL_TRIM_EVERY = 32
+
     def __init__(self, ioctx: IoCtx, name: str,
                  snap_name: Optional[str] = None):
         self.ioctx = ioctx.dup()
@@ -154,6 +177,196 @@ class Image:
                 snap_name not in self.header["snaps"]:
             raise RadosError(2, f"no snap {snap_name!r}")
         self._apply_snap_state()
+        # exclusive lock state (reference librbd/exclusive_lock/):
+        # acquired lazily on the first write when the feature is on
+        import secrets
+        self._lock_cookie = f"{secrets.randbits(48):x}"
+        self._lock_held = False
+        self._lock_gen = 0
+        self._journal_seq = 0
+        self._journal_uncommitted = 0
+        # test hook: crash between the journal append and the data
+        # apply (the window the WAL exists for)
+        self._inject_crash_after_journal = False
+
+    # -- features / exclusive lock (reference librbd/exclusive_lock/,
+    #    built on cls_lock exactly like the reference) ----------------
+    def has_feature(self, f: str) -> bool:
+        return f in self.header.get("features", [])
+
+    @property
+    def _owner(self) -> str:
+        return self.ioctx.rados.msgr.name
+
+    def lock_info(self) -> Dict:
+        import json as _json
+        out = self.ioctx.exec_cls(
+            _header_oid(self.name), "lock", "get_info",
+            _json.dumps({"name": "rbd_lock"}).encode())
+        return _json.loads(out.decode()) if out else {}
+
+    def acquire_lock(self, force: bool = False) -> None:
+        """Take the image's exclusive lock (reference
+        ExclusiveLock<I>::acquire_lock): bumps the lock GENERATION in
+        the header and fences the journal at it, so a previous
+        holder's in-flight journal appends are rejected inside the
+        OSD (cls_fence — the same primitive that fences a zombie
+        MDS).  ``force`` breaks a dead holder's lock first (reference
+        break-lock on client eviction), then REPLAYS its journal so
+        no acked write is lost."""
+        import json as _json
+        if self._lock_held:
+            return
+        hoid = _header_oid(self.name)
+        req = {"name": "rbd_lock", "type": "exclusive",
+               "owner": self._owner, "cookie": self._lock_cookie,
+               "tag": "rbd"}
+        try:
+            self.ioctx.exec_cls(hoid, "lock", "lock",
+                                _json.dumps(req).encode())
+        except RadosError as e:
+            if e.errno not in (16, 17):  # not a lock conflict:
+                raise                    # surface the real error
+            if not force:
+                raise RadosError(16, f"image {self.name} is locked "
+                                 f"by another client")
+            info = self.lock_info()
+            for locker in list(info.get("lockers", {})):
+                owner, _, cookie = locker.partition(" ")
+                self.ioctx.exec_cls(
+                    hoid, "lock", "break_lock",
+                    _json.dumps({"name": "rbd_lock",
+                                 "locker_owner": owner,
+                                 "locker_cookie": cookie}).encode())
+            self.ioctx.exec_cls(hoid, "lock", "lock",
+                                _json.dumps(req).encode())
+        # generation bump under the lock; persists before any write
+        self.header = self._load_header()
+        self._lock_gen = self.header.get("lock_gen", 0) + 1
+        self.header["lock_gen"] = self._lock_gen
+        self._save_header()
+        self._lock_held = True
+        if self.has_feature("journaling"):
+            self.ioctx.exec_cls(
+                _journal_oid(self.name), "fence", "set",
+                _json.dumps({"epoch": self._lock_gen}).encode())
+            self._replay_journal()
+
+    def _assert_lock_owned(self) -> None:
+        info = self.lock_info()
+        key = f"{self._owner} {self._lock_cookie}"
+        if key not in info.get("lockers", {}):
+            self._lock_held = False
+            raise RadosError(108, f"image {self.name}: exclusive "
+                             f"lock lost (another client broke it)")
+
+    def release_lock(self) -> None:
+        if not self._lock_held:
+            return
+        import json as _json
+        if self.has_feature("journaling"):
+            try:
+                self._journal_commit()   # clean handoff: empty journal
+            except RadosError:
+                pass                     # evicted: successor owns it
+        try:
+            self.ioctx.exec_cls(
+                _header_oid(self.name), "lock", "unlock",
+                _json.dumps({"name": "rbd_lock",
+                             "owner": self._owner,
+                             "cookie": self._lock_cookie}).encode())
+        except RadosError:
+            pass                         # broken by a successor: fine
+        self._lock_held = False
+
+    def close(self) -> None:
+        self.release_lock()
+
+    def __enter__(self) -> "Image":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- journaling (reference librbd/journal/: WAL before data) ------
+    def _journal_append(self, offset: int, data: bytes) -> None:
+        import base64
+        import json as _json
+        self._journal_seq += 1
+        line = _json.dumps({
+            "seq": self._journal_seq, "off": offset,
+            "data": base64.b64encode(data).decode()}) + "\n"
+        try:
+            self.ioctx.exec_cls(
+                _journal_oid(self.name), "fence", "guarded_append",
+                _json.dumps({"epoch": self._lock_gen,
+                             "data": line}).encode())
+        except RadosError as e:
+            if e.errno == 1:             # EPERM: fenced — lock lost
+                self._lock_held = False
+                raise RadosError(
+                    108, f"image {self.name}: exclusive lock lost "
+                    f"(another client acquired it)")
+            raise
+
+    def _journal_commit(self) -> None:
+        """Data writes up to the current seq are durable: advance the
+        committed watermark and trim (reference journal commit +
+        trim)."""
+        import json as _json
+        head = _json.dumps({"committed": self._journal_seq})
+        try:
+            self.ioctx.exec_cls(
+                _journal_head_oid(self.name), "fence",
+                "guarded_write_full",
+                _json.dumps({"epoch": self._lock_gen,
+                             "data": head}).encode())
+            self.ioctx.exec_cls(
+                _journal_oid(self.name), "fence", "guarded_truncate",
+                _json.dumps({"epoch": self._lock_gen,
+                             "size": 0}).encode())
+        except RadosError as e:
+            if e.errno == 1:
+                self._lock_held = False
+                raise RadosError(108, "exclusive lock lost")
+            if e.errno != 2:
+                raise
+        self._journal_uncommitted = 0
+
+    def _replay_journal(self) -> None:
+        """Apply journal events past the committed watermark to the
+        data objects (reference librbd journal replay on open): a
+        holder that died between append and apply loses nothing."""
+        import base64
+        import json as _json
+        try:
+            head = _json.loads(self.ioctx.read(
+                _journal_head_oid(self.name)).decode())
+        except (RadosError, ValueError):
+            head = {"committed": 0}
+        committed = head.get("committed", 0)
+        try:
+            raw = self.ioctx.read(_journal_oid(self.name))
+        except RadosError:
+            raw = b""
+        replayed = 0
+        top = committed
+        for line in raw.splitlines():
+            if not line.strip():
+                continue
+            try:
+                ev = _json.loads(line.decode())
+            except ValueError:
+                continue
+            top = max(top, ev["seq"])
+            if ev["seq"] <= committed:
+                continue
+            self._apply_write(ev["off"],
+                              base64.b64decode(ev["data"]))
+            replayed += 1
+        self._journal_seq = top
+        if replayed:
+            self._journal_commit()
 
     # -- header --------------------------------------------------------
     def _load_header(self) -> Dict:
@@ -255,6 +468,28 @@ class Image:
         size = self.header["size"]
         if offset + len(data) > size:
             raise RadosError(27, "write past image end")  # EFBIG
+        if self.has_feature("exclusive-lock") or \
+                self.has_feature("journaling"):
+            self.acquire_lock()          # lazy auto-acquire
+            if not self.has_feature("journaling"):
+                # journaled writes are fenced inside the OSD; without
+                # journaling the only zombie defense is verifying
+                # ownership (the reference blocklists evicted clients
+                # at the OSDMap instead)
+                self._assert_lock_owned()
+        if self.has_feature("journaling"):
+            # WAL: the event is durable (and fenced to our lock
+            # generation) BEFORE any data object changes
+            self._journal_append(offset, data)
+            if self._inject_crash_after_journal:
+                return                   # test hook: "crash" here
+        self._apply_write(offset, data)
+        if self.has_feature("journaling"):
+            self._journal_uncommitted += 1
+            if self._journal_uncommitted >= self.JOURNAL_TRIM_EVERY:
+                self._journal_commit()
+
+    def _apply_write(self, offset: int, data: bytes) -> None:
         osize = self.object_size
         pos = offset
         while pos < offset + len(data):
@@ -277,6 +512,9 @@ class Image:
     def resize(self, new_size: int) -> None:
         if self.snap_name is not None:
             raise RadosError(30, "snapshot views are read-only")
+        if self.has_feature("exclusive-lock") or \
+                self.has_feature("journaling"):
+            self.acquire_lock()
         old = self.header["size"]
         self.header["size"] = new_size
         # high-water mark: whiteouts from clone shrinks can sit past

@@ -257,3 +257,96 @@ def test_clone_shrink_remove_leaks_nothing(io):
     assert not left, f"leaked: {left}"
     Image(io, "lkp").snap_rm("g")
     rbd.remove("lkp")
+
+
+def test_exclusive_lock_blocks_second_writer():
+    """exclusive-lock feature (reference librbd/exclusive_lock/ over
+    cls_lock): a second client cannot write while the lock is held;
+    force-acquire breaks a dead holder's lock."""
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.rbd.image import Image, RBD
+    with Cluster(n_osds=3, conf=test_config()) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rbl", "replicated", size=2)
+        io_a = c.rados().open_ioctx("rbl")
+        io_b = c.rados().open_ioctx("rbl")
+        RBD(io_a).create("locked", size=1 << 22, order=20,
+                         features=("layering", "exclusive-lock"))
+        a = Image(io_a, "locked")
+        a.write(0, b"A" * 4096)          # lazy-acquires the lock
+        assert a._lock_held
+        b = Image(io_b, "locked")
+        with pytest.raises(RadosError) as ei:
+            b.write(0, b"B" * 4096)
+        assert ei.value.errno == 16      # EBUSY
+        # dead holder: the next writer force-breaks
+        b.acquire_lock(force=True)
+        b.write(0, b"B" * 4096)
+        assert b.read(0, 4096) == b"B" * 4096
+        b.close()
+
+
+def test_journaling_replays_acked_writes_after_crash():
+    """journaling feature (reference librbd/journal/): every write is
+    journaled BEFORE data objects change; a client that dies between
+    the two loses nothing — the next opener replays (VERDICT r3 Next
+    #9 done-bar: no lost acked writes)."""
+    import os as _os
+
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.rbd.image import Image, RBD
+    with Cluster(n_osds=3, conf=test_config()) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rbj", "replicated", size=2)
+        io = c.rados().open_ioctx("rbj")
+        RBD(io).create("wal", size=1 << 22, order=20,
+                       features=("layering", "exclusive-lock",
+                                 "journaling"))
+        a = Image(io, "wal")
+        base = _os.urandom(8192)
+        a.write(0, base)                 # journaled + applied
+        lost = _os.urandom(4096)
+        a._inject_crash_after_journal = True
+        a.write(4096, lost)              # acked, journaled, NOT applied
+        # the writer "crashes" here (no release, no apply)
+        io2 = c.rados().open_ioctx("rbj")
+        b = Image(io2, "wal")
+        b.acquire_lock(force=True)       # break + REPLAY
+        got = b.read(0, 8192)
+        assert got[:4096] == base[:4096]
+        assert got[4096:] == lost, "acked journaled write was lost"
+        b.close()
+
+
+def test_journal_fences_zombie_writer():
+    """A deposed lock holder's journal appends are rejected inside
+    the OSD (cls_fence at the lock generation) — the same guarantee
+    as MDS zombie fencing, so a paused writer can never corrupt the
+    successor's image."""
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.rbd.image import Image, RBD
+    with Cluster(n_osds=3, conf=test_config()) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rbz", "replicated", size=2)
+        io_a = c.rados().open_ioctx("rbz")
+        io_b = c.rados().open_ioctx("rbz")
+        RBD(io_a).create("z", size=1 << 22, order=20,
+                         features=("layering", "exclusive-lock",
+                                   "journaling"))
+        a = Image(io_a, "z")
+        a.write(0, b"A" * 4096)
+        # B evicts A (A is "wedged", not dead)
+        b = Image(io_b, "z")
+        b.acquire_lock(force=True)
+        b.write(0, b"B" * 4096)
+        # the zombie's next write must fail, not interleave
+        with pytest.raises(RadosError) as ei:
+            a.write(1 << 20, b"ZOMBIE")
+        assert ei.value.errno in (16, 108)
+        assert b.read(0, 4096) == b"B" * 4096
+        b.close()
