@@ -30,6 +30,7 @@ from .store.filestore import FileStore
 from .store.memstore import MemStore
 from .store.objectstore import ObjectStore
 from .utils.config import Config
+from .utils.machine import scaled
 
 
 def test_config(**overrides) -> Config:
@@ -139,7 +140,9 @@ class Cluster:
         return self
 
     def wait_for_quorum(self, timeout: float = 15.0) -> int:
-        """Block until some live mon is leader; -> leader rank."""
+        """Block until some live mon is leader; -> leader rank.
+        Budget machine-factor-scaled, like every cluster wait."""
+        timeout = scaled(timeout)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             for mon in self.mons.values():
@@ -281,7 +284,13 @@ class Cluster:
 
     def wait_for_clean(self, timeout: float = 30.0) -> float:
         """Block until every PG reports active+clean; -> seconds it
-        took (the rebuild-time metric of BASELINE.json config 5)."""
+        took (the rebuild-time metric of BASELINE.json config 5).
+
+        The budget is scaled by the measured machine factor
+        (utils/machine.py): fixed constants under variable load were
+        r1-r4's flake fountain, and the reference's own helper runs
+        with a 300 s default (qa/standalone/ceph-helpers.sh:1579)."""
+        timeout = scaled(timeout)
         t0 = time.monotonic()
         deadline = t0 + timeout
         while time.monotonic() < deadline:
@@ -293,6 +302,7 @@ class Cluster:
             f"cluster not clean after {timeout}s: {self.health()}")
 
     def wait_for_osd_up(self, osd_id: int, timeout: float = 10.0) -> None:
+        timeout = scaled(timeout)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             ret, _, out = self.mon_command({"prefix": "osd dump"})
@@ -305,6 +315,7 @@ class Cluster:
 
     def wait_for_osd_down(self, osd_id: int,
                           timeout: float = 15.0) -> None:
+        timeout = scaled(timeout)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             ret, _, out = self.mon_command({"prefix": "osd dump"})

@@ -122,11 +122,17 @@ class ErasureCodeJerasure(ErasureCode):
         """Data piece i lives at key chunk_index(i) (where encode_prepare
         put it); parity for code position k+i goes to key chunk_index(k+i).
         With the default identity mapping this is byte-identical to the
-        reference (ErasureCodeJerasure.cc:105-113)."""
-        data = np.stack([encoded[self.chunk_index(i)] for i in range(self.k)])
+        reference (ErasureCodeJerasure.cc:105-113).
+
+        Batch-transparent: chunk buffers may carry leading batch axes
+        ([..., L]); all stripes encode in one core call (the seam the
+        layered LRC plugin batches through)."""
+        data = np.stack([encoded[self.chunk_index(i)]
+                         for i in range(self.k)], axis=-2)
         parity = self.core.encode(data)
         for i in range(self.m):
-            encoded[self.chunk_index(self.k + i)][:] = parity[i]
+            encoded[self.chunk_index(self.k + i)][:] = \
+                parity[..., i, :]
 
     def decode_chunks(self, want_to_read: Set[int],
                       chunks: Mapping[int, np.ndarray],
@@ -137,7 +143,7 @@ class ErasureCodeJerasure(ErasureCode):
         pos_of_key = {self.chunk_index(p): p
                       for p in range(self.k + self.m)}
         present = {pos_of_key[i]: np.asarray(c) for i, c in chunks.items()}
-        blocksize = len(next(iter(present.values())))
+        blocksize = next(iter(present.values())).shape[-1]
         rebuilt = self.core.decode_chunks(present, blocksize)
         for pos, arr in rebuilt.items():
             decoded[self.chunk_index(pos)][:] = arr

@@ -99,6 +99,14 @@ class ErasureCodeLrc(ErasureCode):
 
     # -- init -------------------------------------------------------------
     def init(self, profile: ErasureCodeProfile) -> None:
+        # inner=<plugin> selects the default per-layer plugin (the
+        # north-star wiring, BASELINE config 4: plugin=lrc inner=tpu
+        # accelerates every layer; a layer profile's own plugin= still
+        # wins).  The reference reaches the same effect by writing
+        # plugin= into each layer's profile (ErasureCodeLrc.cc:215-247
+        # layers_init); the kml simple form needs this knob because it
+        # generates the layer profiles itself.
+        self.inner_plugin = profile.pop("inner", "jerasure")
         kml_used = self.parse_kml(profile)
         self.parse(profile)
         if "layers" not in profile:
@@ -220,7 +228,9 @@ class ErasureCodeLrc(ErasureCode):
             layer.chunks = layer.data + layer.coding
             layer.profile.setdefault("k", str(len(layer.data)))
             layer.profile.setdefault("m", str(len(layer.coding)))
-            layer.profile.setdefault("plugin", "jerasure")
+            layer.profile.setdefault("plugin",
+                                     getattr(self, "inner_plugin",
+                                             "jerasure"))
             layer.profile.setdefault("technique", "reed_sol_van")
             layer.erasure_code = registry.factory(layer.profile["plugin"],
                                                   layer.profile)
@@ -305,6 +315,61 @@ class ErasureCodeLrc(ErasureCode):
                 if c in want_to_encode:
                     layer_want.add(j)
             layer.erasure_code.encode_chunks(layer_want, layer_encoded)
+
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        """Batched layered encode: uint8 [B, k, L] -> parity
+        [B, n-k, L] (code-position order k..n-1).  ONE inner encode
+        per LAYER over the whole object batch — where the per-object
+        path pays len(layers) inner calls per object, this pays
+        len(layers) total (VERDICT r4 Next #5: LRC's layers are
+        independent row-sets over the same chunks; batch them).
+        Chunk buffers flow through the same encode_chunks layer walk
+        (reference ErasureCodeLrc.cc:737-776), which is
+        batch-transparent."""
+        data = np.asarray(data, dtype=np.uint8)
+        B, k, L = data.shape
+        if k != self.get_data_chunk_count():
+            raise ValueError(
+                f"expected [batch, k={self.get_data_chunk_count()}, "
+                f"L] input")
+        n = self.get_chunk_count()
+        encoded: Dict[int, np.ndarray] = {}
+        for i in range(k):
+            encoded[self.chunk_index(i)] = np.ascontiguousarray(
+                data[:, i])
+        for i in range(k, n):
+            encoded[self.chunk_index(i)] = np.zeros((B, L),
+                                                    dtype=np.uint8)
+        self.encode_chunks(set(range(n)), encoded)
+        return np.stack([encoded[self.chunk_index(i)]
+                         for i in range(k, n)], axis=1)
+
+    def encode_batch_device(self, dev_data):
+        """Device-resident batched layered encode: device array
+        [B, k, L] in -> device parity [B, n-k, L] out, no host round
+        trip between layers (the codec-kernel boundary, matching the
+        headline's framing).  Each layer's chunk subset is gathered
+        on-device and encoded through the inner plugin's
+        encode_batch_device, so parity produced by earlier layers
+        feeds later layers without leaving HBM.  Requires every
+        inner plugin to expose encode_batch_device (the tpu plugin)."""
+        import jax.numpy as jnp
+
+        B, k, L = dev_data.shape
+        n = self.get_chunk_count()
+        chunks: Dict[int, object] = {}
+        for i in range(k):
+            chunks[self.chunk_index(i)] = dev_data[:, i]
+        for layer in self.layers:
+            inner = layer.erasure_code
+            lk = inner.get_data_chunk_count()
+            stack = jnp.stack([chunks[c] for c in layer.chunks[:lk]],
+                              axis=1)
+            parity = inner.encode_batch_device(stack)
+            for idx, c in enumerate(layer.chunks[lk:]):
+                chunks[c] = parity[:, idx]
+        return jnp.stack([chunks[self.chunk_index(i)]
+                          for i in range(k, n)], axis=1)
 
     # -- decode (reference :777-860) --------------------------------------
     def decode_chunks(self, want_to_read: Set[int],

@@ -54,6 +54,59 @@ class _Req:
         self.nstripes = len(data) // sinfo.stripe_width
 
 
+class _DecReq:
+    """One queued reconstruction: rebuild ``want - have`` shard chunks
+    from the equal-length chunk buffers in ``have``."""
+
+    def __init__(self, ec_impl, sinfo: ecutil.StripeInfo,
+                 have: Dict[int, bytes], want,
+                 cb: Callable[[Optional[Dict[int, bytes]]], None]):
+        self.ec_impl = ec_impl
+        self.sinfo = sinfo
+        self.have = have
+        self.want = frozenset(want)
+        self.cb = cb
+        total = len(next(iter(have.values())))
+        self.nstripes = total // sinfo.chunk_size
+
+
+class _BatchTwin:
+    """Device-free execution twin with the BATCHED codec API: encode
+    and decode run as ONE kernel call over a whole [N, k, chunk]
+    stripe batch — through the native C++ GF kernels when the
+    toolchain is available, numpy otherwise.  This is what a coalesced
+    group executes on when the learned crossover routes it off the
+    device: the coalescing win (one call for many ops' stripes) is
+    preserved even when the device round trip would lose, where the
+    reference encodes stripe-by-stripe on the submitting thread
+    (reference src/osd/ECUtil.cc:136-148 per-stripe loop).
+
+    Wraps a jerasure-plugin codec of the same geometry (bit-exact by
+    the corpus contract) and exposes ``encode_batch`` /
+    ``decode_batch`` like the tpu plugin, so ``ecutil.encode/decode``
+    take their batched paths."""
+
+    def __init__(self, base):
+        self.base = base
+        try:
+            from ..ops import native as native_mod
+            base.core.backend = native_mod.NativeBackend()
+        except Exception:
+            pass                     # no toolchain: numpy stays
+
+    def __getattr__(self, name):
+        return getattr(self.base, name)
+
+    def encode_batch(self, data: np.ndarray) -> np.ndarray:
+        return self.base.core.encode_batch(
+            np.asarray(data, dtype=np.uint8))
+
+    def decode_batch(self, present, chunk_len: int):
+        arrays = {i: np.asarray(c, dtype=np.uint8)
+                  for i, c in present.items()}
+        return self.base.core.decode_chunks(arrays, chunk_len)
+
+
 def _geometry_key(ec_impl, sinfo: ecutil.StripeInfo) -> Tuple:
     """Requests may share one device call iff they encode with the
     same coding matrix over the same chunk size.  The matrix is a
@@ -105,15 +158,21 @@ class EncodeBatcher:
         self.cpu_reqs = 0                        # routed to CPU twin
         self.perf = perf
         self._cond = threading.Condition()
-        self._queues: Dict[Tuple, List[_Req]] = {}
+        self._queues: Dict[Tuple, List] = {}
         self._pending_stripes = 0
         self._first_enqueue = 0.0
         self._stop = False
         # introspection (tested + surfaced via perf counters)
-        self.calls = 0               # device calls issued
+        self.calls = 0               # batched encode calls issued
         self.reqs_total = 0          # requests encoded
         self.reqs_coalesced = 0      # requests that shared a call
+        self.cpu_calls = 0           # batched encode calls on the twin
+        self.dec_calls = 0           # batched decode calls issued
+        self.dec_reqs = 0            # decode requests served
+        self.dec_coalesced = 0       # decode requests that shared a call
+        self.dec_cpu_reqs = 0        # decode requests on the CPU twin
         self._cpu_twins: Dict[Tuple, object] = {}  # device-failure path
+        self._dec_threads: List[threading.Thread] = []
         self._thread = threading.Thread(target=self._run,
                                         name="ec-batcher", daemon=True)
         self._thread.start()
@@ -139,12 +198,59 @@ class EncodeBatcher:
                 stopped = False
                 if not self._queues:
                     self._first_enqueue = time.monotonic()
-                self._queues.setdefault(_geometry_key(ec_impl, sinfo),
-                                        []).append(req)
+                self._queues.setdefault(
+                    ("enc",) + _geometry_key(ec_impl, sinfo),
+                    []).append(req)
                 self._pending_stripes += req.nstripes
                 self._cond.notify()
         if stopped:
             cb(ecutil.encode(sinfo, ec_impl, data))
+
+    def submit_decode(self, ec_impl, sinfo: ecutil.StripeInfo,
+                      have: Dict[int, bytes], want,
+                      cb: Callable[[Optional[Dict[int, bytes]]], None]
+                      ) -> None:
+        """Queue a batched reconstruction of ``want - have`` shard
+        chunks; ``cb`` later receives {missing_shard: bytes} (or None
+        on failure) from the collector thread.
+
+        Decode requests coalesce per (geometry, erasure signature):
+        recovery after an OSD loss hammers ONE signature for the whole
+        rebuild (every object lost the same shard), which makes it the
+        best possible coalescing customer — the reference decodes each
+        object's recovery window separately on the submitting thread
+        (reference src/osd/ECBackend.cc:414-481
+        handle_recovery_read_complete)."""
+        missing = set(want) - set(have)
+        if not missing:
+            # everything wanted was read directly (e.g. a stray held
+            # the 'missing' shard): passthrough, like ecutil.decode
+            cb({s: bytes(have[s]) for s in want})
+            return
+        stopped = self._stop or not hasattr(ec_impl, "decode_batch")
+        req = None
+        if not stopped:
+            req = _DecReq(ec_impl, sinfo, have, want, cb)
+            if req.nstripes == 0:
+                cb({s: b"" for s in want})
+                return
+            key = ("dec", _geometry_key(ec_impl, sinfo),
+                   tuple(sorted(have)), tuple(sorted(missing)))
+            with self._cond:
+                if self._stop:
+                    stopped = True   # raced shutdown: decode inline
+                else:
+                    if not self._queues:
+                        self._first_enqueue = time.monotonic()
+                    self._queues.setdefault(key, []).append(req)
+                    self._pending_stripes += req.nstripes
+                    self._cond.notify()
+        if stopped:
+            try:
+                dec = ecutil.decode(sinfo, ec_impl, have, set(want))
+            except Exception:
+                dec = None
+            cb(dec)
 
     def prewarm(self, ec_impl, sinfo: ecutil.StripeInfo) -> None:
         """Pay the pool geometry's one-time costs at backend-build
@@ -215,7 +321,10 @@ class EncodeBatcher:
         with self._cond:
             self._stop = True
             self._cond.notify()
+        deadline = time.monotonic() + max(drain, 0.1)
         self._thread.join(timeout=max(drain, 0.1))
+        for t in self._dec_threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
 
     # -- collector -------------------------------------------------------
     def _run(self) -> None:
@@ -243,13 +352,18 @@ class EncodeBatcher:
             # OSD — so each step is fault-isolated to its own ops.
             groups = []
             for key, reqs in queues.items():
-                if self._route_to_cpu(key, reqs):
-                    groups.append((reqs, "cpu"))
+                if key[0] == "dec":
+                    groups.append((key, reqs, "dec"))
+                elif self._route_to_cpu(key, reqs):
+                    groups.append((key, reqs, "cpu"))
                 else:
-                    groups.append((reqs, self._dispatch_group(reqs)))
-            for reqs, handle in groups:
+                    groups.append((key, reqs,
+                                   self._dispatch_group(reqs)))
+            for key, reqs, handle in groups:
                 try:
-                    if handle == "cpu":
+                    if handle == "dec":
+                        self._complete_group_dec(key, reqs)
+                    elif handle == "cpu":
                         self._complete_group_cpu(reqs)
                     else:
                         # loss-direction learning runs on EVERY
@@ -311,16 +425,152 @@ class EncodeBatcher:
         return rate
 
     def _complete_group_cpu(self, reqs: List[_Req]) -> None:
-        for r in reqs:
-            try:
-                chunks = self._cpu_encode(r)
-            except Exception:
-                self._cb_error()
-                chunks = None
+        """Coalesced device-free encode: the whole group's stripes go
+        through ONE batched kernel call on the _BatchTwin (native C++
+        when available) — the coalescing win survives CPU routing."""
+        chunks_list: Optional[List] = None
+        try:
+            sinfo = reqs[0].sinfo
+            k = reqs[0].ec_impl.get_data_chunk_count()
+            m = reqs[0].ec_impl.get_coding_chunk_count()
+            twin = self.cpu_twin(reqs[0].ec_impl, sinfo)
+            arrs = [np.frombuffer(r.data, dtype=np.uint8).reshape(
+                r.nstripes, k, sinfo.chunk_size) for r in reqs]
+            batch = np.concatenate(arrs, axis=0) \
+                if len(arrs) > 1 else arrs[0]
+            parity = twin.encode_batch(batch)
+            self.cpu_calls += 1
+            if len(reqs) > 1:
+                self.reqs_coalesced += len(reqs)
+                if self.perf is not None:
+                    self.perf.inc("ec_batch_coalesced", len(reqs))
+            chunks_list = []
+            off = 0
+            for r, arr in zip(reqs, arrs):
+                p = parity[off:off + r.nstripes]
+                off += r.nstripes
+                out: Dict[int, bytes] = {
+                    i: arr[:, i].tobytes() for i in range(k)}
+                for j in range(m):
+                    out[k + j] = np.ascontiguousarray(
+                        p[:, j]).tobytes()
+                chunks_list.append(out)
+        except Exception:
+            chunks_list = None
+        if chunks_list is None:
+            # twin trouble: per-request fallback (still device-free)
+            chunks_list = []
+            for r in reqs:
+                try:
+                    chunks = self._cpu_encode(r)
+                    self.cpu_calls += 1
+                except Exception:
+                    self._cb_error()
+                    chunks = None
+                chunks_list.append(chunks)
+        for r, chunks in zip(reqs, chunks_list):
             self.reqs_total += 1
             self.cpu_reqs += 1
             try:
                 r.cb(chunks)
+            except Exception:
+                self._cb_error()
+
+    def _complete_group_dec(self, key: Tuple,
+                            reqs: List[_DecReq]) -> None:
+        """One batched reconstruction for every decode request of one
+        (geometry, erasure-signature) group.  Routing mirrors the
+        encode side: below the learned crossover the batch decodes on
+        the _BatchTwin (one native C++ call); above it, on the device
+        codec's signature-cached compiled kernel.  Device round trips
+        run on their OWN thread — a congested-tunnel decode stalling
+        the collector would block every pending encode group behind
+        it (the encode path likewise dispatches all groups before
+        joining any)."""
+        sinfo = reqs[0].sinfo
+        total = sum(sum(len(v) for v in r.have.values())
+                    for r in reqs)
+        impl = None
+        if self.adaptive_cpu and self._min_device_bytes > 0 and \
+                total < self._min_device_bytes:
+            try:
+                impl = self.cpu_twin(reqs[0].ec_impl, sinfo)
+            except Exception:
+                impl = None
+        on_twin = impl is not None
+        if impl is None:
+            impl = reqs[0].ec_impl
+        if on_twin:
+            self._exec_group_dec(key, reqs, impl, on_twin)
+        else:
+            t = threading.Thread(
+                target=self._exec_group_dec,
+                args=(key, reqs, impl, on_twin),
+                name="ec-dec-dev", daemon=True)
+            # tracked so stop() can honor its drain contract (no
+            # continuation after the caller unmounts the store)
+            self._dec_threads = [x for x in self._dec_threads
+                                 if x.is_alive()] + [t]
+            t.start()
+
+    def _exec_group_dec(self, key: Tuple, reqs: List[_DecReq],
+                        impl, on_twin: bool) -> None:
+        sinfo = reqs[0].sinfo
+        cs = sinfo.chunk_size
+        have_ids, missing = key[2], key[3]
+        rec = None
+        try:
+            present = {
+                s: (np.concatenate(
+                    [np.frombuffer(r.have[s], dtype=np.uint8)
+                     .reshape(r.nstripes, cs) for r in reqs], axis=0)
+                    if len(reqs) > 1 else
+                    np.frombuffer(reqs[0].have[s], dtype=np.uint8)
+                    .reshape(-1, cs))
+                for s in have_ids}
+            rec = impl.decode_batch(present, cs)
+        except Exception:
+            rec = None
+        if rec is None:
+            # group decode trouble: per-request fallback
+            for r in reqs:
+                try:
+                    dec = ecutil.decode(sinfo, r.ec_impl, r.have,
+                                        set(r.want))
+                except Exception:
+                    self._cb_error()
+                    dec = None
+                self.dec_reqs += 1
+                try:
+                    r.cb(dec)
+                except Exception:
+                    self._cb_error()
+            return
+        self.dec_calls += 1
+        self.dec_reqs += len(reqs)
+        if len(reqs) > 1:
+            self.dec_coalesced += len(reqs)
+        if on_twin:
+            self.dec_cpu_reqs += len(reqs)
+        if self.perf is not None:
+            self.perf.inc("ec_dec_batch_calls")
+            if len(reqs) > 1:
+                self.perf.inc("ec_dec_batch_coalesced", len(reqs))
+        off = 0
+        for r in reqs:
+            # reconstructed shards from the batched call; wanted
+            # shards that were read directly pass through (same
+            # contract as ecutil.decode)
+            out = {}
+            for s in r.want:
+                if s in missing:
+                    out[s] = np.ascontiguousarray(
+                        rec[s][off:off + r.nstripes]).tobytes()
+                else:
+                    out[s] = bytes(r.have[s])
+            off += r.nstripes
+            try:
+                r.cb(out)
             except Exception:
                 self._cb_error()
 
@@ -361,10 +611,11 @@ class EncodeBatcher:
                 and nbytes < self._min_device_bytes)
 
     def cpu_twin(self, ec_impl, sinfo: ecutil.StripeInfo):
-        """The device-free jerasure twin for this geometry (cached);
-        bit-exact by the corpus contract.  Used by encode fallback and
-        by read/recovery decode when prefer_cpu() says the device
-        round trip loses."""
+        """The device-free BATCHED twin for this geometry (cached);
+        bit-exact by the corpus contract, executing whole stripe
+        batches in one native C++ kernel call (_BatchTwin).  Used by
+        encode/decode fallback and by read/recovery decode when
+        prefer_cpu() says the device round trip loses."""
         key = _geometry_key(ec_impl, sinfo)
         twin = self._cpu_twins.get(key)
         if twin is None:
@@ -377,7 +628,8 @@ class EncodeBatcher:
             ps = getattr(ec_impl, "packetsize", 0)
             if ps:
                 prof["packetsize"] = str(ps)
-            twin = ecreg.instance().factory("jerasure", prof)
+            twin = _BatchTwin(ecreg.instance().factory("jerasure",
+                                                       prof))
             self._cpu_twins[key] = twin
         return twin
 

@@ -1009,12 +1009,44 @@ class ECBackend(PGBackend):
                 self.recovery_ops.pop(oid, None)
                 rec.cb(-5)
                 return
+            # recovery decodes ride the OSD's cross-op batcher: every
+            # object of a rebuild lost the SAME shard (one erasure
+            # signature), so concurrent recovery ops coalesce into one
+            # batched decode call (VERDICT r4 Next #3; the reference
+            # decodes per recovery window on the submitting thread,
+            # reference ECBackend.cc:414-481)
+            batcher = getattr(self.host, "encode_batcher", None)
+            if batcher is not None and \
+                    hasattr(self.ec_impl, "decode_batch"):
+                batcher.submit_decode(
+                    self.ec_impl, self.sinfo, received,
+                    set(missing_shards),
+                    lambda dec: decode_done_async(dec))
+                return
             try:
                 nbytes = sum(len(v) for v in received.values())
                 dec = ecutil.decode(self.sinfo,
                                     self._decode_impl(nbytes),
                                     received, set(missing_shards))
             except Exception:
+                dec = None
+            decoded(dec)
+
+        def decode_done_async(dec) -> None:
+            """Continuation from the batcher's collector thread:
+            re-enter the PG under its lock (same contract as
+            _encode_done)."""
+            lock = getattr(self.host, "lock", None)
+            if lock is None:
+                import contextlib
+                lock = contextlib.nullcontext()
+            with lock:
+                if rec.oid not in self.recovery_ops:
+                    return
+                decoded(dec)
+
+        def decoded(dec) -> None:
+            if dec is None:
                 self.recovery_ops.pop(oid, None)
                 rec.cb(-5)
                 return

@@ -189,6 +189,10 @@ class OSD(Dispatcher):
                       description="stripes encoded through the batcher")
         self.perf.add("ec_batch_coalesced",
                       description="write ops that shared a device call")
+        self.perf.add("ec_dec_batch_calls",
+                      description="batched EC decode calls")
+        self.perf.add("ec_dec_batch_coalesced",
+                      description="decode requests that shared a call")
         # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
         from .batcher import EncodeBatcher
         self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf)
@@ -489,7 +493,14 @@ class OSD(Dispatcher):
             pg = self._lookup_pg(pgid)
             if pg is not None:
                 with pg.lock:
-                    pg.backend.handle_message(msg)
+                    if pg.pool.is_erasure() and pg.own_shard < 0:
+                        # map race: we are not (yet) in this PG's
+                        # acting set, so there is no shard collection
+                        # to apply against — park until advance_map
+                        # assigns the shard
+                        pg.waiting_for_shard.append(msg)
+                    else:
+                        pg.backend.handle_message(msg)
             return True
         if isinstance(msg, MCommand):
             self._handle_command(conn, msg)

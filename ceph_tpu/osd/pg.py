@@ -105,6 +105,13 @@ class PG:
         self.peer_missing: Dict[int, MissingSet] = {}
         self._peer_notifies: Dict[int, dict] = {}
         self.waiting_for_active: deque = deque()
+        # backend sub-ops that raced our map: an EC shard message
+        # arriving before this OSD's map places it in the acting set
+        # has no home shard collection yet (own_shard -1) — applying
+        # it would write to a collection that does not exist.  Queued
+        # until advance_map assigns the shard (reference: op queue
+        # waits on waiting_for_map / waiting_peering)
+        self.waiting_for_shard: deque = deque()
         self.waiting_for_degraded: Dict[str, deque] = {}
         # per-object write tracking at the PG level (oid -> in-flight
         # count).  Most write classes serialize per object so size-
@@ -136,6 +143,7 @@ class PG:
         # flushed to the base pool, and observability counters
         self._promoting: Dict[str, List[Tuple]] = {}
         self._flushing: Set[str] = set()
+        self._evicting: Set[str] = set()
         self._base_deleting: Set[str] = set()
         self.cache_promotes = 0
         self.cache_flushes = 0
@@ -805,6 +813,7 @@ class PG:
             self._client_ops.clear()
             self.waiting_for_active.clear()
             self.waiting_for_obj.clear()
+            self._evicting.clear()
             self.inflight_writes.clear()
             self._pending_versions.clear()
             for m, conn in held:
@@ -815,6 +824,10 @@ class PG:
             if self.whoami not in [o for o in acting if o is not None]:
                 if self._stray_shard < 0 and prev_shard >= 0:
                     self._stray_shard = prev_shard  # keep EC identity
+                # sub-ops parked for a shard assignment that never
+                # came are from a dead interval: drop (the primary's
+                # new interval re-issues what still matters)
+                self.waiting_for_shard.clear()
                 self.state = STATE_INACTIVE
                 # announce ourselves to the current primary — WITH data
                 # (recovery source) or EMPTY (the split-child gate needs
@@ -825,6 +838,14 @@ class PG:
             self._stray_shard = -1       # back in the acting set
             if self.pool.is_erasure() and self._split_source_shard >= 0:
                 self._audit_split_shard(osdmap)
+            # back in the acting set with a shard collection: apply
+            # the backend sub-ops that raced this map (queued by
+            # ms_dispatch while own_shard was -1)
+            if self.own_shard >= 0 or not self.pool.is_erasure():
+                self._ensure_collections()
+                while self.waiting_for_shard:
+                    self.backend.handle_message(
+                        self.waiting_for_shard.popleft())
             self.state = STATE_PEERING
             if self.is_primary():
                 self._start_peering()
@@ -1286,6 +1307,17 @@ class PG:
             self.waiting_for_obj.setdefault(oid, deque()).append(
                 (msg, conn))
             return True
+        if oid in self._evicting:
+            # mid-evict window (internal delete in flight): a read
+            # probing now finds the object gone but can't promote
+            # (the delete holds inflight_writes) and would ENOENT an
+            # object that still exists in the base — park until the
+            # evict commits, then the re-run promotes it back
+            # (reference: ops wait on the blocked object context
+            # during evict)
+            self.waiting_for_obj.setdefault(oid, deque()).append(
+                (msg, conn))
+            return True
         if not getattr(msg, "_promote_checked", False) and \
                 self.backend.get_object_info(oid) is None and \
                 not self._is_degraded(oid) and \
@@ -1647,7 +1679,23 @@ class PG:
             mut = Mutation()
             mut.delete = True
             self.cache_evicts += 1
-            self._submit_internal(oid, mut)
+            self._evicting.add(oid)
+
+            def done(res: int) -> None:
+                self._evicting.discard(oid)
+                q = self.waiting_for_obj.pop(oid, None)
+                if q:
+                    for m, c in q:
+                        try:
+                            self._do_op(m, c)
+                        except Exception:
+                            import traceback
+                            traceback.print_exc()
+            try:
+                self._submit_internal(oid, mut, on_done=done)
+            except Exception:
+                done(-5)
+                return False
         return True
 
     def _can_pipeline(self, msg: MOSDOp, oid: str) -> bool:

@@ -262,3 +262,59 @@ def test_thrash_tiered_pool():
     rc = run_thrash(n_osds=4, seconds=8.0, pool_type="replicated",
                     seed=11, out=out, tiered=True)
     assert rc == 0, out.getvalue()
+
+
+def test_read_racing_evict_promotes_instead_of_enoent():
+    """The r4 1-in-10 tiered-thrash flake: a read arriving inside the
+    evict's internal-delete window must park and promote afterwards —
+    not fall through to a normal read of the half-deleted object and
+    ENOENT data that still lives in the base pool."""
+    import threading
+    import time as _t
+
+    from ceph_tpu.cluster import Cluster
+
+    with Cluster(n_osds=3) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("rb", "replicated", size=2)
+        c.create_pool("rb-cache", "replicated", size=2)
+        for prefix, extra in (
+                ("osd tier add", {"pool": "rb", "tierpool": "rb-cache"}),
+                ("osd tier cache-mode",
+                 {"tierpool": "rb-cache", "mode": "writeback"}),
+                ("osd tier set-overlay",
+                 {"pool": "rb", "tierpool": "rb-cache"})):
+            ret, msg, _ = c.mon_command(dict({"prefix": prefix}, **extra))
+            assert ret == 0, msg
+        io = c.rados().open_ioctx("rb")
+        payload = os.urandom(32_000)
+        io.write_full("hot", payload)
+        # flush so the base holds the bytes, then race reads against
+        # explicit evicts: before the fix the read that lands in the
+        # evict's in-flight window returned -2
+        cache_io = c.rados().open_ioctx("rb-cache")
+        errors = []
+
+        def reader():
+            for _ in range(40):
+                try:
+                    assert io.read("hot") == payload
+                except Exception as e:          # noqa: BLE001
+                    errors.append(e)
+                    return
+
+        t = threading.Thread(target=reader)
+        t.start()
+        for _ in range(12):
+            try:
+                cache_io.cache_flush("hot")
+            except Exception:
+                pass
+            try:
+                cache_io.cache_evict("hot")
+            except Exception:
+                pass
+            _t.sleep(0.01)
+        t.join(60)
+        assert not errors, f"read raced evict into: {errors[0]!r}"

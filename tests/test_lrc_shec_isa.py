@@ -166,3 +166,45 @@ def test_isa_validation():
         reg().factory("isa", {"k": "8", "m": "5"})
     with pytest.raises(ErasureCodeValidationError):
         reg().factory("isa", {"technique": "liberation"})
+
+
+def test_lrc_encode_batch_matches_per_object():
+    """The batched layer walk (one inner call per layer per batch,
+    VERDICT r4 Next #5) must be byte-identical to the per-object
+    encode for every object in the batch, for both inner plugins."""
+    import numpy as np
+    for inner in (None, "tpu"):
+        prof = {"k": "4", "m": "2", "l": "3"}
+        if inner:
+            prof["inner"] = inner
+        codec = reg().factory("lrc", dict(prof))
+        k = codec.get_data_chunk_count()
+        n = codec.get_chunk_count()
+        L = codec.get_chunk_size(4096 * k)
+        rng = np.random.default_rng(5)
+        batch = rng.integers(0, 256, (5, k, L), dtype=np.uint8)
+        out = codec.encode_batch(batch)          # [5, n-k, L]
+        assert out.shape == (5, n - k, L)
+        for b in range(5):
+            obj = batch[b].tobytes()
+            ref = codec.encode(set(range(n)), obj)
+            for i in range(k, n):
+                assert out[b, i - k].tobytes() == \
+                    ref[codec.chunk_index(i)], \
+                    f"inner={inner} obj {b} chunk {i} mismatch"
+
+
+def test_lrc_encode_batch_device_bit_exact():
+    """Device-resident layered encode (HBM-resident layer feeding)
+    equals the host batched walk."""
+    import jax.numpy as jnp
+    import numpy as np
+    codec = reg().factory("lrc", {"k": "4", "m": "2", "l": "3",
+                                  "inner": "tpu"})
+    k = codec.get_data_chunk_count()
+    L = codec.get_chunk_size(4096 * k)
+    rng = np.random.default_rng(6)
+    batch = rng.integers(0, 256, (3, k, L), dtype=np.uint8)
+    dev = np.asarray(codec.encode_batch_device(jnp.asarray(batch)))
+    host = codec.encode_batch(batch)
+    assert np.array_equal(dev, host)
