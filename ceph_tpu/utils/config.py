@@ -328,6 +328,11 @@ def _opts() -> List[Option]:
         Option("rgw_max_put_size", int, 5 << 30, min=1,
                description="largest single PUT (reference "
                            "rgw_max_put_size)"),
+        Option("rgw_lc_interval", float, 86400.0, min=0.0,
+               description="seconds between lifecycle worker passes; "
+                           "0 disables the worker (reference "
+                           "rgw_lc_debug_interval/rgw_lifecycle_work_"
+                           "time)"),
         # -- mon ----------------------------------------------------------
         Option("mon_allow_pool_delete", bool, True,
                description="refuse `osd pool delete` when false "
