@@ -313,14 +313,19 @@ def bench_encode_rs(k, m, stripe_bytes, batch, n_bufs=6, cycles=8):
 _HL: dict = {}
 
 
-def headline_setup(batch=512, n_bufs=2, cycles=2):
+def headline_setup(batch=512, n_bufs=2, cycles=4):
     """Stage the headline working set and register its spread sampler
     (untimed: staging, compile, and the bit-exactness check are setup,
     exactly as the reference benchmark fills its buffers before timing,
     reference test/erasure-code/ceph_erasure_code_benchmark.cc:156).
     512 MiB per dispatch: measured +6% over 256 MiB and the largest
     size that still gains (1 GiB regresses) — per-dispatch volume, not
-    kernel parameters, is the robustness lever on this tunnel."""
+    kernel parameters, is the robustness lever on this tunnel.  Window
+    size is the other half of that lever: the fence's host fetch
+    measured ~100 ms RT under tunnel congestion (direct probe, r5)
+    while the kernel's true rate is ~30 GiB/s, so a 2 GiB window can
+    lose a 2x factor to pure fence latency — 4 GiB windows (cycles=4)
+    halve that tax's worst case."""
     if _HL:
         return _HL
     import jax
@@ -433,15 +438,24 @@ def _packet_apply_native(nb, B, w, ps, arr):
 _DC: dict = {}
 
 
-def decode_setup(k=10, m=4, stripe_bytes=4 << 20, batch=64,
-                 n_erasures=3, n_bufs=2, cycles=2):
-    """Stage the decode working set (250 MiB survivor stacks — the
+def decode_setup(k=10, m=4, stripe_bytes=4 << 20, batch=128,
+                 n_erasures=3, n_bufs=2, cycles=4):
+    """Stage the decode working set (500 MiB survivor stacks — the
     deployed shape: a rebuild hammers ONE erasure signature and the
     OSD batcher coalesces recovery decodes, so large per-dispatch
     batches are the production decode geometry, not a bench artifact)
     and register its spread sampler.  Parity for the survivor stacks
     is generated on the native CPU kernel so setup never blocks on a
-    congested tunnel."""
+    congested tunnel.
+
+    r4 decode read 6.09x while encode read 15x ON THE SAME RUN; a
+    direct probe (r5) explains the whole gap as measurement, not
+    kernel: the fence fetch costs ~100 ms RT when the tunnel
+    congests, decode's true kernel rate is ~30 GiB/s (within noise
+    of encode's), and decode's windows simply carried half the bytes
+    — so its apparent rate ate twice the latency tax.  Same window
+    geometry as the headline now: ~500 MiB dispatches, 4 cycles x 2
+    buffers = 4 GiB per fenced window."""
     if _DC:
         return _DC
     import jax

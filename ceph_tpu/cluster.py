@@ -100,7 +100,9 @@ class Cluster:
             path = os.path.join(self.data_dir, f"osd.{osd_id}")
             if self.store_kind == "block":
                 from .store.blockstore import BlockStore
-                store = BlockStore(path)
+                store = BlockStore(
+                    path, compression=self.conf[
+                        "blockstore_compression_algorithm"])
             else:
                 store = FileStore(path,
                                   fsync=self.conf["filestore_fsync"])

@@ -979,13 +979,6 @@ class Monitor(Dispatcher):
                     return (-22, "pg_num must be >= 1", {})
                 if n > 65536:
                     return (-22, "pg_num too large", {})
-                if n < pool.pg_num and pool.is_erasure():
-                    # EC merges need chunk-position migration the
-                    # collection-fold design doesn't cover yet (a
-                    # holder's chunks land at its CHILD acting
-                    # position); replicated merges are supported
-                    return (-95, "pg_num decrease on erasure pools "
-                            "is not supported yet", {})
                 if n < pool.pg_num:
                     # merge only from a healthy baseline (the
                     # reference's pg_num_pending holds the decrease

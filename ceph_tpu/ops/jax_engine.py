@@ -285,6 +285,88 @@ def _packet_pallas_fn(schedule, w: int, packetsize: int,
     return fn
 
 
+def _packet_mxu_pallas_fn(B: np.ndarray, w: int, packetsize: int,
+                          interpret: bool = False):
+    """Fused MXU kernel for packet-layout bitmatrix codes: uint8
+    [batch, k, L] -> uint8 [batch, R/w, L] with L = nw * w * ps.
+
+    The packet apply is out_row[r] = XOR of the k*w input packets
+    selected by bitmatrix row r — per OUTPUT BIT j that is a mod-2
+    matmul of B [R, k*w] against bit-plane j of the packets.  One
+    VMEM-resident pass per super-word: extract the 8 bit-planes of the
+    [k*w, ps] packet block, ONE int8 dot_general over all planes at
+    once ([R, k*w] @ [k*w, 8*ps], mod 2 via the int32 accumulator's
+    low bit), repack to bytes.  Replaces the static XOR-schedule chain
+    (_packet_pallas_fn) on the MXU: the chain serializes ~fan-in
+    short VPU ops per output row, which measured ~14 GiB/s HBM on this
+    device where the byte-domain MXU twin (_gf_mxu_pallas_fn) streams
+    ~36 — decode (and with it rebuild MB/s) is bound by exactly this
+    kernel (VERDICT r4 Next #4).  Bit-exact with the CPU oracle: bit j
+    of an XOR of bytes is the mod-2 sum of the operands' bit j
+    (reference jerasure_schedule_encode / jerasure_matrix_decode,
+    erasure-code/jerasure/ErasureCodeJerasure.cc:170,265 — same
+    transform, dense instead of scheduled)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    R, KW = B.shape
+    m_out = R // w
+    ps = packetsize
+    Bconst = jnp.asarray(B, dtype=jnp.int8)
+
+    def fn(data):
+        batch, k_, L = data.shape
+        sw = w * ps
+        nw = L // sw
+        # tile a contiguous RUN of super-words per grid step (largest
+        # divisor of nw within the VMEM budget): a one-super-word
+        # block would fragment every HBM read into k*w strided
+        # ``ps``-byte pieces, which measured ~2.5x below the device's
+        # streaming rate — the contiguous run keeps reads at
+        # TB*w*ps-byte granularity, same idea as the byte-domain
+        # kernel's _pick_block_len
+        budget = max(1, (4 << 20) // (k_ * sw))
+        TB = 1
+        for t in range(1, min(nw, budget) + 1):
+            if nw % t == 0:
+                TB = t
+        xin = data.reshape(batch, k_, nw, w, ps)
+
+        def kernel(b_ref, in_ref, out_ref):
+            for t in range(TB):
+                x = in_ref[0, :, t, :, :].reshape(KW, ps)  # [k*w, ps]
+                planes = [((x & jnp.uint8(1 << j)) != 0).astype(jnp.int8)
+                          for j in range(8)]
+                bits = jnp.concatenate(planes, axis=1)     # [k*w, 8*ps]
+                pb = jax.lax.dot_general(
+                    b_ref[:, :], bits, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)      # [R, 8*ps]
+                acc = None
+                for j in range(8):
+                    v = (pb[:, j * ps:(j + 1) * ps] & 1) << j
+                    acc = v if acc is None else acc | v
+                out_ref[0, :, t, :, :] = acc.astype(jnp.uint8).reshape(
+                    m_out, w, ps)
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(batch, nw // TB),
+            in_specs=[pl.BlockSpec((R, KW), lambda b, i: (0, 0),
+                                   memory_space=pltpu.VMEM),
+                      pl.BlockSpec((1, k_, TB, w, ps),
+                                   lambda b, i: (b, 0, i, 0, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((1, m_out, TB, w, ps),
+                                   lambda b, i: (b, 0, i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((batch, m_out, nw, w, ps),
+                                           jnp.uint8),
+            interpret=interpret,
+        )(Bconst, xin)
+        return out.reshape(batch, m_out, L)
+    return fn
+
+
 def _pick_block_len(L: int, cap: int = 1 << 19) -> int:
     """Largest 128-multiple divisor of L that is <= cap (VMEM budget)."""
     best = 128
@@ -379,7 +461,7 @@ def gf8_inner(rows: np.ndarray):
     return functools.partial(_gf8_chain, coeffs=coeffs)
 
 
-_PALLAS_PROBE = {"ok": None, "mxu": None}
+_PALLAS_PROBE = {"ok": None, "mxu": None, "pmxu": None}
 
 
 def pallas_gf_mxu_ok() -> bool:
@@ -403,6 +485,40 @@ def pallas_gf_mxu_ok() -> bool:
         except Exception:
             _PALLAS_PROBE["mxu"] = False
     return _PALLAS_PROBE["mxu"]
+
+
+def pallas_packet_mxu_ok(w: int, packetsize: int) -> bool:
+    """Whether the fused MXU packet kernel should serve this geometry
+    (preferred over the XOR-schedule chain on TPU — ~2.5x the HBM
+    efficiency); lane-aligned packets plus a one-time bit-exactness
+    smoke probe, mirroring pallas_packet_ok."""
+    try:
+        if jax.default_backend() != "tpu" or packetsize % 128:
+            return False
+    except Exception:
+        return False
+    if _PALLAS_PROBE["pmxu"] is None:
+        try:
+            B = np.array([[1, 0, 1, 1], [0, 1, 1, 0],
+                          [1, 1, 0, 1], [0, 1, 1, 1]], dtype=np.uint8)
+            fn = jax.jit(_packet_mxu_pallas_fn(B, 2, 128))
+            rng = np.random.default_rng(3)
+            x = rng.integers(0, 256, (1, 2, 512), dtype=np.uint8)
+            # numpy oracle: XOR the selected packet rows
+            pk = x.reshape(1, 2, 2, 2, 128).transpose(0, 2, 1, 3, 4) \
+                .reshape(1, 2, 4, 128)
+            rows = np.zeros((1, 2, 4, 128), dtype=np.uint8)
+            for r in range(4):
+                for c in range(4):
+                    if B[r, c]:
+                        rows[:, :, r] ^= pk[:, :, c]
+            ref = rows.reshape(1, 2, 2, 2, 128).transpose(
+                0, 2, 1, 3, 4).reshape(1, 2, 512)
+            _PALLAS_PROBE["pmxu"] = bool(
+                np.array_equal(np.asarray(fn(jnp.asarray(x))), ref))
+        except Exception:
+            _PALLAS_PROBE["pmxu"] = False
+    return _PALLAS_PROBE["pmxu"]
 
 
 def pallas_packet_ok(w: int, packetsize: int) -> bool:
@@ -621,6 +737,9 @@ class JaxBackend:
         key = ("pkt", B.shape, B.tobytes(), w, packetsize)
 
         def build():
+            if pallas_packet_mxu_ok(w, packetsize):
+                return jax.jit(_packet_mxu_pallas_fn(
+                    np.asarray(B, dtype=np.uint8), w, packetsize))
             schedule = build_xor_schedule(B)
             if pallas_packet_ok(w, packetsize):
                 return jax.jit(_packet_pallas_fn(schedule, w, packetsize))

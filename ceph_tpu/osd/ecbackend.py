@@ -823,6 +823,11 @@ class ECBackend(PGBackend):
                 length)
         except FileNotFoundError:
             return b"", -2
+        except OSError:
+            # store-level csum mismatch (BlockStore EIO): treat like
+            # corruption — the read retries over other shards and
+            # reconstruction replaces the bytes
+            return b"", -5
         if len(data) < length:
             # shards are never legitimately short (every write pads to
             # stripe bounds): a short read means truncation/corruption,
@@ -1305,7 +1310,9 @@ class ECBackend(PGBackend):
                             hinfo.crcs[shard] == entry["data_crc"]
                     else:
                         entry["hinfo_ok"] = None    # CRC unknowable
-            except FileNotFoundError:
+            except OSError:
+                # missing OR store-csum EIO: both scrub as read_error
+                # and repair via recovery
                 entry = {"error": "read_error", "shard": shard}
             out[obj.oid] = entry
         return out

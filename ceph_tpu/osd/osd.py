@@ -330,16 +330,24 @@ class OSD(Dispatcher):
             _, _, p_acting, _ = osdmap.pg_to_up_acting_osds(
                 PGid(pool_id, tseed))
             if self.whoami not in [o for o in p_acting
-                                   if o is not None]:
-                # we hold child data but are NOT a parent acting
-                # member: the merge gate required a fully CLEAN
-                # cluster, so the acting set holds everything current
-                # — our copy may even be a STALE stray left by churn.
-                # Folding it could rebase stale history into the
-                # parent; drop it instead (the purge we would get
-                # anyway, just earlier).  Quiesce like the fold path:
-                # a racing client op must bounce, not ack into a
-                # collection being removed.
+                                   if o is not None] \
+                    and not pool.is_erasure():
+                # replicated pool, and we hold child data but are NOT
+                # a parent acting member: the merge gate required a
+                # fully CLEAN cluster, so the acting set holds
+                # everything current — our copy may even be a STALE
+                # stray left by churn.  Folding it could rebase stale
+                # history into the parent; drop it instead (the purge
+                # we would get anyway, just earlier).  EC pools take
+                # the fold path below even when non-acting: each
+                # holder owns ONE chunk position, so the parent acting
+                # set alone cannot reconstruct the merged objects —
+                # the holder must keep serving its chunk as a
+                # shard-qualified stray source until recovery lands
+                # (adopt_merge's stray branch; split machinery in
+                # reverse).  Quiesce like the fold path: a racing
+                # client op must bounce, not ack into a collection
+                # being removed.
                 with self.pg_lock:
                     dropped = self.pgs.pop(PGid(pool_id, seed), None)
                 import contextlib as _ctx

@@ -571,16 +571,26 @@ class PG:
             self._last_split_pgnum = min(self._last_split_pgnum,
                                          merge_pgnum)
             merged_locs = merged_locs or {}
-            # (EC pools never reach here: the monitor rejects their
-            # pg_num decrease — chunk-position migration across
-            # acting sets is not implemented)
+            shards = {s for s in merged_locs.values() if s >= 0}
             if stray_here and merged_locs:
                 # we hold merged data without being in the parent's
                 # acting set: serve as a stray source until purged
-                # (same machinery as split strays)
-                shards = {s for s in merged_locs.values() if s >= 0}
+                # (same machinery as split strays; for EC the folded
+                # chunks keep their CHILD shard identity)
                 if shards:
                     self._stray_shard = sorted(shards)[0]
+            elif shards and self.own_shard not in shards:
+                # EC acting member whose folded chunks sit at the
+                # CHILD acting position, not ours: our position data
+                # is missing until recovery reconstructs it, while the
+                # folded chunks serve as a shard-qualified recovery
+                # source — the split audit machinery in reverse
+                # (reference merge_from + the distinguished-position
+                # rule of ecbackend.rst; chunk bytes are portable
+                # between PGs because shard s of an object encodes
+                # identically wherever it is placed)
+                self._split_source_shard = sorted(shards)[0]
+                self._audit_split_shard(self.service.get_osdmap())
             self._persist_pgmeta()
             if self.is_primary():
                 # our log advanced: re-peer so activation pushes the
@@ -1587,7 +1597,10 @@ class PG:
                 data = self.store.read(self.coll, obj)
                 raw_attrs = self.store.getattrs(self.coll, obj)
                 omap = self.store.omap_get(self.coll, obj)
-            except FileNotFoundError:
+            except OSError:
+                # missing OR store-csum EIO: skip this object (scrub
+                # repair re-homes good bytes) instead of aborting the
+                # whole agent pass
                 return False
             attrs = {k[2:]: v for k, v in raw_attrs.items()
                      if k.startswith("u_")

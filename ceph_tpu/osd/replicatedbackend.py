@@ -172,6 +172,11 @@ class ReplicatedBackend(PGBackend):
         except FileNotFoundError:
             cb(-2, b"")
             return
+        except OSError:
+            # store-level csum mismatch (BlockStore EIO): surface it
+            # — scrub repair-via-recovery re-homes a good replica
+            cb(-5, b"")
+            return
         cb(0, data)
 
     # ------------------------------------------------------------------
@@ -198,7 +203,9 @@ class ReplicatedBackend(PGBackend):
                 attrs = self.host.store.getattrs(self.host.coll, obj)
                 omap = self.host.store.omap_get(self.host.coll, obj)
                 have_local = True
-            except FileNotFoundError:
+            except OSError:
+                # missing OR store-csum EIO (BlockStore bitrot): our
+                # copy cannot source the push — pull from a holder
                 pass
         if not have_local:
             # pull from a surviving holder (reference
@@ -357,8 +364,11 @@ class ReplicatedBackend(PGBackend):
                                                     obj)
                     info = self.get_object_info(oid)
                     ver = info.version if info else (0, 0)
-                except FileNotFoundError:
-                    continue             # puller retries elsewhere
+                except OSError:
+                    # missing or csum-EIO copy: either way we cannot
+                    # serve it; silence lets the puller rotate to
+                    # another holder
+                    continue
                 self.host.send_shard(msg.from_osd, MOSDPGPush(
                     pgid=self.host.pgid_str, shard=msg.shard,
                     from_osd=self.host.whoami, epoch=self.host.epoch,
@@ -410,7 +420,9 @@ class ReplicatedBackend(PGBackend):
                         ac = crc32c(k.encode() + b"\0" + attrs[k],
                                     ac)
                     entry["attrs_crc"] = ac
-            except FileNotFoundError:
+            except OSError:
+                # missing OR store-csum EIO (BlockStore verify): both
+                # scrub as read_error and repair via recovery
                 entry = {"error": "read_error"}
             out[obj.oid] = entry
         return out

@@ -98,6 +98,12 @@ class MultipartMixin:
         self._check_bucket(bucket)
         if not key:
             raise RGWError(400, "InvalidArgument", "empty key")
+        if "\x00" in key:
+            # same reservation as put_object: a NUL key would complete
+            # into an index row the versioning machinery parses as a
+            # version row
+            raise RGWError(400, "InvalidArgument",
+                           "NUL in key reserved for version rows")
         import secrets as _secrets
         upload_id = _secrets.token_hex(16)
         rec = {"key": key, "content_type": content_type,
@@ -345,17 +351,26 @@ class RGWService(MultipartMixin):
         entry["acl"] = acl
         rows = {key: json.dumps(entry).encode()}
         vid = entry.get("version_id")
-        if vid and vid != "null":
+        # keep the current version ROW in sync too — including a
+        # materialized "null" version (suspended-era write): _entry
+        # serves versionId=null from that row, so a stale copy would
+        # enforce the old ACL for versioned reads of the same object
+        if vid and self.ioctx.omap_get_by_key(
+                idx, _vkey(key, vid)) is not None:
             rows[_vkey(key, vid)] = rows[key]
         self.ioctx.omap_set(idx, rows)
 
     def check_access(self, identity: Optional[str], op: str,
-                     bucket: str, key: str = "") -> None:
+                     bucket: str, key: str = "",
+                     head: Optional[dict] = None) -> None:
         """Enforce the canned ACL for ``identity`` (None = anonymous;
         an empty-owner bucket predates auth and stays open, matching
         the reference's anonymous dev mode).  op is 'read', 'write'
         or 'acl' (ACL reads/writes are owner-only, reference
-        verify_bucket_owner_or_policy)."""
+        verify_bucket_owner_or_policy).  ``head``: a pre-fetched
+        object head — the GET/HEAD hot path fetches the entry once
+        and threads it through here and get_object instead of paying
+        three bucket-meta + two index-row reads per request."""
         meta = self._bucket_meta(bucket)
         owner = meta.get("owner", "")
         acl = meta.get("acl", "private")
@@ -364,12 +379,14 @@ class RGWService(MultipartMixin):
             # bucket-WRITE-ACL territory (S3: DeleteObject/PutObject
             # permission comes from the bucket, GetObject from the
             # object)
-            try:
-                head = self.head_object(bucket, key)
+            if head is None:
+                try:
+                    head = self.head_object(bucket, key)
+                except RGWError:
+                    pass             # no object yet: bucket ACL rules
+            if head is not None:
                 owner = head.get("owner", owner)
                 acl = head.get("acl", acl)
-            except RGWError:
-                pass                 # no object yet: bucket ACL rules
         if not owner or identity == owner:
             return
         if op == "read" and acl in ("public-read",
@@ -494,9 +511,11 @@ class RGWService(MultipartMixin):
 
     def get_object(self, bucket: str, key: str,
                    rng: Optional[Tuple[int, int]] = None,
-                   version_id: Optional[str] = None
+                   version_id: Optional[str] = None,
+                   head: Optional[dict] = None
                    ) -> Tuple[dict, bytes]:
-        head = self.head_object(bucket, key, version_id)
+        if head is None:
+            head = self.head_object(bucket, key, version_id)
         if head.get("delete_marker"):
             raise RGWError(405, "MethodNotAllowed",
                            f"{key} version {version_id} is a delete "
@@ -617,10 +636,47 @@ class RGWService(MultipartMixin):
         omap = self.ioctx.omap_get(_index_oid(bucket))
         versions: List[dict] = []
         truncated = False
+
+        def emit(group: List[dict]) -> bool:
+            """Append one key's versions newest-first; -> True when
+            the page filled.  Ordering is by recorded mtime with the
+            latest pinned on top: the omap's inverted-timestamp vids
+            already sort newest-first, but a materialized "null"
+            version (written in a suspended era) sorts
+            lexicographically LAST however old or new it is — S3
+            clients take the first entry as the newest.  Truncation
+            is WHOLE-KEY: continuation is by key-marker, so a key cut
+            mid-group could never finish listing — the partial key
+            moves entirely to the next page (unless it alone exceeds
+            the page, which then serves it oversized rather than
+            loop forever)."""
+            nonlocal truncated
+            if len(versions) + len(group) > max_keys and versions:
+                truncated = True
+                return True
+            group.sort(key=lambda e: (not e.get("is_latest"),
+                                      -e.get("mtime", 0)))
+            versions.extend(group)
+            return len(versions) >= max_keys
+
+        # rows of one key are contiguous in the sorted omap (keys
+        # cannot contain NUL), so groups stream and the scan stops at
+        # the page boundary instead of json-decoding the whole bucket
+        # (same paging principle as list_objects)
+        group: List[dict] = []
+        group_key = None
         for row in sorted(omap):
             base = row.split("\x00", 1)[0]
             if not base.startswith(prefix) or base <= key_marker:
                 continue
+            if base != group_key:
+                if group and emit(group):
+                    # emit said stop AND a further key's row is in
+                    # hand — whether the group was deferred or the
+                    # page filled exactly, more data exists
+                    truncated = True
+                    break
+                group, group_key = [], base
             if "\x00" not in row:
                 ent = json.loads(omap[row].decode())
                 if _vkey(base, ent.get("version_id",
@@ -635,11 +691,10 @@ class RGWService(MultipartMixin):
                            .get("version_id", "null")
                            if cur else None)
                 ent["is_latest"] = ent.get("version_id") == cur_vid
-            if len(versions) >= max_keys:
-                truncated = True
-                break
             ent["key"] = base
-            versions.append(ent)
+            group.append(ent)
+        if group and not truncated:
+            emit(group)
         return {"bucket": bucket, "prefix": prefix,
                 "versions": versions, "is_truncated": truncated}
 
@@ -709,13 +764,18 @@ class RGWService(MultipartMixin):
                 omap = self.ioctx.omap_get(idx)
             except RadosError:
                 continue
+            # rows already acted on this pass: the omap snapshot is
+            # taken once per bucket, so without this an overlapping
+            # later rule re-sees the stale pre-action entry and
+            # double-expires (one junk delete marker per extra rule)
+            acted: set = set()
             for rule in rules:
                 pre = rule.get("prefix", "")
                 days = rule.get("days")
                 nc_days = rule.get("noncurrent_days")
                 for row in sorted(omap):
                     base = row.split("\x00", 1)[0]
-                    if not base.startswith(pre):
+                    if not base.startswith(pre) or row in acted:
                         continue
                     ent = json.loads(omap[row].decode())
                     if "\x00" not in row:
@@ -727,6 +787,7 @@ class RGWService(MultipartMixin):
                             try:
                                 self.delete_object(bucket, base)
                                 stats["expired"] += 1
+                                acted.add(row)
                             except RGWError:
                                 pass
                         continue
@@ -744,6 +805,7 @@ class RGWService(MultipartMixin):
                             self._delete_version(bucket, idx, base,
                                                  vid)
                             stats["noncurrent_removed"] += 1
+                            acted.add(row)
                         except RGWError:
                             pass
                 if rule.get("expired_delete_marker") and versioned:

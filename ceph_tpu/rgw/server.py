@@ -153,7 +153,18 @@ class RGWServer:
                         # bucket-owner permissions, not READ ACL)
                         svc.check_access(ident, "acl", bucket)
                     else:
-                        svc.check_access(ident, "read", bucket, key)
+                        head = None
+                        if key and "uploadId" not in q:
+                            # fetch the entry ONCE for the object-GET
+                            # hot path; check_access and get_object
+                            # both reuse it
+                            try:
+                                head = svc.head_object(
+                                    bucket, key, q.get("versionId"))
+                            except RGWError:
+                                head = None
+                        svc.check_access(ident, "read", bucket, key,
+                                         head=head)
                     if not key and "versioning" in q:
                         state = svc.get_bucket_versioning(bucket)
                         inner = (f"<Status>{state}</Status>"
@@ -174,7 +185,8 @@ class RGWServer:
                         self._list_parts(bucket, q["uploadId"])
                     else:
                         self._get_object(bucket, key,
-                                         q.get("versionId"))
+                                         q.get("versionId"),
+                                         head=head)
                 except RGWError as e:
                     self._error(e)
 
@@ -221,9 +233,15 @@ class RGWServer:
                     f"</LifecycleConfiguration>").encode())
 
             def _list_versions(self, bucket, q):
+                try:
+                    max_keys = int(q.get("max-keys", "0")) or None
+                except ValueError:
+                    raise RGWError(400, "InvalidArgument",
+                                   q.get("max-keys", ""))
                 res = svc.list_object_versions(
                     bucket, prefix=q.get("prefix", ""),
-                    key_marker=q.get("key-marker", ""))
+                    key_marker=q.get("key-marker", ""),
+                    max_keys=max_keys)
                 rows = ""
                 for v in res["versions"]:
                     tag = ("DeleteMarker" if v.get("delete_marker")
@@ -238,9 +256,20 @@ class RGWServer:
                         f"{str(v['is_latest']).lower()}</IsLatest>"
                         f"<LastModified>{_iso(v['mtime'])}"
                         f"</LastModified>{extra}</{tag}>")
+                # paging contract (S3 ListObjectVersions): truncation
+                # is explicit, and NextKeyMarker is the last key the
+                # page covered so the client can continue
+                trunc = res.get("is_truncated", False)
+                marker = ""
+                if trunc and res["versions"]:
+                    marker = (f"<NextKeyMarker>"
+                              f"{escape(res['versions'][-1]['key'])}"
+                              f"</NextKeyMarker>")
                 self._send(200, (
                     f"<?xml version='1.0'?><ListVersionsResult>"
-                    f"<Name>{escape(bucket)}</Name>{rows}"
+                    f"<Name>{escape(bucket)}</Name>"
+                    f"<IsTruncated>{str(trunc).lower()}"
+                    f"</IsTruncated>{marker}{rows}"
                     f"</ListVersionsResult>").encode())
 
             def do_POST(self):         # noqa: N802
@@ -323,9 +352,17 @@ class RGWServer:
                 bucket, key, q = self._split()
                 try:
                     ident = self._auth(b"")
-                    svc.check_access(ident, "read", bucket, key)
-                    head = svc.head_object(bucket, key,
-                                           q.get("versionId"))
+                    try:
+                        head = svc.head_object(bucket, key,
+                                               q.get("versionId"))
+                    except RGWError:
+                        # access verdict outranks existence: an
+                        # unauthorized HEAD of a missing key must
+                        # stay 403, not leak 404
+                        svc.check_access(ident, "read", bucket, key)
+                        raise
+                    svc.check_access(ident, "read", bucket, key,
+                                     head=head)
                     self.send_response(200)
                     self.send_header("Content-Length",
                                      str(head["size"]))
@@ -500,7 +537,8 @@ class RGWServer:
                 self._send(200, body)
 
             def _get_object(self, bucket: str, key: str,
-                            version_id: Optional[str] = None):
+                            version_id: Optional[str] = None,
+                            head: Optional[dict] = None):
                 rng = None
                 hdr = self.headers.get("Range", "")
                 if hdr.startswith("bytes="):
@@ -508,8 +546,8 @@ class RGWServer:
                     try:
                         if lo == "" and hi:
                             # suffix range: last N bytes
-                            size = svc.head_object(
-                                bucket, key, version_id)["size"]
+                            size = (head or svc.head_object(
+                                bucket, key, version_id))["size"]
                             n = int(hi)
                             rng = (max(0, size - n), size - 1)
                         else:
@@ -518,7 +556,7 @@ class RGWServer:
                     except ValueError:
                         raise RGWError(416, "InvalidRange", hdr)
                 head, data = svc.get_object(bucket, key, rng,
-                                            version_id)
+                                            version_id, head=head)
                 headers = {"ETag": f'"{head["etag"]}"'}
                 if head.get("version_id", "null") != "null":
                     headers["x-amz-version-id"] = \

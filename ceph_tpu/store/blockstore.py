@@ -10,13 +10,29 @@ into freshly allocated blocks (reference blob/extent COW) so crash
 consistency reduces to "data blocks written+synced BEFORE the one
 atomic KV commit that references them".
 
+Every data block carries a CRC32C in the extent map, verified on
+every read (reference BlueStore::_verify_csum on each blob read,
+BlueStore.cc:10425,10446 — scrub is the backstop, the csum is the
+front line): a mismatch surfaces as EIO so the OSD read path retries
+over other replicas/shards and repair-via-recovery can re-home a good
+copy over the rot.  Large aligned writes optionally compress inline
+through the framework's compressor registry (reference
+bluestore_compression_algorithm/_mode, BlueStore.cc:4549 blob
+compression): a run of full blocks that shrinks by at least one block
+is stored as a compressed SEGMENT; per-logical-block CRCs are kept of
+the UNCOMPRESSED content, so the same verify covers both paths.
+
 Layout:
   block file     fixed ``BLOCK`` -sized slots, grown on demand
   kv ``meta``    C/<coll>, E/<coll>/<obj>          (as FileStore)
                  A/… xattrs, M/… omap, H/… omap header
-                 X/<coll>/<obj> -> {"size": n, "blocks": [phys...]}
+                 X/<coll>/<obj> -> {"size": n, "blocks": [...],
+                                    "crcs": [...], "segs": {...}}
                  alloc          -> allocator bitmap (bytes)
                  J/<seq>        -> journaled Transaction (WAL)
+
+``blocks[lb]``: >= 0 raw physical block, -1 hole, <= -2 member of
+compressed segment ``-(lb_value) - 2`` (see ``_Extents``).
 
 Write path per transaction: journal the txn (WAL) → for every touched
 logical block, read old block (if partial), merge, write a NEW block →
@@ -28,11 +44,13 @@ so nothing leaks.
 """
 from __future__ import annotations
 
+import errno
 import json
 import os
 import threading
 from typing import Callable, Dict, List, Optional, Set
 
+from ..utils.crc import crc32c
 from ..utils.finisher import Finisher
 from .filestore import _BatchView, _objkey, _unobjkey
 from .kv import LogDB, WriteBatch
@@ -40,6 +58,9 @@ from .objectstore import (GHObject, ObjectStat, ObjectStore,
                           Transaction, check_ops)
 
 BLOCK = 4096
+# compress only runs of at least this many full blocks (reference
+# bluestore min_blob sizing: tiny blobs aren't worth the cycles)
+COMPRESS_MIN_BLOCKS = 4
 
 
 class BitmapAllocator:
@@ -69,31 +90,53 @@ class BitmapAllocator:
 
 
 class _Extents:
-    """Per-object extent map: logical block i -> physical block (or -1
-    for a hole), plus the byte size (reference ExtentMap)."""
+    """Per-object extent map (reference ExtentMap + blob csums):
+    logical block i -> physical block (>= 0), hole (-1), or compressed
+    segment member (value <= -2 names segment ``-value - 2``); a
+    parallel per-logical-block CRC32C of the UNCOMPRESSED content
+    (0 = hole/unknown — pre-csum maps verify lazily as they rewrite);
+    and the segment table sid -> {phys blocks, compressed length,
+    algorithm, first logical block}."""
 
     def __init__(self, size: int = 0,
-                 blocks: Optional[List[int]] = None):
+                 blocks: Optional[List[int]] = None,
+                 crcs: Optional[List[int]] = None,
+                 segs: Optional[Dict[str, dict]] = None):
         self.size = size
         self.blocks = blocks if blocks is not None else []
+        self.crcs = crcs if crcs is not None else []
+        self.segs = segs if segs is not None else {}
+        while len(self.crcs) < len(self.blocks):
+            self.crcs.append(0)
 
     @classmethod
     def load(cls, raw: Optional[bytes]) -> "_Extents":
         if raw is None:
             return cls()
         d = json.loads(raw.decode())
-        return cls(d["size"], d["blocks"])
+        return cls(d["size"], d["blocks"], d.get("crcs"),
+                   d.get("segs"))
 
     def dump(self) -> bytes:
-        return json.dumps({"size": self.size,
-                           "blocks": self.blocks}).encode()
+        out = {"size": self.size, "blocks": self.blocks,
+               "crcs": self.crcs}
+        if self.segs:
+            out["segs"] = self.segs
+        return json.dumps(out).encode()
+
+    def seg_of(self, lb: int) -> Optional[str]:
+        v = self.blocks[lb]
+        return str(-v - 2) if v <= -2 else None
+
+    def next_sid(self) -> str:
+        return str(1 + max((int(s) for s in self.segs), default=-1))
 
 
 class BlockStore(ObjectStore):
     medium = "hdd"
     """reference BlueStore, collapsed to its storage model."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, compression: str = "none"):
         self.path = path
         self._lock = threading.RLock()
         self._db: Optional[LogDB] = None
@@ -101,6 +144,24 @@ class BlockStore(ObjectStore):
         self._alloc: Optional[BitmapAllocator] = None
         self._journal_seq = 0
         self._finisher: Optional[Finisher] = None
+        # inline compression (reference bluestore_compression_algorithm)
+        # — decompression ignores this and honors whatever algorithm a
+        # segment was written with, so flipping the option is safe on
+        # existing data
+        self._comp_alg = "" if compression in ("", "none") \
+            else compression
+        self._comp = None
+        # observability (reference bluestore compressed/original statfs
+        # + checksum error counters)
+        self.compress_logical_bytes = 0
+        self.compress_stored_bytes = 0
+        self.csum_failures = 0
+
+    def _compressor(self, alg: str):
+        from ..compressor import registry as creg
+        if self._comp is None or self._comp.name != alg:
+            self._comp = creg().create(alg)
+        return self._comp
 
     # -- lifecycle -----------------------------------------------------
     def mkfs(self) -> None:
@@ -202,7 +263,19 @@ class BlockStore(ObjectStore):
             self._db.submit(WriteBatch().set(jkey, merged.encode()),
                             sync=True)
             batch = WriteBatch()
-            dirty = self._apply_ops(merged.ops, batch)
+            try:
+                dirty = self._apply_ops(merged.ops, batch)
+            except Exception:
+                # apply failed (e.g. csum EIO on an RMW base read):
+                # COW means nothing it did is referenced — the KV
+                # batch was never submitted, so extent maps are
+                # untouched and blocks it allocated were never
+                # persisted as allocated.  Retire the WAL entry and
+                # surface the error; leaving it would re-raise the
+                # same failure from _replay_journal on EVERY mount
+                # (one rotten block must not brick the store)
+                self._db.submit(WriteBatch().rm(jkey), sync=True)
+                raise
             self._flush_dev(dirty)       # data durable first
             batch.rm(jkey)
             batch.set("alloc", self._alloc.state())
@@ -238,12 +311,7 @@ class BlockStore(ObjectStore):
             return ext_cache[key]
 
         def read_in_txn(coll, obj) -> bytes:
-            ext = get_ext(coll, obj)
-            out = bytearray()
-            for phys in ext.blocks:
-                out.extend(b"\x00" * BLOCK if phys < 0
-                           else self._read_block(phys))
-            return bytes(out[:ext.size])
+            return self._materialize(get_ext(coll, obj))
 
         def put_ext(coll, obj, ext) -> None:
             ext_cache[self._xkey(coll, obj)] = ext
@@ -253,36 +321,161 @@ class BlockStore(ObjectStore):
                 raise FileNotFoundError(f"no collection {coll!r}")
             batch.set(self._exists_key(coll, obj), b"")
 
+        def free_ext(ext: _Extents) -> None:
+            for phys in ext.blocks:
+                if phys >= 0:
+                    freed.add(phys)
+            for seg in ext.segs.values():
+                freed.update(seg["phys"])
+
+        def grow(ext: _Extents, nblocks: int) -> None:
+            while len(ext.blocks) < nblocks:
+                ext.blocks.append(-1)
+                ext.crcs.append(0)
+
+        def read_base_block(ext: _Extents, lb: int) -> bytes:
+            """RMW base read, CRC-verified: merging over rotten bytes
+            and stamping a FRESH crc would launder the corruption as
+            valid data — the partial write must fail with EIO instead
+            (and the txn unrolls via the queue_transactions guard)."""
+            blk = self._read_block(ext.blocks[lb])
+            want = ext.crcs[lb] if lb < len(ext.crcs) else 0
+            if want and crc32c(blk) != want:
+                self.csum_failures += 1
+                raise OSError(errno.EIO,
+                              f"csum mismatch at logical block {lb} "
+                              f"(RMW base)")
+            return blk
+
+        def flatten_seg(ext: _Extents, sid: str,
+                        drop_lbs: frozenset = frozenset()) -> None:
+            """Dissolve a compressed segment back into raw COW blocks
+            (members in ``drop_lbs`` become holes instead): any
+            mutation that touches part of a segment re-materializes
+            the rest — overwrite of compressed data is the store's
+            rare path, so simplicity wins over re-compression.  When
+            every member is dropped (full overwrite / truncate-away)
+            nothing is decompressed: the old bytes are not needed, so
+            a ROTTEN segment must not brick the overwrite that would
+            replace it."""
+            nonlocal dirty
+            seg = ext.segs.pop(sid)
+            keep = [i for i in range(seg["nlb"])
+                    if (lb := seg["lb0"] + i) < len(ext.blocks)
+                    and ext.seg_of(lb) == sid and lb not in drop_lbs]
+            raw = self._decompress_seg(seg) if keep else b""
+            for i in range(seg["nlb"]):
+                lb = seg["lb0"] + i
+                if lb >= len(ext.blocks) or ext.seg_of(lb) != sid:
+                    continue             # member dropped earlier
+                if i not in keep:
+                    ext.blocks[lb] = -1
+                    ext.crcs[lb] = 0
+                    continue
+                blk = raw[i * BLOCK:(i + 1) * BLOCK]
+                phys = self._alloc.allocate()
+                self._write_block(phys, blk)
+                ext.blocks[lb] = phys
+                ext.crcs[lb] = crc32c(blk)
+                dirty = True
+            freed.update(seg["phys"])
+
+        def flatten_range(ext: _Extents, lb0: int, lb1: int,
+                          drop_lbs: frozenset = frozenset()) -> None:
+            for lb in range(lb0, min(lb1, len(ext.blocks))):
+                sid = ext.seg_of(lb)
+                if sid is not None:
+                    flatten_seg(ext, sid, drop_lbs)
+
+        def try_compress(ext, data, offset, first_full, last_full
+                         ) -> bool:
+            """Store the full-block span [first_full, last_full) as a
+            compressed segment when it saves at least one block;
+            -> True when it did (reference BlueStore blob compression:
+            compress, keep only if the result helps)."""
+            nonlocal dirty
+            nfull = last_full - first_full
+            if not self._comp_alg or nfull < COMPRESS_MIN_BLOCKS:
+                return False
+            lo = first_full * BLOCK - offset
+            span = data[lo:lo + nfull * BLOCK]
+            try:
+                comp = self._compressor(self._comp_alg).compress(span)
+            except Exception:
+                return False
+            nphys = (len(comp) + BLOCK - 1) // BLOCK
+            if nphys >= nfull:           # no win: store raw
+                return False
+            # old content of the span: raw blocks freed, segment
+            # members flattened-with-drop (their survivors re-home)
+            flatten_range(ext, first_full, last_full,
+                          frozenset(range(first_full, last_full)))
+            phys_list = []
+            for i in range(nphys):
+                phys = self._alloc.allocate()
+                self._write_block(phys, comp[i * BLOCK:(i + 1) * BLOCK]
+                                  .ljust(BLOCK, b"\x00"))
+                phys_list.append(phys)
+            sid = ext.next_sid()
+            ext.segs[sid] = {"phys": phys_list, "clen": len(comp),
+                             "alg": self._comp_alg, "lb0": first_full,
+                             "nlb": nfull}
+            ref = -(int(sid) + 2)
+            for i in range(nfull):
+                lb = first_full + i
+                if ext.blocks[lb] >= 0:
+                    freed.add(ext.blocks[lb])
+                ext.blocks[lb] = ref
+                ext.crcs[lb] = crc32c(span[i * BLOCK:(i + 1) * BLOCK])
+            self.compress_logical_bytes += len(span)
+            self.compress_stored_bytes += nphys * BLOCK
+            dirty = True
+            return True
+
         def write_extent(coll, obj, offset, data) -> None:
             nonlocal dirty
             ensure_obj(coll, obj)
             ext = get_ext(coll, obj)
             end = offset + len(data)
             nblocks = (max(ext.size, end) + BLOCK - 1) // BLOCK
-            while len(ext.blocks) < nblocks:
-                ext.blocks.append(-1)
-            pos = offset
-            while pos < end:
-                lb = pos // BLOCK
-                boff = pos % BLOCK
-                run = min(BLOCK - boff, end - pos)
-                old_phys = ext.blocks[lb]
-                if boff == 0 and run == BLOCK:
-                    base = b"\x00" * BLOCK
-                elif old_phys >= 0:
-                    base = self._read_block(old_phys)
-                else:
-                    base = b"\x00" * BLOCK
-                merged_blk = (base[:boff]
-                              + data[pos - offset:pos - offset + run]
-                              + base[boff + run:])
-                new_phys = self._alloc.allocate()   # COW
-                self._write_block(new_phys, merged_blk)
-                if old_phys >= 0:
-                    freed.add(old_phys)
-                ext.blocks[lb] = new_phys
-                dirty = True
-                pos += run
+            grow(ext, nblocks)
+            first_full = (offset + BLOCK - 1) // BLOCK
+            last_full = end // BLOCK
+            ranges = [(offset, end)]
+            if try_compress(ext, data, offset, first_full, last_full):
+                ranges = [(offset, first_full * BLOCK),
+                          (last_full * BLOCK, end)]
+            for lo, hi in ranges:
+                if lo >= hi:
+                    continue
+                # a partial overwrite of a compressed segment member
+                # re-materializes the segment's survivors first
+                flatten_range(ext, lo // BLOCK,
+                              (hi + BLOCK - 1) // BLOCK)
+                pos = lo
+                while pos < hi:
+                    lb = pos // BLOCK
+                    boff = pos % BLOCK
+                    run = min(BLOCK - boff, hi - pos)
+                    old_phys = ext.blocks[lb]
+                    if boff == 0 and run == BLOCK:
+                        base = b"\x00" * BLOCK
+                    elif old_phys >= 0:
+                        base = read_base_block(ext, lb)
+                    else:
+                        base = b"\x00" * BLOCK
+                    merged_blk = (base[:boff]
+                                  + data[pos - offset:pos - offset
+                                         + run]
+                                  + base[boff + run:])
+                    new_phys = self._alloc.allocate()   # COW
+                    self._write_block(new_phys, merged_blk)
+                    if old_phys >= 0:
+                        freed.add(old_phys)
+                    ext.blocks[lb] = new_phys
+                    ext.crcs[lb] = crc32c(merged_blk)
+                    dirty = True
+                    pos += run
             ext.size = max(ext.size, end)
             put_ext(coll, obj, ext)
 
@@ -302,16 +495,20 @@ class BlockStore(ObjectStore):
                     ext = get_ext(coll, obj)
                     end = offset + length
                     nblocks = (max(ext.size, end) + BLOCK - 1) // BLOCK
-                    while len(ext.blocks) < nblocks:
-                        ext.blocks.append(-1)
+                    grow(ext, nblocks)
                     # aligned full blocks become holes (deallocation,
-                    # as BlueStore treats zero); ragged edges RMW
+                    # as BlueStore treats zero); ragged edges RMW;
+                    # compressed segments re-home their survivors
                     first_full = (offset + BLOCK - 1) // BLOCK
                     last_full = end // BLOCK
+                    flatten_range(ext, first_full, last_full,
+                                  frozenset(range(first_full,
+                                                  last_full)))
                     for lb in range(first_full, last_full):
                         if ext.blocks[lb] >= 0:
                             freed.add(ext.blocks[lb])
                         ext.blocks[lb] = -1
+                        ext.crcs[lb] = 0
                     ext.size = max(ext.size, end)
                     put_ext(coll, obj, ext)
                     if first_full * BLOCK > offset:
@@ -328,24 +525,34 @@ class BlockStore(ObjectStore):
                     ensure_obj(coll, obj)
                     ext = get_ext(coll, obj)
                     nblocks = (size + BLOCK - 1) // BLOCK
+                    # any segment reaching past the cut (or holding
+                    # the new ragged tail block) re-homes its kept
+                    # members; the cut ones drop straight to holes.
+                    # A block-aligned cut keeps block nblocks-1 whole,
+                    # so its segment (if any) survives untouched.
+                    flat_from = nblocks if size % BLOCK == 0 \
+                        else max(0, nblocks - 1)
+                    flatten_range(ext, flat_from, len(ext.blocks),
+                                  frozenset(range(nblocks,
+                                                  len(ext.blocks))))
                     for phys in ext.blocks[nblocks:]:
                         if phys >= 0:
                             freed.add(phys)
                     ext.blocks = ext.blocks[:nblocks]
-                    while len(ext.blocks) < nblocks:
-                        ext.blocks.append(-1)    # grow = holes
+                    ext.crcs = ext.crcs[:nblocks]
+                    grow(ext, nblocks)           # grow = holes
                     if size % BLOCK and size < ext.size:
                         lb = size // BLOCK
                         if lb < len(ext.blocks) and \
                                 ext.blocks[lb] >= 0:
-                            base = self._read_block(ext.blocks[lb])
+                            base = read_base_block(ext, lb)
                             keep = size % BLOCK
+                            blk = base[:keep].ljust(BLOCK, b"\x00")
                             new_phys = self._alloc.allocate()
-                            self._write_block(
-                                new_phys, base[:keep].ljust(BLOCK,
-                                                            b"\x00"))
+                            self._write_block(new_phys, blk)
                             freed.add(ext.blocks[lb])
                             ext.blocks[lb] = new_phys
+                            ext.crcs[lb] = crc32c(blk)
                             dirty = True
                     ext.size = size
                     put_ext(coll, obj, ext)
@@ -353,10 +560,7 @@ class BlockStore(ObjectStore):
                     _, coll, obj = op
                     if view.get(f"C/{coll}") is None:
                         raise FileNotFoundError(f"no coll {coll!r}")
-                    ext = get_ext(coll, obj)
-                    for phys in ext.blocks:
-                        if phys >= 0:
-                            freed.add(phys)
+                    free_ext(get_ext(coll, obj))
                     k = _objkey(obj)
                     batch.rm(self._exists_key(coll, obj))
                     batch.rm(self._xkey(coll, obj))
@@ -371,10 +575,7 @@ class BlockStore(ObjectStore):
                             f"no object {src} in {coll!r}")
                     data = read_in_txn(coll, src)
                     # dst replaced wholesale
-                    old = get_ext(coll, dst)
-                    for phys in old.blocks:
-                        if phys >= 0:
-                            freed.add(phys)
+                    free_ext(get_ext(coll, dst))
                     put_ext(coll, dst, _Extents())
                     ensure_obj(coll, dst)
                     if data:
@@ -431,10 +632,7 @@ class BlockStore(ObjectStore):
                     pfx = f"E/{coll}/"
                     for kk, _vv in view.iterate(pfx):
                         o = _unobjkey(kk[len(pfx):])
-                        ext = get_ext(coll, o)
-                        for phys in ext.blocks:
-                            if phys >= 0:
-                                freed.add(phys)
+                        free_ext(get_ext(coll, o))
                         ext_cache.pop(self._xkey(coll, o), None)
                     batch.rm_prefix(f"E/{coll}/")
                     batch.rm_prefix(f"X/{coll}/")
@@ -450,10 +648,7 @@ class BlockStore(ObjectStore):
                             f"no object {src} in {src_coll!r}")
                     data = read_in_txn(src_coll, src)
                     ensure_obj(dst_coll, dst)
-                    old = get_ext(dst_coll, dst)
-                    for phys in old.blocks:
-                        if phys >= 0:
-                            freed.add(phys)
+                    free_ext(get_ext(dst_coll, dst))
                     put_ext(dst_coll, dst, _Extents())
                     if data:
                         write_extent(dst_coll, dst, 0, data)
@@ -473,10 +668,7 @@ class BlockStore(ObjectStore):
                         batch.set(f"H/{dst_coll}/{dk}", hdr)
                     batch.rm(f"H/{src_coll}/{sk}")
                     # drop the source
-                    src_ext = get_ext(src_coll, src)
-                    for phys in src_ext.blocks:
-                        if phys >= 0:
-                            freed.add(phys)
+                    free_ext(get_ext(src_coll, src))
                     batch.rm(self._exists_key(src_coll, src))
                     batch.rm(self._xkey(src_coll, src))
                     batch.rm_prefix(f"A/{src_coll}/{sk}/")
@@ -484,7 +676,10 @@ class BlockStore(ObjectStore):
                     ext_cache.pop(self._xkey(src_coll, src), None)
                 else:
                     raise ValueError(f"unknown store op {name!r}")
-            except FileNotFoundError:
+            except OSError:
+                # missing object (idempotent re-apply) or csum EIO:
+                # on replay, skip the op and keep mounting — a WAL
+                # entry poisoned by rot must not brick the store
                 if not replay:
                     raise
         # the COW flip: all extent maps updated in the same batch
@@ -503,15 +698,54 @@ class BlockStore(ObjectStore):
         if self._db.get(self._exists_key(coll, obj)) is None:
             raise FileNotFoundError(f"no object {obj} in {coll!r}")
 
-    def _read_object(self, coll: str, obj: GHObject) -> bytes:
-        ext = self._load_extents(coll, obj)
+    def _decompress_seg(self, seg: dict) -> bytes:
+        """Compressed segment -> its nlb * BLOCK uncompressed bytes."""
+        comp = bytearray()
+        for phys in seg["phys"]:
+            comp.extend(self._read_block(phys))
+        try:
+            raw = self._compressor(seg["alg"]).decompress(
+                bytes(comp[:seg["clen"]]))
+        except Exception as e:
+            self.csum_failures += 1
+            raise OSError(errno.EIO,
+                          f"segment decompress failed: {e!r}")
+        if len(raw) != seg["nlb"] * BLOCK:
+            self.csum_failures += 1
+            raise OSError(errno.EIO, "segment length mismatch")
+        return raw
+
+    def _materialize(self, ext: _Extents) -> bytes:
+        """Full object bytes with every block CRC-verified (reference
+        _verify_csum on each read, BlueStore.cc:10425): rot surfaces
+        as EIO here instead of propagating silently — the OSD read
+        path turns it into a reconstructing/replica retry and scrub
+        repair re-homes a good copy."""
         out = bytearray()
-        for phys in ext.blocks:
-            if phys < 0:
+        seg_cache: Dict[str, bytes] = {}
+        for lb, phys in enumerate(ext.blocks):
+            if phys == -1:
                 out.extend(b"\x00" * BLOCK)
+                continue
+            sid = ext.seg_of(lb)
+            if sid is None:
+                blk = self._read_block(phys)
             else:
-                out.extend(self._read_block(phys))
+                if sid not in seg_cache:
+                    seg_cache[sid] = self._decompress_seg(
+                        ext.segs[sid])
+                i = lb - ext.segs[sid]["lb0"]
+                blk = seg_cache[sid][i * BLOCK:(i + 1) * BLOCK]
+            want = ext.crcs[lb] if lb < len(ext.crcs) else 0
+            if want and crc32c(blk) != want:
+                self.csum_failures += 1
+                raise OSError(errno.EIO,
+                              f"csum mismatch at logical block {lb}")
+            out.extend(blk)
         return bytes(out[:ext.size])
+
+    def _read_object(self, coll: str, obj: GHObject) -> bytes:
+        return self._materialize(self._load_extents(coll, obj))
 
     def read(self, coll: str, obj: GHObject, offset: int = 0,
              length: Optional[int] = None) -> bytes:
@@ -596,5 +830,10 @@ class BlockStore(ObjectStore):
                     "blocks_used": self._alloc.used(),
                     "bytes_used": self._alloc.used() * BLOCK,
                     "dev_bytes": os.path.getsize(
-                        os.path.join(self.path, "block.dev"))}
+                        os.path.join(self.path, "block.dev")),
+                    "compress_logical_bytes":
+                        self.compress_logical_bytes,
+                    "compress_stored_bytes":
+                        self.compress_stored_bytes,
+                    "csum_failures": self.csum_failures}
 

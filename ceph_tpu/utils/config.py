@@ -370,6 +370,14 @@ def _opts() -> List[Option]:
         Option("filestore_fsync", bool, False,
                description="fsync the WAL before acking commits "
                            "(durability vs test speed)"),
+        Option("blockstore_compression_algorithm", str, "none",
+               enum_allowed=("none", "zlib", "bz2", "lzma", "snappy",
+                             "zstd"),
+               description="inline-compress large aligned BlockStore "
+                           "writes with this registry codec "
+                           "(reference bluestore_compression_"
+                           "algorithm; none disables; reads honor "
+                           "whatever a segment was written with)"),
         # -- client -------------------------------------------------------
         Option("rados_mon_op_timeout", float, 30.0, min=0.1,
                description="default mon_command timeout (reference "

@@ -268,9 +268,13 @@ def test_grow_shrink_grow_anchor_soundness():
 
 
 def test_erasure_pool_merge_live():
-    """EC pool shrink: per-shard collections fold into the parent's
-    shard collections; holders outside the parent acting set serve as
-    stray sources (split machinery in reverse)."""
+    """EC pool shrink (VERDICT r4 Next #10): per-shard collections
+    fold into parent-named shard collections keeping their CHILD
+    chunk position; mispositioned acting members audit their position
+    data missing and serve the folded shard as a recovery source,
+    non-acting holders keep serving as shard-qualified strays, and
+    reconstruction re-homes every chunk (split machinery in reverse,
+    reference OSD.cc:329-422 merge-source tracking)."""
     conf = make_conf()
     with Cluster(n_osds=4, conf=conf) as c:
         for i in range(4):
@@ -279,12 +283,26 @@ def test_erasure_pool_merge_live():
         c.create_pool("emp", "erasure", pg_num=4,
                       erasure_code_profile="mep")
         io = c.rados().open_ioctx("emp")
-        blobs = _write_objects(io, 8, seed=51)
+        blobs = _write_objects(io, 8, size=12 << 10, seed=51)
         c.wait_for_clean(30)
         rc, msg, _ = c.mon_command(
             {"prefix": "osd pool set", "pool": "emp", "var": "pg_num",
              "val": "2"})
-        assert rc == -95, (rc, msg)
+        assert rc == 0, (rc, msg)
+        c.wait_for_clean(90)
+        _, _, health = c.mon_command({"prefix": "health"})
+        assert health.get("num_pgs", 99) == 2
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
+        # writes after the merge land in the parents and dup detection
+        # survives (reqids adopted with the rebased log)
+        blobs.update(_write_objects(io, 4, size=12 << 10, seed=52))
+        for name, blob in blobs.items():
+            assert io.read(name, len(blob)) == blob, name
+        # degraded read after the merge: kill one OSD, every object
+        # must still reconstruct from the remaining shard holders
+        c.kill_osd(3)
+        c.wait_for_osd_down(3)
         for name, blob in blobs.items():
             assert io.read(name, len(blob)) == blob, name
 

@@ -240,3 +240,68 @@ def test_periodic_background_scrub(tmp_path):
                         seen = True
             time.sleep(0.3)
         assert seen, "background scrub never ran"
+
+
+def test_blockstore_bitrot_eio_and_repair(tmp_path):
+    """End-to-end media-corruption story on the durable store
+    (VERDICT r4 Next #9): flip bytes in an OSD's raw block device
+    UNDER the extent map — the per-block CRC turns the read into EIO
+    at the store boundary (reference BlueStore _verify_csum,
+    BlueStore.cc:10425), deep scrub localizes the bad replica, and
+    repair re-homes good bytes over the rot."""
+    from ceph_tpu.store.blockstore import BLOCK
+
+    with Cluster(n_osds=3, data_dir=str(tmp_path),
+                 store_kind="block") as cl:
+        for i in range(3):
+            cl.wait_for_osd_up(i, 20)
+        cl.create_pool("bp", "replicated", size=3)
+        io = cl.rados().open_ioctx("bp")
+        payload = os.urandom(12288)
+        io.write_full("victim", payload)
+        cl.wait_for_clean(20)
+
+        pgid, _ = pg_stat_of(cl, "victim", "bp")
+        ret, _, out = cl.mon_command({"prefix": "pg dump"})
+        primary = out["pg_stats"][pgid]["acting"][0]
+        bad_osd = next(o for o in cl.stores if o != primary)
+        store = cl.stores[bad_osd]
+        coll, gobj = next(
+            (c, o) for c in store.list_collections()
+            for o in store.collection_list(c) if o.oid == "victim")
+        ext = store._load_extents(coll, gobj)
+        phys = next(p for p in ext.blocks if p >= 0)
+        with open(os.path.join(store.path, "block.dev"), "r+b") as f:
+            f.seek(phys * BLOCK + 9)
+            b = f.read(1)
+            f.seek(phys * BLOCK + 9)
+            f.write(bytes([b[0] ^ 0xA5]))
+
+        # the store read is now EIO, not silent garbage
+        with pytest.raises(OSError):
+            store.read(coll, gobj)
+        assert store.usage()["csum_failures"] >= 1
+
+        # deep scrub flags exactly this replica; repair recovers it
+        ret, rs, _ = cl.mon_command({"prefix": "pg deep-scrub",
+                                     "pgid": pgid})
+        assert ret == 0, rs
+        stat = wait_scrub_errors(
+            cl, pgid, lambda s: s.get("num_scrub_errors", 0) > 0)
+        assert "victim" in stat["inconsistent"]
+        ret, rs, _ = cl.mon_command({"prefix": "pg repair",
+                                     "pgid": pgid})
+        assert ret == 0, rs
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            cl.mon_command({"prefix": "pg deep-scrub", "pgid": pgid})
+            ret, _, out = cl.mon_command({"prefix": "pg dump"})
+            stat = out["pg_stats"].get(pgid, {})
+            if stat.get("num_scrub_errors", 1) == 0 and \
+                    stat.get("num_missing", 1) == 0:
+                break
+            time.sleep(0.3)
+        else:
+            raise TimeoutError(f"repair never converged: {stat}")
+        assert io.read("victim", len(payload)) == payload
+        assert store.read(coll, gobj) == payload

@@ -555,3 +555,179 @@ def test_blockstore_grow_truncate_and_rmcoll(tmp_path):
     with pytest.raises(FileNotFoundError):
         s.read(C, obj("g"))
     s.umount()
+
+
+def test_blockstore_csum_detects_bitrot(tmp_path):
+    """Every read verifies the per-block CRC32C (reference BlueStore
+    _verify_csum, BlueStore.cc:10425): flipping bits in the raw block
+    device surfaces as EIO, not silent corruption (VERDICT r4 Next
+    #9)."""
+    path = str(tmp_path / "bs")
+    s = BlockStore(path)
+    s.mkfs()
+    s.mount()
+    s.queue_transactions([Transaction().create_collection(C)])
+    payload = bytes(range(256)) * 64
+    s.queue_transactions([Transaction().write(C, obj("rot"), 0,
+                                              payload)])
+    assert s.read(C, obj("rot")) == payload
+    # find the object's first physical block and flip a byte under
+    # the store's feet
+    ext = s._load_extents(C, obj("rot"))
+    phys = next(p for p in ext.blocks if p >= 0)
+    with open(os.path.join(path, "block.dev"), "r+b") as f:
+        f.seek(phys * 4096 + 17)
+        b = f.read(1)
+        f.seek(phys * 4096 + 17)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(OSError):
+        s.read(C, obj("rot"))
+    assert s.usage()["csum_failures"] >= 1
+    s.umount()
+
+
+def test_blockstore_compression_roundtrip(tmp_path):
+    """Inline compression (reference bluestore_compression_algorithm):
+    a large compressible write stores as a compressed segment (fewer
+    blocks than logical), reads back bit-exact — including after a
+    partial overwrite that re-materializes the segment — and the
+    ratio shows in usage()."""
+    s = BlockStore(str(tmp_path / "bs"), compression="zlib")
+    s.mkfs()
+    s.mount()
+    s.queue_transactions([Transaction().create_collection(C)])
+    payload = b"compress me! " * 5000          # 65 KB, compressible
+    s.queue_transactions([Transaction().write(C, obj("z"), 0,
+                                              payload)])
+    u = s.usage()
+    logical_blocks = (len(payload) + 4095) // 4096
+    assert u["blocks_used"] < logical_blocks
+    assert u["compress_stored_bytes"] < u["compress_logical_bytes"]
+    assert s.read(C, obj("z")) == payload
+    # partial overwrite inside the compressed span: the segment's
+    # survivors re-home as raw blocks, content stays exact
+    patch_at = 10000
+    s.queue_transactions([Transaction().write(C, obj("z"), patch_at,
+                                              b"PATCH")])
+    want = bytearray(payload)
+    want[patch_at:patch_at + 5] = b"PATCH"
+    assert s.read(C, obj("z")) == bytes(want)
+    # truncate into the (re-homed or remaining) span
+    s.queue_transactions([Transaction().truncate(C, obj("z"), 9000)])
+    assert s.read(C, obj("z")) == bytes(want)[:9000]
+    # clone of a compressed object is deep and exact
+    s.queue_transactions([Transaction().write(C, obj("z2"), 0,
+                                              payload)])
+    s.queue_transactions([Transaction().clone(C, obj("z2"),
+                                              obj("z3"))])
+    assert s.read(C, obj("z3")) == payload
+    # remove releases the segment's physical blocks too
+    for o in ("z", "z2", "z3"):
+        s.queue_transactions([Transaction().remove(C, obj(o))])
+    assert s.usage()["blocks_used"] == 0
+    s.umount()
+
+
+def test_blockstore_compressed_survives_remount_and_detects_rot(
+        tmp_path):
+    """Segments persist across remount (decompression follows the
+    segment's recorded algorithm, not the mount option) and a
+    corrupted compressed block still surfaces as EIO through the
+    per-logical-block CRC."""
+    path = str(tmp_path / "bs")
+    s = BlockStore(path, compression="zlib")
+    s.mkfs()
+    s.mount()
+    s.queue_transactions([Transaction().create_collection(C)])
+    payload = b"persistent segment " * 4000
+    s.queue_transactions([Transaction().write(C, obj("ps"), 0,
+                                              payload)])
+    s.umount()
+    s2 = BlockStore(path)                      # compression OFF
+    s2.mount()
+    assert s2.read(C, obj("ps")) == payload
+    ext = s2._load_extents(C, obj("ps"))
+    assert ext.segs, "expected a compressed segment"
+    phys = next(iter(ext.segs.values()))["phys"][0]
+    with open(os.path.join(path, "block.dev"), "r+b") as f:
+        f.seek(phys * 4096 + 5)
+        b = f.read(1)
+        f.seek(phys * 4096 + 5)
+        f.write(bytes([b[0] ^ 0x55]))
+    with pytest.raises(OSError):
+        s2.read(C, obj("ps"))
+    assert s2.usage()["csum_failures"] >= 1
+    s2.umount()
+
+
+def test_blockstore_overwrite_of_rotten_segment_succeeds(tmp_path):
+    """A full overwrite needs none of the old bytes, so a CORRUPT
+    compressed segment must not brick the write that would replace it
+    (flatten skips decompression when every member is dropped);
+    reads of the new data then verify clean."""
+    path = str(tmp_path / "bs")
+    s = BlockStore(path, compression="zlib")
+    s.mkfs()
+    s.mount()
+    s.queue_transactions([Transaction().create_collection(C)])
+    payload = b"rotting segment " * 4000
+    s.queue_transactions([Transaction().write(C, obj("rw"), 0,
+                                              payload)])
+    ext = s._load_extents(C, obj("rw"))
+    phys = next(iter(ext.segs.values()))["phys"][0]
+    with open(os.path.join(path, "block.dev"), "r+b") as f:
+        f.seek(phys * 4096 + 3)
+        b = f.read(1)
+        f.seek(phys * 4096 + 3)
+        f.write(bytes([b[0] ^ 0x3C]))
+    with pytest.raises(OSError):
+        s.read(C, obj("rw"))
+    # full-cover overwrite (writefull shape: new size >= old): every
+    # old segment member is replaced, so no decompression is needed
+    fresh = b"fresh bytes " * 6000
+    assert len(fresh) >= len(payload)
+    t = Transaction().write(C, obj("rw"), 0, fresh)
+    t.truncate(C, obj("rw"), len(fresh))
+    s.queue_transactions([t])            # must not raise
+    assert s.read(C, obj("rw")) == fresh
+    s.umount()
+
+
+def test_blockstore_rmw_over_rot_raises_and_store_survives(tmp_path):
+    """A partial overwrite whose RMW base block is rotten must fail
+    with EIO — NOT merge over the garbage and stamp a fresh CRC
+    (which would launder the corruption as valid data) — and the
+    failed, already-journaled transaction must not poison the WAL:
+    the store stays mountable and later writes work."""
+    path = str(tmp_path / "bs")
+    s = BlockStore(path)
+    s.mkfs()
+    s.mount()
+    s.queue_transactions([Transaction().create_collection(C)])
+    payload = bytes(range(256)) * 64
+    s.queue_transactions([Transaction().write(C, obj("rm"), 0,
+                                              payload)])
+    ext = s._load_extents(C, obj("rm"))
+    phys = ext.blocks[0]
+    with open(os.path.join(path, "block.dev"), "r+b") as f:
+        f.seek(phys * 4096 + 200)
+        b = f.read(1)
+        f.seek(phys * 4096 + 200)
+        f.write(bytes([b[0] ^ 0x11]))
+    with pytest.raises(OSError):
+        s.queue_transactions([Transaction().write(C, obj("rm"), 0,
+                                                  b"tiny")])
+    # the rot is still detected (not laundered under a fresh CRC)
+    with pytest.raises(OSError):
+        s.read(C, obj("rm"))
+    s.umount()
+    # the failed txn's WAL entry must not brick the next mount
+    s2 = BlockStore(path)
+    s2.mount()
+    with pytest.raises(OSError):
+        s2.read(C, obj("rm"))
+    # and the store still takes writes (full overwrite needs no base)
+    s2.queue_transactions([Transaction().write(C, obj("other"), 0,
+                                               b"fine")])
+    assert s2.read(C, obj("other")) == b"fine"
+    s2.umount()

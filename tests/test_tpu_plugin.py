@@ -239,6 +239,33 @@ def test_packet_pallas_kernel_interpret():
     assert np.array_equal(out, ref)
 
 
+def test_packet_mxu_pallas_kernel_interpret():
+    """The fused MXU packet kernel (the TPU fast path that replaced
+    the XOR-schedule chain for cauchy-family encode AND per-signature
+    decode — VERDICT r4 Next #4) must match the XLA schedule chain
+    bit-for-bit, for both encode-shaped (R = m*w) and decode-shaped
+    (arbitrary row-set) bitmatrices, across w values including the
+    non-power-of-two widths the liberation family uses."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.jax_engine import (_packet_chain,
+                                         _packet_mxu_pallas_fn,
+                                         build_xor_schedule)
+    from ceph_tpu.ops.matrix import (cauchy_good_coding_matrix,
+                                     matrix_to_bitmatrix)
+    rng = np.random.default_rng(37)
+    for k, m, w, ps in ((4, 2, 8, 128), (3, 2, 7, 256), (5, 3, 4, 128)):
+        B = matrix_to_bitmatrix(cauchy_good_coding_matrix(k, m, w), w)
+        data = rng.integers(0, 256, (2, k, 3 * w * ps), dtype=np.uint8)
+        for rows in (B, B[: 2 * w]):     # encode shape + decode shape
+            sched = build_xor_schedule(rows)
+            ref = np.asarray(_packet_chain(jnp.asarray(data), sched,
+                                           w, ps))
+            out = np.asarray(_packet_mxu_pallas_fn(
+                rows, w, ps, interpret=True)(jnp.asarray(data)))
+            assert np.array_equal(out, ref), (k, m, w, ps, rows.shape)
+
+
 def test_gf_mxu_pallas_kernel_interpret():
     """The fused bit-plane MXU kernel (TPU w=8 fast path for encode and
     per-signature decode) must match the scalar oracle bit-for-bit,
