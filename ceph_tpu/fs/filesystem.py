@@ -54,6 +54,26 @@ def _data_soid(ino: int) -> str:
     return f"data.{ino}"
 
 
+def parent_path(path: str) -> str:
+    """Parent directory of an absolute path ('/' is its own)."""
+    p = "/" + path.strip("/")
+    return "/" if p == "/" else (p.rsplit("/", 1)[0] or "/")
+
+
+def pin_rank_of(pins, path: str) -> int:
+    """Longest-prefix subtree-pin match -> authoritative MDS rank
+    (default 0).  THE routing rule, shared by the MDS daemon and the
+    client so the two can never drift (reference
+    Client::choose_target_mds vs the server's subtree auth)."""
+    p = "/" + path.strip("/")
+    best, rank = -1, 0
+    for pin, r in (pins or {}).items():
+        pin = "/" + pin.strip("/")
+        if (p == pin or p.startswith(pin + "/")) and len(pin) > best:
+            best, rank = len(pin), int(r)
+    return rank
+
+
 class FileSystem:
     """One mounted filesystem view (reference libcephfs Client).
     ``meta`` must be a replicated pool (omap); ``data`` may be any

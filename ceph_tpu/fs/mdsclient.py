@@ -23,7 +23,7 @@ from ..client.striper import Layout, StripedIoCtx
 from ..msg.messages import MMDSCapRecall, MMDSOp
 from ..msg.messenger import Connection, Dispatcher
 from ..utils.config import Config
-from .filesystem import FSError, _data_soid
+from .filesystem import FSError, _data_soid, parent_path, pin_rank_of
 
 
 class MDSClient(Dispatcher):
@@ -48,8 +48,12 @@ class MDSClient(Dispatcher):
         rados.msgr.add_dispatcher(self)
         # mds_addr=None resolves the active MDS through the monitor's
         # MDSMap (reference Client consults the mdsmap; a fixed addr
-        # keeps solo/test deployments working)
+        # keeps solo/test deployments working).  Multi-MDS: the map's
+        # pin table routes each request to its subtree's rank
+        # (reference Client::choose_target_mds walking dir auth).
         self._fixed_addr = mds_addr is not None
+        self._map: dict = {}            # actives: {rank: addr}, pins
+        self._rank_conns: Dict[str, Connection] = {}
         if mds_addr is None:
             mds_addr = self._resolve_active(timeout=15.0)
         self.mds_addr = mds_addr
@@ -64,12 +68,46 @@ class MDSClient(Dispatcher):
                 ret, _, out = self.rados.mon_command(
                     {"prefix": "mds getmap"}, timeout=5.0)
                 if ret == 0 and out.get("addr"):
+                    self._map = out
                     return tuple(out["addr"])
             except Exception:
                 pass
             if _t.monotonic() >= deadline:
                 raise FSError(110, "no active MDS")
             _t.sleep(0.25)
+
+    # -- multi-MDS routing (the daemon applies the same shared rule,
+    # filesystem.pin_rank_of, so client and server cannot drift) ------
+    def _route_rank(self, op: str, args: dict) -> int:
+        pins = self._map.get("pins") or {}
+        if not pins:
+            return 0
+        if op == "listdir":
+            p = args.get("path", "/")
+        elif op == "rename":
+            p = parent_path(args.get("old", "/"))
+        else:
+            p = parent_path(args.get("path", "/"))
+        return pin_rank_of(pins, p)
+
+    def _conn_for(self, rank: int) -> Connection:
+        if rank == 0 or self._fixed_addr:
+            return self._conn
+        addr = (self._map.get("actives") or {}).get(str(rank))
+        if addr is None:
+            # stale map: refresh once; rank 0 serves as last resort
+            # (it forwards again if it disagrees)
+            self._resolve_active(timeout=5.0)
+            addr = (self._map.get("actives") or {}).get(str(rank))
+            if addr is None:
+                return self._conn
+        key = f"{rank}:{addr}"
+        conn = self._rank_conns.get(key)
+        if conn is None or not conn.is_connected():
+            conn = self.rados.msgr.connect_to(tuple(addr),
+                                              lossless=False)
+            self._rank_conns[key] = conn
+        return conn
 
     # -- transport -----------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg) -> bool:
@@ -121,16 +159,39 @@ class MDSClient(Dispatcher):
         # fixed-addr clients keep single-shot semantics (no failover)
         attempt_wait = timeout if self._fixed_addr \
             else min(5.0, timeout)
+        forced_rank = None           # set by a forward (-108) verdict
         while True:
+            rank = forced_rank if forced_rank is not None \
+                else self._route_rank(op, args)
+            conn = self._conn_for(rank)
             with self.lock:
                 ev = threading.Event()
                 self._pending[tid] = ev
-            self._conn.send_message(MMDSOp(client=self.name, tid=tid,
-                                           op=op, args=args))
+            conn.send_message(MMDSOp(client=self.name, tid=tid,
+                                     op=op, args=args))
             got = ev.wait(attempt_wait)
             with self.lock:
                 self._pending.pop(tid, None)
                 reply = self._replies.pop(tid, None)
+            if got and reply is not None and reply.result == -108:
+                # forward verdict: the op belongs to another rank's
+                # subtree (our pin table was stale) — refresh and
+                # follow the daemon's word.  Deadline-bounded: a pin
+                # to a VACANT rank bounces every attempt back to rank
+                # 0, which must end in ETIMEDOUT, not a busy-loop
+                if _t.monotonic() >= deadline:
+                    raise FSError(110, f"mds op {op} timed out "
+                                  f"(forwarded to rank "
+                                  f"{(reply.out or {}).get('rank')} "
+                                  f"with no serving daemon)")
+                forced_rank = int((reply.out or {}).get("rank", 0))
+                try:
+                    self._resolve_active(
+                        timeout=max(0.5, deadline - _t.monotonic()))
+                except FSError:
+                    raise FSError(110, f"mds op {op} timed out")
+                _t.sleep(0.1)        # pace re-forwards
+                continue
             stale = got and reply is not None and reply.result == -116
             if got and not stale:
                 if reply.result < 0:
@@ -145,6 +206,8 @@ class MDSClient(Dispatcher):
                     timeout=max(0.5, deadline - _t.monotonic()))
             except FSError:
                 raise FSError(110, f"mds op {op} timed out")
+            forced_rank = None       # failover: re-route by fresh map
+            self._rank_conns.clear()
             if addr != self.mds_addr or not self._conn.is_connected():
                 self.mds_addr = addr
                 self._conn = self.rados.msgr.connect_to(
@@ -261,7 +324,10 @@ class FileHandle:
         with self._lock:
             if self.cap_id is None:
                 return
-            args = {"ino": self.ino, "cap_id": self.cap_id}
+            # path rides along purely for ROUTING: the cap lives at
+            # the rank that granted it (the file's subtree rank)
+            args = {"ino": self.ino, "cap_id": self.cap_id,
+                    "path": self.path}
             if self._dirty:
                 args["size"] = self.size
             self.cap_id = None

@@ -5,12 +5,22 @@ Python-native equivalent of the reference's metadata server (reference
 MDLog journaling + Locker capabilities) reduced to the duties that
 give CephFS its semantics:
 
-* **single metadata authority**: every namespace mutation (mkdir,
-  create, unlink, rename, setattr...) executes HERE, serialized, so
-  multi-client races resolve in one place (reference Server::
+* **per-subtree metadata authority**: every namespace mutation
+  (mkdir, create, unlink, rename, setattr...) executes at the RANK
+  authoritative for its dentry's parent directory, serialized there,
+  so multi-client races resolve in one place (reference Server::
   handle_client_request) — clients talk to the MDS over the ordinary
   messenger; file DATA still flows client -> OSD directly (striped to
-  the data pool), exactly like the reference;
+  the data pool), exactly like the reference.  Multi-MDS scaling is
+  static subtree pinning (reference ``max_mds`` + ``ceph.dir.pin`` /
+  Migrator subtree auth, mds/Migrator.cc, mds/MDBalancer.cc): the
+  monitor's pin table maps subtrees to ranks, each rank journals to
+  its own objects and fences them on takeover, mismatched requests
+  get a forward verdict the client follows, and cross-subtree
+  renames run a journal-backed master/slave 2-phase (prepare ->
+  peer link -> commit, resumed from the journal after a crash —
+  the Migrator/MMDSSlaveRequest protocol reduced to its rename
+  essentials);
 * **journaling** (reference MDLog/LogEvent + EMetaBlob): each
   mutation appends a low-level, idempotent record to a RADOS-backed
   journal BEFORE touching the backing metadata objects; a restart
@@ -39,7 +49,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ..client.rados import Rados, RadosError
 from ..fs.filesystem import (DIR_TYPE, FILE_TYPE, FSError, FileSystem,
-                             ROOT_INO, _data_soid, _dir_oid, _ino_oid)
+                             ROOT_INO, _data_soid, _dir_oid, _ino_oid,
+                             parent_path, pin_rank_of)
 from ..msg.messages import MMDSCapRecall, MMDSOp, MMDSOpReply
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..utils.config import Config, default_config
@@ -50,6 +61,15 @@ JOURNAL_OID = "mds.journal"          # reference MDLog journal objects
 JOURNAL_HEAD = "mds.journal.head"    # checkpoint: applied-through seq
 # journal trim cadence + forced-recall timeout come from conf
 # (mds_journal_checkpoint_interval / mds_recall_timeout)
+
+
+def rank_journal_oids(rank: int) -> Tuple[str, str]:
+    """Journal object names for a rank (reference: one MDLog per
+    MDSRank, journal inodes 0x200+rank).  Rank 0 keeps the legacy
+    names so solo deployments and pre-multi-MDS journals replay."""
+    if rank <= 0:
+        return JOURNAL_OID, JOURNAL_HEAD
+    return f"{JOURNAL_OID}.r{rank}", f"{JOURNAL_HEAD}.r{rank}"
 
 
 class _Cap:
@@ -95,6 +115,29 @@ class MDSDaemon(Dispatcher):
         # otherwise so solo deployments without mds-aware monitors
         # keep working
         self.active = True
+        # multi-MDS (reference MDSRank + static subtree pinning):
+        # rank assigned by the monitor, journal objects per rank
+        # (rank 0 keeps the legacy names so solo deployments and old
+        # journals keep working), subtree pin table + peer addrs from
+        # the beacon reply for request routing
+        self.rank = 0
+        self._joid = JOURNAL_OID
+        self._jhead = JOURNAL_HEAD
+        self._pins: Dict[str, int] = {}
+        self._peer_addrs: Dict[str, Tuple[str, int]] = {}
+        # peer-op RPC state (cross-rank rename slave requests):
+        # tid -> Event/reply, guarded by _peer_lock, NOT self.lock —
+        # peer replies must land while a handler thread is blocked
+        self._peer_lock = threading.Lock()
+        # serializes outbound slave requests (one in flight per
+        # daemon: makes the constant slave tid unambiguous)
+        self._peer_rpc_mutex = threading.Lock()
+        self._peer_tid = 0
+        self._peer_waiting: Dict[int, threading.Event] = {}
+        self._peer_replies: Dict[int, object] = {}
+        # unresolved cross-rank rename prepares (prep id -> record):
+        # rebuilt on replay, resolved by the tick until commit/abort
+        self._pending_renames: Dict[str, dict] = {}
         self._last_beacon = 0.0
         self._checkpoint_every = \
             self.conf["mds_journal_checkpoint_interval"]
@@ -150,6 +193,26 @@ class MDSDaemon(Dispatcher):
             new_epoch = int(out.get("epoch", 0))
         except (TypeError, ValueError):
             new_epoch = 0
+        # routing state rides every beacon reply: the pin table and
+        # the other actives' addrs (multi-MDS request forwarding +
+        # cross-rank rename slave requests)
+        with self.lock:
+            if "pins" in out:
+                self._pins = {("/" + p.strip("/")): int(r)
+                              for p, r in out["pins"].items()}
+            if "actives" in out:
+                self._peer_addrs = {
+                    k: tuple(v) for k, v in out["actives"].items()
+                    if v is not None}
+        new_rank = out.get("rank")
+        if want_active and self.active and new_rank is not None \
+                and new_rank != self.rank:
+            # reassigned to a different rank: drop the old role state
+            # first, then take the new rank through the full
+            # fence+replay takeover below
+            with self.lock:
+                self._demote(f"reassigned rank {self.rank} -> "
+                             f"{new_rank}")
         if want_active and not self.active:
             with self.lock:
                 # TAKEOVER: adopt the epoch ONLY here, under the lock
@@ -166,14 +229,20 @@ class MDSDaemon(Dispatcher):
                 # collapsed to a fresh tail replay — the journal is
                 # small by the checkpoint cadence).
                 self._epoch = max(self._epoch, new_epoch)
+                if new_rank is not None:
+                    self.rank = int(new_rank)
+                    self._joid, self._jhead = \
+                        rank_journal_oids(self.rank)
                 if not self._fence_journal():
                     return               # stale/unreachable: next
                                          # beacon retries promotion
                 self._reqids.clear()
+                self._pending_renames.clear()
                 self._replay_journal()
                 self.active = True
-            self.log.dout(1, "promoted to active (journal fenced at "
-                          f"e{self._epoch}, adopted)")
+            self.log.dout(1, f"promoted to active rank {self.rank} "
+                          f"(journal fenced at e{self._epoch}, "
+                          f"adopted)")
         elif not want_active and self.active:
             with self.lock:
                 self._demote("monitor reassigned active")
@@ -188,7 +257,7 @@ class MDSDaemon(Dispatcher):
     # ------------------------------------------------------------------
     def _replay_journal(self) -> None:
         try:
-            head = json.loads(self.meta.read(JOURNAL_HEAD).decode())
+            head = json.loads(self.meta.read(self._jhead).decode())
         except (RadosError, ValueError):
             head = {"applied": 0}
         self._applied = head["applied"]
@@ -198,7 +267,7 @@ class MDSDaemon(Dispatcher):
         # skipped as already-applied on the next replay
         self._seq = self._applied
         try:
-            raw = self.meta.read(JOURNAL_OID)
+            raw = self.meta.read(self._joid)
         except RadosError:
             raw = b""
         replayed = 0
@@ -210,6 +279,15 @@ class MDSDaemon(Dispatcher):
             if ent.get("reqid"):
                 self._reqids[tuple(ent["reqid"])] = \
                     {"ino": ent["ino"]} if "ino" in ent else {}
+            # cross-rank rename 2-phase bookkeeping: a prepare with
+            # no commit/abort is an interrupted master — the tick
+            # re-drives the slave link and commits (reference
+            # Migrator resolve after mds failure)
+            if ent["op"] == "rename_out_prepare":
+                self._pending_renames[ent["prep"]] = ent
+            elif ent["op"] in ("rename_out_commit",
+                               "rename_out_abort"):
+                self._pending_renames.pop(ent.get("prep"), None)
             if ent["seq"] <= self._applied:
                 continue
             self._apply(ent)
@@ -244,8 +322,8 @@ class MDSDaemon(Dispatcher):
         rather than bricking the filesystem."""
         try:
             payload = json.dumps({"epoch": self._epoch}).encode()
-            self.meta.exec_cls(JOURNAL_OID, "fence", "set", payload)
-            self.meta.exec_cls(JOURNAL_HEAD, "fence", "set", payload)
+            self.meta.exec_cls(self._joid, "fence", "set", payload)
+            self.meta.exec_cls(self._jhead, "fence", "set", payload)
             return True
         except RadosError as e:
             if e.errno == 95:            # EOPNOTSUPP: unfenced pool
@@ -285,8 +363,8 @@ class MDSDaemon(Dispatcher):
         raise RadosError(116, "fenced: no longer the active mds")
 
     def _fenced_append(self, line: bytes) -> None:
-        self._guarded(JOURNAL_OID, "guarded_append",
-                      lambda: self.meta.append(JOURNAL_OID, line),
+        self._guarded(self._joid, "guarded_append",
+                      lambda: self.meta.append(self._joid, line),
                       data=line.decode("utf-8"))
 
     def _journal(self, ent: dict) -> int:
@@ -324,14 +402,19 @@ class MDSDaemon(Dispatcher):
         and its trim would erase the successor's entries (reference
         MDLog trim, safe there because the old active is blocklisted
         before promotion)."""
+        if self._pending_renames:
+            # an unresolved cross-rank rename prepare lives ONLY in
+            # the journal tail; trimming now would lose the intent a
+            # crash needs to resume (the tick resolves these fast)
+            return
         head = json.dumps({"applied": self._applied})
-        self._guarded(JOURNAL_HEAD, "guarded_write_full",
-                      lambda: self.meta.write_full(JOURNAL_HEAD,
+        self._guarded(self._jhead, "guarded_write_full",
+                      lambda: self.meta.write_full(self._jhead,
                                                    head.encode()),
                       data=head)
         try:
-            self._guarded(JOURNAL_OID, "guarded_truncate",
-                          lambda: self.meta.truncate(JOURNAL_OID, 0),
+            self._guarded(self._joid, "guarded_truncate",
+                          lambda: self.meta.truncate(self._joid, 0),
                           size=0)
         except RadosError as e:
             if e.errno != 2:             # ENOENT: nothing to trim
@@ -379,6 +462,28 @@ class MDSDaemon(Dispatcher):
         elif op == "setattr":
             fs._write_inode(ent["ino"], ent["type"], ent["size"],
                             ent.get("mode", 0o644))
+        elif op == "rename_out_prepare":
+            pass     # intent marker only: replay bookkeeping resumes
+                     # the slave link + commit (no namespace effect)
+        elif op == "rename_out_commit":
+            # master side of a cross-rank rename: the slave already
+            # linked the dentry at the destination rank; drop ours
+            fs._unlink(ent["oparent"], ent["oname"])
+        elif op == "rename_out_abort":
+            pass     # slave refused: nothing ever changed
+        elif op == "link":
+            # slave side of a cross-rank rename (reference
+            # MMDSSlaveRequest OP_LINKPREP collapsed to one journaled
+            # insert): adopt the inode's dentry under our subtree,
+            # replacing a same-name file target like a local rename
+            fs._link(ent["parent"], ent["name"], ent["ino"],
+                     ent["type"])
+            if ent.get("unlink_ino"):
+                try:
+                    fs.striper.remove(_data_soid(ent["unlink_ino"]))
+                except RadosError:
+                    pass
+                fs._remove_oid(_ino_oid(ent["unlink_ino"]))
 
     # ------------------------------------------------------------------
     # capabilities (reference Locker, exclusive-writer collapse)
@@ -450,11 +555,100 @@ class MDSDaemon(Dispatcher):
                 for ino in stale:
                     self.log.dout(1, f"recall timeout ino {ino}")
                     self._revoke(ino)
+                # re-drive cross-rank rename prepares whose first
+                # attempt went indeterminate (or that a crash left in
+                # the journal): the slave's reqid table makes the
+                # retried link exactly-once
+                retries = [p for p, rec in
+                           self._pending_renames.items()
+                           if self.active
+                           and now - rec.get("t0", 0) > 10.0]
+                for prep in retries:
+                    self._pending_renames[prep]["t0"] = now
+                    threading.Thread(
+                        target=self._drive_cross_rename,
+                        args=(prep, None),
+                        name=f"{self.name}-xrename-retry",
+                        daemon=True).start()
+
+    # ------------------------------------------------------------------
+    # multi-MDS routing (static subtree pinning: the reference's
+    # Migrator/MDBalancer subtree auth reduced to a monitor-held pin
+    # table; every rank reads the shared backing store but MUTATES
+    # only the subtrees pinned to it, so dir omaps have one writer)
+    # ------------------------------------------------------------------
+    _parent_path = staticmethod(parent_path)
+
+    def _rank_of_path(self, path: str) -> int:
+        return pin_rank_of(self._pins, path)
+
+    def _route_rank(self, op: str, a: dict):
+        """Authoritative rank for an op, or None when routing does
+        not apply.  Namespace mutations and lookups route by the
+        DENTRY'S PARENT directory (the dentry lives in the parent's
+        omap — reference: a subtree bound's dentry belongs to the
+        parent subtree); listdir routes by the directory itself;
+        cap_release and slave requests are rank-local."""
+        if not self._pins:
+            return None
+        if op in ("cap_release", "peer_link"):
+            return None
+        if op == "listdir":
+            return self._rank_of_path(a.get("path", "/"))
+        if op == "rename":
+            return self._rank_of_path(
+                self._parent_path(a.get("old", "/")))
+        return self._rank_of_path(
+            self._parent_path(a.get("path", "/")))
+
+    def _peer_request(self, rank: int, op: str, args: dict,
+                      prep: str, timeout: float = 20.0):
+        """One blocking slave request to another rank (reference
+        MMDSSlaveRequest).  Serialized per daemon so the constant
+        slave tid is unambiguous; the client name carries the prep id
+        so the peer's journal-backed reqid table makes retries (in-
+        session or post-crash) exactly-once.  Callers must NOT hold
+        self.lock — the peer may be sending us a slave request of its
+        own at the same moment.  Raises TimeoutError when the outcome
+        is indeterminate (never on a definite refusal)."""
+        addr = self._peer_addrs.get(str(rank))
+        if addr is None:
+            raise TimeoutError(f"no address for mds rank {rank}")
+        with self._peer_rpc_mutex:
+            with self._peer_lock:
+                self._peer_tid += 1
+                tid = self._peer_tid
+                ev = threading.Event()
+                self._peer_waiting[tid] = ev
+            try:
+                conn = self.msgr.connect_to(tuple(addr),
+                                            lossless=False)
+                conn.send_message(MMDSOp(
+                    client=f"mdspeer:{prep}", tid=1, op=op,
+                    args=dict(args, reply_tid=tid)))
+                if not ev.wait(timeout):
+                    raise TimeoutError(f"peer rank {rank} silent")
+            finally:
+                with self._peer_lock:
+                    self._peer_waiting.pop(tid, None)
+                    reply = self._peer_replies.pop(tid, None)
+            if reply is None:
+                raise TimeoutError(f"peer rank {rank} silent")
+            return reply
 
     # ------------------------------------------------------------------
     # request handling (reference Server::handle_client_request)
     # ------------------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, MMDSOpReply):
+            # a slave request's answer (peer ops echo our reply_tid)
+            rtid = (msg.out or {}).get("reply_tid", msg.tid)
+            with self._peer_lock:
+                self._peer_replies[rtid] = msg
+                ev = self._peer_waiting.pop(rtid, None)
+            if ev:
+                ev.set()
+            return True
         if not isinstance(msg, MMDSOp):
             return False
         with self.lock:
@@ -470,9 +664,19 @@ class MDSDaemon(Dispatcher):
 
     def _reply(self, conn, msg, result: int = 0,
                out: Optional[dict] = None) -> None:
+        out = dict(out or {})
+        # slave requests carry the master's correlation id; echo it in
+        # EVERY reply shape (including reqid-dedup hits) so the master
+        # never mis-matches a late reply to the wrong request
+        try:
+            rt = msg.args.get("reply_tid")
+        except AttributeError:
+            rt = None
+        if rt is not None:
+            out["reply_tid"] = rt
         try:
             conn.send_message(MMDSOpReply(tid=msg.tid, result=result,
-                                          out=out or {}))
+                                          out=out))
         except Exception:
             pass
 
@@ -487,8 +691,19 @@ class MDSDaemon(Dispatcher):
         hit = self._reqids.get((msg.client, msg.tid))
         if hit is not None:
             # duplicate of an already-journaled mutation (client
-            # resent across a failover): re-reply, don't re-execute
+            # resent across a failover): re-reply, don't re-execute.
+            # Checked BEFORE the routing verdict: a pin change between
+            # first try and resend must not forward the resend to a
+            # rank that never saw the reqid (it would re-execute or
+            # mis-error an op that already succeeded here)
             self._reply(conn, msg, 0, dict(hit))
+            return
+        target = self._route_rank(msg.op, a)
+        if target is not None and target != self.rank:
+            # another rank's subtree: forward verdict (reference
+            # Server forwards via mdsmap; here the client re-sends to
+            # out["rank"] itself)
+            self._reply(conn, msg, -108, {"rank": target})
             return
         self._cur_reqid = (msg.client, msg.tid)
         try:
@@ -497,7 +712,7 @@ class MDSDaemon(Dispatcher):
                 self._reply(conn, msg)
                 return
             if msg.op in ("open", "stat", "truncate", "setattr",
-                          "unlink", "rename"):
+                          "unlink", "rename", "peer_link"):
                 # coherence point: these must observe (or take over)
                 # any writer's buffered attributes — including the
                 # namespace ops that destroy the target
@@ -592,6 +807,29 @@ class MDSDaemon(Dispatcher):
                 self._reply(conn, msg)
             elif msg.op == "rename":
                 self._rename(msg, conn, a["old"], a["new"])
+            elif msg.op == "peer_link":
+                # slave side of a cross-rank rename (reference
+                # MMDSSlaveRequest): adopt the inode under our
+                # subtree; rename-over-file semantics match _rename
+                nparent, nname = fs._resolve_parent(a["path"])
+                target = fs._lookup(nparent, nname)
+                unlink_ino = None
+                if target is not None:
+                    if target["ino"] == a["ino"]:
+                        self._reply(conn, msg)   # already linked
+                        return
+                    if target["type"] == DIR_TYPE:
+                        raise FSError(21, a["path"])
+                    if a["type"] == DIR_TYPE:
+                        raise FSError(20, a["path"])
+                    unlink_ino = target["ino"]
+                self._journal({"op": "link", "parent": nparent,
+                               "name": nname, "ino": a["ino"],
+                               "type": a["type"],
+                               "unlink_ino": unlink_ino})
+                if unlink_ino is not None and unlink_ino in self.caps:
+                    self._revoke(unlink_ino)
+                self._reply(conn, msg)
             elif msg.op in ("truncate", "setattr"):
                 ino, ent = fs._resolve(a["path"])
                 node = fs._read_inode(ino)
@@ -637,6 +875,12 @@ class MDSDaemon(Dispatcher):
             return
         if ent["type"] == DIR_TYPE and nparts[:len(oparts)] == oparts:
             raise FSError(22, old)
+        dst_rank = self._rank_of_path(self._parent_path(new)) \
+            if self._pins else self.rank
+        if dst_rank != self.rank:
+            self._start_cross_rename(msg, conn, ent, oparent, oname,
+                                     new, dst_rank)
+            return
         nparent, nname = fs._resolve_parent(new)
         target = fs._lookup(nparent, nname)
         unlink_ino = None
@@ -654,3 +898,85 @@ class MDSDaemon(Dispatcher):
         if unlink_ino is not None and unlink_ino in self.caps:
             self._revoke(unlink_ino)
         self._reply(conn, msg)
+
+    # ------------------------------------------------------------------
+    # cross-rank rename: 2-phase master (reference Migrator +
+    # MMDSSlaveRequest, collapsed to prepare -> slave link -> commit
+    # with journal-backed resume on either side's crash)
+    # ------------------------------------------------------------------
+    def _start_cross_rename(self, msg, conn, ent, oparent: int,
+                            oname: str, new: str,
+                            dst_rank: int) -> None:
+        """Journal the master intent under self.lock, then drive the
+        blocking slave request off-thread (holding the MDS lock
+        across a network round trip would deadlock two masters
+        renaming into each other's subtrees)."""
+        prep = f"{self.name}.e{self._epoch}.{self._seq + 1}"
+        saved = self._cur_reqid
+        self._cur_reqid = None       # the COMMIT carries the client
+        try:                         # reqid: a resend must not get a
+                                     # dup-hit before the dest exists
+            self._journal({"op": "rename_out_prepare",
+                           "oparent": oparent, "oname": oname,
+                           "ino": ent["ino"], "type": ent["type"],
+                           "new": new, "peer_rank": dst_rank,
+                           "prep": prep})
+        finally:
+            self._cur_reqid = saved
+        self._pending_renames[prep] = {
+            "oparent": oparent, "oname": oname, "ino": ent["ino"],
+            "type": ent["type"], "new": new, "peer_rank": dst_rank,
+            "prep": prep, "t0": time.monotonic()}
+        threading.Thread(
+            target=self._drive_cross_rename,
+            args=(prep, self._cur_reqid, msg, conn),
+            name=f"{self.name}-xrename", daemon=True).start()
+
+    def _drive_cross_rename(self, prep: str, reqid, msg=None,
+                            conn=None) -> None:
+        """Slave link + local commit/abort for one prepared
+        cross-rank rename.  Runs WITHOUT self.lock around the peer
+        round trip; also re-driven by the tick for prepares found in
+        the journal after a crash (msg=None: nobody to answer)."""
+        with self.lock:
+            rec = self._pending_renames.get(prep)
+        if rec is None:
+            if msg is not None:
+                self._reply(conn, msg)   # already resolved
+            return
+        try:
+            reply = self._peer_request(
+                rec["peer_rank"], "peer_link",
+                {"path": rec["new"], "ino": rec["ino"],
+                 "type": rec["type"]}, prep)
+        except TimeoutError:
+            # indeterminate: keep the prepare; the tick retries (the
+            # slave's reqid table absorbs the duplicate) — the client
+            # gets EAGAIN and may resend
+            if msg is not None:
+                self._reply(conn, msg, -11)
+            return
+        ok = reply.result == 0
+        with self.lock:
+            if prep not in self._pending_renames:
+                return
+            self._cur_reqid = reqid if ok else None
+            try:
+                self._journal({
+                    "op": "rename_out_commit" if ok
+                    else "rename_out_abort",
+                    "oparent": rec["oparent"], "oname": rec["oname"],
+                    "ino": rec["ino"], "prep": prep})
+            except Exception:
+                self._cur_reqid = None
+                if msg is not None:
+                    self._reply(conn, msg, -11)
+                return
+            self._cur_reqid = None
+            self._pending_renames.pop(prep, None)
+            # the inode now lives under another rank's authority: any
+            # cap we granted on it must not linger here
+            if ok and rec["ino"] in self.caps:
+                self._revoke(rec["ino"])
+        if msg is not None:
+            self._reply(conn, msg, 0 if ok else reply.result)

@@ -135,10 +135,18 @@ class Monitor(Dispatcher):
         self.pg_stats_from: Dict[str, int] = {}
         self.osd_stats: Dict[int, dict] = {}     # osd -> osd_stat_t
         self._data_path = data_path
-        # MDSMap (reference mon/MDSMonitor.cc reduced to one active +
-        # standbys with beacon-grace failover); leader-local, persisted
+        # MDSMap (reference mon/MDSMonitor.cc FSMap reduced to rank ->
+        # name assignment + standbys with beacon-grace failover).
+        # "actives" maps rank (str, JSON-keyed) -> daemon name up to
+        # max_mds ranks (reference fs set max_mds); "pins" maps a
+        # directory subtree path -> authoritative rank (the static
+        # analog of reference ceph.dir.pin / Migrator subtree
+        # auth delegation); "active" mirrors rank 0 for legacy
+        # consumers.  Leader-local, persisted.
         self.mds_map: Dict = {"epoch": 0, "active": None,
-                              "addrs": {}, "standbys": []}
+                              "addrs": {}, "standbys": [],
+                              "max_mds": 1, "actives": {},
+                              "pins": {}}
         self._mds_beacons: Dict[str, float] = {}
         self._booted_addr: Dict[int, Tuple[str, int]] = {}
         self.msgr = Messenger(name, conf=self.conf)
@@ -195,6 +203,12 @@ class Monitor(Dispatcher):
         saved_mds = self.store.get_raw("mdsmap")
         if saved_mds:
             self.mds_map = saved_mds
+            # maps persisted before multi-MDS lack the rank fields
+            self.mds_map.setdefault("max_mds", 1)
+            self.mds_map.setdefault("pins", {})
+            acts = self.mds_map.setdefault("actives", {})
+            if self.mds_map.get("active") and not acts:
+                acts["0"] = self.mds_map["active"]
             now = time.monotonic()
             for name in self.mds_map.get("addrs", {}):
                 self._mds_beacons[name] = now
@@ -882,10 +896,40 @@ class Monitor(Dispatcher):
         return (0, "", {"enabled": enabled,
                         "available": sorted(discover())})
 
+    def _mds_fill_ranks_locked(self) -> bool:
+        """Assign unfilled ranks 0..max_mds-1 from the standby queue
+        (reference MDSMonitor::maybe_promote_standby); -> changed."""
+        m = self.mds_map
+        changed = False
+        for r in range(int(m.get("max_mds", 1))):
+            key = str(r)
+            if m["actives"].get(key) is None and m["standbys"]:
+                m["actives"][key] = m["standbys"].pop(0)
+                changed = True
+        # ranks past a lowered max_mds drain back to standby
+        for key in sorted(m["actives"]):
+            if int(key) >= int(m.get("max_mds", 1)):
+                name = m["actives"].pop(key)
+                if name is not None and name not in m["standbys"]:
+                    m["standbys"].append(name)
+                changed = True
+        if m.get("active") != m["actives"].get("0"):
+            m["active"] = m["actives"].get("0")
+            changed = True
+        return changed
+
+    def _mds_role_of_locked(self, name: str):
+        for key, holder in self.mds_map["actives"].items():
+            if holder == name:
+                return int(key)
+        return None
+
     def _cmd_mds_beacon(self, cmd: dict):
-        """MDS liveness + role assignment (reference MDSMonitor
-        beacon handling): first beacon wins active; later ones queue
-        as standbys; the tick promotes on beacon-grace expiry."""
+        """MDS liveness + rank assignment (reference MDSMonitor
+        beacon handling): beacons fill unheld ranks up to max_mds in
+        arrival order; the rest queue as standbys; the tick promotes
+        on beacon-grace expiry.  The reply tells the daemon its rank
+        and the subtree pin table it must route by."""
         name = cmd.get("name", "")
         addr = tuple(cmd.get("addr", ())) or None
         if not name or addr is None:
@@ -895,17 +939,23 @@ class Monitor(Dispatcher):
             self._mds_beacons[name] = time.monotonic()
             changed = m["addrs"].get(name) != list(addr)
             m["addrs"][name] = list(addr)
-            if m["active"] is None:
-                m["active"] = name
-                changed = True
-            if name != m["active"] and name not in m["standbys"]:
+            if self._mds_role_of_locked(name) is None and \
+                    name not in m["standbys"]:
                 m["standbys"].append(name)
                 changed = True
+            changed |= self._mds_fill_ranks_locked()
             if changed:
                 m["epoch"] += 1
                 self.store.put_raw("mdsmap", m)
-            role = "active" if m["active"] == name else "standby"
-            return (0, role, {"role": role, "epoch": m["epoch"]})
+            rank = self._mds_role_of_locked(name)
+            role = "active" if rank is not None else "standby"
+            return (0, role, {
+                "role": role, "rank": rank, "epoch": m["epoch"],
+                "max_mds": int(m.get("max_mds", 1)),
+                "pins": dict(m.get("pins", {})),
+                "actives": {k: m["addrs"].get(v)
+                            for k, v in m["actives"].items()
+                            if v is not None}})
 
     def _cmd_mds_getmap(self, cmd: dict):
         with self.lock:
@@ -913,11 +963,74 @@ class Monitor(Dispatcher):
             return (0, "", {
                 "epoch": m["epoch"], "active": m["active"],
                 "addr": m["addrs"].get(m["active"]),
-                "standbys": list(m["standbys"])})
+                "standbys": list(m["standbys"]),
+                "max_mds": int(m.get("max_mds", 1)),
+                "pins": dict(m.get("pins", {})),
+                "actives": {k: m["addrs"].get(v)
+                            for k, v in m["actives"].items()
+                            if v is not None}})
+
+    def _cmd_fs_set(self, cmd: dict):
+        """fs set max_mds <n> (reference MDSMonitor fs set): raise or
+        lower the active rank count; standbys fill new ranks on the
+        spot or at their next beacon."""
+        var = cmd.get("var", "")
+        if var != "max_mds":
+            return (-22, f"unknown fs var {var!r}", {})
+        try:
+            n = int(cmd.get("val", ""))
+        except ValueError:
+            return (-22, "max_mds must be an integer", {})
+        if not 1 <= n <= 64:
+            return (-22, "max_mds must be in [1, 64]", {})
+        with self.lock:
+            m = self.mds_map
+            # pins to ranks being removed would strand their subtrees
+            for path, r in m.get("pins", {}).items():
+                if int(r) >= n:
+                    return (-22, f"pin {path!r} -> rank {r} blocks "
+                            f"shrinking max_mds to {n}; unpin first",
+                            {})
+            m["max_mds"] = n
+            self._mds_fill_ranks_locked()
+            m["epoch"] += 1
+            self.store.put_raw("mdsmap", m)
+            return (0, f"max_mds = {n}", {"epoch": m["epoch"]})
+
+    def _cmd_fs_pin(self, cmd: dict):
+        """fs pin <path> <rank> (static analog of reference
+        ceph.dir.pin): the subtree rooted at path is served by that
+        rank; rank -1 removes the pin.  Root ("/") stays rank 0."""
+        path = cmd.get("path", "")
+        if not path.startswith("/"):
+            return (-22, "pin path must be absolute", {})
+        path = "/" + path.strip("/")
+        if path == "/":
+            return (-22, "the root is always rank 0; pin a subtree",
+                    {})
+        try:
+            rank = int(cmd.get("rank", ""))
+        except ValueError:
+            return (-22, "rank must be an integer", {})
+        with self.lock:
+            m = self.mds_map
+            if rank < 0:
+                m.get("pins", {}).pop(path, None)
+            else:
+                if rank >= int(m.get("max_mds", 1)):
+                    return (-22, f"rank {rank} >= max_mds "
+                            f"{m.get('max_mds', 1)}", {})
+                m.setdefault("pins", {})[path] = rank
+            m["epoch"] += 1
+            self.store.put_raw("mdsmap", m)
+            return (0, f"pinned {path} -> {rank}"
+                    if rank >= 0 else f"unpinned {path}",
+                    {"epoch": m["epoch"]})
 
     def _mds_tick(self) -> None:
-        """Fail over a beacon-silent active MDS to the freshest
-        standby (reference MDSMonitor::tick beacon grace)."""
+        """Fail over beacon-silent rank holders to the freshest
+        standbys (reference MDSMonitor::tick beacon grace), one rank
+        at a time per silent daemon."""
         grace = self.conf["mds_beacon_grace"] * \
             self.conf["mon_mds_beacon_grace_factor"]
         now = time.monotonic()
@@ -929,16 +1042,16 @@ class Monitor(Dispatcher):
                     m["standbys"].remove(name)
                     m["addrs"].pop(name, None)
                     changed = True
-            active = m["active"]
-            if active is not None and \
-                    now - self._mds_beacons.get(active, 0) > grace:
-                m["addrs"].pop(active, None)
-                m["active"] = m["standbys"].pop(0) \
-                    if m["standbys"] else None
-                self.log.dout(1, f"mds {active} beacon-silent "
-                              f"> {grace}s: active -> "
-                              f"{m['active']}")
-                changed = True
+            for key in sorted(m["actives"]):
+                holder = m["actives"][key]
+                if holder is not None and \
+                        now - self._mds_beacons.get(holder, 0) > grace:
+                    m["addrs"].pop(holder, None)
+                    m["actives"][key] = None
+                    self.log.dout(1, f"mds {holder} beacon-silent "
+                                  f"> {grace}s: rank {key} open")
+                    changed = True
+            changed |= self._mds_fill_ranks_locked()
             if changed:
                 m["epoch"] += 1
                 self.store.put_raw("mdsmap", m)
@@ -1450,6 +1563,8 @@ class Monitor(Dispatcher):
         "osd pool set": _cmd_pool_set,
         "mds beacon": _cmd_mds_beacon,
         "mds getmap": _cmd_mds_getmap,
+        "fs set": _cmd_fs_set,
+        "fs pin": _cmd_fs_pin,
         "osd pool delete": _cmd_pool_delete,
         "mgr module enable": _cmd_mgr_module_enable,
         "mgr module disable": _cmd_mgr_module_disable,

@@ -302,7 +302,17 @@ class BlockStore(ObjectStore):
         ext_cache: Dict[str, _Extents] = {}
         view = _BatchView(self._db, batch)
         freed: Set[int] = set()
+        allocated: List[int] = []
         dirty = False
+
+        def alloc() -> int:
+            # every in-txn allocation is tracked so a failed apply
+            # (csum EIO mid-transaction) rolls the in-memory bitmap
+            # back — otherwise the next successful commit would
+            # persist the leak with no reclaim path
+            phys = self._alloc.allocate()
+            allocated.append(phys)
+            return phys
 
         def get_ext(coll, obj) -> _Extents:
             key = self._xkey(coll, obj)
@@ -373,7 +383,7 @@ class BlockStore(ObjectStore):
                     ext.crcs[lb] = 0
                     continue
                 blk = raw[i * BLOCK:(i + 1) * BLOCK]
-                phys = self._alloc.allocate()
+                phys = alloc()
                 self._write_block(phys, blk)
                 ext.blocks[lb] = phys
                 ext.crcs[lb] = crc32c(blk)
@@ -412,7 +422,7 @@ class BlockStore(ObjectStore):
                           frozenset(range(first_full, last_full)))
             phys_list = []
             for i in range(nphys):
-                phys = self._alloc.allocate()
+                phys = alloc()
                 self._write_block(phys, comp[i * BLOCK:(i + 1) * BLOCK]
                                   .ljust(BLOCK, b"\x00"))
                 phys_list.append(phys)
@@ -449,9 +459,16 @@ class BlockStore(ObjectStore):
                 if lo >= hi:
                     continue
                 # a partial overwrite of a compressed segment member
-                # re-materializes the segment's survivors first
+                # re-materializes the segment's survivors first —
+                # but blocks this write FULLY covers need none of
+                # their old bytes, so they drop instead of decompress
+                # (a rotten segment must not brick the overwrite that
+                # replaces it, and a full overwrite of compressed
+                # data must not pay a pointless decompress)
+                full = frozenset(range((lo + BLOCK - 1) // BLOCK,
+                                       hi // BLOCK))
                 flatten_range(ext, lo // BLOCK,
-                              (hi + BLOCK - 1) // BLOCK)
+                              (hi + BLOCK - 1) // BLOCK, full)
                 pos = lo
                 while pos < hi:
                     lb = pos // BLOCK
@@ -468,7 +485,7 @@ class BlockStore(ObjectStore):
                                   + data[pos - offset:pos - offset
                                          + run]
                                   + base[boff + run:])
-                    new_phys = self._alloc.allocate()   # COW
+                    new_phys = alloc()   # COW
                     self._write_block(new_phys, merged_blk)
                     if old_phys >= 0:
                         freed.add(old_phys)
@@ -548,7 +565,7 @@ class BlockStore(ObjectStore):
                             base = read_base_block(ext, lb)
                             keep = size % BLOCK
                             blk = base[:keep].ljust(BLOCK, b"\x00")
-                            new_phys = self._alloc.allocate()
+                            new_phys = alloc()
                             self._write_block(new_phys, blk)
                             freed.add(ext.blocks[lb])
                             ext.blocks[lb] = new_phys
@@ -679,8 +696,13 @@ class BlockStore(ObjectStore):
             except OSError:
                 # missing object (idempotent re-apply) or csum EIO:
                 # on replay, skip the op and keep mounting — a WAL
-                # entry poisoned by rot must not brick the store
+                # entry poisoned by rot must not brick the store.
+                # Live path: roll the in-memory bitmap back (nothing
+                # this apply did is referenced — the batch never
+                # commits) and surface the error
                 if not replay:
+                    for phys in allocated:
+                        self._alloc.free(phys)
                     raise
         # the COW flip: all extent maps updated in the same batch
         for key, ext in ext_cache.items():

@@ -95,6 +95,17 @@ def _build_command(words: List[str], ns: argparse.Namespace
     if is_("osd", "tree"):
         return ({"prefix": "osd tree"}, w[2:])
 
+    if is_("fs", "set"):
+        return ({"prefix": "fs set",
+                 "var": arg(2, "fs set <var> <val>"),
+                 "val": arg(3, "fs set <var> <val>")}, w[4:])
+    if is_("fs", "pin"):
+        return ({"prefix": "fs pin",
+                 "path": arg(2, "fs pin <path> <rank>"),
+                 "rank": arg(3, "fs pin <path> <rank>")}, w[4:])
+    if is_("mds", "getmap") or is_("fs", "status"):
+        return ({"prefix": "mds getmap"}, w[2:])
+
     if is_("status") or is_("-s"):
         return ({"prefix": "status"}, w[1:])
     if is_("health"):
