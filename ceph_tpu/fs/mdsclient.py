@@ -79,6 +79,8 @@ class MDSClient(Dispatcher):
     # -- multi-MDS routing (the daemon applies the same shared rule,
     # filesystem.pin_rank_of, so client and server cannot drift) ------
     def _route_rank(self, op: str, args: dict) -> int:
+        if "_rank" in args:
+            return int(args["_rank"])    # explicit (cap releases)
         pins = self._map.get("pins") or {}
         if not pins:
             return 0
@@ -121,12 +123,14 @@ class MDSClient(Dispatcher):
             return True
         if isinstance(msg, MMDSCapRecall):
             threading.Thread(target=self._recalled,
-                             args=(msg.ino, msg.cap_id),
+                             args=(msg.ino, msg.cap_id,
+                                   getattr(msg, "rank", 0)),
                              daemon=True).start()
             return True
         return False
 
-    def _recalled(self, ino: int, cap_id: int) -> None:
+    def _recalled(self, ino: int, cap_id: int,
+                  rank: int = 0) -> None:
         # a recall can race the open reply (cap granted, handle not
         # yet registered): wait briefly for the handle so its
         # buffered size flushes instead of being dropped
@@ -142,8 +146,13 @@ class MDSClient(Dispatcher):
         if fh is not None:
             fh._flush_and_drop_cap()
         else:
+            # no handle left to supply a path: route by the GRANTING
+            # rank the recall carried (a release landing at the wrong
+            # rank would silently no-op and the recall would stall to
+            # its timeout)
             self.request("cap_release", {"ino": ino,
-                                         "cap_id": cap_id})
+                                         "cap_id": cap_id,
+                                         "_rank": rank})
 
     def request(self, op: str, args: dict,
                 timeout: float = 30.0) -> dict:

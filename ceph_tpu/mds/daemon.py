@@ -508,7 +508,7 @@ class MDSDaemon(Dispatcher):
             cap = self.caps[ino]
             try:
                 cap.conn.send_message(MMDSCapRecall(
-                    ino=ino, cap_id=cap.cap_id))
+                    ino=ino, cap_id=cap.cap_id, rank=self.rank))
             except Exception:
                 self._revoke(ino)        # dead session: drop now
 

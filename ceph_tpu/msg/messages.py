@@ -1181,15 +1181,20 @@ class MMDSCapRecall(Message):
     ``cap_release`` MMDSOp carrying its buffered size/mtime."""
     TYPE = 47
 
-    def __init__(self, ino: int = 0, cap_id: int = 0):
+    def __init__(self, ino: int = 0, cap_id: int = 0,
+                 rank: int = 0):
         super().__init__()
         self.ino = ino
         self.cap_id = cap_id
+        # granting rank (multi-MDS): the client's release must come
+        # BACK here — ino alone cannot be path-routed
+        self.rank = rank
 
     def encode_payload(self) -> bytes:
-        return Encoder().u64(self.ino).u64(self.cap_id).build()
+        return Encoder().u64(self.ino).u64(self.cap_id) \
+            .u64(self.rank).build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MMDSCapRecall":
         d = Decoder(buf)
-        return cls(ino=d.u64(), cap_id=d.u64())
+        return cls(ino=d.u64(), cap_id=d.u64(), rank=d.u64())

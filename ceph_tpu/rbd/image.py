@@ -57,6 +57,37 @@ def _data_oid(name: str, objectno: int) -> str:
     return f"rbd_data.{name}.{objectno:016x}"
 
 
+def _mirror_peer_oid(name: str) -> str:
+    """Peer journal position at the PRIMARY site (reference: the
+    rbd-mirror peer registers as a journal client and its committed
+    position gates local trimming, journal/JournalTrimmer).  Lives
+    outside the header so the mirror daemon's updates never race the
+    lock holder's header saves."""
+    return f"rbd_mirror.{name}.peer"
+
+
+def _mirror_pos_oid(name: str) -> str:
+    """Sync position at the SECONDARY site: highest primary journal
+    seq already applied here."""
+    return f"rbd_mirror.{name}.pos"
+
+
+def _omap_oid(name: str, snap_id: Optional[int] = None) -> str:
+    """Object-map object (reference rbd_object_map.<id> and
+    rbd_object_map.<id>.<snapid>, librbd/object_map/)."""
+    base = f"rbd_object_map.{name}"
+    return base if snap_id is None else f"{base}.{snap_id}"
+
+
+# object-map states, 2 bits per data object (reference
+# cls/rbd/cls_rbd_types OBJECT_*): EXISTS means written since the
+# last snapshot (the dirty bit fast-diff reads), EXISTS_CLEAN means
+# present but untouched since then
+OM_NONEXISTENT = 0
+OM_EXISTS = 1
+OM_EXISTS_CLEAN = 3
+
+
 class RBD:
     """Pool-level image operations (reference librbd.h RBD class)."""
 
@@ -95,6 +126,20 @@ class RBD:
         if conf["rbd_validate_names"] and (
                 not name or any(c in name for c in "/@\0")):
             raise ValueError(f"invalid image name {name!r}")
+        feats = set(features or ("layering",))
+        if "fast-diff" in feats:
+            feats.add("object-map")      # reference: fast-diff is an
+                                         # object-map annotation
+        if "object-map" in feats:
+            # reference requires exclusive-lock under the object map;
+            # this implementation additionally requires journaling —
+            # the post-crash journal replay is what re-marks the
+            # dirty bits an apply crash could lose, keeping fast-diff
+            # exact without the reference's detained-update machinery
+            if not {"exclusive-lock", "journaling"} <= feats:
+                raise ValueError("object-map requires exclusive-lock "
+                                 "+ journaling")
+        features = tuple(sorted(feats))
         names = self._dir()
         if name in names:
             raise RadosError(17, f"image {name!r} exists")  # EEXIST
@@ -116,7 +161,8 @@ class RBD:
         if img.header["snaps"]:
             raise RadosError(39, "image has snapshots")  # ENOTEMPTY
         img._remove_all_data()
-        for oid in (_journal_oid(name), _journal_head_oid(name)):
+        for oid in (_journal_oid(name), _journal_head_oid(name),
+                    _omap_oid(name)):
             try:
                 self.ioctx.remove(oid)
             except RadosError:
@@ -291,11 +337,13 @@ class Image:
     # -- journaling (reference librbd/journal/: WAL before data) ------
     def _journal_append(self, offset: int, data: bytes) -> None:
         import base64
+        self._journal_event({"off": offset,
+                             "data": base64.b64encode(data).decode()})
+
+    def _journal_event(self, ev: dict) -> None:
         import json as _json
         self._journal_seq += 1
-        line = _json.dumps({
-            "seq": self._journal_seq, "off": offset,
-            "data": base64.b64encode(data).decode()}) + "\n"
+        line = _json.dumps(dict(ev, seq=self._journal_seq)) + "\n"
         try:
             self.ioctx.exec_cls(
                 _journal_oid(self.name), "fence", "guarded_append",
@@ -312,19 +360,34 @@ class Image:
     def _journal_commit(self) -> None:
         """Data writes up to the current seq are durable: advance the
         committed watermark and trim (reference journal commit +
-        trim)."""
+        trim).  With mirroring enabled, trimming additionally waits
+        for the peer's committed position (reference: journal clients
+        gate trimming, journal/JournalTrimmer) — the journal IS the
+        replication stream, so entries the peer has not consumed are
+        retained."""
         import json as _json
         head = _json.dumps({"committed": self._journal_seq})
+        mirror = self.header.get("mirror") or {}
+        trim = True
+        if mirror.get("enabled"):
+            try:
+                peer = _json.loads(self.ioctx.read(
+                    _mirror_peer_oid(self.name)).decode())
+            except (RadosError, ValueError):
+                peer = {"committed": 0}
+            trim = peer.get("committed", 0) >= self._journal_seq
         try:
             self.ioctx.exec_cls(
                 _journal_head_oid(self.name), "fence",
                 "guarded_write_full",
                 _json.dumps({"epoch": self._lock_gen,
                              "data": head}).encode())
-            self.ioctx.exec_cls(
-                _journal_oid(self.name), "fence", "guarded_truncate",
-                _json.dumps({"epoch": self._lock_gen,
-                             "size": 0}).encode())
+            if trim:
+                self.ioctx.exec_cls(
+                    _journal_oid(self.name), "fence",
+                    "guarded_truncate",
+                    _json.dumps({"epoch": self._lock_gen,
+                                 "size": 0}).encode())
         except RadosError as e:
             if e.errno == 1:
                 self._lock_held = False
@@ -361,8 +424,11 @@ class Image:
             top = max(top, ev["seq"])
             if ev["seq"] <= committed:
                 continue
-            self._apply_write(ev["off"],
-                              base64.b64decode(ev["data"]))
+            if "resize" in ev:
+                self._apply_resize(ev["resize"])
+            else:
+                self._apply_write(ev["off"],
+                                  base64.b64decode(ev["data"]))
             replayed += 1
         self._journal_seq = top
         if replayed:
@@ -443,6 +509,162 @@ class Image:
         except RadosError:
             return False
 
+    # -- mirroring control (reference librbd/mirror/ +
+    # cls_rbd mirror_image state; the data path lives in
+    # rbd/mirror.py's MirrorDaemon) ------------------------------------
+    def mirror_enable(self, primary: bool = True) -> None:
+        """Mark the image for journal-based mirroring (reference
+        rbd mirror image enable, mode journal): requires the
+        journaling feature — the journal is the replication
+        stream."""
+        if not self.has_feature("journaling"):
+            raise RadosError(22, "mirroring needs the journaling "
+                             "feature")
+        self.header["mirror"] = {"enabled": True, "primary": primary}
+        self._save_header()
+
+    def mirror_disable(self) -> None:
+        self.header.pop("mirror", None)
+        self._save_header()
+        try:
+            self.ioctx.remove(_mirror_peer_oid(self.name))
+        except RadosError:
+            pass
+
+    def mirror_promote(self) -> None:
+        """Make this site's copy the writable primary (reference rbd
+        mirror image promote — failover step 2, after demoting or
+        losing the old primary)."""
+        m = self.header.get("mirror")
+        if not m or not m.get("enabled"):
+            raise RadosError(22, "mirroring not enabled")
+        m["primary"] = True
+        self._save_header()
+
+    def mirror_demote(self) -> None:
+        """Primary -> non-primary (failover step 1): further writes
+        here are refused until promoted again."""
+        m = self.header.get("mirror")
+        if not m or not m.get("enabled"):
+            raise RadosError(22, "mirroring not enabled")
+        m["primary"] = False
+        self._save_header()
+
+    def mirror_status(self) -> Dict:
+        m = dict(self.header.get("mirror") or {})
+        import json as _json
+        try:
+            m["peer_committed"] = _json.loads(self.ioctx.read(
+                _mirror_peer_oid(self.name)).decode()).get(
+                    "committed", 0)
+        except (RadosError, ValueError):
+            pass
+        m["journal_seq"] = self._journal_seq
+        return m
+
+    def _assert_writable(self) -> None:
+        m = self.header.get("mirror") or {}
+        if m.get("enabled") and not m.get("primary", True):
+            raise RadosError(30, f"image {self.name} is a "
+                             f"non-primary mirror (promote first)")
+
+    # -- object map (reference librbd/object_map/: 2-bit per-object
+    # state under the exclusive lock; dirty bits power fast-diff,
+    # existence bits power fast delete/du) -----------------------------
+    def _om_load(self, snap_id: Optional[int] = None) -> bytearray:
+        try:
+            return bytearray(self.ioctx.read(
+                _omap_oid(self.name, snap_id)))
+        except RadosError:
+            return bytearray()
+
+    def _om_save(self, om: bytearray,
+                 snap_id: Optional[int] = None) -> None:
+        self.ioctx.write_full(_omap_oid(self.name, snap_id),
+                              bytes(om))
+
+    @staticmethod
+    def _om_get(om: bytearray, objno: int) -> int:
+        byte = objno // 4
+        if byte >= len(om):
+            return OM_NONEXISTENT
+        return (om[byte] >> ((objno % 4) * 2)) & 3
+
+    @staticmethod
+    def _om_set(om: bytearray, objno: int, state: int) -> None:
+        byte = objno // 4
+        while len(om) <= byte:
+            om.append(0)
+        shift = (objno % 4) * 2
+        om[byte] = (om[byte] & ~(3 << shift)) | (state << shift)
+
+    def _om_mark(self, objnos, state: int) -> None:
+        """Batch state transition, one read-modify-write (single
+        writer: the exclusive lock the feature requires)."""
+        if not self.has_feature("object-map") \
+                or self.snap_name is not None:
+            return
+        om = self._om_load()
+        for objno in objnos:
+            self._om_set(om, objno, state)
+        self._om_save(om)
+
+    def rebuild_object_map(self) -> None:
+        """Re-derive the map from actual object existence (reference
+        object_map_rebuild): recovers from any drift; rebuilt objects
+        mark EXISTS (dirty) so the next fast-diff over-reports rather
+        than misses."""
+        om = bytearray()
+        hwm = max(self.header.get("hwm", 0), self.header["size"])
+        for objno in range(self._n_objs(hwm)):
+            try:
+                self.ioctx.stat(_data_oid(self.name, objno))
+                self._om_set(om, objno, OM_EXISTS)
+            except RadosError:
+                pass
+        self._om_save(om)
+
+    def fast_diff(self, from_snap: str,
+                  to_snap: Optional[str] = None) -> List[int]:
+        """Data objects possibly changed between two points in time
+        (reference fast-diff / DiffIterate with whole-object=true):
+        the union of every intermediate snapshot map's dirty bits
+        plus the endpoint's — each snap map's EXISTS bits mean
+        "written since the PREVIOUS snap", so the union covers the
+        whole interval; deletions show as existence flips."""
+        if not self.has_feature("object-map"):
+            raise RadosError(95, "fast-diff needs the object-map "
+                             "feature")
+        snaps = self.snap_list()                 # id-ascending
+        from_meta = self.header["snaps"].get(from_snap)
+        if from_meta is None:
+            raise RadosError(2, f"no snap {from_snap!r}")
+        if to_snap is not None and \
+                to_snap not in self.header["snaps"]:
+            raise RadosError(2, f"no snap {to_snap!r}")
+        maps = []
+        for s in snaps:
+            if s["id"] <= from_meta["id"]:
+                continue
+            if to_snap is not None and \
+                    s["id"] > self.header["snaps"][to_snap]["id"]:
+                break
+            maps.append(self._om_load(s["id"]))
+        if to_snap is None:
+            maps.append(self._om_load())         # head
+        from_map = self._om_load(from_meta["id"])
+        end_map = maps[-1] if maps else from_map
+        hwm = max(self.header.get("hwm", 0), self.header["size"])
+        changed = []
+        for objno in range(self._n_objs(hwm)):
+            dirty = any(self._om_get(m, objno) == OM_EXISTS
+                        for m in maps)
+            flipped = (self._om_get(from_map, objno) == 0) != \
+                (self._om_get(end_map, objno) == 0)
+            if dirty or flipped:
+                changed.append(objno)
+        return changed
+
     # -- IO ------------------------------------------------------------
     def read(self, offset: int, length: int) -> bytes:
         size = self.size()
@@ -465,6 +687,7 @@ class Image:
     def write(self, offset: int, data: bytes) -> None:
         if self.snap_name is not None:
             raise RadosError(30, "snapshot views are read-only")
+        self._assert_writable()
         size = self.header["size"]
         if offset + len(data) > size:
             raise RadosError(27, "write past image end")  # EFBIG
@@ -492,6 +715,7 @@ class Image:
     def _apply_write(self, offset: int, data: bytes) -> None:
         osize = self.object_size
         pos = offset
+        touched = []
         while pos < offset + len(data):
             objectno = pos // osize
             o_off = pos % osize
@@ -507,14 +731,34 @@ class Image:
             # the image's SnapContext and the object clones itself
             self.ioctx.write(oid, data[pos - offset:pos - offset
                                        + run], o_off)
+            touched.append(objectno)
             pos += run
+        # object map AFTER the data (journal replay re-marks across
+        # an apply crash, so the dirty bits stay exact — the create-
+        # time journaling requirement exists for exactly this)
+        self._om_mark(touched, OM_EXISTS)
 
     def resize(self, new_size: int) -> None:
         if self.snap_name is not None:
             raise RadosError(30, "snapshot views are read-only")
+        self._assert_writable()
         if self.has_feature("exclusive-lock") or \
                 self.has_feature("journaling"):
             self.acquire_lock()
+        if self.has_feature("journaling"):
+            # resize rides the journal like writes: replay restores
+            # it after a crash, and the mirror peer re-applies it at
+            # the OBJECT level (a header-only copy would leave the
+            # secondary's truncated objects behind and the sites
+            # would silently diverge on a shrink-then-grow)
+            self._journal_event({"resize": new_size})
+        self._apply_resize(new_size)
+        if self.has_feature("journaling"):
+            self._journal_uncommitted += 1
+            if self._journal_uncommitted >= self.JOURNAL_TRIM_EVERY:
+                self._journal_commit()
+
+    def _apply_resize(self, new_size: int) -> None:
         old = self.header["size"]
         self.header["size"] = new_size
         # high-water mark: whiteouts from clone shrinks can sit past
@@ -541,6 +785,9 @@ class Image:
                     pass
                 if parent is not None:
                     self.ioctx.write_full(oid, b"")   # whiteout
+            self._om_mark(range(first_gone, self._n_objs(old)),
+                          OM_EXISTS if parent is not None
+                          else OM_NONEXISTENT)
             if new_size % osize:
                 objectno = new_size // osize
                 oid = _data_oid(self.name, objectno)
@@ -559,11 +806,22 @@ class Image:
     # -- snapshots (reference librbd snap_create/rollback/remove on
     # selfmanaged snaps) ----------------------------------------------
     def snap_create(self, snap_name: str) -> None:
+        self._assert_writable()
         if snap_name in self.header["snaps"]:
             raise RadosError(17, f"snap {snap_name!r} exists")
         sid = self.ioctx.selfmanaged_snap_create()
         self.header["snaps"][snap_name] = {
             "id": sid, "size": self.header["size"]}
+        if self.has_feature("object-map"):
+            # freeze the map at the snap and reset the head's dirty
+            # bits: from here on EXISTS means "written since THIS
+            # snap" (reference snapshot object maps)
+            om = self._om_load()
+            self._om_save(om, sid)
+            for objno in range(len(om) * 4):
+                if self._om_get(om, objno) == OM_EXISTS:
+                    self._om_set(om, objno, OM_EXISTS_CLEAN)
+            self._om_save(om)
         self._save_header()
         self._apply_snap_state()
 
@@ -573,6 +831,7 @@ class Image:
                        key=lambda kv: kv[1]["id"])]
 
     def snap_rm(self, snap_name: str) -> None:
+        self._assert_writable()
         snap = self.header["snaps"].get(snap_name)
         if snap is None:
             raise RadosError(2, f"no snap {snap_name!r}")
@@ -582,6 +841,10 @@ class Image:
         del self.header["snaps"][snap_name]
         self._save_header()
         self._apply_snap_state()
+        try:
+            self.ioctx.remove(_omap_oid(self.name, snap["id"]))
+        except RadosError:
+            pass
         # release the id: the OSD snap trimmer reclaims the clones
         self.ioctx.selfmanaged_snap_remove(snap["id"])
 
@@ -589,6 +852,7 @@ class Image:
         """Roll every data object back to the snapshot through the
         OSD's rollback op (reference librbd snap_rollback ->
         rados selfmanaged_snap_rollback per object)."""
+        self._assert_writable()
         snap = self.header["snaps"].get(snap_name)
         if snap is None:
             raise RadosError(2, f"no snap {snap_name!r}")
@@ -601,15 +865,23 @@ class Image:
                     _data_oid(self.name, objectno), snap["id"])
             except RadosError:
                 pass
+        if self.has_feature("object-map"):
+            om = self._om_load(snap["id"])
+            for objno in range(len(om) * 4):
+                if self._om_get(om, objno) == OM_EXISTS_CLEAN:
+                    self._om_set(om, objno, OM_EXISTS)  # content moved
+            self._om_save(om)
         self._save_header()
 
     # -- clones --------------------------------------------------------
     def flatten(self) -> None:
         """Copy all parent-provided data in and sever the parent link
         (reference librbd flatten)."""
+        self._assert_writable()
         parent = self.header.get("parent")
         if parent is None:
             return
+        copied = []
         for objectno in range(self._n_objs()):
             if self._object_exists(objectno):
                 continue
@@ -617,6 +889,8 @@ class Image:
             if data:
                 self.ioctx.write_full(_data_oid(self.name, objectno),
                                       data)
+                copied.append(objectno)
+        self._om_mark(copied, OM_EXISTS)
         self.header["parent"] = None
         self._save_header()
 

@@ -171,7 +171,8 @@ def _opts() -> List[Option]:
                description="beacon-silent MDS is failed over after "
                            "this (reference mds_beacon_grace)"),
         Option("mgr_enabled_modules", str,
-               "prometheus restful balancer pg_autoscaler alerts",
+               "prometheus restful dashboard balancer pg_autoscaler "
+               "alerts",
                description="mgr modules to run (reference MgrMap "
                            "module list; edited by `ceph mgr module "
                            "enable/disable` through the central "
