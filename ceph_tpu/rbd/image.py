@@ -162,7 +162,8 @@ class RBD:
             raise RadosError(39, "image has snapshots")  # ENOTEMPTY
         img._remove_all_data()
         for oid in (_journal_oid(name), _journal_head_oid(name),
-                    _omap_oid(name)):
+                    _omap_oid(name), _mirror_peer_oid(name),
+                    _mirror_pos_oid(name)):
             try:
                 self.ioctx.remove(oid)
             except RadosError:

@@ -108,13 +108,28 @@ class MirrorDaemon:
             stats["bootstrapped"] = True
             return stats
         dst_img = Image(self.dst, name)
-        if (dst_img.header.get("mirror") or {}).get("primary"):
+        dm = dst_img.header.get("mirror") or {}
+        if not dm.get("enabled"):
+            # a same-name image that mirroring did NOT create: never
+            # overwrite it (reference: the replayer requires a
+            # mirror-registered peer image; anything else is an
+            # operator conflict to resolve)
+            return {"conflict": True}
+        if dm.get("primary"):
             # both sides primary: split brain — refuse to overwrite
             # (reference flags the pair split-brained and waits for
             # an operator resync)
             return {"split_brain": True}
+        stats["replayed"] = self._replay(name, dst_img, hdr)
+        return stats
+
+    def _replay(self, name: str, dst_img: Image, hdr: dict) -> int:
+        """Incremental replay of the master journal into dst_img
+        (shared by steady-state sync and promote's final catch-up so
+        the two can never diverge); -> events applied."""
         synced = self._synced_pos(name)
         top = synced
+        applied = 0
         for ev in sorted(self._journal_entries(name),
                          key=lambda e: e["seq"]):
             if ev["seq"] <= synced:
@@ -128,14 +143,14 @@ class MirrorDaemon:
                 dst_img._apply_write(ev["off"],
                                      base64.b64decode(ev["data"]))
             top = max(top, ev["seq"])
-            stats["replayed"] += 1
+            applied += 1
         if dst_img.header["size"] != hdr["size"]:
             # drift safety net (resize that predates mirroring or a
             # trimmed journal): correct at the object level too
             dst_img._apply_resize(hdr["size"])
         if top != synced:
             self._record_pos(name, top)
-        return stats
+        return applied
 
     def sync_once(self) -> Dict[str, Dict]:
         """One pass over every image at the primary site (the
@@ -165,28 +180,11 @@ class MirrorDaemon:
                                      # have (disaster failover)
         if hdr is not None and (hdr.get("mirror") or {}).get(
                 "enabled") and name in RBD(self.dst).list():
-            self._catch_up(name, hdr)
+            dst_img = Image(self.dst, name)
+            if (dst_img.header.get("mirror") or {}).get("enabled"):
+                self._replay(name, dst_img, hdr)
         img = Image(self.dst, name)
         img.mirror_promote()
-
-    def _catch_up(self, name: str, hdr: dict) -> None:
-        dst_img = Image(self.dst, name)
-        synced = self._synced_pos(name)
-        top = synced
-        for ev in sorted(self._journal_entries(name),
-                         key=lambda e: e["seq"]):
-            if ev["seq"] <= synced:
-                continue
-            if "resize" in ev:
-                dst_img._apply_resize(ev["resize"])
-            else:
-                dst_img._apply_write(ev["off"],
-                                     base64.b64decode(ev["data"]))
-            top = max(top, ev["seq"])
-        if dst_img.header["size"] != hdr["size"]:
-            dst_img._apply_resize(hdr["size"])
-        if top != synced:
-            self._record_pos(name, top)
 
     def demote_primary(self, name: str) -> None:
         """Demote the source copy (failover step 1)."""

@@ -51,6 +51,15 @@ def _data_soid(bucket: str, key: str) -> str:
     return f"rgw.data.{len(bucket)}.{bucket}.{key}"
 
 
+def _datalog_oid(bucket: str) -> str:
+    """Per-bucket change log feeding multisite incremental sync
+    (reference cls_rgw bucket index log + rgw_data_sync.cc's datalog):
+    omap rows keyed by a monotonic-enough timestamp, value = the
+    mutated key and op.  Trimmed by the sync agent once every peer
+    zone has consumed them."""
+    return f"rgw.datalog.{len(bucket)}.{bucket}"
+
+
 def _vkey(key: str, vid: str) -> str:
     """Bucket-index row for one VERSION of a key.  NUL separates key
     from version id (keys containing NUL are rejected at PUT), and
@@ -224,6 +233,7 @@ class MultipartMixin:
         if versioning != "off":
             rows[_vkey(key, vid)] = enc
         self.ioctx.omap_set(idx, rows)
+        self._datalog(bucket, key, "put")
         self._mp_cleanup(bucket, upload_id, rec)
         return final_etag
 
@@ -457,7 +467,22 @@ class RGWService(MultipartMixin):
             # a fresh version row
             rows[_vkey(key, vid)] = enc
         self.ioctx.omap_set(idx, rows)
+        self._datalog(bucket, key, "put")
         return entry
+
+    def _datalog(self, bucket: str, key: str, op: str) -> None:
+        """Append one change record (reference bucket index log).
+        The timestamp key keeps entries ordered; a random suffix
+        keeps concurrent writers from colliding — sync copies the
+        CURRENT state of each named key, so ordering within the same
+        instant is immaterial."""
+        import secrets as _secrets
+        row = f"{time.time_ns():020d}.{_secrets.token_hex(4)}"
+        try:
+            self.ioctx.omap_set(_datalog_oid(bucket), {
+                row: json.dumps({"key": key, "op": op}).encode()})
+        except RadosError:
+            pass                     # log loss degrades to full sync
 
     def _materialize_null_version(self, idx: str, bucket: str,
                                   key: str, rows: dict) -> None:
@@ -553,6 +578,7 @@ class RGWService(MultipartMixin):
             except RadosError:
                 pass
             self.ioctx.omap_rm_keys(idx, [key])
+            self._datalog(bucket, key, "del")
             return None
         # versioned (enabled or suspended): delete marker.  Suspended
         # buckets write it as the null version, removing any existing
@@ -574,6 +600,7 @@ class RGWService(MultipartMixin):
         rows[key] = enc
         rows[_vkey(key, vid)] = enc
         self.ioctx.omap_set(idx, rows)
+        self._datalog(bucket, key, "del")
         return marker
 
     def _delete_version(self, bucket: str, idx: str, key: str,
@@ -607,6 +634,10 @@ class RGWService(MultipartMixin):
             else:
                 rm.append(key)
         self.ioctx.omap_rm_keys(idx, rm)
+        # version deletes can change the key's CURRENT state
+        # (survivor promotion / key removal): the peer zone must
+        # re-converge it
+        self._datalog(bucket, key, "del")
         return entry
 
     def _version_rows(self, idx: str, key: str,
@@ -828,6 +859,7 @@ class RGWService(MultipartMixin):
                             self.ioctx.omap_rm_keys(
                                 idx, [row, _vkey(
                                     row, ent["version_id"])])
+                            self._datalog(bucket, row, "del")
                             stats["markers_removed"] += 1
         return stats
 

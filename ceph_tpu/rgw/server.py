@@ -69,10 +69,14 @@ class RGWServer:
     def __init__(self, ioctx, addr: Tuple[str, int] = ("127.0.0.1", 0),
                  auth_enabled: bool = False):
         from .auth import SigV4Verifier, UserStore
+        from .swift import SwiftAdapter
         self.service = RGWService(ioctx)
         self.users = UserStore(ioctx)
         self.verifier = SigV4Verifier(self.users)
         self.auth_enabled = auth_enabled
+        # the Swift dialect shares the gateway core (reference: one
+        # radosgw, two REST APIs over the same buckets)
+        self.swift = SwiftAdapter(self.service, self.users)
         svc = self.service
         gw = self
 
@@ -130,6 +134,8 @@ class RGWServer:
 
             # --------------------------------------------------- verbs
             def do_GET(self):          # noqa: N802
+                if gw.swift.maybe_handle(self, "GET"):
+                    return
                 bucket, key, q = self._split()
                 try:
                     ident = self._auth(b"")
@@ -273,6 +279,8 @@ class RGWServer:
                     f"</ListVersionsResult>").encode())
 
             def do_POST(self):         # noqa: N802
+                if gw.swift.maybe_handle(self, "POST"):
+                    return
                 bucket, key, q = self._split()
                 body = self._body()
                 try:
@@ -349,6 +357,8 @@ class RGWServer:
                 self._send(200, xml.encode())
 
             def do_HEAD(self):         # noqa: N802
+                if gw.swift.maybe_handle(self, "HEAD"):
+                    return
                 bucket, key, q = self._split()
                 try:
                     ident = self._auth(b"")
@@ -379,6 +389,8 @@ class RGWServer:
                     self.end_headers()
 
             def do_PUT(self):          # noqa: N802
+                if gw.swift.maybe_handle(self, "PUT"):
+                    return
                 bucket, key, q = self._split()
                 # always drain the body first: leaving it unread
                 # desyncs the keep-alive connection (the next request
@@ -459,6 +471,8 @@ class RGWServer:
                     self._error(e)
 
             def do_DELETE(self):       # noqa: N802
+                if gw.swift.maybe_handle(self, "DELETE"):
+                    return
                 bucket, key, q = self._split()
                 try:
                     ident = self._auth(b"")

@@ -811,3 +811,137 @@ def test_rgw_bucket_delete_and_config_are_owner_only(cl):
         assert req("DELETE", "/open", user=owner)[0] == 204
     finally:
         srv.shutdown()
+
+
+def test_swift_api_end_to_end(cl):
+    """The Swift dialect over the same gateway (VERDICT r4 Missing
+    #1, reference rgw_rest_swift.cc + TempAuth): auth handshake,
+    container/object CRUD, listings (plain + json), metadata
+    headers — against the SAME buckets the S3 API serves."""
+    import json as _json
+    import urllib.request
+    from urllib.error import HTTPError
+
+    from ceph_tpu.rgw.server import RGWServer
+    io = cl.rados().open_ioctx("clsp")
+    srv = RGWServer(io, auth_enabled=True)
+    srv.start()
+    try:
+        user = srv.users.create_user("swifty", "Swift User")
+        host, port = srv.addr
+        base = f"http://{host}:{port}"
+
+        def req(method, path, body=None, headers=None):
+            r = urllib.request.Request(
+                base + path, data=body, method=method,
+                headers=headers or {})
+            try:
+                resp = urllib.request.urlopen(r, timeout=5)
+                return resp.status, dict(resp.headers), resp.read()
+            except HTTPError as e:
+                return e.code, dict(e.headers), e.read()
+
+        # TempAuth: bad key refused, good key issues a token
+        st, _, _ = req("GET", "/auth/v1.0",
+                       headers={"X-Auth-User": "swifty",
+                                "X-Auth-Key": "wrong"})
+        assert st == 401
+        st, hdrs, _ = req("GET", "/auth/v1.0",
+                          headers={"X-Auth-User": "swifty",
+                                   "X-Auth-Key":
+                                       user["secret_key"]})
+        assert st == 204 and hdrs["X-Auth-Token"]
+        tok = {"X-Auth-Token": hdrs["X-Auth-Token"]}
+        sturl = hdrs["X-Storage-Url"]
+        acct_path = sturl[len(base):]
+
+        # container lifecycle + object IO with metadata
+        st, _, _ = req("PUT", f"{acct_path}/swc", headers=tok)
+        assert st == 201
+        st, _, _ = req("PUT", f"{acct_path}/swc", headers=tok)
+        assert st == 202                      # idempotent re-PUT
+        payload = os.urandom(9000)
+        st, hdrs, _ = req(
+            "PUT", f"{acct_path}/swc/hello.bin", body=payload,
+            headers=dict(tok, **{"Content-Type": "application/x-t",
+                                 "X-Object-Meta-Color": "teal"}))
+        assert st == 201 and hdrs["ETag"]
+        st, hdrs, body = req("GET", f"{acct_path}/swc/hello.bin",
+                             headers=tok)
+        assert st == 200 and body == payload
+        assert hdrs["X-Object-Meta-Color"] == "teal"
+        assert hdrs["Content-Type"] == "application/x-t"
+        st, hdrs, _ = req("HEAD", f"{acct_path}/swc/hello.bin",
+                          headers=tok)
+        assert st == 200 and int(hdrs["Content-Length"]) == 9000
+
+        # listings: account + container, plain and json
+        st, _, body = req("GET", acct_path, headers=tok)
+        assert st == 200 and b"swc" in body
+        st, _, body = req("GET", f"{acct_path}/swc?format=json",
+                          headers=tok)
+        rows = _json.loads(body)
+        assert rows[0]["name"] == "hello.bin"
+        assert rows[0]["bytes"] == 9000
+        st, hdrs, _ = req("HEAD", f"{acct_path}/swc", headers=tok)
+        assert hdrs["X-Container-Object-Count"] == "1"
+        assert hdrs["X-Container-Bytes-Used"] == "9000"
+
+        # the S3 dialect sees the same object (one gateway, two APIs)
+        assert srv.service.get_object("swc", "hello.bin")[1] \
+            == payload
+
+        # token required; deletes; empty-container delete succeeds
+        st, _, _ = req("GET", acct_path)
+        assert st == 401
+        st, _, _ = req("DELETE", f"{acct_path}/swc", headers=tok)
+        assert st == 409                      # not empty
+        st, _, _ = req("DELETE", f"{acct_path}/swc/hello.bin",
+                       headers=tok)
+        assert st == 204
+        st, _, _ = req("DELETE", f"{acct_path}/swc", headers=tok)
+        assert st == 204
+    finally:
+        srv.shutdown()
+
+
+def test_multisite_zone_sync(cl):
+    """Zone-to-zone sync (VERDICT r4 Missing #1, reference
+    rgw_data_sync.cc): full sync on first contact, datalog-driven
+    incremental afterwards (puts, overwrites, deletes), bucket
+    config convergence, and datalog trim."""
+    from ceph_tpu.rgw.gateway import _datalog_oid
+    from ceph_tpu.rgw.multisite import ZoneSyncAgent
+    cl.create_pool("zoneb", "replicated", size=2)
+    master = RGWService(cl.rados().open_ioctx("clsp"))
+    local = RGWService(cl.rados().open_ioctx("zoneb"))
+    master.create_bucket("msb", owner="alice", acl="public-read")
+    d1 = os.urandom(50_000)
+    master.put_object("msb", "a/one.bin", d1, meta={"k": "v"})
+    master.put_object("msb", "two.txt", b"hello zone",
+                      content_type="text/plain")
+
+    agent = ZoneSyncAgent(master, local)
+    out = agent.sync_once()
+    assert out["msb"]["full"] and out["msb"]["copied"] == 2
+    head, data = local.get_object("msb", "a/one.bin")
+    assert data == d1 and head["meta"] == {"k": "v"}
+    assert local._bucket_meta("msb")["acl"] == "public-read"
+
+    # incremental: overwrite + new object + delete
+    d2 = os.urandom(20_000)
+    master.put_object("msb", "a/one.bin", d2)
+    master.put_object("msb", "three.bin", b"3")
+    master.delete_object("msb", "two.txt")
+    out = agent.sync_once()
+    assert not out["msb"]["full"]
+    assert out["msb"]["copied"] == 2 and out["msb"]["deleted"] == 1
+    assert local.get_object("msb", "a/one.bin")[1] == d2
+    assert local.get_object("msb", "three.bin")[1] == b"3"
+    with pytest.raises(RGWError):
+        local.head_object("msb", "two.txt")
+    # consumed datalog rows trimmed at the master
+    assert master.ioctx.omap_get(_datalog_oid("msb")) == {}
+    # idempotent re-run: nothing to do
+    out = agent.sync_once()
+    assert out["msb"] == {"copied": 0, "deleted": 0, "full": False}
