@@ -698,7 +698,13 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         assert all(comp.wait(60 * f) == 0 for comp in comps)
         write_s = time.perf_counter() - t0
         stats = {"calls": 0, "reqs": 0, "coalesced": 0, "cpu": 0,
-                 "cpu_calls": 0}
+                 "cpu_calls": 0, "write_wall_s": write_s}
+        # per-stage attribution: the batcher's cumulative stage
+        # clocks (queue-wait through d2h) plus the commit leg from
+        # each primary's op-tracker timeline (ec:encoded ->
+        # op_commit).  Op-seconds, not wall — concurrent ops overlap
+        stages = {"queue_wait": 0.0, "batch_form": 0.0, "h2d": 0.0,
+                  "device": 0.0, "d2h": 0.0, "commit": 0.0}
         for osd in c.osds.values():
             b = getattr(osd, "encode_batcher", None)
             if b is not None:
@@ -707,6 +713,20 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                 stats["coalesced"] += b.reqs_coalesced
                 stats["cpu"] += b.cpu_reqs
                 stats["cpu_calls"] += b.cpu_calls
+                for s in ("queue_wait", "batch_form", "h2d",
+                          "device", "d2h"):
+                    stages[s] += getattr(b, "stage_seconds",
+                                         {}).get(s, 0.0)
+            trk = getattr(osd, "op_tracker", None)
+            if trk is not None:
+                for opd in trk.dump_historic_ops():
+                    ev = {e["event"]: e["time"]
+                          for e in opd["events"]}
+                    t_enc = ev.get("ec:encoded")
+                    t_com = ev.get("op_commit", ev.get("done"))
+                    if t_enc is not None and t_com is not None:
+                        stages["commit"] += max(0.0, t_com - t_enc)
+        stats["stages"] = stages
         c.wait_for_clean(30)
         victim = n_osds - 1
         c.kill_osd(victim, lose_data=True)
@@ -749,6 +769,25 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
          f"coalesced, {st['cpu']} routed to cpu twin; "
          f"baseline=plugin-jerasure per-stripe inline encode "
          f"{w_cpu:.1f} MB/s)", w_tpu, "MB/s", w_tpu / w_cpu)
+    att = st.get("stages") or {}
+    opsec = sum(att.values())
+    wall = st.get("write_wall_s", 0.0)
+    if opsec > 0 and wall > 0:
+        # wall seconds split proportionally to measured op-seconds
+        # (ops overlap, so raw op-seconds exceed wall; the split
+        # keeps each stage's relative weight and sums to wall)
+        scaled = {s: round(wall * v / opsec, 4)
+                  for s, v in att.items()}
+        print(json.dumps({
+            "metric": "cluster k8m4 write per-stage time attribution"
+                      " (wall split over queue_wait/batch_form/h2d/"
+                      "device/d2h/commit by tracker+batcher "
+                      "op-seconds, raw in op_seconds)",
+            "value": round(wall, 3), "unit": "s",
+            "vs_baseline": round(sum(scaled.values()) / wall, 3),
+            "stages": scaled,
+            "op_seconds": {s: round(v, 4) for s, v in att.items()},
+        }), flush=True)
     emit(f"OSD rebuild MB/s (k=8 m=4 pool, kill osd with data loss; "
          f"recovery decodes batched through the OSD coalescer: "
          f"{st['dec_reqs']} decode reqs -> {st['dec_calls']} batched "

@@ -87,6 +87,7 @@ class _InflightOp:
         self.target_osd: Optional[int] = None
         self.sent_epoch = 0
         self.trace_id = 0
+        self.parent_span_id = 0        # client root span id
         self.snapc: Tuple[int, List[int]] = (0, [])  # write SnapContext
         self.snapid = 0                # read snap (0 = head)
 
@@ -155,7 +156,8 @@ class Objecter(Dispatcher):
                bypass_tier: bool = False,
                trace_id: int = 0,
                snapc: Tuple[int, List[int]] = (0, []),
-               snapid: int = 0) -> Completion:
+               snapid: int = 0,
+               parent_span_id: int = 0) -> Completion:
         from ..osd.pg import WRITE_OPS
         is_write = any(o.op in WRITE_OPS for o in ops)
         nbytes = sum(len(o.data) for o in ops if o.data)
@@ -174,6 +176,7 @@ class Objecter(Dispatcher):
             op.is_write = is_write
             op.bypass_tier = bypass_tier
             op.trace_id = trace_id
+            op.parent_span_id = parent_span_id
             op.snapc = snapc
             op.snapid = snapid
             self.inflight[tid] = op
@@ -236,7 +239,7 @@ class Objecter(Dispatcher):
             pool=self._route_pool(osdmap, op), oid=op.oid, ops=op.ops,
             pgid_seed=pgid.seed, trace_id=op.trace_id,
             snap_seq=op.snapc[0], snaps=list(op.snapc[1]),
-            snapid=op.snapid))
+            snapid=op.snapid, parent_span_id=op.parent_span_id))
 
     def cancel(self, tid: int) -> None:
         """Drop a timed-out/abandoned op from the window (reference
@@ -422,6 +425,7 @@ class IoCtx:
         c = self.rados.objecter.submit(
             self.pool_id, oid, ops,
             trace_id=span.trace_id if span else 0,
+            parent_span_id=span.span_id if span else 0,
             snapc=self._write_snapc() if is_write else (0, []),
             snapid=0 if (is_write or head_pinned)
             else self._read_snap,

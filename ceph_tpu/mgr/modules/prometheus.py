@@ -3,12 +3,45 @@
 Reference analog: ``src/pybind/mgr/prometheus/module.py`` — every
 aggregated perf counter plus cluster gauges in the Prometheus text
 format, served through the mgr's HTTP frontend.
+
+Histogram counter sets (PerfCounters.add_histogram — e.g. the OSD
+``ec_batcher`` subsystem's queue_wait_us / batch_stripes /
+dispatch_ms) render in the native Prometheus histogram convention:
+cumulative ``_bucket{le=...}`` samples ending at ``le="+Inf"`` plus a
+``_count``, all contiguous under one ``# TYPE ... histogram`` line,
+and derived p50/p95/p99 gauges interpolated from the raw buckets
+(same math as PromQL histogram_quantile).
 """
 from __future__ import annotations
 
 from typing import Dict, List, Tuple
 
 from . import MgrModule
+
+_QUANTILES = ((0.50, "p50"), (0.95, "p95"), (0.99, "p99"))
+
+
+def _histogram_percentile(bounds: List[float], buckets: List[int],
+                          q: float) -> float:
+    """The q-quantile of a (bounds, buckets) histogram as dumped by
+    PerfCounters (len(buckets) == len(bounds) + 1; the last bucket is
+    the overflow).  Linear interpolation inside the landing bucket,
+    clamped to the last finite bound for the overflow bucket —
+    exactly PromQL's histogram_quantile."""
+    total = sum(buckets)
+    if total <= 0 or not bounds:
+        return 0.0
+    target = q * total
+    cum = 0.0
+    for i, count in enumerate(buckets):
+        if cum + count >= target and count > 0:
+            if i >= len(bounds):        # overflow bucket
+                return float(bounds[-1])
+            lo = float(bounds[i - 1]) if i > 0 else 0.0
+            hi = float(bounds[i])
+            return lo + (hi - lo) * (target - cum) / count
+        cum += count
+    return float(bounds[-1])
 
 
 def render(osdmap, perf: Dict[str, dict]) -> str:
@@ -26,11 +59,25 @@ def render(osdmap, perf: Dict[str, dict]) -> str:
     # metric-major grouping: the exposition format requires all
     # samples of one family to be contiguous under its # TYPE line
     families: Dict[str, List[Tuple[str, float]]] = {}
+    ftypes: Dict[str, str] = {}
+    hists: Dict[str, List[Tuple[str, List[float], List[int]]]] = {}
     for daemon in sorted(perf):
         for subsys, counters in perf[daemon].items():
             for cname, val in counters.items():
                 metric = f"ceph_{subsys}_{cname}"
-                if isinstance(val, dict):          # timeavg
+                if isinstance(val, dict) and "buckets" in val:
+                    bounds = list(val.get("bounds", []))
+                    buckets = list(val["buckets"])
+                    hists.setdefault(metric, []).append(
+                        (daemon, bounds, buckets))
+                    for q, sfx in _QUANTILES:
+                        pm = f"{metric}_{sfx}"
+                        ftypes[pm] = "gauge"
+                        families.setdefault(pm, []).append(
+                            (daemon,
+                             _histogram_percentile(bounds, buckets,
+                                                   q)))
+                elif isinstance(val, dict):        # timeavg
                     for part, sfx in (("sum", "total"),
                                       ("avgcount", "count")):
                         if part in val:
@@ -41,9 +88,24 @@ def render(osdmap, perf: Dict[str, dict]) -> str:
                     families.setdefault(metric, []).append(
                         (daemon, val))
     for metric in sorted(families):
-        lines.append(f"# TYPE {metric} counter")
+        lines.append(f"# TYPE {metric} {ftypes.get(metric, 'counter')}")
         for daemon, val in families[metric]:
             lines.append(f'{metric}{{daemon="{daemon}"}} {val}')
+    for metric in sorted(hists):
+        lines.append(f"# TYPE {metric} histogram")
+        for daemon, bounds, buckets in hists[metric]:
+            cum = 0
+            for bound, count in zip(bounds, buckets):
+                cum += count
+                lines.append(
+                    f'{metric}_bucket{{daemon="{daemon}",'
+                    f'le="{bound}"}} {cum}')
+            cum += buckets[len(bounds)] if len(buckets) > len(bounds) \
+                else 0
+            lines.append(
+                f'{metric}_bucket{{daemon="{daemon}",'
+                f'le="+Inf"}} {cum}')
+            lines.append(f'{metric}_count{{daemon="{daemon}"}} {cum}')
     return "\n".join(lines) + "\n"
 
 

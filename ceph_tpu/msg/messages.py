@@ -98,7 +98,8 @@ class MOSDOp(Message):
                  ops: Optional[List[OSDOp]] = None,
                  pgid_seed: int = 0, flags: int = 0,
                  trace_id: int = 0, snap_seq: int = 0,
-                 snaps: Optional[List[int]] = None, snapid: int = 0):
+                 snaps: Optional[List[int]] = None, snapid: int = 0,
+                 parent_span_id: int = 0):
         super().__init__()
         self.client = client
         self.tid = tid
@@ -109,6 +110,7 @@ class MOSDOp(Message):
         self.pgid_seed = pgid_seed
         self.flags = flags
         self.trace_id = trace_id     # blkin-style trace context (0=off)
+        self.parent_span_id = parent_span_id   # client root span
         # write SnapContext (reference MOSDOp snapc) + read snap
         self.snap_seq = snap_seq
         self.snaps = snaps or []
@@ -123,6 +125,7 @@ class MOSDOp(Message):
         e.u32(len(self.ops))
         for op in self.ops:
             op.encode(e)
+        e.u64(self.parent_span_id)
         return e.build()
 
     @classmethod
@@ -135,6 +138,7 @@ class MOSDOp(Message):
         m.snaps = [int(x) for x in d.i64_list()]
         m.snapid = d.u64()
         m.ops = [OSDOp.decode(d) for _ in range(d.u32())]
+        m.parent_span_id = d.u64()
         return m
 
 
@@ -184,7 +188,7 @@ class MOSDECSubOpWrite(Message):
                  from_osd: int = -1, tid: int = 0, epoch: int = 0,
                  txn: bytes = b"", log_entries: Optional[list] = None,
                  at_version: Tuple[int, int] = (0, 0),
-                 trace_id: int = 0):
+                 trace_id: int = 0, parent_span_id: int = 0):
         super().__init__()
         self.pgid = pgid             # str(PGid), shard-free
         self.shard = shard           # destination shard position
@@ -195,6 +199,7 @@ class MOSDECSubOpWrite(Message):
         self.log_entries = log_entries or []   # pg-log dicts
         self.at_version = at_version
         self.trace_id = trace_id     # blkin-style trace context
+        self.parent_span_id = parent_span_id   # primary's osd_op span
 
     def encode_payload(self) -> bytes:
         e = Encoder()
@@ -203,6 +208,7 @@ class MOSDECSubOpWrite(Message):
         e.bytes(_enc_json(self.log_entries))
         e.u32(self.at_version[0]).u64(self.at_version[1])
         e.u64(self.trace_id)
+        e.u64(self.parent_span_id)
         return e.build()
 
     @classmethod
@@ -213,6 +219,7 @@ class MOSDECSubOpWrite(Message):
         m.log_entries = _dec_json(d.bytes())
         m.at_version = (d.u32(), d.u64())
         m.trace_id = d.u64()
+        m.parent_span_id = d.u64()
         return m
 
 
@@ -257,7 +264,8 @@ class MOSDECSubOpRead(Message):
                  from_osd: int = -1, tid: int = 0, epoch: int = 0,
                  reads: Optional[List[Tuple[str, int, int]]] = None,
                  attrs_to_read: Optional[List[str]] = None,
-                 for_recovery: bool = False):
+                 for_recovery: bool = False, trace_id: int = 0,
+                 parent_span_id: int = 0):
         super().__init__()
         self.pgid = pgid
         self.shard = shard
@@ -267,6 +275,8 @@ class MOSDECSubOpRead(Message):
         self.reads = reads or []     # (oid, offset, length)
         self.attrs_to_read = attrs_to_read or []
         self.for_recovery = for_recovery
+        self.trace_id = trace_id     # blkin-style trace context
+        self.parent_span_id = parent_span_id
 
     def encode_payload(self) -> bytes:
         e = Encoder()
@@ -277,6 +287,7 @@ class MOSDECSubOpRead(Message):
             e.str(oid).u64(off).i64(length)
         e.str_list(self.attrs_to_read)
         e.bool(self.for_recovery)
+        e.u64(self.trace_id).u64(self.parent_span_id)
         return e.build()
 
     @classmethod
@@ -287,6 +298,8 @@ class MOSDECSubOpRead(Message):
         m.reads = [(d.str(), d.u64(), d.i64()) for _ in range(d.u32())]
         m.attrs_to_read = d.str_list()
         m.for_recovery = d.bool()
+        m.trace_id = d.u64()
+        m.parent_span_id = d.u64()
         return m
 
 
@@ -348,7 +361,7 @@ class MOSDRepOp(Message):
                  epoch: int = 0, txn: bytes = b"",
                  log_entries: Optional[list] = None,
                  at_version: Tuple[int, int] = (0, 0),
-                 trace_id: int = 0):
+                 trace_id: int = 0, parent_span_id: int = 0):
         super().__init__()
         self.pgid = pgid
         self.from_osd = from_osd
@@ -358,6 +371,7 @@ class MOSDRepOp(Message):
         self.log_entries = log_entries or []
         self.at_version = at_version
         self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
 
     def encode_payload(self) -> bytes:
         e = Encoder()
@@ -366,6 +380,7 @@ class MOSDRepOp(Message):
         e.bytes(_enc_json(self.log_entries))
         e.u32(self.at_version[0]).u64(self.at_version[1])
         e.u64(self.trace_id)
+        e.u64(self.parent_span_id)
         return e.build()
 
     @classmethod
@@ -376,6 +391,7 @@ class MOSDRepOp(Message):
         m.log_entries = _dec_json(d.bytes())
         m.at_version = (d.u32(), d.u64())
         m.trace_id = d.u64()
+        m.parent_span_id = d.u64()
         return m
 
 

@@ -52,6 +52,8 @@ class Mutation:
     omap_rm: List[str] = field(default_factory=list)
     omap_clear: bool = False
     trace_id: int = 0               # blkin-style trace context (0=off)
+    parent_span_id: int = 0         # primary's osd_op span (0=none)
+    tracked_op: Optional[object] = None   # OpTracker TrackedOp handle
     # -- snapshot machinery (reference make_writeable, osd/snaps.py) --
     clone_to: Optional[str] = None  # COW the head to this oid FIRST
     clone_attrs: Dict[str, bytes] = field(default_factory=dict)

@@ -46,12 +46,14 @@ from . import ecutil
 
 class _Req:
     def __init__(self, ec_impl, sinfo: ecutil.StripeInfo, data: bytes,
-                 cb: Callable[[Dict[int, bytes]], None]):
+                 cb: Callable[[Dict[int, bytes]], None], tracked=None):
         self.ec_impl = ec_impl
         self.sinfo = sinfo
         self.data = data
         self.cb = cb
         self.nstripes = len(data) // sinfo.stripe_width
+        self.tracked = tracked       # OpTracker handle (stage events)
+        self.t_enq = time.monotonic()
 
 
 class _DecReq:
@@ -134,8 +136,9 @@ class EncodeBatcher:
     _cpu_bps: Dict[Tuple, float] = {}        # per geometry, shared
     _min_device_bytes: float = 0.0           # learned crossover, shared
     _warmed: set = set()                     # geometries prewarmed
+    _h2d_bps: float = 0.0                    # measured link rate, shared
 
-    def __init__(self, conf=None, perf=None):
+    def __init__(self, conf=None, perf=None, perf_coll=None):
         def get(k, d):
             if conf is None:
                 return d
@@ -157,6 +160,48 @@ class EncodeBatcher:
         self.prewarm_enabled = get("osd_ec_prewarm", True)
         self.cpu_reqs = 0                        # routed to CPU twin
         self.perf = perf
+        # dedicated "ec_batcher" counter subsystem: per-stage
+        # histograms + routing/transfer/compile counters, dumped via
+        # the admin socket's perf dump and scraped by mgr prometheus
+        self.bperf = None
+        if perf_coll is not None:
+            bp = perf_coll.create("ec_batcher")
+            if "queue_wait_us" not in bp._types:
+                bp.add_histogram(
+                    "queue_wait_us",
+                    [50, 100, 200, 500, 1000, 2000, 5000, 20000,
+                     100000],
+                    "per-request wait from submit to dispatch (us)")
+                bp.add_histogram(
+                    "batch_stripes",
+                    [1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024],
+                    "stripes per batched device/twin call")
+                bp.add_histogram(
+                    "dispatch_ms",
+                    [0.5, 1, 2, 5, 10, 25, 50, 100, 250, 1000],
+                    "fenced dispatch-to-parity latency (ms)")
+                bp.add("h2d_bytes",
+                       description="data bytes staged to the device")
+                bp.add("d2h_bytes",
+                       description="parity bytes fetched back")
+                bp.add("device_reqs",
+                       description="encode requests routed to device")
+                bp.add("cpu_reqs",
+                       description="encode requests routed to twin")
+                bp.add("coalesced_reqs",
+                       description="requests that shared a call")
+                bp.add("compile_count",
+                       description="JIT compiles paid (prewarm)")
+                bp.add_time_avg("compile_seconds",
+                                "seconds per JIT compile")
+            self.bperf = bp
+        # cumulative per-stage attribution (seconds of request time
+        # spent in each pipeline stage; consumed by bench.py's
+        # time-attribution line).  Collector-thread writes only.
+        self.stage_seconds = {"queue_wait": 0.0, "batch_form": 0.0,
+                              "h2d": 0.0, "device": 0.0, "d2h": 0.0}
+        self.compile_count = 0
+        self.compile_seconds = 0.0
         self._cond = threading.Condition()
         self._queues: Dict[Tuple, List] = {}
         self._pending_stripes = 0
@@ -179,15 +224,18 @@ class EncodeBatcher:
 
     # -- producer side ---------------------------------------------------
     def submit(self, ec_impl, sinfo: ecutil.StripeInfo, data: bytes,
-               cb: Callable[[Dict[int, bytes]], None]) -> None:
+               cb: Callable[[Dict[int, bytes]], None],
+               tracked=None) -> None:
         """Queue one aligned extent for encoding; ``cb`` receives the
         full {shard: bytes} chunk map (data + parity) later, from the
-        collector thread.  Codecs without the batched async API don't
-        benefit from coalescing — they encode inline."""
+        collector thread.  ``tracked`` is an optional OpTracker handle
+        that receives batcher stage events.  Codecs without the
+        batched async API don't benefit from coalescing — they encode
+        inline."""
         if self._stop or not hasattr(ec_impl, "encode_batch_async"):
             cb(ecutil.encode(sinfo, ec_impl, data))
             return
-        req = _Req(ec_impl, sinfo, data, cb)
+        req = _Req(ec_impl, sinfo, data, cb, tracked)
         if req.nstripes == 0:
             cb({i: b"" for i in range(ec_impl.get_chunk_count())})
             return
@@ -294,7 +342,25 @@ class EncodeBatcher:
                         return
                     z = np.zeros((nb, k, sinfo.chunk_size),
                                  dtype=np.uint8)
+                    if EncodeBatcher._h2d_bps <= 0:
+                        # measure the link once per process: feeds
+                        # the h2d/device/d2h split of the fenced
+                        # dispatch window (stage_seconds)
+                        try:
+                            t0 = time.monotonic()
+                            jax.block_until_ready(jax.device_put(z))
+                            EncodeBatcher._h2d_bps = z.nbytes / max(
+                                time.monotonic() - t0, 1e-9)
+                        except Exception:
+                            pass
+                    t0 = time.monotonic()
                     ec_impl.encode_batch_async(z).wait()  # compile
+                    dt = time.monotonic() - t0
+                    self.compile_count += 1
+                    self.compile_seconds += dt
+                    if self.bperf is not None:
+                        self.bperf.inc("compile_count")
+                        self.bperf.tinc("compile_seconds", dt)
                     # SEED the crossover from a second, POST-compile
                     # call (timing the first would fold seconds of
                     # jit into the estimate and misroute a healthy
@@ -428,6 +494,11 @@ class EncodeBatcher:
         """Coalesced device-free encode: the whole group's stripes go
         through ONE batched kernel call on the _BatchTwin (native C++
         when available) — the coalescing win survives CPU routing."""
+        t_form = time.monotonic()
+        self._account_queue_wait(reqs, t_form)
+        for r in reqs:
+            if r.tracked is not None:
+                r.tracked.mark_event("ec:batch_dispatched")
         chunks_list: Optional[List] = None
         try:
             sinfo = reqs[0].sinfo
@@ -440,6 +511,14 @@ class EncodeBatcher:
                 if len(arrs) > 1 else arrs[0]
             parity = twin.encode_batch(batch)
             self.cpu_calls += 1
+            # twin encode is pure compute: no transfer legs
+            self.stage_seconds["device"] += \
+                time.monotonic() - t_form
+            if self.bperf is not None:
+                self.bperf.hinc("batch_stripes", batch.shape[0])
+                self.bperf.inc("cpu_reqs", len(reqs))
+                if len(reqs) > 1:
+                    self.bperf.inc("coalesced_reqs", len(reqs))
             if len(reqs) > 1:
                 self.reqs_coalesced += len(reqs)
                 if self.perf is not None:
@@ -648,6 +727,8 @@ class EncodeBatcher:
         shards (dp x sp) over the mesh (parallel/mesh.py
         ShardedEncoder via the tpu plugin) so this production path
         rides every local chip, not just chip 0."""
+        t_form = time.monotonic()
+        self._account_queue_wait(reqs, t_form)
         try:
             sinfo = reqs[0].sinfo
             k = reqs[0].ec_impl.get_data_chunk_count()
@@ -665,9 +746,25 @@ class EncodeBatcher:
             handles = [
                 reqs[0].ec_impl.encode_batch_async(batch[i:i + tile])
                 for i in range(0, batch.shape[0], tile)]
-            return (arrs, handles, time.monotonic())
+            t_disp = time.monotonic()
+            self.stage_seconds["batch_form"] += t_disp - t_form
+            if self.bperf is not None:
+                self.bperf.hinc("batch_stripes", batch.shape[0])
+                self.bperf.inc("h2d_bytes", batch.nbytes)
+            for r in reqs:
+                if r.tracked is not None:
+                    r.tracked.mark_event("ec:batch_dispatched")
+            return (arrs, handles, t_disp)
         except Exception:
             return None
+
+    def _account_queue_wait(self, reqs: List[_Req],
+                            now: float) -> None:
+        for r in reqs:
+            w = max(0.0, now - r.t_enq)
+            self.stage_seconds["queue_wait"] += w
+            if self.bperf is not None:
+                self.bperf.hinc("queue_wait_us", w * 1e6)
 
     def _complete_group(self, reqs: List[_Req], handle,
                         learn: bool = True,
@@ -715,6 +812,27 @@ class EncodeBatcher:
             self.perf.inc("ec_batch_stripes", nstripes)
             if len(reqs) > 1:
                 self.perf.inc("ec_batch_coalesced", len(reqs))
+        if dev_time is not None:
+            # split the fenced device window into transfer vs compute
+            # using the link rate prewarm measured; without a
+            # measurement the whole window is charged to "device"
+            in_bytes = sum(len(r.data) for r in reqs)
+            out_bytes = parity.nbytes
+            h2d_s = d2h_s = 0.0
+            if self._h2d_bps > 0:
+                h2d_s = min(dev_time, in_bytes / self._h2d_bps)
+                d2h_s = min(dev_time - h2d_s,
+                            out_bytes / self._h2d_bps)
+            self.stage_seconds["h2d"] += h2d_s
+            self.stage_seconds["d2h"] += d2h_s
+            self.stage_seconds["device"] += max(
+                0.0, dev_time - h2d_s - d2h_s)
+            if self.bperf is not None:
+                self.bperf.hinc("dispatch_ms", dev_time * 1e3)
+                self.bperf.inc("d2h_bytes", out_bytes)
+                self.bperf.inc("device_reqs", len(reqs))
+                if len(reqs) > 1:
+                    self.bperf.inc("coalesced_reqs", len(reqs))
         off = 0
         for r, arr in zip(reqs, arrs):
             p = parity[off:off + r.nstripes]

@@ -361,10 +361,13 @@ class ECBackend(PGBackend):
             self._reads_to_commit(op)
             return
         op.to_read = (astart, existing_end - astart)
+        if mut.tracked_op is not None:
+            mut.tracked_op.mark_event("ec:rmw_read")
         self.objects_read(
             op.oid, astart, min(existing_end, op.committed_size)
             - astart,
-            lambda res, data: self._rmw_read_done(op, res, data))
+            lambda res, data: self._rmw_read_done(op, res, data),
+            trace=(mut.trace_id, mut.parent_span_id))
 
     @staticmethod
     def _fully_covers(writes: List[Tuple[int, bytes]], lo: int,
@@ -425,13 +428,20 @@ class ECBackend(PGBackend):
         batcher = getattr(self.host, "encode_batcher", None)
         if batcher is not None and \
                 hasattr(self.ec_impl, "encode_batch_async"):
+            if mut.tracked_op is not None:
+                mut.tracked_op.mark_event("ec:encode_queued")
             batcher.submit(
                 self.ec_impl, self.sinfo, bytes(buf),
                 lambda chunks: self._encode_done(op, astart, hi,
-                                                 chunks))
+                                                 chunks),
+                tracked=mut.tracked_op)
         else:
+            if mut.tracked_op is not None:
+                mut.tracked_op.mark_event("ec:encode_queued")
             chunks = ecutil.encode(self.sinfo, self.ec_impl,
                                    bytes(buf))
+            if mut.tracked_op is not None:
+                mut.tracked_op.mark_event("ec:encoded")
             self._encoded_to_commit(op, astart, hi, chunks)
 
     def _encode_done(self, op: _WriteOp, astart: int, hi: int,
@@ -446,6 +456,8 @@ class ECBackend(PGBackend):
         with lock:
             if not op.alive:
                 return               # on_change() cleared the pipeline
+            if op.mutation.tracked_op is not None:
+                op.mutation.tracked_op.mark_event("ec:encoded")
             if chunks is None:       # encode failed even on CPU: EIO
                 self._fail_op(op, -5)
                 return
@@ -506,6 +518,9 @@ class ECBackend(PGBackend):
                    self.host.acting_shards() if osd is not None]
         op.pending_commits = {shard for shard, _ in targets}
         self.waiting_commit[op.tid] = op
+        tracked = op.mutation.tracked_op
+        if tracked is not None:
+            tracked.mark_event("ec:sub_write_sent")
         local_txn: Optional[Transaction] = None
         for shard, osd in targets:
             txn = shard_txns.get(shard) or Transaction()
@@ -518,10 +533,18 @@ class ECBackend(PGBackend):
                 epoch=self.host.epoch, txn=txn.encode(),
                 log_entries=wire_entries,
                 at_version=op.at_version,
-                trace_id=op.mutation.trace_id))
+                trace_id=op.mutation.trace_id,
+                parent_span_id=op.mutation.parent_span_id))
         if local_txn is not None:
             # the primary's own shard goes through the same sub-write
-            # handler, local call (reference ECBackend.cc:2086-2092)
+            # handler, local call (reference ECBackend.cc:2086-2092);
+            # it bypasses handle_message, so its child span is cut here
+            span = self.host.trace_span(
+                "ec_sub_write", op.mutation.trace_id,
+                op.mutation.parent_span_id)
+            if span is not None:
+                span.tag("shard", self.host.own_shard).tag(
+                    "pgid", self.host.pgid_str).finish()
             tid = op.tid
             self._apply_sub_write(
                 self.host.own_shard, local_txn, wire_entries,
@@ -675,6 +698,9 @@ class ECBackend(PGBackend):
         op.pending_commits.discard(shard)
         if not op.pending_commits:
             del self.waiting_commit[tid]
+            if op.mutation.tracked_op is not None:
+                op.mutation.tracked_op.mark_event(
+                    "ec:all_shards_committed")
             # ordered sends over ordered channels make completions
             # arrive in submission order; clients observe per-object
             # commit order
@@ -685,7 +711,8 @@ class ECBackend(PGBackend):
     # read path (reference objects_read_and_reconstruct)
     # ------------------------------------------------------------------
     def objects_read(self, oid: str, offset: int, length: int,
-                     cb: Callable[[int, bytes], None]) -> None:
+                     cb: Callable[[int, bytes], None],
+                     trace: Tuple[int, int] = (0, 0)) -> None:
         info = self.get_object_info(oid)
         if info is None:
             cb(-2, b"")                  # -ENOENT
@@ -738,7 +765,7 @@ class ECBackend(PGBackend):
             cb(0, data[lo:lo + length])
 
         self._start_read(oid, chunk_off, chunk_len, shards, reads_done,
-                         need=need)
+                         need=need, trace=trace)
 
     def _decode_impl(self, nbytes: int):
         """Decode through the CPU twin when the OSD batcher's learned
@@ -787,9 +814,11 @@ class ECBackend(PGBackend):
                                  None],
                     tried: Optional[Set[int]] = None,
                     ranges: Optional[Dict[int, List[Tuple[int, int]]]]
-                    = None, need: Optional[int] = None) -> None:
+                    = None, need: Optional[int] = None,
+                    trace: Tuple[int, int] = (0, 0)) -> None:
         rop = _ReadOp(self.new_tid(), oid, chunk_off, chunk_len,
                       dict(shards), cb, tried, ranges, need)
+        rop.trace = trace
         self.in_flight_reads[rop.tid] = rop
         for shard, osd in shards.items():
             extents = rop.ranges.get(shard,
@@ -813,7 +842,8 @@ class ECBackend(PGBackend):
                     from_osd=self.host.whoami, tid=rop.tid,
                     epoch=self.host.epoch,
                     reads=[(oid, off, length)
-                           for off, length in extents]))
+                           for off, length in extents],
+                    trace_id=trace[0], parent_span_id=trace[1]))
 
     def _local_chunk_read(self, oid: str, shard: int, off: int,
                           length: int) -> Tuple[bytes, int]:
@@ -887,7 +917,8 @@ class ECBackend(PGBackend):
             if retry is not None:
                 self._start_read(rop.oid, rop.chunk_off, rop.chunk_len,
                                  retry, rop.cb,
-                                 tried=rop.tried | set(retry))
+                                 tried=rop.tried | set(retry),
+                                 trace=getattr(rop, "trace", (0, 0)))
                 return
         rop.cb(rop.received, rop.errors)
 
@@ -1190,10 +1221,13 @@ class ECBackend(PGBackend):
     # ------------------------------------------------------------------
     def handle_message(self, msg) -> bool:
         if isinstance(msg, MOSDECSubOpWrite):
-            span = self.host.trace_span("ec_sub_write", msg.trace_id)
+            span = self.host.trace_span(
+                "ec_sub_write", msg.trace_id,
+                getattr(msg, "parent_span_id", 0))
             if span is not None:
-                # child span per shard sub-write (reference
-                # ECBackend.cc:2063-2068 blkin spans)
+                # child span per shard sub-write, parented under the
+                # primary's osd_op span (reference ECBackend.cc:
+                # 2063-2068 blkin spans)
                 span.tag("shard", msg.shard).tag(
                     "pgid", msg.pgid).finish()
             txn = Transaction.decode(msg.txn)
@@ -1209,6 +1243,12 @@ class ECBackend(PGBackend):
             self._sub_write_committed(msg.tid, msg.shard)
             return True
         if isinstance(msg, MOSDECSubOpRead):
+            span = self.host.trace_span(
+                "ec_sub_read", getattr(msg, "trace_id", 0),
+                getattr(msg, "parent_span_id", 0))
+            if span is not None:
+                span.tag("shard", msg.shard).tag(
+                    "pgid", msg.pgid).finish()
             self._handle_sub_read(msg)
             return True
         if isinstance(msg, MOSDECSubOpReadReply):

@@ -195,7 +195,8 @@ class OSD(Dispatcher):
                       description="decode requests that shared a call")
         # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
         from .batcher import EncodeBatcher
-        self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf)
+        self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf,
+                                            perf_coll=self.perf_coll)
         self.op_tracker = OpTracker(
             history_size=self.conf["osd_op_history_size"],
             history_duration=self.conf["osd_op_history_duration"],
@@ -204,6 +205,24 @@ class OSD(Dispatcher):
         self.tracer = Tracer(f"osd.{whoami}",
                              enabled=self.conf["osd_tracing"],
                              keep=self.conf["trace_keep_spans"])
+        # optional unix-socket command surface (reference AdminSocket,
+        # common/admin_socket.cc; the MCommand path stays primary)
+        self.admin_socket = None
+        sock_tmpl = self.conf["admin_socket"]
+        if sock_tmpl:
+            from string import Template
+            from ..utils.admin_socket import AdminSocket
+            path = Template(sock_tmpl).safe_substitute(
+                name=f"osd.{whoami}")
+            self.admin_socket = AdminSocket(path)
+            for prefix in ("perf dump", "dump_traces",
+                           "dump_historic_ops",
+                           "dump_historic_slow_ops",
+                           "dump_blocked_ops", "dump_ops_in_flight",
+                           "dump_slow_ops", "status", "config get",
+                           "config set"):
+                self.admin_socket.register(
+                    prefix, self._admin_socket_hook)
 
     # ------------------------------------------------------------------
     # lifecycle (reference OSD::init)
@@ -227,10 +246,14 @@ class OSD(Dispatcher):
             self._threads.append(t)
         self.monc.subscribe_osdmap()
         self.monc.send_boot(self.whoami, self.my_addr)
+        if self.admin_socket is not None:
+            self.admin_socket.start()
         self.log.dout(1, f"booted, addr {self.my_addr}")
 
     def shutdown(self) -> None:
         self._stop.set()
+        if self.admin_socket is not None:
+            self.admin_socket.stop()
         self.encode_batcher.stop(
             drain=self.conf["osd_batcher_drain_timeout"])
         self._recovery_kick.set()
@@ -549,6 +572,12 @@ class OSD(Dispatcher):
     # -- sharded op queue (reference enqueue_op/dequeue_op) -------------
     def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
         pgid = PGid(msg.pool, msg.pgid_seed)
+        # track from ENQUEUE so queue-wait shows in the event timeline
+        # (reference OpTracker starts at op receipt, not dequeue)
+        msg.tracked = self.op_tracker.create(
+            f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
+            f"{'+'.join(op.op for op in msg.ops)})")
+        msg.tracked.mark_event("queued_for_pg")
         shard = hash(pgid) % self._n_shards
         self._shard_queues[shard].enqueue("client", (conn, msg))
 
@@ -623,22 +652,28 @@ class OSD(Dispatcher):
                 continue
             conn, msg = item
             pgid = PGid(msg.pool, msg.pgid_seed)
+            tracked = getattr(msg, "tracked", None)
             pg = self._lookup_pg(pgid)
             if pg is None:
                 # not our PG: tell the client to refresh its map
                 from ..msg.messages import MOSDOpReply
                 conn.send_message(MOSDOpReply(
                     tid=msg.tid, result=-108, epoch=self.osdmap.epoch))
+                if tracked is not None:
+                    tracked.finish()
                 continue
             is_write = any(PG._op_is_write(op) for op in msg.ops)
-            span = self.tracer.start("osd_op", msg.trace_id) \
+            span = self.tracer.start(
+                "osd_op", msg.trace_id,
+                getattr(msg, "parent_span_id", 0)) \
                 if msg.trace_id else None
             if span is not None:
                 span.tag("pg", str(pgid)).tag("oid", msg.oid) \
                     .tag("write", is_write)
-            tracked = self.op_tracker.create(
-                f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
-                f"{'+'.join(op.op for op in msg.ops)})")
+                # child sub-ops (EC shard writes) parent under us
+                msg.osd_span_id = span.span_id
+            if tracked is not None:
+                tracked.mark_event("reached_pg")
             t0 = time.monotonic()
             self.perf.inc("op")
             self.perf.inc("op_w" if is_write else "op_r")
@@ -657,7 +692,18 @@ class OSD(Dispatcher):
                 self.perf.tinc("op_latency", dt)
                 self.perf.tinc("op_w_latency" if is_write
                                else "op_r_latency", dt)
-                tracked.finish()
+                # async writes hand the tracked op to the commit
+                # pipeline (PG._reply finishes it); parked ops (latest
+                # event "waiting ...") stay in flight for
+                # dump_blocked_ops until requeued.  finish() is
+                # idempotent, so a synchronous reply that already
+                # retired the op is a no-op here.
+                if tracked is not None and \
+                        not getattr(msg, "_tracked_async", False) and \
+                        not (tracked.events and
+                             tracked.events[-1][1].startswith(
+                                 "waiting")):
+                    tracked.finish()
                 if span is not None:
                     span.finish()
 
@@ -665,8 +711,11 @@ class OSD(Dispatcher):
     # daemon-direct commands (reference 'ceph tell osd.N', MCommand;
     # command set mirrors the admin socket's, common/admin_socket.cc)
     # ------------------------------------------------------------------
-    def _handle_command(self, conn: Connection, msg: MCommand) -> None:
-        prefix = msg.cmd.get("prefix", "")
+    def _exec_command(self, cmd: dict) -> Tuple[int, str, dict]:
+        """Shared command table behind both MCommand ('ceph tell') and
+        the unix admin socket ('ceph daemon') — one implementation, two
+        transports (reference common/admin_socket.cc)."""
+        prefix = cmd.get("prefix", "")
         retcode, rs, out = 0, "", {}
         try:
             if prefix == "perf dump":
@@ -675,6 +724,11 @@ class OSD(Dispatcher):
                 out = {"spans": self.tracer.dump()}
             elif prefix == "dump_historic_ops":
                 out = {"ops": self.op_tracker.dump_historic_ops()}
+            elif prefix == "dump_historic_slow_ops":
+                out = {"ops":
+                       self.op_tracker.dump_historic_slow_ops()}
+            elif prefix == "dump_blocked_ops":
+                out = {"ops": self.op_tracker.dump_blocked_ops()}
             elif prefix == "dump_ops_in_flight":
                 out = {"ops": self.op_tracker.dump_ops_in_flight()}
             elif prefix == "dump_slow_ops":
@@ -686,15 +740,25 @@ class OSD(Dispatcher):
                        "osdmap_epoch": self.osdmap.epoch,
                        "state": "active"}
             elif prefix == "config get":
-                out = {"value": self.conf.get(msg.cmd["name"])}
+                out = {"value": self.conf.get(cmd["name"])}
             elif prefix == "config set":
-                self.conf.set(msg.cmd["name"], msg.cmd["value"])
+                self.conf.set(cmd["name"], cmd["value"])
             else:
                 retcode, rs = -22, f"unknown command {prefix!r}"
         except Exception as e:
             retcode, rs = -22, str(e)
+        return retcode, rs, out
+
+    def _handle_command(self, conn: Connection, msg: MCommand) -> None:
+        retcode, rs, out = self._exec_command(msg.cmd)
         conn.send_message(MCommandReply(tid=msg.tid, retcode=retcode,
                                         rs=rs, out=out))
+
+    def _admin_socket_hook(self, cmd: dict):
+        retcode, rs, out = self._exec_command(cmd)
+        if retcode != 0:
+            raise RuntimeError(rs or f"error {retcode}")
+        return out
 
     # ------------------------------------------------------------------
     # peer messaging

@@ -827,6 +827,9 @@ class PG:
             self.inflight_writes.clear()
             self._pending_versions.clear()
             for m, conn in held:
+                tracked = getattr(m, "tracked", None)
+                if tracked is not None:
+                    tracked.finish()
                 if conn is not None:
                     reply = MOSDOpReply(tid=m.tid, result=-108,
                                         epoch=osdmap.epoch)
@@ -1166,6 +1169,15 @@ class PG:
             msg, conn = self.waiting_for_active.popleft()
             self._do_op(msg, conn)
 
+    @staticmethod
+    def _mark_waiting(msg, event: str) -> None:
+        """Stamp a park on the op's tracker timeline; ops whose latest
+        event is a wait surface through dump_blocked_ops (reference
+        OpTracker blocked-op accounting)."""
+        tracked = getattr(msg, "tracked", None)
+        if tracked is not None:
+            tracked.mark_event(event)
+
     # ------------------------------------------------------------------
     # client op execution (reference do_request -> do_op -> do_osd_ops)
     # ------------------------------------------------------------------
@@ -1183,6 +1195,7 @@ class PG:
                 return
             self._client_ops[(msg.client, msg.tid)] = (msg, conn)
             if self.state != STATE_ACTIVE:
+                self._mark_waiting(msg, "waiting for active")
                 self.waiting_for_active.append((msg, conn))
                 return
             self._do_op(msg, conn)
@@ -1260,10 +1273,12 @@ class PG:
             # scrub snapshots must describe one committed state; new
             # writes wait for the round (reference write blocking on
             # the scrubbed chunk)
+            self._mark_waiting(msg, "waiting for scrub")
             self.waiting_for_scrub.append((msg, conn))
             return
         if has_write and self._is_degraded(oid):
             # block until recovered (reference wait_for_degraded_object)
+            self._mark_waiting(msg, "waiting for degraded object")
             self.waiting_for_degraded.setdefault(oid, deque()).append(
                 (msg, conn))
             self.service.kick_recovery(self)
@@ -1274,6 +1289,7 @@ class PG:
                 return
             if oid in self.inflight_writes and \
                     not self._can_pipeline(msg, oid):
+                self._mark_waiting(msg, "waiting for object")
                 self.waiting_for_obj.setdefault(oid, deque()).append(
                     (msg, conn))
                 return
@@ -1282,6 +1298,7 @@ class PG:
             if self.missing.is_missing(oid):
                 # the primary's own copy is unreadable until recovery
                 # (reference wait_for_unreadable_object)
+                self._mark_waiting(msg, "waiting for degraded object")
                 self.waiting_for_degraded.setdefault(
                     oid, deque()).append((msg, conn))
                 self.service.kick_recovery(self)
@@ -1831,6 +1848,11 @@ class PG:
             return
         mut = Mutation()
         mut.trace_id = msg.trace_id
+        # child spans (EC shard sub-writes) hang off the primary's
+        # osd_op span; the tracked op rides along so the backend /
+        # batcher can stamp stage events on the client op's timeline
+        mut.parent_span_id = getattr(msg, "osd_span_id", 0)
+        mut.tracked_op = getattr(msg, "tracked", None)
         err = 0
         ec = self.pool.is_erasure()
         full_replace = any(op.op == "writefull" for op in msg.ops)
@@ -2051,6 +2073,11 @@ class PG:
                                 reqid=(msg.client, msg.tid)))
         self._pending_versions[msg.oid] = version
         self._inflight_add(msg.oid)
+        if mut.tracked_op is not None:
+            mut.tracked_op.mark_event("started_write")
+        # the commit pipeline owns the tracker entry from here: the
+        # shard worker must not retire it when do_request returns
+        msg._tracked_async = True
         self.backend.submit_transaction(
             msg.oid, mut, version, entries,
             lambda res: self._op_committed(msg, conn, res,
@@ -2058,6 +2085,9 @@ class PG:
 
     def _op_committed(self, msg: MOSDOp, conn, res: int,
                       out_data: Optional[List[bytes]] = None) -> None:
+        tracked = getattr(msg, "tracked", None)
+        if tracked is not None:
+            tracked.mark_event("op_commit")
         self._inflight_remove(msg.oid)
         if msg.oid not in self.inflight_writes:
             self._pending_versions.pop(msg.oid, None)
@@ -2319,6 +2349,12 @@ class PG:
                out_data: List[bytes], extra: Optional[Dict] = None
                ) -> None:
         self._client_ops.pop((msg.client, msg.tid), None)
+        # every client reply retires the op's tracker entry (the single
+        # chokepoint: reads, write commits, and error bounces all land
+        # here); finish() is idempotent
+        tracked = getattr(msg, "tracked", None)
+        if tracked is not None:
+            tracked.finish()
         if conn is None:
             return
         reply = MOSDOpReply(tid=msg.tid, result=result,

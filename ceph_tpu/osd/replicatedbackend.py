@@ -76,7 +76,8 @@ class ReplicatedBackend(PGBackend):
                 pgid=self.host.pgid_str, from_osd=self.host.whoami,
                 tid=op.tid, epoch=self.host.epoch, txn=enc,
                 log_entries=wire_entries, at_version=at_version,
-                trace_id=mutation.trace_id))
+                trace_id=mutation.trace_id,
+                parent_span_id=mutation.parent_span_id))
         tid = op.tid
         self._apply_local(txn, wire_entries,
                           lambda: self._committed(tid, self.host.whoami))
@@ -316,7 +317,9 @@ class ReplicatedBackend(PGBackend):
     # ------------------------------------------------------------------
     def handle_message(self, msg) -> bool:
         if isinstance(msg, MOSDRepOp):
-            span = self.host.trace_span("rep_sub_write", msg.trace_id)
+            span = self.host.trace_span(
+                "rep_sub_write", msg.trace_id,
+                getattr(msg, "parent_span_id", 0))
             if span is not None:
                 span.tag("pgid", msg.pgid).tag("from",
                                                msg.from_osd).finish()

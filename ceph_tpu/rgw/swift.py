@@ -145,12 +145,21 @@ class SwiftAdapter:
                 h._send(201, b"")
             except RGWError as e:
                 if e.code == "BucketAlreadyExists":
+                    # idempotent 202 is for re-PUTting YOUR OWN
+                    # container; a name collision with another
+                    # account's bucket must surface, not masquerade
+                    # as success
+                    owner = self.svc.get_bucket_acl(cont)["owner"]
+                    if owner and owner != acct:
+                        raise RGWError(403, "AccessDenied", cont)
                     h._send(202, b"")    # Swift PUT is idempotent
                 else:
                     raise
             return
         if method == "DELETE":
-            self.svc.check_access(acct, "write", cont)
+            # owner-only, matching S3 DeleteBucket: bucket WRITE ACL
+            # grants object creation, never bucket destruction
+            self.svc.check_access(acct, "acl", cont)
             self.svc.delete_bucket(cont)
             h._send(204, b"")
             return

@@ -693,17 +693,21 @@ class BlockStore(ObjectStore):
                     ext_cache.pop(self._xkey(src_coll, src), None)
                 else:
                     raise ValueError(f"unknown store op {name!r}")
-            except OSError:
+            except Exception as e:
                 # missing object (idempotent re-apply) or csum EIO:
                 # on replay, skip the op and keep mounting — a WAL
                 # entry poisoned by rot must not brick the store.
-                # Live path: roll the in-memory bitmap back (nothing
-                # this apply did is referenced — the batch never
-                # commits) and surface the error
-                if not replay:
-                    for phys in allocated:
-                        self._alloc.free(phys)
-                    raise
+                # Any other failure: roll the in-memory bitmap back
+                # (nothing this apply did is referenced — the batch
+                # never commits) and surface the error.  The rollback
+                # covers EVERY exception kind, not just OSError — a
+                # malformed op mid-transaction must not leak its
+                # earlier allocations into the next commit
+                if replay and isinstance(e, OSError):
+                    continue
+                for phys in allocated:
+                    self._alloc.free(phys)
+                raise
         # the COW flip: all extent maps updated in the same batch
         for key, ext in ext_cache.items():
             batch.set(key, ext.dump())

@@ -403,6 +403,10 @@ def _opts() -> List[Option]:
                            "osd_op_history_duration)"),
         Option("trace_keep_spans", int, 512, min=1,
                description="finished spans retained per tracer"),
+        Option("admin_socket", str, "",
+               description="unix-socket path template for daemon admin "
+                           "commands; $name expands to the daemon name "
+                           "(reference admin_socket, empty disables)"),
         Option("osd_heartbeat_min_size", int, 0, min=0,
                description="pad pings to at least this many bytes "
                            "(reference osd_heartbeat_min_size — "

@@ -86,3 +86,19 @@ class OpTracker:
         with self._lock:
             return [op.dump() for op in self._in_flight.values()
                     if now - op.start > self.slow_op_warn_threshold]
+
+    def dump_historic_slow_ops(self) -> List[Dict]:
+        """Completed ops that ran past the warn threshold (reference
+        OpTracker::dump_historic_slow_ops)."""
+        with self._lock:
+            return [op.dump() for op in self._history
+                    if op.duration >= self.slow_op_warn_threshold]
+
+    def dump_blocked_ops(self) -> List[Dict]:
+        """In-flight ops whose latest stage is a wait (reference
+        OpTracker::dump_blocked_ops — ops parked on a scrub, a
+        degraded object, or the per-object write pipeline)."""
+        with self._lock:
+            return [op.dump() for op in self._in_flight.values()
+                    if op.events and
+                    op.events[-1][1].startswith("waiting")]

@@ -484,3 +484,40 @@ def test_rebuild_decodes_ride_the_batcher():
             "recovery decodes did not ride the batcher"
         for i in range(8):
             assert io.read(f"r{i}") == blob
+
+
+def test_stage_counters_and_tracked_events(codec):
+    """The dedicated ec_batcher perf subsystem fills the per-stage
+    histograms/counters for a device-routed group, the cumulative
+    stage clocks advance, and a tracked op receives the batcher's
+    dispatch stage event."""
+    from ceph_tpu.utils.optracker import OpTracker
+    from ceph_tpu.utils.perf import PerfCountersCollection
+    EncodeBatcher.reset_learning()
+    coll = PerfCountersCollection()
+    b = EncodeBatcher({"ec_tpu_batch_stripes": 1024,
+                       "ec_tpu_queue_window_us": 1000},
+                      perf_coll=coll)
+    try:
+        top = OpTracker().create("osd_op(client.1.1 ...)")
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = os.urandom(4 * 8192)
+        done = threading.Event()
+        b.submit(codec, sinfo, data, lambda _c: done.set(),
+                 tracked=top)
+        assert done.wait(30)
+        assert "ec:batch_dispatched" in [e for _, e in top.events]
+        d = coll.perf_dump()["ec_batcher"]
+        assert sum(d["queue_wait_us"]["buckets"]) == 1
+        assert sum(d["batch_stripes"]["buckets"]) == 1
+        assert sum(d["dispatch_ms"]["buckets"]) == 1
+        assert d["device_reqs"] == 1 and d["cpu_reqs"] == 0
+        assert d["h2d_bytes"] == len(data)
+        assert d["d2h_bytes"] > 0            # parity came back
+        assert b.stage_seconds["queue_wait"] > 0
+        # the fenced window is fully attributed across the legs
+        dev = (b.stage_seconds["h2d"] + b.stage_seconds["device"]
+               + b.stage_seconds["d2h"])
+        assert dev > 0
+    finally:
+        b.stop()

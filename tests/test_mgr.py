@@ -350,3 +350,63 @@ def test_dashboard_module():
             assert rc == 0 and "/dashboard" in out["url"]
         finally:
             mgr.shutdown()
+
+
+def test_prometheus_histogram_roundtrip():
+    """Histogram counter sets render with every sample of a family
+    contiguous under ONE # TYPE line, and the emitted p50/p95/p99
+    gauges match percentiles recomputed from the raw buckets parsed
+    back out of the exposition text."""
+    import re
+    from types import SimpleNamespace
+
+    from ceph_tpu.mgr.modules.prometheus import (_histogram_percentile,
+                                                 render)
+    bounds = [50, 100, 200, 500]
+    buckets = [3, 7, 5, 2, 1]
+    perf = {"osd.0": {"ec_batcher": {
+                "queue_wait_us": {"bounds": bounds,
+                                  "buckets": buckets},
+                "h2d_bytes": 4096}},
+            "osd.1": {"ec_batcher": {
+                "queue_wait_us": {"bounds": bounds,
+                                  "buckets": [0, 1, 0, 0, 4]},
+                "h2d_bytes": 512}}}
+    osdmap = SimpleNamespace(osds={}, pools={}, epoch=7)
+    body = render(osdmap, perf)
+    lines = body.splitlines()
+    m = "ceph_ec_batcher_queue_wait_us"
+    # exactly one TYPE line; every family sample contiguous below it
+    ti = lines.index(f"# TYPE {m} histogram")
+    block = []
+    for ln in lines[ti + 1:]:
+        if ln.startswith("# TYPE"):
+            break
+        block.append(ln)
+    in_block = set(range(ti + 1, ti + 1 + len(block)))
+    stray = [ln for i, ln in enumerate(lines)
+             if ln.startswith(m + "_bucket") and i not in in_block]
+    assert not stray, stray
+    # parse osd.0's cumulative buckets back out of the text
+    pat = re.compile(m + r'_bucket\{daemon="osd\.0",'
+                         r'le="([^"]+)"\} (\d+)')
+    cum = {mt.group(1): int(mt.group(2))
+           for ln in block if (mt := pat.match(ln))}
+    assert cum["+Inf"] == sum(buckets)
+    raw, prev = [], 0
+    for bnd in bounds:
+        raw.append(cum[str(bnd)] - prev)
+        prev = cum[str(bnd)]
+    raw.append(cum["+Inf"] - prev)
+    assert raw == buckets                # lossless round trip
+    assert f'{m}_count{{daemon="osd.0"}} {sum(buckets)}' in body
+    # percentile gauges match the raw-bucket computation
+    for q, sfx in ((0.50, "p50"), (0.95, "p95"), (0.99, "p99")):
+        assert f"# TYPE {m}_{sfx} gauge" in body
+        got = [float(ln.rsplit(" ", 1)[1]) for ln in lines
+               if ln.startswith(f'{m}_{sfx}{{daemon="osd.0"}}')]
+        assert len(got) == 1
+        assert abs(got[0] - _histogram_percentile(bounds, raw, q)) \
+            < 1e-9
+    # plain counters from the same subsystem still render
+    assert 'ceph_ec_batcher_h2d_bytes{daemon="osd.0"} 4096' in body

@@ -83,6 +83,99 @@ def test_spans_cross_daemons_ec_write():
             client.shutdown()
 
 
+def test_ec_write_span_tree_and_stage_timeline(tmp_path):
+    """One traced client EC write yields a LINKED span tree — client
+    rados_op -> primary osd_op (parent = client span) -> one
+    ec_sub_write child per shard (parent = osd_op span, including the
+    primary's own shard) — the primary's dump_historic_ops timeline
+    shows the write-pipeline stage events in order, and the OSD's
+    admin socket serves the observability surface."""
+    conf = make_conf(osd_tracing=True, rados_tracing=True,
+                     admin_socket=str(tmp_path) + "/$name.asok")
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("trs", plugin="jerasure", k="2", m="1")
+        c.create_pool("trsp", "erasure",
+                      erasure_code_profile="trs")
+        client = Rados(c.mon_addr, conf=conf).connect()
+        try:
+            io = client.open_ioctx("trsp")
+            io.write_full("tree", b"y" * 8192)
+            root = next(s for s in client.tracer.dump()
+                        if s["tags"].get("oid") == "tree")
+            tid = root["trace_id"]
+            deadline = time.monotonic() + 15
+            op_spans, subs = [], []
+            while time.monotonic() < deadline:
+                spans = [s for osd in c.osds.values()
+                         if osd is not None
+                         for s in osd.tracer.dump()
+                         if s["trace_id"] == tid]
+                op_spans = [s for s in spans
+                            if s["name"] == "osd_op"]
+                subs = [s for s in spans
+                        if s["name"] == "ec_sub_write"]
+                if op_spans and len(subs) >= 3:
+                    break
+                time.sleep(0.2)
+            # the primary's osd_op span is the client span's child
+            assert len(op_spans) == 1, op_spans
+            assert op_spans[0]["parent_id"] == root["span_id"]
+            # one sub-write child per shard (k=2 m=1 -> 3 shards),
+            # every one parented on the primary's osd_op span
+            assert len(subs) == 3, subs
+            assert all(s["parent_id"] == op_spans[0]["span_id"]
+                       for s in subs), subs
+            # ... and they landed on every shard OSD
+            for osd in c.osds.values():
+                if osd is None:
+                    continue
+                assert any(s["trace_id"] == tid
+                           and s["name"] == "ec_sub_write"
+                           for s in osd.tracer.dump()), \
+                    f"osd.{osd.whoami} recorded no sub-write span"
+
+            # stage timeline: the primary's historic-op dump carries
+            # the write pipeline's stage events in pipeline order
+            hist = None
+            deadline = time.monotonic() + 15
+            while hist is None and time.monotonic() < deadline:
+                for osd in c.osds.values():
+                    if osd is None:
+                        continue
+                    for opd in osd.op_tracker.dump_historic_ops():
+                        if "tree" in opd["description"]:
+                            hist = opd
+                if hist is None:
+                    time.sleep(0.2)
+            assert hist is not None
+            names = [e["event"] for e in hist["events"]]
+            want = ["initiated", "queued_for_pg", "reached_pg",
+                    "started_write", "ec:encode_queued",
+                    "ec:encoded", "ec:sub_write_sent",
+                    "ec:all_shards_committed", "op_commit", "done"]
+            assert set(want) <= set(names), names
+            idx = [names.index(w) for w in want]
+            assert idx == sorted(idx), names
+
+            # admin socket surface: perf dump carries the ec_batcher
+            # subsystem; the op dumps answer over the same socket
+            from ceph_tpu.utils.admin_socket import admin_command
+            sock = str(tmp_path) + "/osd.0.asok"
+            pd = admin_command(sock, "perf dump")
+            assert "osd" in pd and "ec_batcher" in pd
+            assert "queue_wait_us" in pd["ec_batcher"]
+            for prefix in ("dump_historic_slow_ops",
+                           "dump_blocked_ops"):
+                out = admin_command(sock, prefix)
+                assert isinstance(out["ops"], list), (prefix, out)
+            tr = admin_command(sock, "dump_traces")
+            assert isinstance(tr["spans"], list)
+        finally:
+            client.shutdown()
+
+
 def test_dump_traces_tell_command():
     conf = make_conf(osd_tracing=True, rados_tracing=True)
     with Cluster(n_osds=2, conf=conf) as c:
