@@ -639,13 +639,13 @@ def machine_factor() -> float:
 
 
 def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
-                 n_osds=3):
+                 n_osds=3, osd_backend="classic"):
     """One vstart-style run: write MB/s + rebuild MB/s (+ the
     primary-side batcher's coalescing counters)."""
     from ceph_tpu.cluster import Cluster, test_config
 
     f = machine_factor()
-    overrides = {}
+    overrides = {"osd_backend": osd_backend}
     if n_osds > 4:
         # many daemons on few cores: slow the heartbeat chatter and
         # scale the grace by measured machine speed so scheduler
@@ -657,7 +657,7 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         # the coalescing thesis); enough PGs that a primary can hold
         # several in-flight encodes (the per-PG pipeline admits one
         # encode at a time)
-        overrides = dict(osd_heartbeat_interval=2.0,
+        overrides.update(osd_heartbeat_interval=2.0,
                          osd_heartbeat_grace=max(12.0, 8.0 * f),
                          osd_pool_default_pg_num=32,
                          ec_tpu_queue_window_us=30000)
@@ -796,6 +796,60 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
          f"{r_cpu:.1f} MB/s)", r_tpu, "MB/s", r_tpu / r_cpu)
 
 
+def bench_cluster_crimson(n_objs=26, obj_bytes=8 << 20):
+    """The cluster_k8m4 workload under BOTH OSD execution models:
+    osd_backend=classic (sharded thread pools + queue hops + timed
+    batch window) vs osd_backend=crimson (reactor data path, inline
+    dispatch, tick-boundary batch flush).  Same pool geometry, same
+    object stream, same daemon count — the only variable is the
+    intra-OSD execution model, so the delta is the reactor's."""
+    w_cl, r_cl, st_cl = _cluster_run(
+        "tpu", n_objs, obj_bytes, k="8", m="4", n_osds=13,
+        osd_backend="classic")
+    w_cr, r_cr, st_cr = _cluster_run(
+        "tpu", n_objs, obj_bytes, k="8", m="4", n_osds=13,
+        osd_backend="crimson")
+
+    def _split(st):
+        # wall seconds split proportionally to measured op-seconds
+        # (same attribution scheme as bench_cluster_k8m4)
+        att = st.get("stages") or {}
+        opsec = sum(att.values())
+        wall = st.get("write_wall_s", 0.0)
+        if opsec > 0 and wall > 0:
+            return {s: round(wall * v / opsec, 4)
+                    for s, v in att.items()}
+        return {}
+
+    emit(f"cluster write MB/s (13-OSD vstart, pool plugin=tpu k=8 "
+         f"m=4, {n_objs}x{obj_bytes >> 20} MiB concurrent writes, "
+         f"osd_backend=crimson reactor data path; batcher: "
+         f"{st_cr['reqs']} encode reqs -> {st_cr['calls']} device + "
+         f"{st_cr['cpu_calls']} batched-twin calls, "
+         f"{st_cr['coalesced']} coalesced; baseline=same workload on "
+         f"osd_backend=classic {w_cl:.1f} MB/s)",
+         w_cr, "MB/s", w_cr / w_cl if w_cl else 0.0)
+    print(json.dumps({
+        "metric": "crimson vs classic k8m4 cluster comparison (write/"
+                  "rebuild MB/s + per-stage wall attribution under "
+                  "each backend)",
+        "value": round(w_cr, 2), "unit": "MB/s",
+        "vs_baseline": round(w_cr / w_cl, 3) if w_cl else 0.0,
+        "classic": {"write_mbps": round(w_cl, 2),
+                    "rebuild_mbps": round(r_cl, 2),
+                    "batcher": {k2: st_cl[k2] for k2 in
+                                ("calls", "reqs", "coalesced",
+                                 "cpu_calls")},
+                    "stages": _split(st_cl)},
+        "crimson": {"write_mbps": round(w_cr, 2),
+                    "rebuild_mbps": round(r_cr, 2),
+                    "batcher": {k2: st_cr[k2] for k2 in
+                                ("calls", "reqs", "coalesced",
+                                 "cpu_calls")},
+                    "stages": _split(st_cr)},
+    }), flush=True)
+
+
 def bench_cluster(n_objs=8, obj_bytes=4 << 20):
     """BASELINE config 5: 3-OSD cluster, plugin=tpu pool, 4 MiB
     `rados bench`-style writes + OSD-down rebuild, vs plugin=jerasure
@@ -826,6 +880,7 @@ CONFIGS = {
     "lrc": bench_lrc,
     "cluster": bench_cluster,
     "cluster_k8m4": bench_cluster_k8m4,
+    "cluster_crimson": bench_cluster_crimson,
     # NORTH STAR last: a single-line consumer reads this one, and
     # running it last maximizes the time the spread sampler has had to
     # catch a quiet tunnel window.

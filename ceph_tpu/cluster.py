@@ -80,6 +80,11 @@ class Cluster:
         self.osds: Dict[int, Optional[OSD]] = {}
         self.stores: Dict[int, ObjectStore] = {}
         self._clients: List[Rados] = []
+        # per-OSD execution-model override (osd_id -> classic|crimson)
+        # so one cluster can run both backends side by side; unset ids
+        # follow conf["osd_backend"].  Sticky across kill/revive — a
+        # thrashed crimson OSD comes back crimson.
+        self.backend_overrides: Dict[int, str] = {}
 
     # ------------------------------------------------------------------
     @property
@@ -178,13 +183,25 @@ class Cluster:
             self.mon = mon
         return mon
 
-    def start_osd(self, osd_id: int) -> OSD:
+    def osd_backend(self, osd_id: int) -> str:
+        """Execution model for this OSD id (override, else conf)."""
+        return self.backend_overrides.get(
+            osd_id, self.conf["osd_backend"])
+
+    def start_osd(self, osd_id: int,
+                  backend: Optional[str] = None) -> OSD:
         store = self.stores.get(osd_id)
         if store is None:
             store = self._make_store(osd_id)
             self.stores[osd_id] = store
         store.mount()
-        osd = OSD(osd_id, store, self.client_mon_addrs(),
+        if backend is not None:
+            self.backend_overrides[osd_id] = backend
+        cls: type = OSD
+        if self.osd_backend(osd_id) == "crimson":
+            from .crimson import CrimsonOSD
+            cls = CrimsonOSD
+        osd = cls(osd_id, store, self.client_mon_addrs(),
                   conf=self.conf)
         osd.start()
         self.osds[osd_id] = osd

@@ -1,0 +1,177 @@
+"""CrimsonOSD — the classic OSD's logic on a reactor data path.
+
+Same PG/pglog/backend/scrub/recovery code, different execution model
+(reference crimson-osd reuses the osd-side protocol while replacing
+the threading): no sharded op queues, no per-shard worker threads, no
+heartbeat/tick/recovery threads.  One reactor thread runs
+
+  * the messenger pumps (``CrimsonConnection``) — frames decode and
+    dispatch inline;
+  * client ops as future chains: ``queued_for_pg`` marks at receipt,
+    a continuation runs the op (the OpTracker stage names of PR 1 —
+    ``queued_for_pg → reached_pg → ec:encode_queued → … → op_commit``
+    — are unchanged, so time-attribution JSON compares backends
+    directly);
+  * maintenance as timers: ``_heartbeat_once`` / ``_tick_once`` /
+    ``_recovery_scan`` are the SAME methods the classic threads call,
+    so heartbeats, mon boot/failure reporting and thrash recovery
+    behave identically by construction;
+  * the EC batcher flush as a tick hook: stripes submitted by ALL PGs
+    during a tick coalesce into one device dispatch when the tick
+    ends (``EncodeBatcher.tick_flush``) instead of each PG's stripes
+    waiting out the time window behind per-PG queue hops.
+
+Blocking work keeps its classic helper threads: handshakes/reconnect
+(messenger control plane), copy-from / cache promote / flush fetches
+(internal objecter), the batcher's collector, and the store's own
+machinery.  They were built for a multithreaded OSD and stay safe —
+PG state is still lock-protected.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from ..msg.messages import MOSDOp
+from ..msg.messenger import Connection, Messenger
+from ..osd.osd import OSD
+from ..osd.pg import PG, PGid
+from ..store.objectstore import ObjectStore
+from ..utils.config import Config
+from .net import CrimsonMessenger
+from .reactor import Reactor
+
+
+class ReactorBatcher:
+    """Batcher facade marshalling completions onto the reactor.
+
+    EC backends reach the batcher via ``getattr(host, "encode_batcher")``
+    and hand it continuations that re-enter PG code; wrapping the
+    callback with ``call_soon`` makes those continuations run on the
+    reactor thread whether the encode completed on the collector
+    thread, the device callback, or inline."""
+
+    def __init__(self, inner, reactor: Reactor):
+        self._inner = inner
+        self._reactor = reactor
+
+    def _marshal(self, cb):
+        def done(result):
+            self._reactor.call_soon(cb, result)
+        return done
+
+    def submit(self, ec_impl, sinfo, data, cb, tracked=None) -> None:
+        self._inner.submit(ec_impl, sinfo, data, self._marshal(cb),
+                           tracked=tracked)
+
+    def submit_decode(self, ec_impl, sinfo, have, want, cb) -> None:
+        self._inner.submit_decode(ec_impl, sinfo, have, want,
+                                  self._marshal(cb))
+
+    def __getattr__(self, name):
+        # prewarm / prefer_cpu / tick_flush / stop / counters pass
+        # straight through
+        return getattr(self._inner, name)
+
+
+class CrimsonOSD(OSD):
+    """Drop-in OSD selected by ``osd_backend=crimson``.
+
+    Runs in the same cluster as classic OSDs: wire protocol, maps,
+    heartbeats and recovery are identical — only the intra-daemon
+    execution model differs."""
+
+    #: recovery scan cadence; matches the classic thread's kick wait
+    _RECOVERY_TICK = 0.2
+
+    def __init__(self, whoami: int, store: ObjectStore,
+                 mon_addr: Tuple[str, int],
+                 conf: Optional[Config] = None,
+                 addr: Tuple[str, int] = ("127.0.0.1", 0)):
+        # the reactor must exist before super().__init__ calls
+        # _make_messenger
+        self.reactor = Reactor(name=f"crimson-osd{whoami}")
+        super().__init__(whoami, store, mon_addr, conf=conf, addr=addr)
+        self.encode_batcher = ReactorBatcher(self.encode_batcher,
+                                             self.reactor)
+
+    def _make_messenger(self) -> Messenger:
+        return CrimsonMessenger(f"osd.{self.whoami}", conf=self.conf,
+                                reactor=self.reactor)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> None:
+        self.reactor.start()
+        self.msgr.start()
+        # maintenance runs as reactor timers on the SAME methods the
+        # classic threads drive, so cross-backend behavior is identical
+        self.reactor.call_every(self.conf["osd_heartbeat_interval"],
+                                self._heartbeat_once)
+        self.reactor.call_every(self.conf["osd_tick_interval"],
+                                self._tick_once)
+        self.reactor.call_every(self._RECOVERY_TICK,
+                                self._drain_recovery_kick)
+        # the coalescing barrier: ops processed this tick have already
+        # submitted their stripes, so cut the batch window NOW
+        self.reactor.add_tick_hook(self.encode_batcher.tick_flush)
+        self.monc.subscribe_osdmap()
+        self.monc.send_boot(self.whoami, self.my_addr)
+        if self.admin_socket is not None:
+            self.admin_socket.start()
+        self.log.dout(1, f"booted (crimson), addr {self.my_addr}")
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self.admin_socket is not None:
+            self.admin_socket.stop()
+        # drain before stopping the reactor: encode completions
+        # marshal onto it and commit chains still send over the msgr
+        self.encode_batcher.stop(
+            drain=self.conf["osd_batcher_drain_timeout"])
+        for q in self._shard_queues:
+            q.close()                    # empty; closed for symmetry
+        if self._int_client is not None:
+            try:
+                self._int_client.shutdown()
+            except Exception:
+                pass
+        self.msgr.shutdown()
+        self.reactor.stop()
+        try:
+            self.store.umount()
+        except Exception:
+            pass
+
+    # -- data path ---------------------------------------------------------
+    def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
+        pgid = PGid(msg.pool, msg.pgid_seed)
+        msg.tracked = self.op_tracker.create(
+            f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
+            f"{'+'.join(op.op for op in msg.ops)})")
+        msg.tracked.mark_event("queued_for_pg")
+        # continuation, not queue hop: the op runs later in this very
+        # tick (the ready queue drains to empty), after the reader
+        # finishes parsing whatever else the socket delivered
+        f = self.reactor.future()
+        f.then(lambda _: self._run_client_op(conn, msg))
+        f.set_result(None)
+
+    def queue_recovery_item(self, pg: PG) -> None:
+        with pg.lock:
+            if getattr(pg, "_recovery_queued", False):
+                return
+            pg._recovery_queued = True
+        self.reactor.call_soon(self._run_recovery_item, pg)
+
+    def _queue_scrub(self, pg: PG, deep: bool) -> None:
+        self.reactor.call_soon(self._start_scrub, pg, deep)
+
+    def kick_recovery(self) -> None:
+        # peering events may kick from foreign threads (mon dispatch
+        # runs on the reactor, store completions may not)
+        self.reactor.call_soon(self._recovery_scan)
+
+    def _drain_recovery_kick(self) -> None:
+        # classic parity: the 0.2s timer doubles as the kick-event
+        # consumer for any base-class code setting _recovery_kick
+        self._recovery_kick.clear()
+        self._recovery_scan()

@@ -1,0 +1,394 @@
+"""Single-threaded event loop driving a crimson OSD.
+
+The reactor owns one thread and three sources of work:
+
+  * **IO readiness** — sockets registered via :meth:`Reactor.register`
+    get their ``on_readable`` / ``on_writable`` callbacks invoked from
+    the loop (``selectors``-based, level-triggered).
+  * **Ready callbacks** — :meth:`call_soon` from any thread appends to
+    a run queue drained once per tick; a socketpair wakes the selector
+    so cross-thread scheduling has no polling latency.
+  * **Timers** — :meth:`call_later` / :meth:`call_every` replace the
+    classic OSD's heartbeat/tick/recovery threads.
+
+One *tick* = one selector wait + IO callbacks + due timers + a full
+drain of the ready queue, then the **tick hooks** run.  The hooks are
+the coalescing barrier the EC batcher exploits: every op processed
+this tick has already submitted its stripes, so the hook can cut the
+batching window immediately instead of sleeping it out
+(:meth:`EncodeBatcher.tick_flush`).
+
+No locks guard reactor-owned state beyond the ready-queue mutex;
+everything else is touched only from the loop thread — that is the
+point of the design (reference: Seastar's shared-nothing reactor,
+crimson/common/).
+"""
+from __future__ import annotations
+
+import heapq
+import selectors
+import socket
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class Future:
+    """Minimal completion token for reactor continuation chains.
+
+    Callbacks never run synchronously from :meth:`set_result` — they
+    are scheduled on the reactor (asyncio semantics), so resolving a
+    future from within a callback cannot reenter the continuation
+    under held locks.  :meth:`then` chains: the mapper's return value
+    resolves the next future, and a returned ``Future`` splices in.
+    """
+
+    __slots__ = ("_reactor", "_done", "_result", "_exc", "_cbs")
+
+    def __init__(self, reactor: "Reactor"):
+        self._reactor = reactor
+        self._done = False
+        self._result: Any = None
+        self._exc: Optional[BaseException] = None
+        self._cbs: List[Callable[["Future"], None]] = []
+
+    def done(self) -> bool:
+        return self._done
+
+    def result(self) -> Any:
+        if not self._done:
+            raise RuntimeError("future not resolved")
+        if self._exc is not None:
+            raise self._exc
+        return self._result
+
+    def exception(self) -> Optional[BaseException]:
+        return self._exc
+
+    def set_result(self, value: Any = None) -> None:
+        self._resolve(value, None)
+
+    def set_exception(self, exc: BaseException) -> None:
+        self._resolve(None, exc)
+
+    def _resolve(self, value: Any, exc: Optional[BaseException]) -> None:
+        if self._done:
+            raise RuntimeError("future already resolved")
+        self._done = True
+        self._result = value
+        self._exc = exc
+        cbs, self._cbs = self._cbs, []
+        for cb in cbs:
+            self._reactor.call_soon(cb, self)
+
+    def add_done_callback(self, fn: Callable[["Future"], None]) -> None:
+        if self._done:
+            self._reactor.call_soon(fn, self)
+        else:
+            self._cbs.append(fn)
+
+    def then(self, fn: Callable[[Any], Any]) -> "Future":
+        nxt = Future(self._reactor)
+
+        def _step(fut: "Future") -> None:
+            if fut._exc is not None:
+                nxt.set_exception(fut._exc)
+                return
+            try:
+                out = fn(fut._result)
+            except BaseException as e:  # noqa: BLE001 — propagate to chain
+                nxt.set_exception(e)
+                return
+            if isinstance(out, Future):
+                out.add_done_callback(
+                    lambda f: nxt._resolve(f._result, f._exc))
+            else:
+                nxt.set_result(out)
+
+        self.add_done_callback(_step)
+        return nxt
+
+
+class _Timer:
+    __slots__ = ("when", "seq", "fn", "args", "cancelled")
+
+    def __init__(self, when: float, seq: int, fn, args):
+        self.when = when
+        self.seq = seq
+        self.fn = fn
+        self.args = args
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def __lt__(self, other: "_Timer") -> bool:
+        return (self.when, self.seq) < (other.when, other.seq)
+
+
+class Reactor:
+    """The event loop.  Start with :meth:`start`, stop with :meth:`stop`."""
+
+    #: selector wait cap when idle; keeps stop() latency bounded even
+    #: if the wake pipe were to fail.
+    _IDLE_WAIT = 0.05
+
+    def __init__(self, name: str = "reactor"):
+        self._name = name
+        self._sel = selectors.DefaultSelector()
+        self._ready: List[Tuple[Callable, tuple]] = []
+        self._ready_lock = threading.Lock()
+        self._timers: List[_Timer] = []
+        self._timer_seq = 0
+        self._tick_hooks: List[Callable[[], None]] = []
+        self._handlers: Dict[int, Tuple[Any, Optional[Callable],
+                                        Optional[Callable]]] = {}
+        self._stop_flag = False
+        self._thread: Optional[threading.Thread] = None
+        # self-wake pipe: writing one byte pops the selector out of its
+        # wait so call_soon from foreign threads takes effect at once
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, None)
+        # stats surfaced by tests / admin socket
+        self.ticks = 0
+        self.callbacks_run = 0
+
+    # ------------------------------------------------------------- threads
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._run, name=self._name, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_flag = True
+        self._wake()
+        t = self._thread
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+
+    def in_reactor(self) -> bool:
+        return threading.current_thread() is self._thread
+
+    def _wake(self) -> None:
+        try:
+            self._wake_w.send(b"\0")
+        except OSError:
+            pass
+
+    # ---------------------------------------------------------- scheduling
+    def call_soon(self, fn: Callable, *args) -> None:
+        """Run ``fn(*args)`` on the reactor thread; threadsafe."""
+        with self._ready_lock:
+            self._ready.append((fn, args))
+        if not self.in_reactor():
+            self._wake()
+
+    def call_later(self, delay: float, fn: Callable, *args) -> _Timer:
+        """One-shot timer; returns a handle with ``.cancel()``."""
+        self._timer_seq += 1
+        t = _Timer(time.monotonic() + max(0.0, delay), self._timer_seq,
+                   fn, args)
+        # the heap itself is only mutated under the ready lock so the
+        # loop and foreign threads (call_later from timers is reactor-
+        # side, but OSD code may arm timers before start()) stay safe
+        with self._ready_lock:
+            heapq.heappush(self._timers, t)
+        if not self.in_reactor():
+            self._wake()
+        return t
+
+    def call_every(self, interval: float, fn: Callable, *args) -> _Timer:
+        """Periodic timer; rearms after each run until cancelled."""
+        interval = max(interval, 1e-3)
+        holder: List[_Timer] = []
+
+        def _fire() -> None:
+            try:
+                fn(*args)
+            finally:
+                if not self._stop_flag and not holder[0].cancelled:
+                    nxt = self.call_later(interval, _fire)
+                    nxt.cancelled = holder[0].cancelled
+                    holder[0] = nxt
+
+        first = self.call_later(interval, _fire)
+        holder.append(first)
+
+        class _Periodic:
+            def cancel(self_inner) -> None:
+                holder[0].cancel()
+
+        return _Periodic()  # type: ignore[return-value]
+
+    def future(self) -> Future:
+        return Future(self)
+
+    def resolved(self, value: Any = None) -> Future:
+        f = Future(self)
+        f.set_result(value)
+        return f
+
+    def add_tick_hook(self, fn: Callable[[], None]) -> None:
+        """Run ``fn()`` at the end of every tick (reactor thread)."""
+        self._tick_hooks.append(fn)
+
+    # ------------------------------------------------------------------ IO
+    def register(self, sock, on_readable: Optional[Callable[[], None]],
+                 on_writable: Optional[Callable[[], None]] = None) -> None:
+        """Watch ``sock`` for readability (and, via :meth:`want_write`,
+        writability).  Must be invoked on the reactor thread."""
+        fd = sock.fileno()
+        if fd < 0:
+            return
+        self._handlers[fd] = (sock, on_readable, on_writable)
+        try:
+            self._sel.register(sock, selectors.EVENT_READ, fd)
+        except KeyError:
+            self._sel.modify(sock, selectors.EVENT_READ, fd)
+
+    def want_write(self, sock, flag: bool) -> None:
+        """Toggle EVENT_WRITE interest for a registered socket."""
+        fd = sock.fileno()
+        if fd < 0 or fd not in self._handlers:
+            return
+        events = selectors.EVENT_READ
+        if flag:
+            events |= selectors.EVENT_WRITE
+        try:
+            self._sel.modify(sock, events, fd)
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def unregister(self, sock) -> None:
+        """Forget a socket; tolerant of sockets already closed."""
+        try:
+            key = self._sel.get_key(sock)
+            self._handlers.pop(key.data, None)
+            self._sel.unregister(sock)
+            return
+        except (KeyError, ValueError, OSError):
+            pass
+        # closed socket: fileno() is -1, look it up by identity
+        for fd, (s, _r, _w) in list(self._handlers.items()):
+            if s is sock:
+                self._handlers.pop(fd, None)
+                for key in list(self._sel.get_map().values()):
+                    if key.fileobj is sock:
+                        try:
+                            self._sel.unregister(key.fileobj)
+                        except (KeyError, ValueError, OSError):
+                            pass
+                break
+
+    # ---------------------------------------------------------------- loop
+    def _next_timeout(self) -> float:
+        with self._ready_lock:
+            if self._ready:
+                return 0.0
+            while self._timers and self._timers[0].cancelled:
+                heapq.heappop(self._timers)
+            if self._timers:
+                return max(0.0,
+                           min(self._IDLE_WAIT,
+                               self._timers[0].when - time.monotonic()))
+        return self._IDLE_WAIT
+
+    def _run(self) -> None:
+        while not self._stop_flag:
+            try:
+                events = self._sel.select(self._next_timeout())
+            except OSError:
+                # a watched fd died outside unregister(); purge and retry
+                self._purge_dead()
+                continue
+            for key, mask in events:
+                if key.fileobj is self._wake_r:
+                    try:
+                        while self._wake_r.recv(4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                    continue
+                ent = self._handlers.get(key.data)
+                if ent is None:
+                    continue
+                _sock, on_r, on_w = ent
+                try:
+                    if (mask & selectors.EVENT_READ) and on_r is not None:
+                        on_r()
+                    if (mask & selectors.EVENT_WRITE) and on_w is not None:
+                        # handler may have unregistered in on_r()
+                        if key.data in self._handlers:
+                            on_w()
+                except Exception:  # noqa: BLE001 — a conn dying must not
+                    pass           # take the whole reactor with it
+
+            self._run_timers()
+            self._drain_ready()
+            for hook in self._tick_hooks:
+                try:
+                    hook()
+                except Exception:  # noqa: BLE001
+                    pass
+            self.ticks += 1
+        # drop whatever is left; the OSD is shutting down
+        try:
+            self._sel.close()
+        except OSError:
+            pass
+        for s in (self._wake_r, self._wake_w):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _run_timers(self) -> None:
+        now = time.monotonic()
+        while True:
+            with self._ready_lock:
+                if not self._timers or self._timers[0].when > now:
+                    return
+                t = heapq.heappop(self._timers)
+            if t.cancelled:
+                continue
+            try:
+                t.fn(*t.args)
+            except Exception:  # noqa: BLE001
+                pass
+
+    def _drain_ready(self) -> None:
+        # drain until empty so continuations scheduled by this tick's
+        # ops (encode submits, commit chains) still land in the same
+        # tick and see the tick-hook flush; bounded to break livelock
+        # if a callback perpetually reschedules itself
+        for _ in range(100):
+            with self._ready_lock:
+                batch, self._ready = self._ready, []
+            if not batch:
+                return
+            for fn, args in batch:
+                self.callbacks_run += 1
+                try:
+                    fn(*args)
+                except Exception:  # noqa: BLE001
+                    pass
+
+    def _purge_dead(self) -> None:
+        for key in list(self._sel.get_map().values()):
+            sock = key.fileobj
+            if sock is self._wake_r:
+                continue
+            try:
+                dead = sock.fileno() < 0
+            except OSError:
+                dead = True
+            if dead:
+                self._handlers.pop(key.data, None)
+                try:
+                    self._sel.unregister(sock)
+                except (KeyError, ValueError, OSError):
+                    pass

@@ -916,17 +916,25 @@ class MDSDaemon(Dispatcher):
         self._cur_reqid = None       # the COMMIT carries the client
         try:                         # reqid: a resend must not get a
                                      # dup-hit before the dest exists
+            # ... but the prepare record still CARRIES it (under a
+            # key _replay_journal does not register) so a tick retry
+            # or crash replay can stamp the eventual commit with it —
+            # otherwise a client resend after EAGAIN re-executes and
+            # hits ENOENT on the already-moved source
             self._journal({"op": "rename_out_prepare",
                            "oparent": oparent, "oname": oname,
                            "ino": ent["ino"], "type": ent["type"],
                            "new": new, "peer_rank": dst_rank,
-                           "prep": prep})
+                           "prep": prep,
+                           "client_reqid":
+                               list(saved) if saved else None})
         finally:
             self._cur_reqid = saved
         self._pending_renames[prep] = {
             "oparent": oparent, "oname": oname, "ino": ent["ino"],
             "type": ent["type"], "new": new, "peer_rank": dst_rank,
-            "prep": prep, "t0": time.monotonic()}
+            "prep": prep, "t0": time.monotonic(),
+            "client_reqid": list(saved) if saved else None}
         threading.Thread(
             target=self._drive_cross_rename,
             args=(prep, self._cur_reqid, msg, conn),
@@ -944,6 +952,13 @@ class MDSDaemon(Dispatcher):
             if msg is not None:
                 self._reply(conn, msg)   # already resolved
             return
+        if reqid is None:
+            # tick retry / crash replay: recover the client reqid the
+            # prepare record journaled, so the commit still lands it
+            # in the dedup table and a client resend gets a dup-hit
+            # instead of re-executing
+            cr = rec.get("client_reqid")
+            reqid = tuple(cr) if cr else None
         try:
             reply = self._peer_request(
                 rec["peer_rank"], "peer_link",

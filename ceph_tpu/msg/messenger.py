@@ -27,10 +27,11 @@ stack each (~4 MiB), all blocked in recv() where they cost no
 scheduler time, and CPython's GIL serializes protocol work regardless
 of the IO model, so a selector rewrite changes memory shape, not
 throughput, at this scale.  The full thrash/cluster suite (incl. the
-13-daemon north-star test) passes at these counts.  An epoll reader
-loop becomes worthwhile when one daemon must hold thousands of client
-sessions; that rewrite is contained to Connection._reader_main /
-_writer_main and the socket registry, and is planned, not blocking.
+13-daemon north-star test) passes at these counts.  The selector
+rewrite exists as ceph_tpu/crimson/net.py: the crimson OSD
+(osd_backend=crimson) subclasses Connection/Messenger via the
+``conn_class`` hook below and drives the same session rules from a
+reactor with non-blocking pumps, no reader/writer threads.
 """
 from __future__ import annotations
 
@@ -457,6 +458,11 @@ class Messenger:
     """Entity-named endpoint (reference Messenger::create).  ``name``
     is "type.id" — osd.3, mon.0, client.17."""
 
+    # connection factory: subclasses substitute their own Connection
+    # (the crimson messenger swaps in a reactor-driven, non-blocking
+    # connection while reusing every session/handshake rule here)
+    conn_class = Connection
+
     def __init__(self, name: str, nonce: Optional[int] = None,
                  conf: Optional[Config] = None):
         self.name = name
@@ -601,7 +607,8 @@ class Messenger:
                     if conn.peer_addr == addr and \
                             conn.state != "closed":
                         return conn
-            conn = Connection(self, addr, lossless, connector=True)
+            conn = self.conn_class(self, addr, lossless,
+                                   connector=True)
             conn.intended_peer = peer_name
             self.conns.append(conn)
         if stale is not None:
@@ -727,8 +734,9 @@ class Messenger:
                     # retained seq state, not registered by name) —
                     # reusing a lossless session here would dedup-drop
                     # the new dial's restarted seqs
-                    conn = Connection(self, sock.getpeername(),
-                                      lossless=False, connector=False)
+                    conn = self.conn_class(self, sock.getpeername(),
+                                           lossless=False,
+                                           connector=False)
                     self.conns.append(conn)
                     in_seq = 0
                 else:
@@ -759,8 +767,9 @@ class Messenger:
                         return
                     if conn is None or conn.state == "closed" \
                             or not conn.lossless:
-                        conn = Connection(self, sock.getpeername(),
-                                          lossless=True, connector=False)
+                        conn = self.conn_class(self, sock.getpeername(),
+                                               lossless=True,
+                                               connector=False)
                         self.conns.append(conn)
                         self.conns_by_name[peer_name] = conn
                     in_seq = conn.in_seq

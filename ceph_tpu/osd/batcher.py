@@ -206,6 +206,7 @@ class EncodeBatcher:
         self._queues: Dict[Tuple, List] = {}
         self._pending_stripes = 0
         self._first_enqueue = 0.0
+        self._flush_now = False      # tick_flush(): cut the window
         self._stop = False
         # introspection (tested + surfaced via perf counters)
         self.calls = 0               # batched encode calls issued
@@ -299,6 +300,19 @@ class EncodeBatcher:
             except Exception:
                 dec = None
             cb(dec)
+
+    def tick_flush(self) -> None:
+        """Cut the coalescing window NOW: everything queued dispatches
+        as one group set without waiting out ``window_s``.  The crimson
+        reactor calls this at the end of each event-loop tick — every
+        stripe submitted by ops processed in the tick has already
+        joined the queue, so waiting longer buys no extra coalescing,
+        only latency (the classic OSD has no such natural barrier and
+        must rely on the time window).  No-op when nothing is queued."""
+        with self._cond:
+            if self._queues and not self._flush_now:
+                self._flush_now = True
+                self._cond.notify()
 
     def prewarm(self, ec_impl, sinfo: ecutil.StripeInfo) -> None:
         """Pay the pool geometry's one-time costs at backend-build
@@ -403,13 +417,14 @@ class EncodeBatcher:
                 # linger for the window so concurrent ops can join,
                 # unless the stripe budget is already met
                 deadline = self._first_enqueue + self.window_s
-                while (not self._stop
+                while (not self._stop and not self._flush_now
                        and self._pending_stripes < self.max_stripes
                        and (remaining := deadline - time.monotonic())
                        > 0):
                     self._cond.wait(remaining)
                 queues, self._queues = self._queues, {}
                 self._pending_stripes = 0
+                self._flush_now = False
             # dispatch EVERY group's device call before joining any:
             # h2d staging + MXU compute of group B overlap group A's
             # parity d2h and continuations (same double buffering the

@@ -137,7 +137,7 @@ class OSD(Dispatcher):
         self.pgs: Dict[PGid, PG] = {}
         self.pg_lock = make_lock("osd.pgs")
         self.service = OSDService(self)
-        self.msgr = Messenger(f"osd.{whoami}", conf=self.conf)
+        self.msgr = self._make_messenger()
         self.my_addr = self.msgr.bind(addr)
         self.msgr.add_dispatcher(self)
         self.monc = MonClient(self.msgr, mon_addr,
@@ -223,6 +223,11 @@ class OSD(Dispatcher):
                            "config set"):
                 self.admin_socket.register(
                     prefix, self._admin_socket_hook)
+
+    def _make_messenger(self) -> Messenger:
+        """Messenger factory — the crimson OSD substitutes its
+        reactor-driven messenger here."""
+        return Messenger(f"osd.{self.whoami}", conf=self.conf)
 
     # ------------------------------------------------------------------
     # lifecycle (reference OSD::init)
@@ -651,61 +656,67 @@ class OSD(Dispatcher):
                     traceback.print_exc()
                 continue
             conn, msg = item
-            pgid = PGid(msg.pool, msg.pgid_seed)
-            tracked = getattr(msg, "tracked", None)
-            pg = self._lookup_pg(pgid)
-            if pg is None:
-                # not our PG: tell the client to refresh its map
-                from ..msg.messages import MOSDOpReply
-                conn.send_message(MOSDOpReply(
-                    tid=msg.tid, result=-108, epoch=self.osdmap.epoch))
-                if tracked is not None:
-                    tracked.finish()
-                continue
-            is_write = any(PG._op_is_write(op) for op in msg.ops)
-            span = self.tracer.start(
-                "osd_op", msg.trace_id,
-                getattr(msg, "parent_span_id", 0)) \
-                if msg.trace_id else None
-            if span is not None:
-                span.tag("pg", str(pgid)).tag("oid", msg.oid) \
-                    .tag("write", is_write)
-                # child sub-ops (EC shard writes) parent under us
-                msg.osd_span_id = span.span_id
+            self._run_client_op(conn, msg)
+
+    def _run_client_op(self, conn: Connection, msg: MOSDOp) -> None:
+        """Dequeued client op: span + perf + PG dispatch.  Shared by
+        the classic shard workers and the crimson reactor (which runs
+        it as a continuation instead of on a pool thread)."""
+        pgid = PGid(msg.pool, msg.pgid_seed)
+        tracked = getattr(msg, "tracked", None)
+        pg = self._lookup_pg(pgid)
+        if pg is None:
+            # not our PG: tell the client to refresh its map
+            from ..msg.messages import MOSDOpReply
+            conn.send_message(MOSDOpReply(
+                tid=msg.tid, result=-108, epoch=self.osdmap.epoch))
             if tracked is not None:
-                tracked.mark_event("reached_pg")
-            t0 = time.monotonic()
-            self.perf.inc("op")
-            self.perf.inc("op_w" if is_write else "op_r")
-            if is_write:
-                self.perf.inc("op_in_bytes",
-                              sum(len(op.data or b"") for op in msg.ops))
-            try:
-                pg.do_request(msg, conn)
-            except Exception:
-                import traceback
-                traceback.print_exc()
-            finally:
-                # latency = queue dispatch time; commit waits are async
-                # (reference splits l_osd_op_*_lat similarly)
-                dt = time.monotonic() - t0
-                self.perf.tinc("op_latency", dt)
-                self.perf.tinc("op_w_latency" if is_write
-                               else "op_r_latency", dt)
-                # async writes hand the tracked op to the commit
-                # pipeline (PG._reply finishes it); parked ops (latest
-                # event "waiting ...") stay in flight for
-                # dump_blocked_ops until requeued.  finish() is
-                # idempotent, so a synchronous reply that already
-                # retired the op is a no-op here.
-                if tracked is not None and \
-                        not getattr(msg, "_tracked_async", False) and \
-                        not (tracked.events and
-                             tracked.events[-1][1].startswith(
-                                 "waiting")):
-                    tracked.finish()
-                if span is not None:
-                    span.finish()
+                tracked.finish()
+            return
+        is_write = any(PG._op_is_write(op) for op in msg.ops)
+        span = self.tracer.start(
+            "osd_op", msg.trace_id,
+            getattr(msg, "parent_span_id", 0)) \
+            if msg.trace_id else None
+        if span is not None:
+            span.tag("pg", str(pgid)).tag("oid", msg.oid) \
+                .tag("write", is_write)
+            # child sub-ops (EC shard writes) parent under us
+            msg.osd_span_id = span.span_id
+        if tracked is not None:
+            tracked.mark_event("reached_pg")
+        t0 = time.monotonic()
+        self.perf.inc("op")
+        self.perf.inc("op_w" if is_write else "op_r")
+        if is_write:
+            self.perf.inc("op_in_bytes",
+                          sum(len(op.data or b"") for op in msg.ops))
+        try:
+            pg.do_request(msg, conn)
+        except Exception:
+            import traceback
+            traceback.print_exc()
+        finally:
+            # latency = queue dispatch time; commit waits are async
+            # (reference splits l_osd_op_*_lat similarly)
+            dt = time.monotonic() - t0
+            self.perf.tinc("op_latency", dt)
+            self.perf.tinc("op_w_latency" if is_write
+                           else "op_r_latency", dt)
+            # async writes hand the tracked op to the commit
+            # pipeline (PG._reply finishes it); parked ops (latest
+            # event "waiting ...") stay in flight for
+            # dump_blocked_ops until requeued.  finish() is
+            # idempotent, so a synchronous reply that already
+            # retired the op is a no-op here.
+            if tracked is not None and \
+                    not getattr(msg, "_tracked_async", False) and \
+                    not (tracked.events and
+                         tracked.events[-1][1].startswith(
+                             "waiting")):
+                tracked.finish()
+            if span is not None:
+                span.finish()
 
     # ------------------------------------------------------------------
     # daemon-direct commands (reference 'ceph tell osd.N', MCommand;
@@ -837,35 +848,42 @@ class OSD(Dispatcher):
     def _heartbeat_loop(self) -> None:
         interval = self.conf["osd_heartbeat_interval"]
         while not self._stop.wait(interval):
-            grace = self.conf["osd_heartbeat_grace"]
-            now = time.monotonic()
-            for peer in self._hb_peers():
-                last = self._hb_last_rx.get(peer)
-                if last is None:
-                    self._hb_last_rx[peer] = now   # grace starts now
-                elif now - last > grace:
-                    reported = self._hb_reported.get(peer, 0)
-                    if now - reported > grace:
-                        self._hb_reported[peer] = now
-                        self.log.dout(1, f"osd.{peer} silent "
-                                      f"{now - last:.1f}s, reporting")
-                        try:
-                            self.monc.report_failure(
-                                peer, self.whoami, now - last,
-                                self.osdmap.epoch)
-                        except Exception:
-                            pass
-                pad = self.conf["osd_heartbeat_min_size"]
-                self.send_osd(peer, MOSDPing(
-                    op=MOSDPing.PING, from_osd=self.whoami,
-                    epoch=self.osdmap.epoch, stamp=now,
-                    padding="x" * pad))
-            # forget peers no longer up (map took them out)
-            up = set(self._hb_peers())
-            for peer in list(self._hb_last_rx):
-                if peer not in up:
-                    self._hb_last_rx.pop(peer, None)
-                    self._hb_reported.pop(peer, None)
+            self._heartbeat_once()
+
+    def _heartbeat_once(self) -> None:
+        """One heartbeat round: ping peers, report the silent ones.
+        Shared by the classic heartbeat thread and the crimson
+        reactor's heartbeat timer — the grace/report behavior is
+        IDENTICAL across backends by construction."""
+        grace = self.conf["osd_heartbeat_grace"]
+        now = time.monotonic()
+        for peer in self._hb_peers():
+            last = self._hb_last_rx.get(peer)
+            if last is None:
+                self._hb_last_rx[peer] = now       # grace starts now
+            elif now - last > grace:
+                reported = self._hb_reported.get(peer, 0)
+                if now - reported > grace:
+                    self._hb_reported[peer] = now
+                    self.log.dout(1, f"osd.{peer} silent "
+                                  f"{now - last:.1f}s, reporting")
+                    try:
+                        self.monc.report_failure(
+                            peer, self.whoami, now - last,
+                            self.osdmap.epoch)
+                    except Exception:
+                        pass
+            pad = self.conf["osd_heartbeat_min_size"]
+            self.send_osd(peer, MOSDPing(
+                op=MOSDPing.PING, from_osd=self.whoami,
+                epoch=self.osdmap.epoch, stamp=now,
+                padding="x" * pad))
+        # forget peers no longer up (map took them out)
+        up = set(self._hb_peers())
+        for peer in list(self._hb_last_rx):
+            if peer not in up:
+                self._hb_last_rx.pop(peer, None)
+                self._hb_reported.pop(peer, None)
 
     # ------------------------------------------------------------------
     # recovery (reference start_recovery_ops + recovery_wq)
@@ -883,56 +901,67 @@ class OSD(Dispatcher):
             self._recovery_kick.clear()
             if self._stop.is_set():
                 return
-            with self.pg_lock:
-                pgs = list(self.pgs.values())
-            # osd_max_backfills: bound the PGs QUEUED for recovery at
-            # once per daemon (reference backfill reservations) so one
-            # OSD's rebuild never floods every PG simultaneously.
-            # Only count transient queued state — an in-backend
-            # recovery op wedged on a dead peer must not eat a slot
-            # forever (its PG re-queues via the tick's stuck-retry)
-            slots = self.conf["osd_max_backfills"] * 4
-            active_recovering = sum(
-                1 for pg in pgs
-                if getattr(pg, "_recovery_queued", False))
-            for pg in pgs:
-                if self._stop.is_set():
-                    return
-                if active_recovering >= slots:
-                    break                # next kick continues
-                try:
-                    with pg.lock:
-                        need = pg.is_primary() and \
-                            pg.state == STATE_ACTIVE and \
-                            (pg.num_missing() > 0
-                             or pg.waiting_for_degraded)
-                    if need:
-                        self.queue_recovery_item(pg)
-                        active_recovering += 1
-                except Exception:
-                    import traceback
-                    traceback.print_exc()
+            self._recovery_scan()
+
+    def _recovery_scan(self) -> None:
+        """One pass over hosted PGs, queueing recovery items up to the
+        backfill budget.  Shared by the classic recovery thread and
+        the crimson reactor's recovery timer."""
+        with self.pg_lock:
+            pgs = list(self.pgs.values())
+        # osd_max_backfills: bound the PGs QUEUED for recovery at
+        # once per daemon (reference backfill reservations) so one
+        # OSD's rebuild never floods every PG simultaneously.
+        # Only count transient queued state — an in-backend
+        # recovery op wedged on a dead peer must not eat a slot
+        # forever (its PG re-queues via the tick's stuck-retry)
+        slots = self.conf["osd_max_backfills"] * 4
+        active_recovering = sum(
+            1 for pg in pgs
+            if getattr(pg, "_recovery_queued", False))
+        for pg in pgs:
+            if self._stop.is_set():
+                return
+            if active_recovering >= slots:
+                break                    # next kick continues
+            try:
+                with pg.lock:
+                    need = pg.is_primary() and \
+                        pg.state == STATE_ACTIVE and \
+                        (pg.num_missing() > 0
+                         or pg.waiting_for_degraded)
+                if need:
+                    self.queue_recovery_item(pg)
+                    active_recovering += 1
+            except Exception:
+                import traceback
+                traceback.print_exc()
 
     # ------------------------------------------------------------------
     # tick: pg stats + stuck-peering retry
     # ------------------------------------------------------------------
     def _tick_loop(self) -> None:
         interval = self.conf["osd_tick_interval"]
-        last_report = 0.0
         while not self._stop.wait(interval):
-            # osd_mon_report_interval throttles stat traffic on big
-            # clusters; 0 reports every tick (test default)
-            min_gap = self.conf["osd_mon_report_interval"]
-            if time.monotonic() - last_report >= min_gap:
-                last_report = time.monotonic()
-                self._send_pg_stats()
-            self._retry_stuck_peering()
-            self._renotify_strays()
-            self._maybe_schedule_scrub()
-            self._maybe_trim_snaps()
-            self._maybe_trim_pg_logs()
-            self._maybe_cache_agent()
-            self._maybe_reboot()
+            self._tick_once()
+
+    def _tick_once(self) -> None:
+        """One maintenance tick.  Shared by the classic tick thread
+        and the crimson reactor's tick timer."""
+        # osd_mon_report_interval throttles stat traffic on big
+        # clusters; 0 reports every tick (test default)
+        min_gap = self.conf["osd_mon_report_interval"]
+        if time.monotonic() - getattr(self, "_last_stat_report",
+                                      0.0) >= min_gap:
+            self._last_stat_report = time.monotonic()
+            self._send_pg_stats()
+        self._retry_stuck_peering()
+        self._renotify_strays()
+        self._maybe_schedule_scrub()
+        self._maybe_trim_snaps()
+        self._maybe_trim_pg_logs()
+        self._maybe_cache_agent()
+        self._maybe_reboot()
 
     def _renotify_strays(self) -> None:
         """Stray copies (split children on the parent's holders,
@@ -1071,11 +1100,14 @@ class OSD(Dispatcher):
                 budget -= 1
                 deep = deep_iv > 0 and \
                     now - pg.scrubber.last_deep_scrub >= deep_iv
-                # scrub-class work goes through the scheduler so it
-                # never outruns client IO (reference PGScrub items)
-                self._shard_queues[self._shard_of_pg(pg)].enqueue(
-                    "scrub",
-                    lambda p=pg, d=deep: self._start_scrub(p, d))
+                self._queue_scrub(pg, deep)
+
+    def _queue_scrub(self, pg: PG, deep: bool) -> None:
+        """Scrub-class work goes through the scheduler so it never
+        outruns client IO (reference PGScrub items); the crimson OSD
+        queues it on the reactor instead."""
+        self._shard_queues[self._shard_of_pg(pg)].enqueue(
+            "scrub", lambda p=pg, d=deep: self._start_scrub(p, d))
 
     def _start_scrub(self, pg: PG, deep: bool) -> None:
         with pg.lock:

@@ -58,6 +58,7 @@ INFO_KEY = "info"
 SPLIT_KEY = "split_pgnum"       # pool pg_num this PG last split at
 STRAY_SHARD_KEY = "stray_shard"  # EC shard identity kept while stray
 SPLIT_SRC_KEY = "split_src"     # parent shard whose chunks we hold
+MERGE_SRC_KEY = "merge_srcs"    # all child shards a merge folded here
 SPLIT_ADOPTED_KEY = "split_adopted"  # a local parent split fed us
 MISSING_KEY = "missing"         # persisted pg_missing_t (reference
                                 # PGLog write_log_and_missing)
@@ -172,6 +173,12 @@ class PG:
         # (audited on activation) while its s-chunks serve as a
         # recovery source.
         self._split_source_shard = -1
+        # EC merge: ALL distinct child shards whose chunks a merge
+        # folded into our collections (a parent may absorb several
+        # children, each at a different position).  Every one is
+        # audited at merge time and re-audited on interval change
+        # until recovery homes our own position's chunks.
+        self._merge_source_shards: List[int] = []
         # True once a local parent split adopted this copy: its content
         # (even empty) is the ancestry's authoritative answer for this
         # child seed
@@ -309,6 +316,8 @@ class PG:
                SPLIT_KEY: str(self._last_split_pgnum).encode(),
                STRAY_SHARD_KEY: str(self._stray_shard).encode(),
                SPLIT_SRC_KEY: str(self._split_source_shard).encode(),
+               MERGE_SRC_KEY: _json.dumps(
+                   self._merge_source_shards).encode(),
                SPLIT_ADOPTED_KEY:
                    (b"1" if self._split_adopted else b"0")}
         txn.omap_setkeys(self.coll, self._meta_obj(), kvs)
@@ -354,6 +363,12 @@ class PG:
             raw = omap.get(SPLIT_SRC_KEY)
             if raw and int(raw) >= 0:
                 self._split_source_shard = int(raw)
+            raw = omap.get(MERGE_SRC_KEY)
+            if raw:
+                merged = _json.loads(raw.decode())
+                if merged:
+                    self._merge_source_shards = sorted(
+                        set(self._merge_source_shards) | set(merged))
             raw = omap.get(SPLIT_ADOPTED_KEY)
             if raw == b"1":
                 self._split_adopted = True
@@ -579,18 +594,30 @@ class PG:
                 # chunks keep their CHILD shard identity)
                 if shards:
                     self._stray_shard = sorted(shards)[0]
-            elif shards and self.own_shard not in shards:
-                # EC acting member whose folded chunks sit at the
-                # CHILD acting position, not ours: our position data
-                # is missing until recovery reconstructs it, while the
-                # folded chunks serve as a shard-qualified recovery
-                # source — the split audit machinery in reverse
-                # (reference merge_from + the distinguished-position
-                # rule of ecbackend.rst; chunk bytes are portable
-                # between PGs because shard s of an object encodes
-                # identically wherever it is placed)
-                self._split_source_shard = sorted(shards)[0]
-                self._audit_split_shard(self.service.get_osdmap())
+            elif shards:
+                # EC acting member: a merge may fold chunks from
+                # SEVERAL children, each at its own CHILD acting
+                # position.  Any position other than ours means our
+                # position data is missing until recovery
+                # reconstructs it, while each folded shard serves as
+                # a shard-qualified recovery source — the split audit
+                # machinery in reverse (reference merge_from + the
+                # distinguished-position rule of ecbackend.rst; chunk
+                # bytes are portable between PGs because shard s of
+                # an object encodes identically wherever it is
+                # placed).  Audit once per DISTINCT folded shard —
+                # including one that equals own_shard (its audit is
+                # the own-position existence check) — so mispositioned
+                # chunks are caught now, not deferred to scrub.
+                self._merge_source_shards = sorted(
+                    set(self._merge_source_shards) | shards)
+                foreign = [s for s in sorted(shards)
+                           if s != self.own_shard]
+                if foreign:
+                    self._split_source_shard = foreign[0]
+                osdmap_now = self.service.get_osdmap()
+                for s in sorted(shards):
+                    self._audit_split_shard(osdmap_now, src=s)
             self._persist_pgmeta()
             if self.is_primary():
                 # our log advanced: re-peer so activation pushes the
@@ -692,15 +719,18 @@ class PG:
             self.missing = MissingSet()
         self.service.forget_pg(self.pgid)
 
-    def _audit_split_shard(self, osdmap: OSDMap) -> None:
-        """EC child acting member after a split: our physical chunks
-        came from parent shard ``_split_source_shard``, but our acting
-        POSITION may differ — position data we don't physically hold
-        is missing (recoverable by decode), while the chunks we do
-        hold are advertised to the primary as a shard-qualified
-        source.  Idempotent (existence-checked), so re-running on
-        every interval is safe and converges to a no-op once recovery
-        lands our position's chunks."""
+    def _audit_split_shard(self, osdmap: OSDMap,
+                           src: int = None) -> None:
+        """EC acting member holding chunks from a foreign shard
+        position (split child whose chunks came from parent shard
+        ``_split_source_shard``, or merge parent that folded a child
+        shard ``src``): our acting POSITION may differ — position
+        data we don't physically hold is missing (recoverable by
+        decode), while the chunks we do hold are advertised to the
+        primary as a shard-qualified source.  Idempotent
+        (existence-checked), so re-running on every interval is safe
+        and converges to a no-op once recovery lands our position's
+        chunks."""
         own = self.own_shard
         if own < 0:
             return
@@ -713,7 +743,8 @@ class PG:
                     audited += 1
         if audited:
             self._persist_pgmeta()
-        src = self._split_source_shard
+        if src is None:
+            src = self._split_source_shard
         if src == own:
             return                   # lucky position match: data home
         objects = {}
@@ -849,8 +880,14 @@ class PG:
                 self.maybe_notify_stray(osdmap)
                 return
             self._stray_shard = -1       # back in the acting set
-            if self.pool.is_erasure() and self._split_source_shard >= 0:
-                self._audit_split_shard(osdmap)
+            if self.pool.is_erasure():
+                if self._split_source_shard >= 0:
+                    self._audit_split_shard(osdmap)
+                # merge-folded shards are re-audited per distinct
+                # source until recovery homes our position's chunks
+                for s in self._merge_source_shards:
+                    if s != self._split_source_shard:
+                        self._audit_split_shard(osdmap, src=s)
             # back in the acting set with a shard collection: apply
             # the backend sub-ops that raced this map (queued by
             # ms_dispatch while own_shard was -1)

@@ -44,15 +44,29 @@ def main(argv: List[str] = None) -> int:
     p.add_argument("--ec-k", type=int, default=2)
     p.add_argument("--ec-m", type=int, default=1)
     p.add_argument("--ec-plugin", default="tpu")
+    p.add_argument("--osd-backend", choices=("classic", "crimson"),
+                   default="classic",
+                   help="OSD execution model: classic sharded thread "
+                        "pools or the crimson single-threaded reactor; "
+                        "use --crimson-osds for a mixed cluster")
+    p.add_argument("--crimson-osds", default="",
+                   help="comma-separated OSD ids to run crimson while "
+                        "the rest stay classic (side-by-side compare)")
     p.add_argument("--out-conf", help="file to write the mon address to "
                    "(default <data-dir>/mon.addr)")
     ns = p.parse_args(argv)
 
-    from ..cluster import Cluster
+    from ..cluster import Cluster, test_config
 
+    conf = test_config(osd_backend=ns.osd_backend)
     cluster = Cluster(n_osds=ns.num_osds, data_dir=ns.data_dir,
-                      n_mons=ns.num_mons, with_mgr=ns.mgr,
+                      conf=conf, n_mons=ns.num_mons, with_mgr=ns.mgr,
                       store_kind=ns.objectstore)
+    # mixed-backend cluster: the listed ids boot crimson, others follow
+    # --osd-backend (overrides are sticky across kill/revive)
+    for tok in ns.crimson_osds.split(","):
+        if tok.strip():
+            cluster.backend_overrides[int(tok)] = "crimson"
     cluster.start()
     host, port = cluster.mon_addr
     addr = f"{host}:{port}"

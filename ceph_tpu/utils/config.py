@@ -83,6 +83,13 @@ def _opts() -> List[Option]:
                description="CPU bit-plane path when no TPU is present "
                            "(monitors validate profiles without devices)"),
         # -- osd (reference options.cc:2869-2901,2478,3159) ---------------
+        Option("osd_backend", str, "classic",
+               enum_allowed=("classic", "crimson"),
+               description="OSD execution model: classic sharded "
+                           "thread pools, or the crimson single-"
+                           "threaded reactor (reference crimson-osd); "
+                           "both speak the same wire protocol and can "
+                           "mix within one cluster"),
         Option("osd_op_num_shards", int, 5, min=1,
                description="sharded op queue shard count"),
         Option("osd_op_queue", str, "mclock_scheduler",

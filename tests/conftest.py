@@ -31,3 +31,10 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; the slow tier holds long thrash
+    # soaks (e.g. the crimson RadosModel run) that CI runs separately
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 run")
