@@ -651,16 +651,17 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         # scale the grace by measured machine speed so scheduler
         # starvation doesn't fabricate failures (r4's k8m4 runs died
         # to exactly this: grace 6.0 < GIL stalls under 12x8 MiB
-        # writes); widen the batcher window to the op-arrival spread
-        # GIL scheduling produces so concurrent big-object ops
-        # actually meet inside one batched call (latency-for-batch,
-        # the coalescing thesis); enough PGs that a primary can hold
-        # several in-flight encodes (the per-PG pipeline admits one
-        # encode at a time)
+        # writes); keep the batcher base window SHORT now that whole
+        # objects arrive as single pre-batched encode requests and the
+        # admission-aware window grows itself under real queue
+        # pressure — a wide static window only adds latency per
+        # segment of the pipelined fanout; enough PGs that a primary
+        # can hold several in-flight encodes (the per-PG pipeline
+        # admits one encode at a time)
         overrides.update(osd_heartbeat_interval=2.0,
                          osd_heartbeat_grace=max(12.0, 8.0 * f),
                          osd_pool_default_pg_num=32,
-                         ec_tpu_queue_window_us=30000)
+                         ec_tpu_queue_window_us=3000)
     if plugin == "tpu":
         # pay the device-kernel compiles for this geometry OUTSIDE the
         # cluster: a 20-40 s jit inside 13 single-core daemons starves
@@ -677,6 +678,35 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                 codec.encode_batch_async(z).wait()
             except Exception:
                 break                # device trouble: CPU twin serves
+        # characterize device vs CPU-twin encode up front and PIN the
+        # routing crossover: the in-cluster adaptive learner starts
+        # from an async prewarm race, and losing that race routes big
+        # batches to the device even on hosts where the GIL-releasing
+        # native twin is faster (run-to-run throughput then swings
+        # 3-4x on identical config)
+        try:
+            from ceph_tpu.osd.batcher import EncodeBatcher
+            from ceph_tpu.osd import ecutil as osd_ecutil
+            probe = np.random.default_rng(7).integers(
+                0, 256, (256, int(k), 4096), dtype=np.uint8)
+            t = time.perf_counter()
+            codec.encode_batch_async(probe).wait()
+            dev_s = time.perf_counter() - t
+            tb = EncodeBatcher({})
+            twin = tb.cpu_twin(
+                codec, osd_ecutil.StripeInfo(int(k), int(k) * 4096))
+            t = time.perf_counter()
+            twin.encode_batch(probe)
+            twin_s = time.perf_counter() - t
+            tb.stop(drain=0)
+            if twin_s < dev_s:
+                # twin wins at this size: send everything to it (the
+                # batcher's periodic probe still device-routes ~1/16
+                # of groups, so learning can re-lower the pin if the
+                # device starts winning)
+                overrides["ec_tpu_min_device_bytes"] = 256 << 20
+        except Exception:
+            pass                     # calibration is best-effort
     with Cluster(n_osds=n_osds, conf=test_config(**overrides)) as c:
         for i in range(n_osds):
             c.wait_for_osd_up(i, 30)
@@ -692,13 +722,21 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         # mostly find hot caches
         for i in range(2):
             io.write_full(f"warm{i}", blob)
+        from ceph_tpu.utils import copytrack
+        copytrack.reset()
         t0 = time.perf_counter()
         comps = [io.aio_write_full(f"b{i}", blob)
                  for i in range(n_objs)]
         assert all(comp.wait(60 * f) == 0 for comp in comps)
         write_s = time.perf_counter() - t0
+        snap = copytrack.snapshot()
         stats = {"calls": 0, "reqs": 0, "coalesced": 0, "cpu": 0,
-                 "cpu_calls": 0, "write_wall_s": write_s}
+                 "cpu_calls": 0, "write_wall_s": write_s,
+                 "bytes_copied": snap["bytes"],
+                 "copy_sites": {k: v["bytes"] for k, v in
+                                snap["sites"].items()},
+                 "queue_depth_hwm": 0, "window_grows": 0,
+                 "window_cuts": 0}
         # per-stage attribution: the batcher's cumulative stage
         # clocks (queue-wait through d2h) plus the commit leg from
         # each primary's op-tracker timeline (ec:encoded ->
@@ -713,6 +751,11 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                 stats["coalesced"] += b.reqs_coalesced
                 stats["cpu"] += b.cpu_reqs
                 stats["cpu_calls"] += b.cpu_calls
+                stats["queue_depth_hwm"] = max(
+                    stats["queue_depth_hwm"],
+                    getattr(b, "queue_depth_hwm", 0))
+                stats["window_grows"] += getattr(b, "window_grows", 0)
+                stats["window_cuts"] += getattr(b, "window_cuts", 0)
                 for s in ("queue_wait", "batch_form", "h2d",
                           "device", "d2h"):
                     stages[s] += getattr(b, "stage_seconds",
@@ -787,6 +830,15 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
             "vs_baseline": round(sum(scaled.values()) / wall, 3),
             "stages": scaled,
             "op_seconds": {s: round(v, 4) for s, v in att.items()},
+            "bytes_copied": st.get("bytes_copied", 0),
+            "copied_per_payload": round(
+                st.get("bytes_copied", 0) / (n_objs * obj_bytes), 3),
+            "copy_sites": st.get("copy_sites", {}),
+            "routing": {"device_reqs": st["reqs"] - st["cpu"],
+                        "cpu_twin_reqs": st["cpu"]},
+            "queue_depth_hwm": st.get("queue_depth_hwm", 0),
+            "window_grows": st.get("window_grows", 0),
+            "window_cuts": st.get("window_cuts", 0),
         }), flush=True)
     emit(f"OSD rebuild MB/s (k=8 m=4 pool, kill osd with data loss; "
          f"recovery decodes batched through the OSD coalescer: "
@@ -821,6 +873,23 @@ def bench_cluster_crimson(n_objs=26, obj_bytes=8 << 20):
                     for s, v in att.items()}
         return {}
 
+    def _side(w, r, st):
+        return {"write_mbps": round(w, 2),
+                "rebuild_mbps": round(r, 2),
+                "batcher": {k2: st[k2] for k2 in
+                            ("calls", "reqs", "coalesced",
+                             "cpu_calls")},
+                "stages": _split(st),
+                "bytes_copied": st.get("bytes_copied", 0),
+                "copied_per_payload": round(
+                    st.get("bytes_copied", 0) / (n_objs * obj_bytes),
+                    3),
+                "routing": {"device_reqs": st["reqs"] - st["cpu"],
+                            "cpu_twin_reqs": st["cpu"]},
+                "queue_depth_hwm": st.get("queue_depth_hwm", 0),
+                "window_grows": st.get("window_grows", 0),
+                "window_cuts": st.get("window_cuts", 0)}
+
     emit(f"cluster write MB/s (13-OSD vstart, pool plugin=tpu k=8 "
          f"m=4, {n_objs}x{obj_bytes >> 20} MiB concurrent writes, "
          f"osd_backend=crimson reactor data path; batcher: "
@@ -835,18 +904,8 @@ def bench_cluster_crimson(n_objs=26, obj_bytes=8 << 20):
                   "each backend)",
         "value": round(w_cr, 2), "unit": "MB/s",
         "vs_baseline": round(w_cr / w_cl, 3) if w_cl else 0.0,
-        "classic": {"write_mbps": round(w_cl, 2),
-                    "rebuild_mbps": round(r_cl, 2),
-                    "batcher": {k2: st_cl[k2] for k2 in
-                                ("calls", "reqs", "coalesced",
-                                 "cpu_calls")},
-                    "stages": _split(st_cl)},
-        "crimson": {"write_mbps": round(w_cr, 2),
-                    "rebuild_mbps": round(r_cr, 2),
-                    "batcher": {k2: st_cr[k2] for k2 in
-                                ("calls", "reqs", "coalesced",
-                                 "cpu_calls")},
-                    "stages": _split(st_cr)},
+        "classic": _side(w_cl, r_cl, st_cl),
+        "crimson": _side(w_cr, r_cr, st_cr),
     }), flush=True)
 
 

@@ -193,10 +193,26 @@ class StripedIoCtx:
             size = 0
         self._check_file_size(offset + len(data))
         completions = []
+        # slice per-extent buffers out of ONE view of the caller's
+        # data: a single-run extent (the whole-object/full-stripe case)
+        # rides as a zero-copy view all the way to the wire; multi-run
+        # extents gather once into a preallocated bytearray.  The view
+        # pins the caller's buffer until the ops complete — callers
+        # must not mutate `data` while a write is in flight.
+        src = memoryview(data)
         for ext in file_to_extents(soid, layout, offset, len(data)):
-            buf = b"".join(
-                data[lo - offset:lo - offset + ln]
-                for lo, ln in ext.buffer_extents)
+            if len(ext.buffer_extents) == 1:
+                lo, ln = ext.buffer_extents[0]
+                buf = src[lo - offset:lo - offset + ln]
+            else:
+                from ..utils import copytrack
+                buf = bytearray(ext.length)
+                dst = memoryview(buf)
+                pos = 0
+                for lo, ln in ext.buffer_extents:
+                    dst[pos:pos + ln] = src[lo - offset:lo - offset + ln]
+                    pos += ln
+                copytrack.note_copy(ext.length, "striper.write_gather")
             completions.append(self.ioctx.rados.objecter.submit(
                 self.ioctx.pool_id, ext.oid,
                 [self._write_op(ext.offset, buf)]))
@@ -229,17 +245,24 @@ class StripedIoCtx:
                 self.ioctx.pool_id, ext.oid,
                 [OSDOp("read", offset=ext.offset, length=ext.length)])
             pending.append((ext, c))
+        out_mv = memoryview(out)
         for ext, c in pending:
             res = c.wait(self.ioctx.rados.op_timeout)
             if res < 0 and res != -2:
                 raise RadosError(-res, f"striped read: {res}")
             data = c.reply.out_data[0] if res >= 0 else b""
+            # fill the preallocated result through views: no per-chunk
+            # intermediate slices, one direct copy reply -> result
+            src = memoryview(data)
             pos = 0
             for lo, ln in ext.buffer_extents:
-                chunk = data[pos:pos + ln]
-                out[lo - offset:lo - offset + len(chunk)] = chunk
+                n = min(ln, len(src) - pos)
+                if n > 0:
+                    out_mv[lo - offset:lo - offset + n] = \
+                        src[pos:pos + n]
                 pos += ln
-        return bytes(out)
+        out_mv.release()
+        return bytes(out)  # copycheck: ok - immutable result at the API boundary
 
     def stat(self, soid: str) -> Tuple[int, Layout]:
         """-> (logical size, layout) (reference rados_striper_stat)."""

@@ -25,11 +25,13 @@ import random
 import socket
 from typing import Optional
 
+from collections import deque
+
 from ..msg.message import (CRC_LEN, HEADER_LEN, decode_frame_body,
-                           decode_frame_header, encode_frame)
+                           decode_frame_header, encode_frame_parts)
 from ..msg.messages import MAck
 from ..msg.messenger import (ACK_EVERY_BYTES, ACK_EVERY_MSGS, MAX_FRAME,
-                             Connection, Messenger)
+                             _IOV_BATCH, Connection, Messenger)
 from ..utils.encoding import DecodeError
 from .reactor import Reactor
 
@@ -43,10 +45,14 @@ _RECV_ROUNDS = 64
 class CrimsonConnection(Connection):
     """A ``Connection`` whose pumps are reactor callbacks, not threads.
 
-    Reactor-owned fields (``_reg_sock``, ``_rbuf``, ``_wbuf``,
+    Reactor-owned fields (``_reg_sock``, ``_rbuf``, ``_wq``,
     ``_wants_write``) are touched only on the reactor thread; shared
     session state (queues, seqs, state) stays under the inherited lock
-    because handshake/control threads still mutate it."""
+    because handshake/control threads still mutate it.
+
+    The write queue is a deque of frame-part buffers (iovecs) drained
+    by scatter-gather ``sendmsg`` — large payload views ride from the
+    encoder to the kernel without being copied into a staging buffer."""
 
     def __init__(self, msgr: "CrimsonMessenger", peer_addr, lossless,
                  connector):
@@ -57,7 +63,7 @@ class CrimsonConnection(Connection):
         self._reg_sock: Optional[socket.socket] = None
         self._reg_gen = 0
         self._rbuf = bytearray()
-        self._wbuf = bytearray()
+        self._wq: deque = deque()       # pending iovecs (memoryviews)
         self._wants_write = False
 
     @property
@@ -85,7 +91,7 @@ class CrimsonConnection(Connection):
         self._reg_sock = sock
         self._reg_gen = gen
         self._rbuf.clear()
-        self._wbuf.clear()
+        self._wq.clear()
         self._wants_write = False
         self.reactor.register(sock, self._on_readable, self._on_writable)
         self._pump_writes()             # flush traffic queued meanwhile
@@ -94,7 +100,7 @@ class CrimsonConnection(Connection):
         if self._reg_sock is sock:
             self._reg_sock = None
             self._rbuf.clear()
-            self._wbuf.clear()
+            self._wq.clear()
             self._wants_write = False
         self.reactor.unregister(sock)
 
@@ -155,20 +161,31 @@ class CrimsonConnection(Connection):
             if inject and random.randrange(inject) == 0:
                 self._io_error(sock, gen)
                 return
-            self._wbuf += encode_frame(
-                msg, compressor=self.msgr.compressor,
-                compress_min=self.msgr.compress_min,
-                crc_data=self.msgr.conf["ms_crc_data"])
+            for part in encode_frame_parts(
+                    msg, compressor=self.msgr.compressor,
+                    compress_min=self.msgr.compress_min,
+                    crc_data=self.msgr.conf["ms_crc_data"]):
+                self._wq.append(part if isinstance(part, memoryview)
+                                else memoryview(part))
         try:
-            while self._wbuf:
-                n = sock.send(self._wbuf)
-                del self._wbuf[:n]
+            wq = self._wq
+            while wq:
+                n = sock.sendmsg([wq[i] for i in
+                                  range(min(len(wq), _IOV_BATCH))])
+                while n > 0 and wq:
+                    first = len(wq[0])
+                    if n >= first:
+                        n -= first
+                        wq.popleft()
+                    else:
+                        wq[0] = wq[0][n:]
+                        n = 0
         except (BlockingIOError, InterruptedError):
             pass
         except (OSError, ConnectionError):
             self._io_error(sock, gen)
             return
-        want = bool(self._wbuf)
+        want = bool(self._wq)
         if want != self._wants_write:
             self._wants_write = want
             self.reactor.want_write(sock, want)
@@ -200,7 +217,7 @@ class CrimsonConnection(Connection):
         while True:
             if len(buf) < HEADER_LEN:
                 return
-            head = bytes(buf[:HEADER_LEN])
+            head = bytes(buf[:HEADER_LEN])  # copycheck: ok - 18-byte header
             try:
                 mtype, seq, plen = decode_frame_header(head)
                 if plen > MAX_FRAME:
@@ -213,8 +230,13 @@ class CrimsonConnection(Connection):
             total = HEADER_LEN + plen + CRC_LEN
             if len(buf) < total:
                 return
-            payload = bytes(buf[HEADER_LEN:HEADER_LEN + plen])
-            crc = bytes(buf[HEADER_LEN + plen:total])
+            # single-copy extraction through a view (a bytearray slice
+            # would copy once into a bytearray and again into bytes);
+            # the view must be released before the bytearray resizes
+            view = memoryview(buf)
+            payload = bytes(view[HEADER_LEN:HEADER_LEN + plen])  # copycheck: ok - rx reassembly into immutable frame
+            crc = bytes(view[HEADER_LEN + plen:total])  # copycheck: ok - 4-byte trailer crc
+            view.release()
             del buf[:total]
             try:
                 msg = decode_frame_body(mtype, seq, head, payload, crc)

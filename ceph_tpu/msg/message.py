@@ -19,6 +19,7 @@ import struct
 import zlib
 from typing import Callable, Dict, Optional, Type
 
+from ..utils import copytrack
 from ..utils.encoding import DecodeError
 
 FRAME_MAGIC = 0x43455048  # "CEPH" — version 2 framing
@@ -49,6 +50,12 @@ class Message(abc.ABC):
     @abc.abstractmethod
     def encode_payload(self) -> bytes: ...
 
+    def encode_payload_parts(self) -> list:
+        """Payload as an iovec-style list of buffers for scatter-gather
+        sends.  Hot-path messages override this to keep large data
+        buffers by reference; the default materialises once."""
+        return [self.encode_payload()]
+
     @classmethod
     @abc.abstractmethod
     def decode_payload(cls, buf: bytes) -> "Message": ...
@@ -66,25 +73,53 @@ class Message(abc.ABC):
 COMPRESSED_FLAG = 0x8000
 
 
-def encode_frame(msg: Message, compressor=None,
-                 compress_min: int = 4096,
-                 crc_data: bool = True) -> bytes:
-    payload = msg.encode_payload()
+def encode_frame_parts(msg: Message, compressor=None,
+                       compress_min: int = 4096,
+                       crc_data: bool = True) -> list:
+    """Frame as an iovec list [head, *payload, crc] for scatter-gather
+    ``socket.sendmsg`` — no payload byte is copied on the plain path.
+    The CRC is folded incrementally over the parts, so it is identical
+    to the joined-frame CRC."""
+    parts = msg.encode_payload_parts()
+    plen = sum(len(p) for p in parts)
     mtype = msg.TYPE
-    if compressor is not None and len(payload) >= compress_min:
+    if compressor is not None and plen >= compress_min:
+        # compressors need one contiguous input; this join is the
+        # price of compression, not of the framing
+        payload = parts[0] if len(parts) == 1 else b"".join(parts)
+        if not isinstance(payload, bytes):
+            payload = bytes(payload)
+        if len(parts) > 1:
+            copytrack.note_copy(plen, "msg.compress_join")
         comp = compressor.compress(payload)
         # require a REAL win, not a few bytes: a sub-percent size edge
         # is not worth the receiver's decompress cost (reference's
         # required-ratio idea, e.g. compression_required_ratio)
-        if len(comp) + 1 < len(payload) - (len(payload) >> 3):
-            payload = bytes([compressor.numeric_id]) + comp
+        if len(comp) + 1 < plen - (plen >> 3):
+            parts = [bytes([compressor.numeric_id]) + comp]
+            plen = len(parts[0])
             mtype |= COMPRESSED_FLAG
-    head = _PREAMBLE.pack(FRAME_MAGIC, mtype, msg.seq, len(payload))
+        else:
+            parts = [payload]
+    head = _PREAMBLE.pack(FRAME_MAGIC, mtype, msg.seq, plen)
     # reference ms_crc_data: a 0 sentinel skips the payload checksum
     # (secure mode's AEAD already authenticates; crc is then pure
     # overhead) — receivers accept the sentinel unconditionally
-    crc = zlib.crc32(payload, zlib.crc32(head)) if crc_data else 0
-    return head + payload + _CRC.pack(crc)
+    if crc_data:
+        crc = zlib.crc32(head)
+        for p in parts:
+            crc = zlib.crc32(p, crc)
+    else:
+        crc = 0
+    return [head, *parts, _CRC.pack(crc)]
+
+
+def encode_frame(msg: Message, compressor=None,
+                 compress_min: int = 4096,
+                 crc_data: bool = True) -> bytes:
+    return b"".join(encode_frame_parts(
+        msg, compressor=compressor, compress_min=compress_min,
+        crc_data=crc_data))
 
 
 def decode_frame_header(head: bytes):

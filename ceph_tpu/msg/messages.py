@@ -116,7 +116,7 @@ class MOSDOp(Message):
         self.snaps = snaps or []
         self.snapid = snapid         # 0 = head (reference CEPH_NOSNAP)
 
-    def encode_payload(self) -> bytes:
+    def _enc(self) -> Encoder:
         e = Encoder()
         e.str(self.client).u64(self.tid).u32(self.epoch)
         e.i64(self.pool).str(self.oid).u32(self.pgid_seed)
@@ -126,7 +126,14 @@ class MOSDOp(Message):
         for op in self.ops:
             op.encode(e)
         e.u64(self.parent_span_id)
-        return e.build()
+        return e
+
+    def encode_payload(self) -> bytes:
+        return self._enc().build()
+
+    def encode_payload_parts(self) -> list:
+        # op data buffers (write payloads) ride by reference
+        return self._enc().build_parts()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDOp":
@@ -156,14 +163,20 @@ class MOSDOpReply(Message):
         self.out_data = out_data or []
         self.extra = extra or {}     # op-specific structured outputs
 
-    def encode_payload(self) -> bytes:
+    def _enc(self) -> Encoder:
         e = Encoder()
         e.u64(self.tid).i32(self.result).u32(self.epoch)
         e.u32(len(self.out_data))
         for b in self.out_data:
             e.bytes(b)
         e.bytes(_enc_json(self.extra))
-        return e.build()
+        return e
+
+    def encode_payload(self) -> bytes:
+        return self._enc().build()
+
+    def encode_payload_parts(self) -> list:
+        return self._enc().build_parts()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDOpReply":
@@ -195,21 +208,35 @@ class MOSDECSubOpWrite(Message):
         self.from_osd = from_osd     # primary's osd id
         self.tid = tid
         self.epoch = epoch
-        self.txn = txn               # encoded store Transaction
+        # encoded store Transaction: bytes, or a list of buffer
+        # fragments (Transaction.encode_parts()) kept by reference
+        # until the socket — receivers always see joined bytes
+        self.txn = txn
         self.log_entries = log_entries or []   # pg-log dicts
         self.at_version = at_version
         self.trace_id = trace_id     # blkin-style trace context
         self.parent_span_id = parent_span_id   # primary's osd_op span
 
-    def encode_payload(self) -> bytes:
+    def _enc(self) -> Encoder:
         e = Encoder()
         e.str(self.pgid).i32(self.shard).i32(self.from_osd)
-        e.u64(self.tid).u32(self.epoch).bytes(self.txn)
+        e.u64(self.tid).u32(self.epoch)
+        if isinstance(self.txn, (list, tuple)):
+            e.bytes_parts(self.txn)
+        else:
+            e.bytes(self.txn)
         e.bytes(_enc_json(self.log_entries))
         e.u32(self.at_version[0]).u64(self.at_version[1])
         e.u64(self.trace_id)
         e.u64(self.parent_span_id)
-        return e.build()
+        return e
+
+    def encode_payload(self) -> bytes:
+        return self._enc().build()
+
+    def encode_payload_parts(self) -> list:
+        # shard chunk buffers inside txn ride by reference to sendmsg
+        return self._enc().build_parts()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDECSubOpWrite":

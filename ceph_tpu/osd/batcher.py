@@ -42,18 +42,29 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from . import ecutil
+from ..utils import copytrack
 
 
 class _Req:
-    def __init__(self, ec_impl, sinfo: ecutil.StripeInfo, data: bytes,
+    """One queued encode.  ``data`` may be bytes, bytearray,
+    memoryview or a uint8 ndarray — the caller hands over ownership
+    and must not mutate the buffer until ``cb`` fires."""
+
+    def __init__(self, ec_impl, sinfo: ecutil.StripeInfo, data,
                  cb: Callable[[Dict[int, bytes]], None], tracked=None):
         self.ec_impl = ec_impl
         self.sinfo = sinfo
         self.data = data
         self.cb = cb
-        self.nstripes = len(data) // sinfo.stripe_width
+        self.nbytes = ecutil.nbytes_of(data)
+        self.nstripes = self.nbytes // sinfo.stripe_width
         self.tracked = tracked       # OpTracker handle (stage events)
         self.t_enq = time.monotonic()
+
+    def as_array(self, k: int) -> np.ndarray:
+        """[nstripes, k, chunk] view of the request buffer — no copy."""
+        return ecutil.as_stripe_array(self.data, self.nstripes, k,
+                                      self.sinfo.chunk_size)
 
 
 class _DecReq:
@@ -68,7 +79,7 @@ class _DecReq:
         self.have = have
         self.want = frozenset(want)
         self.cb = cb
-        total = len(next(iter(have.values())))
+        total = ecutil.nbytes_of(next(iter(have.values())))
         self.nstripes = total // sinfo.chunk_size
 
 
@@ -135,6 +146,7 @@ class EncodeBatcher:
 
     _cpu_bps: Dict[Tuple, float] = {}        # per geometry, shared
     _min_device_bytes: float = 0.0           # learned crossover, shared
+    _probe_tick: int = 0                     # shared probe cadence
     _warmed: set = set()                     # geometries prewarmed
     _h2d_bps: float = 0.0                    # measured link rate, shared
 
@@ -148,6 +160,23 @@ class EncodeBatcher:
                 return d
         self.max_stripes = get("ec_tpu_batch_stripes", 1024)
         self.window_s = get("ec_tpu_queue_window_us", 200) / 1e6
+        # admission-aware coalescing window: the effective window
+        # (dyn_window_s) doubles while submits keep arriving at its
+        # expiry (queue pressure -> bigger batches clear the device
+        # crossover) and halves back toward the base once a window
+        # closes with no new joiners (drained queue -> don't tax
+        # latency).  tick_flush() remains the hard cut.
+        wmax = get("ec_tpu_queue_window_max_us", 0)
+        self.window_base_s = self.window_s
+        self.window_max_s = (wmax / 1e6) if wmax > 0 \
+            else max(self.window_s * 16, 0.02)
+        self.dyn_window_s = self.window_s
+        self.window_grows = 0        # admission extensions granted
+        self.window_cuts = 0         # drain-driven shrinks
+        self.last_queue_depth = 0    # requests in the last dispatch
+        self.queue_depth_hwm = 0
+        self.bytes_copied = 0        # full-payload copies inside the
+                                     # batcher (gathers/concats)
         # adaptive CPU/device routing (ec_tpu_fallback_cpu): a device
         # call pays a fixed dispatch+transfer cost that can dwarf the
         # MXU win on small batches — especially over a slow link.  The
@@ -155,6 +184,12 @@ class EncodeBatcher:
         # the CPU twin; the threshold doubles when a device call loses
         # to the predicted CPU time and halves when it wins big.
         self.adaptive_cpu = get("ec_tpu_fallback_cpu", True)
+        pin = get("ec_tpu_min_device_bytes", 0)
+        if pin:
+            # operator-pinned crossover: routing is deterministic from
+            # the first op instead of riding the prewarm/learning race
+            # (probes + big wins can still lower it at runtime)
+            EncodeBatcher._min_device_bytes = float(pin)
         self.probe_interval = get("ec_tpu_crossover_probe_interval", 16)
         self.crossover_min = get("ec_tpu_crossover_min_bytes", 64 << 10)
         self.prewarm_enabled = get("osd_ec_prewarm", True)
@@ -194,6 +229,9 @@ class EncodeBatcher:
                        description="JIT compiles paid (prewarm)")
                 bp.add_time_avg("compile_seconds",
                                 "seconds per JIT compile")
+                bp.add("bytes_copied",
+                       description="payload bytes copied inside the "
+                                   "batcher (shard gathers/concats)")
             self.bperf = bp
         # cumulative per-stage attribution (seconds of request time
         # spent in each pipeline stage; consumed by bench.py's
@@ -274,7 +312,9 @@ class EncodeBatcher:
         if not missing:
             # everything wanted was read directly (e.g. a stray held
             # the 'missing' shard): passthrough, like ecutil.decode
-            cb({s: bytes(have[s]) for s in want})
+            cb({s: (have[s] if isinstance(have[s], bytes)
+                    else memoryview(have[s]).cast("B"))
+                for s in want})
             return
         stopped = self._stop or not hasattr(ec_impl, "decode_batch")
         req = None
@@ -384,7 +424,7 @@ class EncodeBatcher:
                     # round trip
                     t0 = time.monotonic()
                     ec_impl.encode_batch_async(z).wait()
-                    warm_req = _Req(ec_impl, sinfo, z.tobytes(),
+                    warm_req = _Req(ec_impl, sinfo, z.tobytes(),  # copycheck: ok - one-time warmup calibration buffer
                                     lambda _c: None)
                     self._learn_crossover(
                         [warm_req], time.monotonic() - t0,
@@ -406,23 +446,91 @@ class EncodeBatcher:
         for t in self._dec_threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
 
+    def _note_copy(self, nbytes: int, site: str) -> None:
+        self.bytes_copied += nbytes
+        copytrack.note_copy(nbytes, site)
+        if self.bperf is not None:
+            self.bperf.inc("bytes_copied", nbytes)
+
+    def _shard_views(self, arr: np.ndarray, parity: np.ndarray,
+                     k: int, m: int) -> Dict[int, memoryview]:
+        """Per-shard chunk buffers as 1-D byte memoryviews.
+
+        The column gathers (arr[:, i] / parity[:, j]) are the ONE
+        unavoidable copy on the encode output side — the
+        [nstripes, k, chunk] layout interleaves shards, so each
+        shard's chunks must be made contiguous exactly once.  The
+        views then ride by reference through the sub-write
+        transactions, the wire iovecs and the store with no further
+        bytes()/tobytes() round trips.  memoryview compares by
+        content, so callers that check chunks against reference
+        encodes with == still work.
+        """
+        out: Dict[int, memoryview] = {}
+        copied = 0
+        for i in range(k):
+            src = arr[:, i]
+            col = np.ascontiguousarray(src)
+            if col is not src:
+                copied += col.nbytes
+            out[i] = memoryview(col).cast("B")
+        for j in range(m):
+            src = parity[:, j]
+            col = np.ascontiguousarray(src)
+            if col is not src:
+                copied += col.nbytes
+            out[k + j] = memoryview(col).cast("B")
+        if copied:
+            self._note_copy(copied, "batcher.shard_gather")
+        return out
+
     # -- collector -------------------------------------------------------
     def _run(self) -> None:
         while True:
+            grew = False
             with self._cond:
                 while not self._queues and not self._stop:
                     self._cond.wait()
                 if not self._queues and self._stop:
                     return
-                # linger for the window so concurrent ops can join,
-                # unless the stripe budget is already met
-                deadline = self._first_enqueue + self.window_s
+                # linger for the (admission-aware) window so concurrent
+                # ops can join, unless the stripe budget is already met
+                deadline = self._first_enqueue + self.dyn_window_s
+                hard = self._first_enqueue + self.window_max_s
+                seen = self._pending_stripes
                 while (not self._stop and not self._flush_now
-                       and self._pending_stripes < self.max_stripes
-                       and (remaining := deadline - time.monotonic())
-                       > 0):
+                       and self._pending_stripes < self.max_stripes):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        if self._pending_stripes > seen \
+                                and deadline < hard:
+                            # submits kept arriving: extend by one base
+                            # window (bounded by window_max_s) and widen
+                            # the next cycle's opening window
+                            grew = True
+                            self.window_grows += 1
+                            seen = self._pending_stripes
+                            self.dyn_window_s = min(
+                                self.dyn_window_s * 2,
+                                self.window_max_s)
+                            deadline = min(
+                                time.monotonic() + self.window_base_s,
+                                hard)
+                            continue
+                        break
                     self._cond.wait(remaining)
+                if self._flush_now or not grew:
+                    # the queue drained inside the window (or the
+                    # reactor tick cut it): shrink back toward the base
+                    nw = max(self.window_base_s, self.dyn_window_s / 2)
+                    if nw < self.dyn_window_s:
+                        self.window_cuts += 1
+                        self.dyn_window_s = nw
                 queues, self._queues = self._queues, {}
+                depth = sum(len(v) for v in queues.values())
+                self.last_queue_depth = depth
+                if depth > self.queue_depth_hwm:
+                    self.queue_depth_hwm = depth
                 self._pending_stripes = 0
                 self._flush_now = False
             # dispatch EVERY group's device call before joining any:
@@ -466,14 +574,19 @@ class EncodeBatcher:
         small to pay the device round trip."""
         if not self.adaptive_cpu or self._min_device_bytes <= 0:
             return False
-        total = sum(len(r.data) for r in reqs)
+        total = sum(r.nbytes for r in reqs)
         if total >= self._min_device_bytes:
             return False
         # periodic probe: route an occasional small batch to the
         # device anyway so the threshold can come back down when the
-        # link/device recovers
-        self._probe_tick = getattr(self, "_probe_tick", 0) + 1
-        return self._probe_tick % self.probe_interval != 0
+        # link/device recovers.  The tick is class-level like the
+        # crossover it refreshes: 13 in-process OSDs share ONE
+        # learned threshold, so they should share one probe cadence
+        # instead of each paying its own 1-in-N device round trips
+        # (per-instance ticks also mean a primary seeing few ops
+        # never probes at all)
+        EncodeBatcher._probe_tick += 1
+        return EncodeBatcher._probe_tick % self.probe_interval != 0
 
     def _cb_error(self) -> None:
         """Report a continuation/encode failure.  During shutdown the
@@ -490,6 +603,7 @@ class EncodeBatcher:
         """Forget the shared crossover/rates (tests; ops can call it
         after a hardware change)."""
         cls._min_device_bytes = 0.0
+        cls._probe_tick = 0
         cls._cpu_bps = {}
         cls._warmed = set()
 
@@ -501,7 +615,7 @@ class EncodeBatcher:
             t0 = time.monotonic()
             self._cpu_encode(req)
             dt = max(time.monotonic() - t0, 1e-6)
-            rate = len(req.data) / dt
+            rate = req.nbytes / dt
             EncodeBatcher._cpu_bps[key] = rate
         return rate
 
@@ -520,10 +634,12 @@ class EncodeBatcher:
             k = reqs[0].ec_impl.get_data_chunk_count()
             m = reqs[0].ec_impl.get_coding_chunk_count()
             twin = self.cpu_twin(reqs[0].ec_impl, sinfo)
-            arrs = [np.frombuffer(r.data, dtype=np.uint8).reshape(
-                r.nstripes, k, sinfo.chunk_size) for r in reqs]
-            batch = np.concatenate(arrs, axis=0) \
-                if len(arrs) > 1 else arrs[0]
+            arrs = [r.as_array(k) for r in reqs]
+            if len(arrs) > 1:
+                batch = np.concatenate(arrs, axis=0)
+                self._note_copy(batch.nbytes, "batcher.batch_concat")
+            else:
+                batch = arrs[0]
             parity = twin.encode_batch(batch)
             self.cpu_calls += 1
             # twin encode is pure compute: no transfer legs
@@ -543,12 +659,8 @@ class EncodeBatcher:
             for r, arr in zip(reqs, arrs):
                 p = parity[off:off + r.nstripes]
                 off += r.nstripes
-                out: Dict[int, bytes] = {
-                    i: arr[:, i].tobytes() for i in range(k)}
-                for j in range(m):
-                    out[k + j] = np.ascontiguousarray(
-                        p[:, j]).tobytes()
-                chunks_list.append(out)
+                chunks_list.append(
+                    self._shard_views(arr, p, k, m))
         except Exception:
             chunks_list = None
         if chunks_list is None:
@@ -582,7 +694,7 @@ class EncodeBatcher:
         it (the encode path likewise dispatches all groups before
         joining any)."""
         sinfo = reqs[0].sinfo
-        total = sum(sum(len(v) for v in r.have.values())
+        total = sum(sum(ecutil.nbytes_of(v) for v in r.have.values())
                     for r in reqs)
         impl = None
         if self.adaptive_cpu and self._min_device_bytes > 0 and \
@@ -616,10 +728,12 @@ class EncodeBatcher:
         try:
             present = {
                 s: (np.concatenate(
-                    [np.frombuffer(r.have[s], dtype=np.uint8)
+                    [ecutil.as_stripe_array(r.have[s], r.nstripes,
+                                            1, cs)
                      .reshape(r.nstripes, cs) for r in reqs], axis=0)
                     if len(reqs) > 1 else
-                    np.frombuffer(reqs[0].have[s], dtype=np.uint8)
+                    ecutil.as_stripe_array(
+                        reqs[0].have[s], reqs[0].nstripes, 1, cs)
                     .reshape(-1, cs))
                 for s in have_ids}
             rec = impl.decode_batch(present, cs)
@@ -658,10 +772,15 @@ class EncodeBatcher:
             out = {}
             for s in r.want:
                 if s in missing:
-                    out[s] = np.ascontiguousarray(
-                        rec[s][off:off + r.nstripes]).tobytes()
+                    # row slice of a contiguous [B, cs] batch result:
+                    # ascontiguousarray is a no-copy view here, and
+                    # the memoryview rides downstream by reference
+                    out[s] = memoryview(np.ascontiguousarray(
+                        rec[s][off:off + r.nstripes])).cast("B")
                 else:
-                    out[s] = bytes(r.have[s])
+                    h = r.have[s]
+                    out[s] = h if isinstance(h, bytes) else \
+                        memoryview(h).cast("B")
             off += r.nstripes
             try:
                 r.cb(out)
@@ -677,7 +796,7 @@ class EncodeBatcher:
         lower it."""
         try:
             key = _geometry_key(reqs[0].ec_impl, reqs[0].sinfo)
-            total = sum(len(r.data) for r in reqs)
+            total = sum(r.nbytes for r in reqs)
             cpu_rate = max(self._cpu_rate(key, reqs[0]), 1.0)
             cpu_pred = total / cpu_rate
             if dev_time > cpu_pred:
@@ -747,10 +866,12 @@ class EncodeBatcher:
         try:
             sinfo = reqs[0].sinfo
             k = reqs[0].ec_impl.get_data_chunk_count()
-            arrs = [np.frombuffer(r.data, dtype=np.uint8).reshape(
-                r.nstripes, k, sinfo.chunk_size) for r in reqs]
-            batch = np.concatenate(arrs, axis=0) \
-                if len(arrs) > 1 else arrs[0]
+            arrs = [r.as_array(k) for r in reqs]
+            if len(arrs) > 1:
+                batch = np.concatenate(arrs, axis=0)
+                self._note_copy(batch.nbytes, "batcher.batch_concat")
+            else:
+                batch = arrs[0]
             # tile oversized batches at max_stripes: bounds per-call
             # device memory AND caps the largest compiled batch shape
             # at bucket(max_stripes) — the shape prewarm() compiles —
@@ -831,7 +952,7 @@ class EncodeBatcher:
             # split the fenced device window into transfer vs compute
             # using the link rate prewarm measured; without a
             # measurement the whole window is charged to "device"
-            in_bytes = sum(len(r.data) for r in reqs)
+            in_bytes = sum(r.nbytes for r in reqs)
             out_bytes = parity.nbytes
             h2d_s = d2h_s = 0.0
             if self._h2d_bps > 0:
@@ -852,11 +973,7 @@ class EncodeBatcher:
         for r, arr in zip(reqs, arrs):
             p = parity[off:off + r.nstripes]
             off += r.nstripes
-            out: Dict[int, bytes] = {}
-            for i in range(k):
-                out[i] = arr[:, i].tobytes()
-            for j in range(m):
-                out[k + j] = np.ascontiguousarray(p[:, j]).tobytes()
+            out = self._shard_views(arr, p, k, m)
             try:
                 r.cb(out)
             except Exception:

@@ -51,6 +51,7 @@ from ..msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                             MOSDECSubOpWrite, MOSDECSubOpWriteReply,
                             MOSDPGPush, MOSDPGPushReply, PushOp)
 from ..store.objectstore import GHObject, Transaction
+from ..utils import copytrack
 from . import ecutil
 from .backend import OI_ATTR, Mutation, ObjectInfo, PGBackend, PGHost
 from .pglog import Eversion, LogEntry
@@ -79,8 +80,26 @@ class _WriteOp:
         self.to_read: Optional[Tuple[int, int]] = None   # aligned extent
         self.read_data: bytes = b""
         self.obj_info = None             # fetched once in _start_rmw
-        self.pending_commits: Set[int] = set()           # shards
+        # shard -> outstanding sub-write commits.  One count per shard
+        # for ordinary ops; segs_total counts for segmented ops (one
+        # sub-write per segment, replies decrement)
+        self.pending_commits: Dict[int, int] = {}
         self.state = self.PENDING
+        # pipelined segmented fanout (large aligned writes): encode of
+        # segment N+1 overlaps the sub-write fanout of segment N.
+        # Metadata (log entries, OI, hinfo finalisation) rides ONLY
+        # the final segment's transaction, so a crash mid-op leaves
+        # the partial data invisible (object size never advanced).
+        self.segs_total = 1
+        self.segs_sent = 0
+        self.seg_ready: Dict[int, Dict[int, bytes]] = {}
+        self.seg_bufs: List = []
+        self.seg_astart = 0              # whole-op aligned bounds
+        self.seg_hi = 0
+        self.seg_width = 0               # logical bytes per segment
+        self.seg_chunk_off0 = 0
+        self.seg_is_append = False
+        self.seg_hinfo = None            # running HashInfo across segs
         self.barrier = True
         self.alive = True                # False after on_change()
         self.tracked = False             # registered in extent overlay
@@ -144,6 +163,18 @@ class ECBackend(PGBackend):
         self.m = ec_impl.get_coding_chunk_count()
         self.sinfo = ecutil.StripeInfo(self.k, stripe_width)
         self.allows_overwrites = allows_overwrites
+        # pipelined commit fanout: writes larger than this are encoded
+        # and fanned out segment-by-segment (0 disables)
+        try:
+            seg = host.conf["osd_ec_pipeline_segment_bytes"]
+        except (AttributeError, KeyError, TypeError):
+            seg = 2 << 20
+        self.seg_bytes = 0
+        if seg:
+            # stripe-align the segment so every segment encodes whole
+            # stripes
+            self.seg_bytes = max(stripe_width,
+                                 seg - seg % stripe_width)
         # write pipeline queues (reference ECBackend.cc:2151)
         self.waiting_commit: Dict[int, _WriteOp] = {}
         self.in_flight_reads: Dict[int, _ReadOp] = {}
@@ -310,6 +341,7 @@ class ECBackend(PGBackend):
         and any LATER in-flight op on the same object that may already
         have absorbed them into its encode fails too (the client is
         told; nothing lands silently)."""
+        self.waiting_commit.pop(op.tid, None)
         op.on_all_commit(err)
         self._untrack_pending(op, failed=True)
         for o in self._pipeline:
@@ -415,31 +447,45 @@ class ECBackend(PGBackend):
         hi = max(off + len(d) for off, d in mut.writes)
         astart, alen = self.sinfo.offset_len_to_stripe_bounds(
             lo, hi - lo)
-        buf = bytearray(alen)            # zero padding to stripe bounds
-        if op.read_data:
-            buf[0:len(op.read_data)] = op.read_data
-        if op.tracked:
-            # in-flight bytes of EARLIER ops shadow whatever the
-            # shards returned (they may predate those uncommitted
-            # writes); own writes applied below
-            self._overlay(op.oid, buf, astart, op.seq)
-        for off, data in mut.writes:
-            buf[off - astart:off - astart + len(data)] = data
+        if len(mut.writes) == 1 and not op.read_data \
+                and lo == astart and hi - astart == alen:
+            # aligned full-cover write (the deployed whole-object
+            # path): the client payload IS the stripe-aligned extent —
+            # hand it to the encoder by reference, zero copies.  Any
+            # overlay bytes are fully shadowed by this op's own data.
+            payload = mut.writes[0][1]
+        else:
+            buf = bytearray(alen)        # zero padding to stripe bounds
+            if op.read_data:
+                buf[0:len(op.read_data)] = op.read_data
+            if op.tracked:
+                # in-flight bytes of EARLIER ops shadow whatever the
+                # shards returned (they may predate those uncommitted
+                # writes); own writes applied below
+                self._overlay(op.oid, buf, astart, op.seq)
+            for off, data in mut.writes:
+                buf[off - astart:off - astart + len(data)] = data
+            copytrack.note_copy(alen, "ecbackend.rmw_gather")
+            payload = buf
         batcher = getattr(self.host, "encode_batcher", None)
         if batcher is not None and \
                 hasattr(self.ec_impl, "encode_batch_async"):
             if mut.tracked_op is not None:
                 mut.tracked_op.mark_event("ec:encode_queued")
+            if self.seg_bytes and not op.barrier \
+                    and alen > self.seg_bytes:
+                self._start_segmented(op, astart, hi, payload,
+                                      batcher)
+                return
             batcher.submit(
-                self.ec_impl, self.sinfo, bytes(buf),
+                self.ec_impl, self.sinfo, payload,
                 lambda chunks: self._encode_done(op, astart, hi,
                                                  chunks),
                 tracked=mut.tracked_op)
         else:
             if mut.tracked_op is not None:
                 mut.tracked_op.mark_event("ec:encode_queued")
-            chunks = ecutil.encode(self.sinfo, self.ec_impl,
-                                   bytes(buf))
+            chunks = ecutil.encode(self.sinfo, self.ec_impl, payload)
             if mut.tracked_op is not None:
                 mut.tracked_op.mark_event("ec:encoded")
             self._encoded_to_commit(op, astart, hi, chunks)
@@ -476,15 +522,28 @@ class ECBackend(PGBackend):
         """Send, in submission order, every encoded op not yet sent;
         stop at the first op still encoding.  Poisoned ops (an earlier
         same-object op failed under them) error out instead of
-        sending."""
+        sending.  Segmented ops send their encoded segment prefix and
+        — until the final (metadata-carrying) segment is out — block
+        everything behind them, keeping shard logs monotonic."""
         for op in list(self._pipeline):
             if op.state in (op.SENT, op.DONE):
                 continue
             if op.state != op.ENCODED:
                 break
             if op.poisoned:
+                # a partially-sent segmented op stops here: its data
+                # sub-writes may have landed, but without the final
+                # segment's metadata they are invisible
+                self.waiting_commit.pop(op.tid, None)
                 op.on_all_commit(op.poisoned)
                 op.state = op.DONE
+                continue
+            if op.segs_total > 1:
+                self._send_ready_segments(op)
+                if op.state == op.DONE:
+                    continue
+                if op.state != op.SENT:
+                    break            # mid-op: later ops must wait
                 continue
             op.state = op.SENT
             if op.encoded is not None:
@@ -511,18 +570,33 @@ class ECBackend(PGBackend):
     def _commit_fanout(self, op: _WriteOp,
                        shard_txns: Dict[int, Transaction]) -> None:
         wire_entries = [e.to_dict() for e in op.log_entries]
-        # populate pending_commits for the WHOLE acting set before any
-        # send: a fast commit reply must not find a half-filled set and
-        # declare the op done early
-        targets = [(shard, osd) for shard, osd in
-                   self.host.acting_shards() if osd is not None]
-        op.pending_commits = {shard for shard, _ in targets}
-        self.waiting_commit[op.tid] = op
+        self._register_commits(op, 1)
         tracked = op.mutation.tracked_op
         if tracked is not None:
             tracked.mark_event("ec:sub_write_sent")
+        self._fanout_txns(op, shard_txns, wire_entries)
+
+    def _register_commits(self, op: _WriteOp, per_shard: int) -> None:
+        """Populate pending_commits for the WHOLE acting set before
+        any send: a fast commit reply must not find a half-filled map
+        and declare the op done early.  ``per_shard`` is the number of
+        sub-writes each shard will receive (segments)."""
+        op.pending_commits = {
+            shard: per_shard for shard, osd in
+            self.host.acting_shards() if osd is not None}
+        self.waiting_commit[op.tid] = op
+
+    def _fanout_txns(self, op: _WriteOp,
+                     shard_txns: Dict[int, Transaction],
+                     wire_entries: List[dict]) -> None:
+        """Send one sub-write per shard.  Remote shards get the
+        transaction as encode_parts() fragments — the messenger ships
+        them as scatter-gather iovecs, so encoded chunk views never
+        round-trip through one big bytes.  The primary's own shard
+        gets the Transaction OBJECT (no encode at all)."""
         local_txn: Optional[Transaction] = None
-        for shard, osd in targets:
+        for shard, osd in [(s, o) for s, o in
+                           self.host.acting_shards() if o is not None]:
             txn = shard_txns.get(shard) or Transaction()
             if osd == self.host.whoami:
                 local_txn = txn
@@ -530,7 +604,7 @@ class ECBackend(PGBackend):
             self.host.send_shard(osd, MOSDECSubOpWrite(
                 pgid=self.host.pgid_str, shard=shard,
                 from_osd=self.host.whoami, tid=op.tid,
-                epoch=self.host.epoch, txn=txn.encode(),
+                epoch=self.host.epoch, txn=txn.encode_parts(),
                 log_entries=wire_entries,
                 at_version=op.at_version,
                 trace_id=op.mutation.trace_id,
@@ -551,14 +625,135 @@ class ECBackend(PGBackend):
                 lambda: self._sub_write_committed(
                     tid, self.host.own_shard))
 
+    # -- pipelined segmented fanout ------------------------------------
+    def _start_segmented(self, op: _WriteOp, astart: int, hi: int,
+                         payload, batcher) -> None:
+        """Cut a large aligned write into stripe-aligned segments and
+        pipeline encode against fanout: segment N's sub-writes go out
+        while the batcher encodes segment N+1 (the next segment is
+        submitted from N's encode continuation, so the collector
+        thread works while this PG thread fans out).  Only the final
+        segment carries log entries, OI and the finalised hinfo —
+        partial data is invisible until it lands."""
+        mv = memoryview(payload)
+        seg = self.seg_bytes
+        op.seg_bufs = [mv[i:i + seg]
+                       for i in range(0, len(mv), seg)]
+        op.segs_total = len(op.seg_bufs)
+        op.seg_astart = astart
+        op.seg_hi = hi
+        op.seg_width = seg
+        op.seg_chunk_off0 = \
+            self.sinfo.aligned_logical_offset_to_chunk_offset(astart)
+        info = op.obj_info or ObjectInfo()
+        op.seg_is_append = op.mutation.append_only_at(info.size) and \
+            astart >= self.sinfo.logical_to_prev_stripe_offset(
+                info.size)
+        self._submit_segment(op, 0, batcher)
+
+    def _submit_segment(self, op: _WriteOp, idx: int,
+                        batcher) -> None:
+        batcher.submit(
+            self.ec_impl, self.sinfo, op.seg_bufs[idx],
+            lambda chunks, i=idx: self._seg_encode_done(op, i, chunks),
+            tracked=op.mutation.tracked_op)
+
+    def _seg_encode_done(self, op: _WriteOp, idx: int,
+                         chunks: Optional[Dict[int, bytes]]) -> None:
+        """Continuation from the batcher's collector thread for one
+        segment: re-enter the PG under its lock, queue the segment for
+        the ordered send, and start the NEXT segment's encode — that
+        encode then overlaps this segment's fanout."""
+        lock = getattr(self.host, "lock", None)
+        if lock is None:
+            import contextlib
+            lock = contextlib.nullcontext()
+        with lock:
+            if not op.alive:
+                return
+            if chunks is None:       # encode failed even on CPU: EIO
+                self.waiting_commit.pop(op.tid, None)
+                self._fail_op(op, -5)
+                return
+            op.seg_ready[idx] = chunks
+            if idx == 0:
+                op.state = op.ENCODED
+            if idx + 1 < op.segs_total:
+                batcher = getattr(self.host, "encode_batcher", None)
+                if batcher is not None:
+                    self._submit_segment(op, idx + 1, batcher)
+            if idx + 1 == op.segs_total \
+                    and op.mutation.tracked_op is not None:
+                op.mutation.tracked_op.mark_event("ec:encoded")
+            self._flush_ready()
+
+    def _send_ready_segments(self, op: _WriteOp) -> None:
+        """Fan out, in order, every segment whose encode has finished.
+        The final segment reuses _generate_transactions (full
+        metadata); intermediate segments carry data + running hinfo
+        only."""
+        while op.segs_sent in op.seg_ready:
+            idx = op.segs_sent
+            chunks = op.seg_ready.pop(idx)
+            if idx == 0:
+                self._register_commits(op, op.segs_total)
+                if op.mutation.tracked_op is not None:
+                    op.mutation.tracked_op.mark_event(
+                        "ec:sub_write_sent")
+            seg_chunk_off = op.seg_chunk_off0 + \
+                idx * (op.seg_width // self.k)
+            op.seg_hinfo = self._update_hinfo(
+                op.oid, chunks, seg_chunk_off, op.seg_is_append,
+                hinfo=op.seg_hinfo)
+            if idx == op.segs_total - 1:
+                txns = self._generate_transactions(
+                    op, write_plan=(op.seg_astart, op.seg_hi, chunks),
+                    hinfo=op.seg_hinfo, chunk_off=seg_chunk_off)
+                wire_entries = [e.to_dict() for e in op.log_entries]
+            else:
+                txns = self._segment_txns(op, seg_chunk_off, chunks)
+                wire_entries = []
+            self._fanout_txns(op, txns, wire_entries)
+            op.segs_sent += 1
+        if op.segs_sent >= op.segs_total:
+            op.state = op.SENT
+
+    def _segment_txns(self, op: _WriteOp, chunk_off: int,
+                      chunks: Dict[int, bytes]
+                      ) -> Dict[int, Transaction]:
+        """Per-shard transactions for a NON-final segment: chunk data
+        + the running hinfo, nothing else — no OI, no log entries, no
+        truncate.  A crash after this lands leaves the bytes invisible
+        (object size unchanged) — same consistency the reference gets
+        from atomic whole-op transactions."""
+        henc = op.seg_hinfo.encode()
+        txns: Dict[int, Transaction] = {}
+        for shard, osd in self.host.acting_shards():
+            if osd is None:
+                continue
+            txn = Transaction()
+            obj = GHObject(op.oid, shard)
+            coll = self.host.coll_of(shard)
+            txn.touch(coll, obj)
+            txn.write(coll, obj, chunk_off, chunks[shard])
+            txn.setattr(coll, obj, ecutil.HINFO_KEY, henc)
+            txns[shard] = txn
+        return txns
+
     def _generate_transactions(self, op: _WriteOp,
-                               write_plan: Optional[Tuple] = None
+                               write_plan: Optional[Tuple] = None,
+                               hinfo: Optional[ecutil.HashInfo] = None,
+                               chunk_off: Optional[int] = None
                                ) -> Dict[int, Transaction]:
         """Lower the logical mutation to per-shard store transactions
         (reference ECTransaction::generate_transactions ->
         encode_and_write, ECTransaction.cc:97,28).  ``write_plan`` is
         (astart, hi, chunks) with the already-encoded chunk map from
-        the batcher when the mutation carries data."""
+        the batcher when the mutation carries data.  For the FINAL
+        segment of a pipelined op, ``hinfo`` is the caller-maintained
+        running HashInfo (already folded through every segment) and
+        ``chunk_off`` the final segment's shard offset, while
+        write_plan keeps the whole-op bounds so sizes stay right."""
         mut, oid = op.mutation, op.oid
         txns: Dict[int, Transaction] = {
             shard: Transaction()
@@ -622,12 +817,15 @@ class ECBackend(PGBackend):
             # concurrent ops from other PGs
             astart, hi, chunks = write_plan
             new_size = max(info.size, hi)
-            is_append = mut.append_only_at(info.size) and \
-                astart >= self.sinfo.logical_to_prev_stripe_offset(
-                    info.size)
-            chunk_off = \
-                self.sinfo.aligned_logical_offset_to_chunk_offset(astart)
-            hinfo = self._update_hinfo(oid, chunks, chunk_off, is_append)
+            if chunk_off is None:
+                chunk_off = self.sinfo \
+                    .aligned_logical_offset_to_chunk_offset(astart)
+            if hinfo is None:
+                is_append = mut.append_only_at(info.size) and \
+                    astart >= \
+                    self.sinfo.logical_to_prev_stripe_offset(info.size)
+                hinfo = self._update_hinfo(oid, chunks, chunk_off,
+                                           is_append)
             henc = hinfo.encode()
             for shard, txn in txns.items():
                 obj = GHObject(oid, shard)
@@ -662,17 +860,21 @@ class ECBackend(PGBackend):
         return txns
 
     def _update_hinfo(self, oid: str, chunks: Dict[int, bytes],
-                      chunk_off: int, is_append: bool) -> ecutil.HashInfo:
+                      chunk_off: int, is_append: bool,
+                      hinfo: Optional[ecutil.HashInfo] = None
+                      ) -> ecutil.HashInfo:
         """Cumulative CRCs stay valid only for pure appends; any
         overwrite clears them (the reference drops hinfo on
-        ec_overwrites pools)."""
-        obj = GHObject(oid, self.host.own_shard)
-        hinfo = None
-        try:
-            hinfo = ecutil.HashInfo.decode(self.host.store.getattr(
-                self.host.coll, obj, ecutil.HINFO_KEY))
-        except (FileNotFoundError, KeyError, ValueError):
-            pass            # absent or corrupt: rebuilt below
+        ec_overwrites pools).  Pass ``hinfo`` to fold a further
+        segment into a running HashInfo without re-reading the
+        store (pipelined segmented writes)."""
+        if hinfo is None:
+            obj = GHObject(oid, self.host.own_shard)
+            try:
+                hinfo = ecutil.HashInfo.decode(self.host.store.getattr(
+                    self.host.coll, obj, ecutil.HINFO_KEY))
+            except (FileNotFoundError, KeyError, ValueError):
+                pass            # absent or corrupt: rebuilt below
         if hinfo is None or len(hinfo.crcs) != self.k + self.m:
             hinfo = ecutil.HashInfo(self.k + self.m)
         if is_append and hinfo.total_chunk_size == chunk_off:
@@ -695,7 +897,13 @@ class ECBackend(PGBackend):
         op = self.waiting_commit.get(tid)
         if op is None:
             return
-        op.pending_commits.discard(shard)
+        left = op.pending_commits.get(shard, 0)
+        if left <= 1:
+            op.pending_commits.pop(shard, None)
+        else:
+            # segmented op: one reply per segment per shard (replies
+            # ride ordered channels, so counting is sufficient)
+            op.pending_commits[shard] = left - 1
         if not op.pending_commits:
             del self.waiting_commit[tid]
             if op.mutation.tracked_op is not None:
@@ -833,9 +1041,13 @@ class ECBackend(PGBackend):
                     if err < 0:
                         break
                     parts.append(data)
-                self._read_piece(rop, shard,
-                                 b"".join(parts) if err == 0 else b"",
-                                 err)
+                if err != 0:
+                    piece = b""
+                elif len(parts) == 1:
+                    piece = parts[0]     # common case: no join copy
+                else:
+                    piece = b"".join(parts)  # copycheck: ok - multi-extent read reassembly
+                self._read_piece(rop, shard, piece, err)
             else:
                 self.host.send_shard(osd, MOSDECSubOpRead(
                     pgid=self.host.pgid_str, shard=shard,
@@ -1090,9 +1302,13 @@ class ECBackend(PGBackend):
                 pieces[s].append(dec[s])
             state["off"] += win
             if state["off"] >= shard_len:
+                # single-window objects skip the join copy entirely;
+                # multi-window recovery gathers once
                 self._push_recovered(
                     rec, attrs,
-                    {s: b"".join(pieces[s]) for s in missing_shards})
+                    {s: (pieces[s][0] if len(pieces[s]) == 1
+                         else b"".join(pieces[s]))  # copycheck: ok - multi-window recovery gather
+                     for s in missing_shards})
             else:
                 read_next()
 
@@ -1265,10 +1481,16 @@ class ECBackend(PGBackend):
                                  msg.errors[0][1])
             elif msg.buffers:
                 # multi-extent replies (CLAY sub-chunk repair runs)
-                # concatenate in request order into one payload
-                self._read_piece(
-                    rop, msg.shard,
-                    b"".join(b for _, _, b in msg.buffers), 0)
+                # concatenate in request order into one payload;
+                # single-extent replies pass through copy-free
+                if len(msg.buffers) == 1:
+                    self._read_piece(rop, msg.shard,
+                                     msg.buffers[0][2], 0)
+                else:
+                    self._read_piece(
+                        rop, msg.shard,
+                        b"".join(  # copycheck: ok - multi-buffer read-reply reassembly
+                            b for _, _, b in msg.buffers), 0)
             return True
         if isinstance(msg, MOSDPGPush):
             for push in msg.pushes:

@@ -30,6 +30,29 @@ import numpy as np
 HINFO_KEY = "hinfo_key"  # reference ECUtil.h ECUtil::get_hinfo_key()
 
 
+def nbytes_of(data) -> int:
+    """Byte length of any bytes-like (bytes, bytearray, memoryview,
+    uint8 ndarray) — the write path now threads views and arrays, not
+    just bytes."""
+    if isinstance(data, (bytes, bytearray)):
+        return len(data)
+    if isinstance(data, np.ndarray):
+        return data.nbytes
+    return memoryview(data).nbytes
+
+
+def as_stripe_array(data, nstripes: int, k: int,
+                    chunk_size: int) -> np.ndarray:
+    """View ``data`` as a [nstripes, k, chunk] uint8 array without
+    copying (buffer-protocol objects and ndarrays alike)."""
+    if isinstance(data, np.ndarray):
+        arr = data if data.dtype == np.uint8 \
+            else data.view(np.uint8)
+        return arr.reshape(nstripes, k, chunk_size)
+    return np.frombuffer(data, dtype=np.uint8).reshape(
+        nstripes, k, chunk_size)
+
+
 class StripeInfo:
     """reference ECUtil::stripe_info_t (ECUtil.h:27)."""
 
@@ -98,16 +121,16 @@ def encode(sinfo: StripeInfo, ec_impl, data: bytes,
     """
     k = ec_impl.get_data_chunk_count()
     m = ec_impl.get_coding_chunk_count()
-    assert len(data) % sinfo.stripe_width == 0, \
-        f"len {len(data)} not stripe aligned"
+    nb = nbytes_of(data)
+    assert nb % sinfo.stripe_width == 0, \
+        f"len {nb} not stripe aligned"
     if want is None:
         want = set(range(k + m))
-    nstripes = len(data) // sinfo.stripe_width
+    nstripes = nb // sinfo.stripe_width
     if nstripes == 0:
         return {i: b"" for i in want}
 
-    arr = np.frombuffer(data, dtype=np.uint8).reshape(
-        nstripes, k, sinfo.chunk_size)
+    arr = as_stripe_array(data, nstripes, k, sinfo.chunk_size)
     if hasattr(ec_impl, "encode_batch"):
         parity = ec_impl.encode_batch(arr)          # [B, m, chunk]
         out: Dict[int, bytes] = {}
@@ -140,8 +163,8 @@ def decode(sinfo: StripeInfo, ec_impl,
     """
     if not have:
         raise IOError("no chunks to decode from")
-    total = len(next(iter(have.values())))
-    assert all(len(v) == total for v in have.values()), \
+    total = nbytes_of(next(iter(have.values())))
+    assert all(nbytes_of(v) == total for v in have.values()), \
         "shard buffers must be equal length"
     assert total % sinfo.chunk_size == 0
     nstripes = total // sinfo.chunk_size
@@ -152,8 +175,9 @@ def decode(sinfo: StripeInfo, ec_impl,
         return {i: b"" for i in want}
 
     if hasattr(ec_impl, "decode_batch"):
-        present = {i: np.frombuffer(v, dtype=np.uint8).reshape(
-            nstripes, sinfo.chunk_size) for i, v in have.items()}
+        present = {i: as_stripe_array(v, nstripes, 1, sinfo.chunk_size)
+                   .reshape(nstripes, sinfo.chunk_size)
+                   for i, v in have.items()}
         rec = ec_impl.decode_batch(present, sinfo.chunk_size)
         out: Dict[int, bytes] = {}
         for i in want:
@@ -215,10 +239,11 @@ class HashInfo:
             f"append at {old_size} != hashed {self.total_chunk_size}"
         size = None
         for i, buf in chunks.items():
-            self.crcs[i] = crc32c(bytes(buf), self.crcs[i])
+            # crc32c reads straight from the buffer — no bytes() copy
+            self.crcs[i] = crc32c(buf, self.crcs[i])
             if size is None:
-                size = len(buf)
-            assert size == len(buf), "unequal chunk appends"
+                size = nbytes_of(buf)
+            assert size == nbytes_of(buf), "unequal chunk appends"
         if size:
             self.total_chunk_size += size
 
@@ -239,6 +264,6 @@ class HashInfo:
         return hi
 
 
-def chunk_crc(data: bytes) -> int:
+def chunk_crc(data) -> int:
     """CRC of a full shard object, for deep-scrub comparison."""
-    return crc32c(bytes(data))
+    return crc32c(data)

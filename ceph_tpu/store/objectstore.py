@@ -76,7 +76,14 @@ class Transaction:
 
     def write(self, coll: str, obj: GHObject, offset: int,
               data: bytes) -> "Transaction":
-        self.ops.append(("write", coll, obj, offset, bytes(data)))
+        # bytes/memoryview/uint8-ndarray payloads ride BY REFERENCE
+        # (the EC write path hands over encoded shard views; copying
+        # here would undo the zero-copy data path).  Ownership
+        # transfers: the caller must not mutate the buffer after
+        # queueing.  Mutable bytearrays still snapshot.
+        if isinstance(data, bytearray):
+            data = bytes(data)  # copycheck: ok - snapshot of a caller-mutable buffer
+        self.ops.append(("write", coll, obj, offset, data))
         return self
 
     def zero(self, coll: str, obj: GHObject, offset: int,
@@ -148,60 +155,79 @@ class Transaction:
     _OBJ_OPS = {"touch", "remove", "omap_clear"}
 
     def encode(self) -> bytes:
+        return Encoder().struct(1, 1, self._encode_body()).build()
+
+    @classmethod
+    def _encode_op(cls, body: Encoder, op: Tuple) -> None:
+        name = op[0]
+        body.str(name)
+        if name in cls._OBJ_OPS:
+            _, coll, obj = op
+            body.str(coll).str(obj.oid).i32(obj.shard)
+        elif name == "write":
+            _, coll, obj, offset, data = op
+            body.str(coll).str(obj.oid).i32(obj.shard)
+            body.u64(offset).bytes(data)
+        elif name in ("zero",):
+            _, coll, obj, offset, length = op
+            body.str(coll).str(obj.oid).i32(obj.shard)
+            body.u64(offset).u64(length)
+        elif name == "truncate":
+            _, coll, obj, size = op
+            body.str(coll).str(obj.oid).i32(obj.shard).u64(size)
+        elif name == "clone":
+            _, coll, src, dst = op
+            body.str(coll).str(src.oid).i32(src.shard)
+            body.str(dst.oid).i32(dst.shard)
+        elif name == "setattr":
+            _, coll, obj, attr, value = op
+            body.str(coll).str(obj.oid).i32(obj.shard)
+            body.str(attr).bytes(value)
+        elif name == "rmattr":
+            _, coll, obj, attr = op
+            body.str(coll).str(obj.oid).i32(obj.shard).str(attr)
+        elif name == "omap_setkeys":
+            _, coll, obj, kvs = op
+            body.str(coll).str(obj.oid).i32(obj.shard)
+            body.str_bytes_map(kvs)
+        elif name == "omap_rmkeys":
+            _, coll, obj, keys = op
+            body.str(coll).str(obj.oid).i32(obj.shard)
+            body.str_list(keys)
+        elif name == "omap_setheader":
+            _, coll, obj, header = op
+            body.str(coll).str(obj.oid).i32(obj.shard).bytes(header)
+        elif name in ("mkcoll", "rmcoll"):
+            _, coll = op
+            body.str(coll)
+        elif name == "coll_move_rename":
+            _, src_coll, src, dst_coll, dst = op
+            body.str(src_coll).str(src.oid).i32(src.shard)
+            body.str(dst_coll).str(dst.oid).i32(dst.shard)
+        else:
+            raise ValueError(f"unencodable op {name!r}")
+
+    def encode_parts(self) -> List:
+        """Wire form as a fragment list: small framing fields coalesce,
+        large write payloads stay as by-reference views — the messenger
+        sends the list as scatter-gather iovecs without ever joining
+        them (ECSubWrite's txn never round-trips through one big
+        bytes)."""
+        body = self._encode_body()
+        return Encoder().struct(1, 1, body).build_parts()
+
+    def _encode_body(self) -> Encoder:
         body = Encoder()
         body.u32(len(self.ops))
         for op in self.ops:
-            name = op[0]
-            body.str(name)
-            if name in self._OBJ_OPS:
-                _, coll, obj = op
-                body.str(coll).str(obj.oid).i32(obj.shard)
-            elif name == "write":
-                _, coll, obj, offset, data = op
-                body.str(coll).str(obj.oid).i32(obj.shard)
-                body.u64(offset).bytes(data)
-            elif name in ("zero",):
-                _, coll, obj, offset, length = op
-                body.str(coll).str(obj.oid).i32(obj.shard)
-                body.u64(offset).u64(length)
-            elif name == "truncate":
-                _, coll, obj, size = op
-                body.str(coll).str(obj.oid).i32(obj.shard).u64(size)
-            elif name == "clone":
-                _, coll, src, dst = op
-                body.str(coll).str(src.oid).i32(src.shard)
-                body.str(dst.oid).i32(dst.shard)
-            elif name == "setattr":
-                _, coll, obj, attr, value = op
-                body.str(coll).str(obj.oid).i32(obj.shard)
-                body.str(attr).bytes(value)
-            elif name == "rmattr":
-                _, coll, obj, attr = op
-                body.str(coll).str(obj.oid).i32(obj.shard).str(attr)
-            elif name == "omap_setkeys":
-                _, coll, obj, kvs = op
-                body.str(coll).str(obj.oid).i32(obj.shard)
-                body.str_bytes_map(kvs)
-            elif name == "omap_rmkeys":
-                _, coll, obj, keys = op
-                body.str(coll).str(obj.oid).i32(obj.shard)
-                body.str_list(keys)
-            elif name == "omap_setheader":
-                _, coll, obj, header = op
-                body.str(coll).str(obj.oid).i32(obj.shard).bytes(header)
-            elif name in ("mkcoll", "rmcoll"):
-                _, coll = op
-                body.str(coll)
-            elif name == "coll_move_rename":
-                _, src_coll, src, dst_coll, dst = op
-                body.str(src_coll).str(src.oid).i32(src.shard)
-                body.str(dst_coll).str(dst.oid).i32(dst.shard)
-            else:
-                raise ValueError(f"unencodable op {name!r}")
-        return Encoder().struct(1, 1, body).build()
+            self._encode_op(body, op)
+        return body
 
     @classmethod
-    def decode(cls, buf: bytes) -> "Transaction":
+    def decode(cls, buf) -> "Transaction":
+        if isinstance(buf, (list, tuple)):
+            # locally-looped message carrying encode_parts() fragments
+            buf = b"".join(buf)
         _, d = Decoder(buf).struct(1)
         t = cls()
         for _ in range(d.u32()):

@@ -79,9 +79,29 @@ def _opts() -> List[Option]:
                description="stripes gathered per device call"),
         Option("ec_tpu_queue_window_us", int, 200, min=0,
                description="max microseconds a stripe waits for a batch"),
+        Option("ec_tpu_queue_window_max_us", int, 0, min=0,
+               description="ceiling for the admission-aware coalescing "
+                           "window (0 = auto: max(16x base, 20ms)); the "
+                           "effective window doubles under sustained "
+                           "queue pressure and shrinks back when the "
+                           "queue drains"),
+        Option("osd_ec_pipeline_segment_bytes", int, 2 << 20, min=0,
+               description="segment size for pipelined EC writes: an "
+                           "aligned write larger than this is encoded "
+                           "and fanned out segment-by-segment so the "
+                           "encode of segment N+1 overlaps the "
+                           "sub-write fanout of segment N (0 disables "
+                           "segmentation)"),
         Option("ec_tpu_fallback_cpu", bool, True,
                description="CPU bit-plane path when no TPU is present "
                            "(monitors validate profiles without devices)"),
+        Option("ec_tpu_min_device_bytes", int, 0, min=0,
+               description="pin the device/CPU-twin routing crossover: "
+                           "encode groups smaller than this route to "
+                           "the batched CPU twin (0 = learn the "
+                           "crossover adaptively at runtime; pin it "
+                           "after characterizing the host so routing "
+                           "does not depend on the learning race)"),
         # -- osd (reference options.cc:2869-2901,2478,3159) ---------------
         Option("osd_backend", str, "classic",
                enum_allowed=("classic", "crimson"),

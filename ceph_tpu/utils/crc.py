@@ -83,10 +83,19 @@ def available_native() -> bool:
     return _load() is not None
 
 
-def crc32c(data: bytes, crc: int = 0) -> int:
-    """Running CRC32C; chain by passing the previous value."""
+def crc32c(data, crc: int = 0) -> int:
+    """Running CRC32C over any bytes-like; chain by passing the
+    previous value.  Writable buffers (bytearray, memoryview, uint8
+    ndarray) are checksummed in place; immutable bytes need the ctypes
+    copy (from_buffer rejects them)."""
     lib = _load()
     if lib is None:
+        if not isinstance(data, (bytes, bytearray)):
+            data = bytes(data)
         return _py_crc32c(data, crc)
-    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data)
-    return lib.crc32c(crc, buf, len(data))
+    n = len(data)
+    try:
+        buf = (ctypes.c_uint8 * n).from_buffer(data)
+    except (TypeError, ValueError, BufferError):
+        buf = (ctypes.c_uint8 * n).from_buffer_copy(data)
+    return lib.crc32c(crc, buf, n)

@@ -21,8 +21,32 @@ class DecodeError(ValueError):
     """Malformed or truncated buffer (maps buffer::malformed_input)."""
 
 
+# Buffers at/above this size are appended by reference (as a flat
+# memoryview) instead of being copied into the encoder.  Callers hand
+# over ownership: a buffer passed to Encoder.bytes()/bytes_parts()
+# must not be mutated until the encoded output has been consumed.
+ZC_MIN = 2048
+
+
+def _flat_view(v) -> Optional[memoryview]:
+    """1-D byte view of any C-contiguous bytes-like / ndarray, else
+    None (caller falls back to a copy)."""
+    try:
+        m = memoryview(v)
+    except TypeError:
+        return None
+    if not m.c_contiguous:
+        return None
+    return m.cast("B") if (m.ndim != 1 or m.format != "B") else m
+
+
 class Encoder:
-    """Append-only little-endian encoder (reference encode(..., bl))."""
+    """Append-only little-endian encoder (reference encode(..., bl)).
+
+    Large buffers (>= ZC_MIN) are held by reference; ``build()`` joins
+    everything into one bytes, while ``build_parts()`` returns a short
+    iovec-style list (small parts coalesced, large buffers untouched)
+    suitable for scatter-gather ``socket.sendmsg``."""
 
     def __init__(self) -> None:
         self._parts: List[bytes] = []
@@ -53,10 +77,41 @@ class Encoder:
         return self.u8(1 if v else 0)
 
     # -- length-prefixed payloads -----------------------------------------
-    def bytes(self, v: bytes) -> "Encoder":
-        """u32 length + raw bytes (reference encode(bufferlist))."""
-        self.u32(len(v))
-        self._parts.append(bytes(v))
+    def bytes(self, v) -> "Encoder":
+        """u32 length + raw bytes (reference encode(bufferlist)).
+        bytes pass through untouched; other bytes-likes (bytearray,
+        memoryview, uint8 ndarray) are referenced without a copy when
+        large, so the payload rides as an iovec to the socket."""
+        if type(v) is bytes:
+            self.u32(len(v))
+            self._parts.append(v)
+            return self
+        m = _flat_view(v)
+        if m is None:
+            b = bytes(v)
+            self.u32(len(b))
+            self._parts.append(b)
+            return self
+        self.u32(m.nbytes)
+        self._parts.append(m if m.nbytes >= ZC_MIN else m.tobytes())
+        return self
+
+    def bytes_parts(self, parts) -> "Encoder":
+        """One length-prefixed buffer supplied as a list of fragments
+        (e.g. Transaction.encode_parts()); fragments are referenced,
+        never joined."""
+        views = []
+        total = 0
+        for p in parts:
+            m = _flat_view(p)
+            if m is None:
+                m = bytes(p)
+                total += len(m)
+            else:
+                total += m.nbytes
+            views.append(m)
+        self.u32(total)
+        self._parts.extend(views)
         return self
 
     def str(self, v: str) -> "Encoder":
@@ -91,13 +146,33 @@ class Encoder:
     # -- versioned envelope (ENCODE_START/ENCODE_FINISH) ------------------
     def struct(self, struct_v: int, compat_v: int,
                body: "Encoder") -> "Encoder":
-        payload = body.build()
-        self.u8(struct_v).u8(compat_v).u32(len(payload))
-        self._parts.append(payload)
+        self.u8(struct_v).u8(compat_v).u32(body.nbytes())
+        self._parts.extend(body._parts)
         return self
+
+    def nbytes(self) -> int:
+        return sum(len(p) for p in self._parts)
 
     def build(self) -> bytes:
         return b"".join(self._parts)
+
+    def build_parts(self) -> List:
+        """Iovec-style part list: runs of small fragments are joined
+        into one bytes each; large by-reference buffers stay as-is so
+        no payload byte is copied."""
+        out: List = []
+        run: List[bytes] = []
+        for p in self._parts:
+            if len(p) >= ZC_MIN:
+                if run:
+                    out.append(run[0] if len(run) == 1 else b"".join(run))
+                    run = []
+                out.append(p)
+            else:
+                run.append(p)
+        if run:
+            out.append(run[0] if len(run) == 1 else b"".join(run))
+        return out
 
 
 class Decoder:

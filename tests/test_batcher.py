@@ -407,7 +407,7 @@ def test_cpu_routed_group_still_coalesces(codec):
     b = make_batcher()
     try:
         EncodeBatcher._min_device_bytes = 1 << 30   # force CPU route
-        b._probe_tick = 1                           # avoid probe tick
+        EncodeBatcher._probe_tick = 1               # avoid probe tick
         sinfo = ecutil.StripeInfo(2, 8192)
         d1 = os.urandom(3 * 8192)
         d2 = os.urandom(5 * 8192)
@@ -521,3 +521,108 @@ def test_stage_counters_and_tracked_events(codec):
         assert dev > 0
     finally:
         b.stop()
+
+
+def test_admission_window_grows_under_pressure_and_cuts(codec):
+    """The coalescing window is admission-aware: submits arriving at
+    window expiry extend it (bounded), and a cycle that closes with no
+    joiners shrinks it back toward the base."""
+    b = make_batcher(ec_tpu_queue_window_us=80_000)
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        base = b.window_base_s
+        got = []
+        done = threading.Event()
+
+        def cb(chunks):
+            got.append(chunks)
+            if len(got) >= 2:
+                done.set()
+
+        b.submit(codec, sinfo, os.urandom(2 * 8192), cb)
+        time.sleep(0.04)                  # mid-window: a joiner lands
+        b.submit(codec, sinfo, os.urandom(2 * 8192), cb)
+        assert done.wait(30)
+        assert b.window_grows >= 1, \
+            "late joiner did not extend the admission window"
+        assert b.dyn_window_s > base
+        assert b.dyn_window_s <= b.window_max_s
+        assert b.queue_depth_hwm >= 2
+
+        # a lone op afterwards closes its window with no joiners: the
+        # window must shrink back toward base
+        lone = threading.Event()
+        b.submit(codec, sinfo, os.urandom(2 * 8192),
+                 lambda _c: lone.set())
+        assert lone.wait(30)
+        assert b.window_cuts >= 1, \
+            "drained queue did not cut the admission window"
+        assert b.dyn_window_s < 2 * base + 1e-9
+    finally:
+        b.stop()
+
+
+def test_view_based_encode_bit_exact_with_bytes_path(codec):
+    """memoryview / bytearray / ndarray submissions must produce
+    chunks byte-identical to the synchronous bytes-input encode (the
+    zero-copy rework may change buffer types, never content)."""
+    sinfo = ecutil.StripeInfo(2, 8192)
+    data = os.urandom(4 * 8192)
+    ref = ecutil.encode(sinfo, codec, data)
+    for variant in (memoryview(data), bytearray(data),
+                    np.frombuffer(data, dtype=np.uint8)):
+        b = make_batcher(ec_tpu_queue_window_us=1_000)
+        try:
+            out = {}
+            ev = threading.Event()
+
+            def cb(chunks):
+                out["c"] = chunks
+                ev.set()
+
+            b.submit(codec, sinfo, variant, cb)
+            assert ev.wait(30)
+            got = out["c"]
+            assert set(got) == set(ref)
+            for s in ref:
+                assert bytes(got[s]) == bytes(ref[s]), \
+                    f"shard {s} diverged for {type(variant).__name__}"
+        finally:
+            b.stop()
+
+
+def test_cluster_workload_device_routes_and_window_adapts():
+    """Cluster-shaped workload: concurrent client writes must land in
+    at least one DEVICE-routed encode group, and the admission window
+    must both grow (overlapping waves) and cut (drained solo ops)."""
+    conf = make_conf(ec_tpu_queue_window_us=150_000,
+                     ec_tpu_fallback_cpu=False)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("aw", plugin="tpu", k="2", m="1")
+        c.create_pool("awp", "erasure", erasure_code_profile="aw")
+        io = c.rados().open_ioctx("awp")
+        blob = os.urandom(48 << 10)
+        # wave 1 opens the windows; wave 2 lands mid-window → grow
+        w1 = [io.aio_write_full(f"a{i}", blob) for i in range(8)]
+        time.sleep(0.07)
+        w2 = [io.aio_write_full(f"b{i}", blob) for i in range(8)]
+        for comp in w1 + w2:
+            assert comp.wait(30) == 0
+        batchers = [o.encode_batcher for o in c.osds.values()
+                    if o is not None]
+        assert sum(b.calls for b in batchers) >= 1, \
+            "no device-routed encode group in a cluster workload"
+        assert sum(b.cpu_reqs for b in batchers) == 0
+        assert sum(b.window_grows for b in batchers) >= 1, \
+            "overlapping write waves never grew a window"
+        # sequential solo writes drain each primary's queue → cut
+        for i in range(6):
+            assert io.aio_write_full(f"s{i}", blob).wait(30) == 0
+        assert sum(b.window_cuts for b in batchers) >= 1, \
+            "drained queues never cut a grown window"
+        assert sum(b.queue_depth_hwm for b in batchers) >= 2
+        for i in range(8):
+            assert io.read(f"a{i}") == blob
+            assert io.read(f"b{i}") == blob

@@ -7,6 +7,7 @@ exactly-once ordering under injected socket failures (reference
 ms_inject_socket_failures, common/options.cc:1075), and corrupt-frame
 recovery.
 """
+import os
 import threading
 import time
 
@@ -15,7 +16,7 @@ import pytest
 from ceph_tpu.msg import messages as M
 from ceph_tpu.msg.message import (MSG_REGISTRY, decode_frame_body,
                                   decode_frame_header, encode_frame,
-                                  HEADER_LEN)
+                                  encode_frame_parts, HEADER_LEN)
 from ceph_tpu.msg.messenger import Dispatcher, Messenger
 from ceph_tpu.utils.config import Config
 from ceph_tpu.utils.encoding import DecodeError
@@ -415,3 +416,48 @@ def test_thread_count_documented_at_scale():
         f"would leave the measured hundreds")
     # and the absolute stays sane at the larger size
     assert counts[6] < 300, counts
+
+
+@pytest.mark.parametrize("msg", sample_messages(),
+                         ids=lambda m: m.get_type_name())
+def test_frame_parts_bitexact_with_joined_frame(msg):
+    """The scatter-gather iovec list must serialize to EXACTLY the
+    bytes of the joined frame (CRC folded over parts included), so a
+    sendmsg sender and a recv-side joiner always agree."""
+    msg.seq = 31
+    parts = encode_frame_parts(msg)
+    assert b"".join(parts) == encode_frame(msg)
+
+
+def test_large_payload_rides_frame_parts_by_reference():
+    """An EC sub-write's transaction buffer must appear in the frame
+    iovecs as the SAME object — the wire path may not copy it."""
+    blob = os.urandom(64 << 10)
+    m = M.MOSDECSubOpWrite(pgid="1.2", shard=3, from_osd=0, tid=8,
+                           epoch=4, txn=blob, log_entries=[],
+                           at_version=(4, 17))
+    m.seq = 1
+    parts = encode_frame_parts(m)
+    assert any(p is blob for p in parts), \
+        "txn payload was copied into the frame instead of riding " \
+        "the iovec list by reference"
+
+
+def test_plain_wire_path_notes_no_copies(pair):
+    """Sending a large message over the plain (no compression, no
+    secure mode) wire must record ZERO tracked hot-path copies: the
+    payload rides sendmsg iovecs straight from the caller's buffer."""
+    from ceph_tpu.utils import copytrack
+    server, client, addr, _ = pair
+    sink = Collector()
+    server.add_dispatcher(sink)
+    conn = client.connect_to(addr)
+    copytrack.reset()
+    blob = os.urandom(256 << 10)
+    conn.send_message(M.MOSDECSubOpWrite(
+        pgid="1.2", shard=0, from_osd=0, tid=1, epoch=1, txn=blob,
+        log_entries=[], at_version=(1, 1)))
+    assert sink.wait_for(1)
+    assert bytes(sink.msgs[0].txn) == blob
+    snap = copytrack.snapshot()
+    assert snap["bytes"] == 0, snap
