@@ -639,13 +639,28 @@ def machine_factor() -> float:
 
 
 def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
-                 n_osds=3, osd_backend="classic"):
+                 n_osds=3, osd_backend="classic",
+                 fault_spec="", fault_seed=0, mid_run_outage=False,
+                 extra_conf=None):
     """One vstart-style run: write MB/s + rebuild MB/s (+ the
-    primary-side batcher's coalescing counters)."""
+    primary-side batcher's coalescing counters).  ``fault_spec`` arms
+    the process fault registry for the run (see ceph_tpu/utils/faults);
+    ``mid_run_outage`` additionally takes the device hard-down partway
+    through the write phase so the breaker opens, then restores the
+    probabilistic schedule so the probe tick can re-admit it."""
     from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.osd.batcher import EncodeBatcher
+    from ceph_tpu.utils import faults as faultlib
 
+    # each run isolates its fault/breaker evidence: counters in the
+    # returned stats must belong to THIS run, not a previous config
+    faultlib.registry().reset()
+    EncodeBatcher.reset_breaker()
     f = machine_factor()
     overrides = {"osd_backend": osd_backend}
+    if fault_spec:
+        overrides.update(fault_injection=fault_spec,
+                         fault_injection_seed=fault_seed)
     if n_osds > 4:
         # many daemons on few cores: slow the heartbeat chatter and
         # scale the grace by measured machine speed so scheduler
@@ -707,6 +722,8 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                 overrides["ec_tpu_min_device_bytes"] = 256 << 20
         except Exception:
             pass                     # calibration is best-effort
+    if extra_conf:
+        overrides.update(extra_conf)
     with Cluster(n_osds=n_osds, conf=test_config(**overrides)) as c:
         for i in range(n_osds):
             c.wait_for_osd_up(i, 30)
@@ -727,8 +744,58 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         t0 = time.perf_counter()
         comps = [io.aio_write_full(f"b{i}", blob)
                  for i in range(n_objs)]
+        if mid_run_outage:
+            # chaos soak: once the pipeline is demonstrably live
+            # (first completion landed — progress-driven, not
+            # wall-clock, so the outage lands mid-run at any machine
+            # speed), take the device hard-down (every dispatch fails
+            # even after retries) with one OSD's store wedged for the
+            # duration; the rest of the timed write stream rides the
+            # outage on the CPU-twin fallback.
+            import threading
+            regi = faultlib.registry()
+            deadline = time.monotonic() + 60 * f
+
+            def _done():
+                return sum(1 for cp in comps if cp.is_complete())
+            while _done() < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            regi.arm(faultlib.DEVICE_DISPATCH, mode="error", every=1)
+            # ONE OSD stalls: store applies wedge only on the victim's
+            # op threads (they are named osd{N}-...), everyone else
+            # stays healthy — the EC fanout must ride it out
+            victim_prefix = f"osd{n_osds // 2}-"
+            regi.arm(faultlib.STORE_APPLY, mode="stall", every=1,
+                     stall_s=0.03,
+                     match=lambda txns: threading.current_thread()
+                     .name.startswith(victim_prefix))
         assert all(comp.wait(60 * f) == 0 for comp in comps)
         write_s = time.perf_counter() - t0
+        if mid_run_outage:
+            # the client stream alone can drain before
+            # ec_tpu_device_error_threshold CONSECUTIVE post-retry
+            # failures accumulate (an in-flight straggler's success
+            # resets the run), so drive untimed serial writes under
+            # the still-armed outage until the breaker opens, then
+            # lift the outage, prime the shared probe tick so the
+            # next CPU-routed group is a re-admission probe, and
+            # drive writes until the probe closes the breaker — both
+            # transitions land in this run's exported counters.
+            for i in range(64):
+                if EncodeBatcher._breaker_open:
+                    break
+                io.write_full(f"chaos{i}", blob[:256 << 10])
+            regi.disarm(faultlib.STORE_APPLY)
+            if fault_spec and "device.dispatch" in fault_spec:
+                regi.arm(faultlib.DEVICE_DISPATCH, mode="error",
+                         one_in=20)
+            else:
+                regi.disarm(faultlib.DEVICE_DISPATCH)
+            EncodeBatcher._probe_tick = -1
+            for i in range(64):
+                if not EncodeBatcher._breaker_open:
+                    break
+                io.write_full(f"probe{i}", blob[:256 << 10])
         snap = copytrack.snapshot()
         stats = {"calls": 0, "reqs": 0, "coalesced": 0, "cpu": 0,
                  "cpu_calls": 0, "write_wall_s": write_s,
@@ -770,6 +837,34 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                     if t_enc is not None and t_com is not None:
                         stages["commit"] += max(0.0, t_com - t_enc)
         stats["stages"] = stages
+        # degraded-mode evidence: fault-site trip counters, the shared
+        # device circuit breaker, and the sub-write deadline counters
+        # summed over the OSD perf dumps — the chaos soak asserts its
+        # acceptance from exactly these exported numbers
+        stats["faults"] = faultlib.registry().counters()
+        stats["breaker"] = {"opens": EncodeBatcher._breaker_opens,
+                            "closes": EncodeBatcher._breaker_closes,
+                            "open_now":
+                                int(EncodeBatcher._breaker_open)}
+        sw = {"timeouts": 0, "retries": 0, "peer_reports": 0}
+        dev_err = enc_err = 0
+        for osd in c.osds.values():
+            b = getattr(osd, "encode_batcher", None)
+            if b is not None:
+                dev_err += getattr(b, "device_errors", 0)
+                enc_err += getattr(b, "encode_errors", 0)
+            try:
+                _, _, dump = osd._exec_command({"prefix": "perf dump"})
+                po = dump.get("osd", {})
+                sw["timeouts"] += po.get("ec_subwrite_timeouts", 0)
+                sw["retries"] += po.get("ec_subwrite_retries", 0)
+                sw["peer_reports"] += po.get(
+                    "ec_subwrite_peer_reports", 0)
+            except Exception:
+                pass
+        stats["breaker"]["device_errors"] = dev_err
+        stats["breaker"]["encode_errors"] = enc_err
+        stats["subwrite"] = sw
         c.wait_for_clean(30)
         victim = n_osds - 1
         c.kill_osd(victim, lose_data=True)
@@ -839,6 +934,9 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
             "queue_depth_hwm": st.get("queue_depth_hwm", 0),
             "window_grows": st.get("window_grows", 0),
             "window_cuts": st.get("window_cuts", 0),
+            "faults": st.get("faults", {}),
+            "breaker": st.get("breaker", {}),
+            "subwrite_deadlines": st.get("subwrite", {}),
         }), flush=True)
     emit(f"OSD rebuild MB/s (k=8 m=4 pool, kill osd with data loss; "
          f"recovery decodes batched through the OSD coalescer: "
@@ -932,6 +1030,66 @@ def bench_cluster(n_objs=8, obj_bytes=4 << 20):
          r_tpu, "MB/s", r_tpu / r_cpu)
 
 
+def bench_chaos_soak(n_objs=26, obj_bytes=8 << 20):
+    """Degraded-mode acceptance run: the cluster_k8m4 write workload
+    once fault-free and once under a seeded 1-in-20 device-dispatch
+    fault schedule with a mid-run hard device outage while one OSD's
+    store is wedged (stalled applies on its op threads only).  Both
+    runs pin identical routing conf
+    (ec_tpu_fallback_cpu off so every encode group actually consults
+    the device site, probe interval shortened so the breaker's
+    re-admission probe lands within the run), so the throughput ratio
+    isolates the cost of the faults.  Asserts, from the exported
+    counters alone: zero client-visible errors (every aio completion
+    returned 0 or _cluster_run would have raised), faults actually
+    tripped, the breaker opened AND re-admitted the device, and
+    degraded throughput held >= 0.5x fault-free."""
+    pin = {"ec_tpu_fallback_cpu": False,
+           "ec_tpu_crossover_probe_interval": 4}
+    w_ff, _, st_ff = _cluster_run("tpu", n_objs, obj_bytes,
+                                  k="8", m="4", n_osds=13,
+                                  extra_conf=pin)
+    w_ch, _, st = _cluster_run("tpu", n_objs, obj_bytes,
+                               k="8", m="4", n_osds=13,
+                               fault_spec="device.dispatch:error:1in20",
+                               fault_seed=42, mid_run_outage=True,
+                               extra_conf=pin)
+    faults = st.get("faults", {})
+    brk = st.get("breaker", {})
+    dd = faults.get("device.dispatch", {})
+    assert dd.get("trips", 0) > 0, \
+        f"chaos soak injected no device faults: {faults}"
+    assert brk.get("opens", 0) >= 1, \
+        f"breaker never opened under hard outage: {brk}"
+    assert brk.get("closes", 0) >= 1, \
+        f"breaker never re-admitted the device: {brk}"
+    ratio = w_ch / w_ff if w_ff else 0.0
+    assert ratio >= 0.5, \
+        (f"degraded throughput {w_ch:.1f} MB/s fell below half of "
+         f"fault-free {w_ff:.1f} MB/s")
+    emit(f"chaos soak write MB/s (13-OSD k=8 m=4, seeded 1-in-20 "
+         f"device-dispatch faults + mid-run device outage with one "
+         f"OSD's store wedged; {dd.get('trips', 0)} faults tripped over "
+         f"{dd.get('hits', 0)} dispatch checks, breaker opened "
+         f"{brk.get('opens', 0)}x / re-admitted {brk.get('closes', 0)}"
+         f"x, {brk.get('device_errors', 0)} classified device errors, "
+         f"0 client-visible errors; baseline=same conf fault-free "
+         f"{w_ff:.1f} MB/s)", w_ch, "MB/s", ratio)
+    print(json.dumps({
+        "metric": "chaos soak degraded/fault-free write ratio "
+                  "(zero client errors; breaker open+re-admit "
+                  "asserted from exported counters)",
+        "value": round(ratio, 3), "unit": "ratio",
+        "vs_baseline": round(ratio, 3),
+        "write_mbps": {"fault_free": round(w_ff, 2),
+                       "chaos": round(w_ch, 2)},
+        "faults": faults,
+        "breaker": brk,
+        "subwrite_deadlines": st.get("subwrite", {}),
+        "fault_free_breaker": st_ff.get("breaker", {}),
+    }), flush=True)
+
+
 CONFIGS = {
     "roofline": bench_roofline,
     "rs_k2m1": lambda: bench_encode_rs(2, 1, 4 << 10, 1024),
@@ -947,7 +1105,11 @@ CONFIGS = {
 }
 
 
-EXTRA_CONFIGS = {}
+EXTRA_CONFIGS = {
+    # opt-in (--only chaos_soak): two full k8m4 runs, excluded from
+    # the default sweep to keep its wall time unchanged
+    "chaos_soak": bench_chaos_soak,
+}
 CONFIGS_ALL = dict(CONFIGS, **EXTRA_CONFIGS)
 
 

@@ -43,6 +43,14 @@ class RadosError(OSError):
     pass
 
 
+class RadosTimeoutError(RadosError, TimeoutError):
+    """An op outlived rados_osd_op_timeout: surfaced as ETIMEDOUT
+    (reference Objecter op_cancel(-ETIMEDOUT) on osd_timeout)."""
+
+    def __init__(self, msg: str):
+        super().__init__(110, msg)       # errno 110 = ETIMEDOUT
+
+
 class Completion:
     """One in-flight op (reference librados AioCompletion)."""
 
@@ -65,7 +73,7 @@ class Completion:
             # objecter_inflight_ops/bytes window until the whole
             # client wedged)
             self._objecter.cancel(self.tid)
-            raise TimeoutError(f"op tid={self.tid} timed out")
+            raise RadosTimeoutError(f"op tid={self.tid} timed out")
         return self.result
 
     def is_complete(self) -> bool:
@@ -706,8 +714,9 @@ class Rados:
         n = secrets.randbits(48)
         self.conf = conf or default_config()
         if op_timeout is None:
-            # reference rados_osd_op_timeout; its 0-means-never is a
-            # hang in tests, so 0 falls back to the library default
+            # reference rados_osd_op_timeout (now defaulting nonzero);
+            # an explicit 0 would mean wait-forever — a hang in tests,
+            # so it still falls back to the library default
             op_timeout = self.conf["rados_osd_op_timeout"] or 30.0
         self.op_timeout = op_timeout
         self.tracer = None

@@ -123,6 +123,11 @@ class Cluster:
         return path
 
     def start(self) -> "Cluster":
+        # arm the process-wide fault registry from the cluster conf
+        # before any daemon boots; idempotent, so per-OSD re-configure
+        # at restart keeps the sites' RNG streams
+        from .utils import faults as faultlib
+        faultlib.configure_from(self.conf)
         # construct every mon first (each binds its port), then share
         # the monmap and start them (reference monmaptool --add before
         # first boot)

@@ -21,7 +21,6 @@ but drives it from the reactor.
 """
 from __future__ import annotations
 
-import random
 import socket
 from typing import Optional
 
@@ -142,7 +141,6 @@ class CrimsonConnection(Connection):
         gen = self._reg_gen
         if sock is None:
             return
-        inject = self.msgr.conf["ms_inject_socket_failures"]
         while True:
             # same per-message session mutation as _writer_main: stamp
             # seq once, remember for resend if lossless
@@ -158,7 +156,9 @@ class CrimsonConnection(Connection):
                         msg.seq = self.out_seq
                     if self.lossless:
                         self.unacked.append(msg)
-            if inject and random.randrange(inject) == 0:
+            # shared msg.send injection point (same registry site and
+            # ms_inject_socket_failures absorption as _writer_main)
+            if self._inject_send_fault():
                 self._io_error(sock, gen)
                 return
             for part in encode_frame_parts(
@@ -195,6 +195,9 @@ class CrimsonConnection(Connection):
         sock = self._reg_sock
         gen = self._reg_gen
         if sock is None:
+            return
+        if self._inject_recv_fault():
+            self._io_error(sock, gen)
             return
         try:
             for _ in range(_RECV_ROUNDS):

@@ -98,6 +98,12 @@ class CrimsonOSD(OSD):
         return CrimsonMessenger(f"osd.{self.whoami}", conf=self.conf,
                                 reactor=self.reactor)
 
+    def _call_later(self, delay: float, fn):
+        # EC sub-write deadlines fire as reactor timers, so their
+        # re-request/report continuations run on the reactor thread
+        # like every other PG continuation (no extra timer threads)
+        return self.reactor.call_later(delay, fn)
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self.reactor.start()

@@ -61,8 +61,17 @@ def render(osdmap, perf: Dict[str, dict]) -> str:
     families: Dict[str, List[Tuple[str, float]]] = {}
     ftypes: Dict[str, str] = {}
     hists: Dict[str, List[Tuple[str, List[float], List[int]]]] = {}
+    # fault-injection sites (utils/faults.py counters riding the OSD
+    # perf dump): site names carry dots, so they become a label —
+    # ceph_fault_site_trips{daemon=...,site="device.dispatch"}
+    fault_samples: List[Tuple[str, str, dict]] = []
     for daemon in sorted(perf):
         for subsys, counters in perf[daemon].items():
+            if subsys == "faults":
+                for site, c in sorted(counters.items()):
+                    if isinstance(c, dict):
+                        fault_samples.append((daemon, site, c))
+                continue
             for cname, val in counters.items():
                 metric = f"ceph_{subsys}_{cname}"
                 if isinstance(val, dict) and "buckets" in val:
@@ -106,6 +115,15 @@ def render(osdmap, perf: Dict[str, dict]) -> str:
                 f'{metric}_bucket{{daemon="{daemon}",'
                 f'le="+Inf"}} {cum}')
             lines.append(f'{metric}_count{{daemon="{daemon}"}} {cum}')
+    for cname, ftype in (("hits", "counter"), ("trips", "counter"),
+                         ("armed", "gauge")):
+        if not fault_samples:
+            break
+        metric = f"ceph_fault_site_{cname}"
+        lines.append(f"# TYPE {metric} {ftype}")
+        for daemon, site, c in fault_samples:
+            lines.append(f'{metric}{{daemon="{daemon}",'
+                         f'site="{site}"}} {int(c.get(cname, 0))}')
     return "\n".join(lines) + "\n"
 
 

@@ -65,9 +65,24 @@ class MonClient(Dispatcher):
         # resets the session immediately so hunting can move on,
         # instead of a lossless reconnect loop pinning us to a corpse
         with self.lock:
-            if self.conn is None or not self.conn.is_connected():
+            # "closed", not "not open": a conn mid-handshake is the
+            # same session, and treating it as dead would re-send the
+            # subscription below on every call until the handshake
+            # lands — each one costing the mon a full-map publish
+            rebuilt = self.conn is None or self.conn.state == "closed"
+            if rebuilt:
                 self.conn = self.msgr.connect_to(self.mon_addr,
                                                  lossless=False)
+            conn, sub = self.conn, self._sub_epoch
+        if rebuilt and sub is not None:
+            # a rebuilt session has no server-side state: renew the
+            # map subscription (reference MonClient resubscribes on
+            # session open), or a daemon whose mon link died
+            # transiently — e.g. an injected socket fault — silently
+            # stops receiving maps and reports PG stats at a stale
+            # epoch forever
+            conn.send_message(
+                MMonSubscribe(what={"osdmap": self._latest_epoch + 1}))
 
     def _mon_conn(self) -> Connection:
         self.connect()

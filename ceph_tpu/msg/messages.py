@@ -201,13 +201,17 @@ class MOSDECSubOpWrite(Message):
                  from_osd: int = -1, tid: int = 0, epoch: int = 0,
                  txn: bytes = b"", log_entries: Optional[list] = None,
                  at_version: Tuple[int, int] = (0, 0),
-                 trace_id: int = 0, parent_span_id: int = 0):
+                 trace_id: int = 0, parent_span_id: int = 0,
+                 seg: int = 0):
         super().__init__()
         self.pgid = pgid             # str(PGid), shard-free
         self.shard = shard           # destination shard position
         self.from_osd = from_osd     # primary's osd id
         self.tid = tid
         self.epoch = epoch
+        self.seg = seg               # pipeline segment index within
+                                     # the tid (deadline re-requests
+                                     # dedup on (from, tid, seg))
         # encoded store Transaction: bytes, or a list of buffer
         # fragments (Transaction.encode_parts()) kept by reference
         # until the socket — receivers always see joined bytes
@@ -229,6 +233,7 @@ class MOSDECSubOpWrite(Message):
         e.u32(self.at_version[0]).u64(self.at_version[1])
         e.u64(self.trace_id)
         e.u64(self.parent_span_id)
+        e.u32(self.seg)
         return e
 
     def encode_payload(self) -> bytes:
@@ -247,6 +252,7 @@ class MOSDECSubOpWrite(Message):
         m.at_version = (d.u32(), d.u64())
         m.trace_id = d.u64()
         m.parent_span_id = d.u64()
+        m.seg = d.u32()
         return m
 
 
@@ -256,7 +262,8 @@ class MOSDECSubOpWriteReply(Message):
 
     def __init__(self, pgid: str = "", shard: int = -1,
                  from_osd: int = -1, tid: int = 0, epoch: int = 0,
-                 committed: bool = True, result: int = 0):
+                 committed: bool = True, result: int = 0,
+                 seg: int = 0):
         super().__init__()
         self.pgid = pgid
         self.shard = shard           # replying shard
@@ -265,12 +272,15 @@ class MOSDECSubOpWriteReply(Message):
         self.epoch = epoch
         self.committed = committed
         self.result = result
+        self.seg = seg               # acked segment index (primary
+                                     # drops duplicate seg acks)
 
     def encode_payload(self) -> bytes:
         e = Encoder()
         e.str(self.pgid).i32(self.shard).i32(self.from_osd)
         e.u64(self.tid).u32(self.epoch).bool(self.committed)
         e.i32(self.result)
+        e.u32(self.seg)
         return e.build()
 
     @classmethod
@@ -278,7 +288,7 @@ class MOSDECSubOpWriteReply(Message):
         d = Decoder(buf)
         return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
                    tid=d.u64(), epoch=d.u32(), committed=d.bool(),
-                   result=d.i32())
+                   result=d.i32(), seg=d.u32())
 
 
 @register

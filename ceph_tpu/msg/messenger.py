@@ -44,6 +44,7 @@ from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..utils import copytrack
+from ..utils import faults as faultlib
 from ..utils.config import Config, default_config
 from ..utils.encoding import DecodeError
 from .message import (CRC_LEN, HEADER_LEN, Message, decode_frame_body,
@@ -411,6 +412,21 @@ class Connection:
             for d in self.msgr.dispatchers:
                 d.ms_handle_reset(self)
 
+    def _inject_send_fault(self) -> bool:
+        """Shared ``msg.send`` injection point — classic and crimson
+        writers consult this before every frame write.  The legacy
+        ``ms_inject_socket_failures`` conf (one in N sends fails) is
+        absorbed by the registry site: its trips are counted there
+        and, under a seeded registry, deterministic.  True = kill the
+        socket (the lossless session reconnects and resends)."""
+        return faultlib.registry().check_send(
+            faultlib.MSG_SEND,
+            self.msgr.conf["ms_inject_socket_failures"])
+
+    def _inject_recv_fault(self) -> bool:
+        """Registry ``msg.recv`` injection point (no legacy conf)."""
+        return faultlib.registry().check_drop(faultlib.MSG_RECV)
+
     # -- pumps -------------------------------------------------------------
     def _current_socket(self):
         """Block until there's an open socket (or the session closes);
@@ -442,9 +458,8 @@ class Connection:
                             msg.seq = self.out_seq
                         if self.lossless:
                             self.unacked.append(msg)
-                inject = self.msgr.conf["ms_inject_socket_failures"]
                 try:
-                    if inject and random.randrange(inject) == 0:
+                    if self._inject_send_fault():
                         raise ConnectionError("injected socket failure")
                     _sendmsg_all(sock, encode_frame_parts(
                         msg, compressor=self.msgr.compressor,
@@ -461,6 +476,8 @@ class Connection:
                 return
             while True:
                 try:
+                    if self._inject_recv_fault():
+                        raise ConnectionError("injected recv fault")
                     head = _read_exact(sock, HEADER_LEN)
                     mtype, seq, plen = decode_frame_header(head)
                     if plen > MAX_FRAME:

@@ -43,6 +43,7 @@ import numpy as np
 
 from . import ecutil
 from ..utils import copytrack
+from ..utils import faults as faultlib
 
 
 class _Req:
@@ -60,6 +61,7 @@ class _Req:
         self.nstripes = self.nbytes // sinfo.stripe_width
         self.tracked = tracked       # OpTracker handle (stage events)
         self.t_enq = time.monotonic()
+        self.done = False            # cb delivered (guards double-fail)
 
     def as_array(self, k: int) -> np.ndarray:
         """[nstripes, k, chunk] view of the request buffer — no copy."""
@@ -79,6 +81,7 @@ class _DecReq:
         self.have = have
         self.want = frozenset(want)
         self.cb = cb
+        self.done = False
         total = ecutil.nbytes_of(next(iter(have.values())))
         self.nstripes = total // sinfo.chunk_size
 
@@ -149,6 +152,15 @@ class EncodeBatcher:
     _probe_tick: int = 0                     # shared probe cadence
     _warmed: set = set()                     # geometries prewarmed
     _h2d_bps: float = 0.0                    # measured link rate, shared
+    # device circuit breaker — class-level like the crossover it
+    # guards: the device is a machine property, so one OSD's string
+    # of dispatch failures should route EVERY in-process batcher's
+    # traffic to the CPU twin, not just its own
+    _breaker_lock = threading.Lock()
+    _breaker_failures: int = 0               # consecutive device errors
+    _breaker_open: bool = False
+    _breaker_opens: int = 0                  # cumulative open transitions
+    _breaker_closes: int = 0                 # cumulative re-admissions
 
     def __init__(self, conf=None, perf=None, perf_coll=None):
         def get(k, d):
@@ -192,6 +204,9 @@ class EncodeBatcher:
             EncodeBatcher._min_device_bytes = float(pin)
         self.probe_interval = get("ec_tpu_crossover_probe_interval", 16)
         self.crossover_min = get("ec_tpu_crossover_min_bytes", 64 << 10)
+        self.device_error_threshold = get(
+            "ec_tpu_device_error_threshold", 3)
+        self.device_retry_s = get("ec_tpu_device_retry_ms", 2.0) / 1e3
         self.prewarm_enabled = get("osd_ec_prewarm", True)
         self.cpu_reqs = 0                        # routed to CPU twin
         self.perf = perf
@@ -232,6 +247,19 @@ class EncodeBatcher:
                 bp.add("bytes_copied",
                        description="payload bytes copied inside the "
                                    "batcher (shard gathers/concats)")
+                bp.add("ec_encode_errors",
+                       description="encode/continuation failures "
+                                   "(each fails its rider ops with "
+                                   "EIO rather than hanging them)")
+                bp.add("device_errors",
+                       description="classified device dispatch/"
+                                   "completion failures (post-retry)")
+                bp.add("breaker_open",
+                       description="circuit-breaker open transitions "
+                                   "(device -> CPU twin routing)")
+                bp.add("breaker_close",
+                       description="circuit-breaker re-admissions "
+                                   "(successful probe closed it)")
             self.bperf = bp
         # cumulative per-stage attribution (seconds of request time
         # spent in each pipeline stage; consumed by bench.py's
@@ -255,6 +283,8 @@ class EncodeBatcher:
         self.dec_reqs = 0            # decode requests served
         self.dec_coalesced = 0       # decode requests that shared a call
         self.dec_cpu_reqs = 0        # decode requests on the CPU twin
+        self.encode_errors = 0       # encode/continuation failures
+        self.device_errors = 0       # classified device failures
         self._cpu_twins: Dict[Tuple, object] = {}  # device-failure path
         self._dec_threads: List[threading.Thread] = []
         self._thread = threading.Thread(target=self._run,
@@ -543,7 +573,8 @@ class EncodeBatcher:
             for key, reqs in queues.items():
                 if key[0] == "dec":
                     groups.append((key, reqs, "dec"))
-                elif self._route_to_cpu(key, reqs):
+                elif self._route_to_cpu(key, reqs) \
+                        or self._breaker_blocks():
                     groups.append((key, reqs, "cpu"))
                 else:
                     groups.append((key, reqs,
@@ -567,7 +598,10 @@ class EncodeBatcher:
                                              trust_win=(len(groups)
                                                         == 1))
                 except Exception:
-                    self._cb_error()
+                    # fail every rider op that has not completed yet:
+                    # a collector-level fault must surface as EIO on
+                    # the affected ops, never as a hang
+                    self._cb_error(reqs)
 
     def _route_to_cpu(self, key: Tuple, reqs: List[_Req]) -> bool:
         """True when the learned crossover says this batch is too
@@ -588,24 +622,98 @@ class EncodeBatcher:
         EncodeBatcher._probe_tick += 1
         return EncodeBatcher._probe_tick % self.probe_interval != 0
 
-    def _cb_error(self) -> None:
+    def _breaker_blocks(self) -> bool:
+        """True when the open circuit breaker routes this encode
+        group to the coalesced CPU twin.  Rides the shared probe tick
+        so 1-in-``probe_interval`` groups still reach the device as
+        re-admission probes — a probe that completes closes the
+        breaker (_device_success)."""
+        if not EncodeBatcher._breaker_open:
+            return False
+        EncodeBatcher._probe_tick += 1
+        return EncodeBatcher._probe_tick % self.probe_interval != 0
+
+    def _device_failure(self, kind: str) -> None:
+        """Record one classified device failure (post-retry); opens
+        the breaker after ``ec_tpu_device_error_threshold``
+        consecutive failures."""
+        self.device_errors += 1
+        if self.bperf is not None:
+            self.bperf.inc("device_errors")
+        opened = False
+        cls = EncodeBatcher
+        with cls._breaker_lock:
+            cls._breaker_failures += 1
+            if not cls._breaker_open and \
+                    cls._breaker_failures >= self.device_error_threshold:
+                cls._breaker_open = True
+                cls._breaker_opens += 1
+                opened = True
+        if opened and self.bperf is not None:
+            self.bperf.inc("breaker_open")
+
+    def _device_success(self) -> None:
+        """A device call completed: clear the consecutive-failure
+        run; if this was a probe through an open breaker, re-admit
+        the device."""
+        cls = EncodeBatcher
+        if not cls._breaker_failures and not cls._breaker_open:
+            return                   # hot path: nothing to clear
+        closed = False
+        with cls._breaker_lock:
+            cls._breaker_failures = 0
+            if cls._breaker_open:
+                cls._breaker_open = False
+                cls._breaker_closes += 1
+                closed = True
+        if closed and self.bperf is not None:
+            self.bperf.inc("breaker_close")
+
+    def _cb_error(self, reqs=None) -> None:
         """Report a continuation/encode failure.  During shutdown the
         op is already dead (teardown races deliver into an unmounting
         OSD — e.g. 'store not mounted'), so stay quiet rather than
-        spraying tracebacks over the console and bench output."""
-        if self._stop:
-            return
-        import traceback
-        traceback.print_exc()
+        spraying tracebacks over the console and bench output.
+
+        When ``reqs`` is given, every request that has not seen its
+        callback yet gets ``cb(None)`` so its write op fails with EIO
+        back through the EC backend instead of hanging until the
+        client op timeout."""
+        if not self._stop:
+            import traceback
+            traceback.print_exc()
+            self.encode_errors += 1
+            if self.bperf is not None:
+                self.bperf.inc("ec_encode_errors")
+        for r in (reqs or ()):
+            if r.done:
+                continue
+            r.done = True
+            try:
+                r.cb(None)
+            except Exception:
+                pass                 # op teardown races
 
     @classmethod
     def reset_learning(cls) -> None:
-        """Forget the shared crossover/rates (tests; ops can call it
-        after a hardware change)."""
+        """Forget the shared crossover/rates and breaker state
+        (tests; ops can call it after a hardware change)."""
         cls._min_device_bytes = 0.0
         cls._probe_tick = 0
         cls._cpu_bps = {}
         cls._warmed = set()
+        cls.reset_breaker()
+
+    @classmethod
+    def reset_breaker(cls) -> None:
+        """Zero the breaker state/counters WITHOUT forgetting the
+        learned crossover (bench runs isolate their breaker stats but
+        keep the routing calibration)."""
+        with cls._breaker_lock:
+            cls._breaker_failures = 0
+            cls._breaker_open = False
+            cls._breaker_opens = 0
+            cls._breaker_closes = 0
 
     def _cpu_rate(self, key: Tuple, req: _Req) -> float:
         """CPU twin throughput for this geometry, measured once on
@@ -678,6 +786,7 @@ class EncodeBatcher:
             self.reqs_total += 1
             self.cpu_reqs += 1
             try:
+                r.done = True
                 r.cb(chunks)
             except Exception:
                 self._cb_error()
@@ -697,8 +806,9 @@ class EncodeBatcher:
         total = sum(sum(ecutil.nbytes_of(v) for v in r.have.values())
                     for r in reqs)
         impl = None
-        if self.adaptive_cpu and self._min_device_bytes > 0 and \
-                total < self._min_device_bytes:
+        if (self.adaptive_cpu and self._min_device_bytes > 0 and
+                total < self._min_device_bytes) or \
+                self._breaker_blocks():
             try:
                 impl = self.cpu_twin(reqs[0].ec_impl, sinfo)
             except Exception:
@@ -724,7 +834,6 @@ class EncodeBatcher:
         sinfo = reqs[0].sinfo
         cs = sinfo.chunk_size
         have_ids, missing = key[2], key[3]
-        rec = None
         try:
             present = {
                 s: (np.concatenate(
@@ -736,9 +845,21 @@ class EncodeBatcher:
                         reqs[0].have[s], reqs[0].nstripes, 1, cs)
                     .reshape(-1, cs))
                 for s in have_ids}
-            rec = impl.decode_batch(present, cs)
         except Exception:
-            rec = None
+            present = None           # malformed input, not a device
+                                     # fault: per-request fallback
+        rec = None
+        if present is not None:
+            try:
+                if not on_twin:
+                    faultlib.registry().hit(faultlib.DEVICE_DISPATCH)
+                rec = impl.decode_batch(present, cs)
+                if not on_twin:
+                    self._device_success()
+            except Exception:
+                rec = None
+                if not on_twin:
+                    self._device_failure("decode")
         if rec is None:
             # group decode trouble: per-request fallback
             for r in reqs:
@@ -750,6 +871,7 @@ class EncodeBatcher:
                     dec = None
                 self.dec_reqs += 1
                 try:
+                    r.done = True
                     r.cb(dec)
                 except Exception:
                     self._cb_error()
@@ -783,6 +905,7 @@ class EncodeBatcher:
                         memoryview(h).cast("B")
             off += r.nstripes
             try:
+                r.done = True
                 r.cb(out)
             except Exception:
                 self._cb_error()
@@ -820,6 +943,8 @@ class EncodeBatcher:
         """Should a ``nbytes``-sized codec call avoid the device?
         Shares the encode path's learned crossover — the fixed
         dispatch/transfer cost is the same either direction."""
+        if EncodeBatcher._breaker_open:
+            return True              # breaker open: device is sick
         return (self.adaptive_cpu and self._min_device_bytes > 0
                 and nbytes < self._min_device_bytes)
 
@@ -864,7 +989,6 @@ class EncodeBatcher:
         t_form = time.monotonic()
         self._account_queue_wait(reqs, t_form)
         try:
-            sinfo = reqs[0].sinfo
             k = reqs[0].ec_impl.get_data_chunk_count()
             arrs = [r.as_array(k) for r in reqs]
             if len(arrs) > 1:
@@ -872,27 +996,49 @@ class EncodeBatcher:
                 self._note_copy(batch.nbytes, "batcher.batch_concat")
             else:
                 batch = arrs[0]
-            # tile oversized batches at max_stripes: bounds per-call
-            # device memory AND caps the largest compiled batch shape
-            # at bucket(max_stripes) — the shape prewarm() compiles —
-            # so a burst can never hit a never-seen (slow-compiling)
-            # shape mid-benchmark.  All tiles dispatch before any
-            # wait: h2d/MXU/d2h still overlap tile-to-tile.
-            tile = max(1, self.max_stripes)
-            handles = [
-                reqs[0].ec_impl.encode_batch_async(batch[i:i + tile])
-                for i in range(0, batch.shape[0], tile)]
-            t_disp = time.monotonic()
-            self.stage_seconds["batch_form"] += t_disp - t_form
-            if self.bperf is not None:
-                self.bperf.hinc("batch_stripes", batch.shape[0])
-                self.bperf.inc("h2d_bytes", batch.nbytes)
-            for r in reqs:
-                if r.tracked is not None:
-                    r.tracked.mark_event("ec:batch_dispatched")
-            return (arrs, handles, t_disp)
         except Exception:
+            # malformed request payload/geometry: NOT a device fault
+            # (must not trip the breaker) — completion falls back to
+            # per-request CPU encode, which fails the bad rider with
+            # EIO and still serves its group-mates
             return None
+        # tile oversized batches at max_stripes: bounds per-call
+        # device memory AND caps the largest compiled batch shape
+        # at bucket(max_stripes) — the shape prewarm() compiles —
+        # so a burst can never hit a never-seen (slow-compiling)
+        # shape mid-benchmark.  All tiles dispatch before any
+        # wait: h2d/MXU/d2h still overlap tile-to-tile.
+        tile = max(1, self.max_stripes)
+        handles = None
+        delay = self.device_retry_s
+        for attempt in range(3):
+            try:
+                faultlib.registry().hit(faultlib.DEVICE_DISPATCH)
+                handles = [
+                    reqs[0].ec_impl.encode_batch_async(
+                        batch[i:i + tile])
+                    for i in range(0, batch.shape[0], tile)]
+                break
+            except Exception:
+                # classified device dispatch failure: transient until
+                # proven otherwise — retry with capped backoff before
+                # charging the breaker
+                handles = None
+                if attempt < 2 and delay > 0:
+                    time.sleep(min(delay, 0.1))
+                    delay *= 2
+        if handles is None:
+            self._device_failure("dispatch")
+            return None
+        t_disp = time.monotonic()
+        self.stage_seconds["batch_form"] += t_disp - t_form
+        if self.bperf is not None:
+            self.bperf.hinc("batch_stripes", batch.shape[0])
+            self.bperf.inc("h2d_bytes", batch.nbytes)
+        for r in reqs:
+            if r.tracked is not None:
+                r.tracked.mark_event("ec:batch_dispatched")
+        return (arrs, handles, t_disp)
 
     def _account_queue_wait(self, reqs: List[_Req],
                             now: float) -> None:
@@ -912,12 +1058,18 @@ class EncodeBatcher:
         if handle is not None:
             arrs, async_tiles, t_dispatch = handle
             try:
+                faultlib.registry().hit(faultlib.DEVICE_COMPLETION)
                 parts = [t.wait() for t in async_tiles]
                 parity = parts[0] if len(parts) == 1 \
                     else np.concatenate(parts, axis=0)
                 dev_time = time.monotonic() - t_dispatch
+                self._device_success()
             except Exception:
+                # classified completion failure (a dispatched handle
+                # cannot be re-waited, so no retry here — the CPU
+                # twin serves the group and the breaker learns)
                 parity = None
+                self._device_failure("completion")
         if parity is None:
             # device trouble: encode each request on a REAL CPU path
             # (a jerasure twin of the same geometry — bit-exact by the
@@ -931,6 +1083,7 @@ class EncodeBatcher:
                     self._cb_error()
                     chunks = None
                 try:
+                    r.done = True
                     r.cb(chunks)
                 except Exception:
                     self._cb_error()
@@ -975,6 +1128,7 @@ class EncodeBatcher:
             off += r.nstripes
             out = self._shard_views(arr, p, k, m)
             try:
+                r.done = True
                 r.cb(out)
             except Exception:
                 # a failing continuation affects only its own op

@@ -52,6 +52,7 @@ from ..msg.messages import (MOSDECSubOpRead, MOSDECSubOpReadReply,
                             MOSDPGPush, MOSDPGPushReply, PushOp)
 from ..store.objectstore import GHObject, Transaction
 from ..utils import copytrack
+from ..utils import faults as faultlib
 from . import ecutil
 from .backend import OI_ATTR, Mutation, ObjectInfo, PGBackend, PGHost
 from .pglog import Eversion, LogEntry
@@ -110,6 +111,15 @@ class _WriteOp:
         self.poisoned = 0                # errno: earlier same-obj op
                                          # failed after we may have
                                          # absorbed its bytes
+        # sub-write deadline state (osd_ec_subwrite_timeout_ms):
+        # acked_segs dedups commit replies per (shard, seg) so a
+        # deadline re-request whose original ack was merely slow can't
+        # double-decrement pending_commits; sent_subwrites retains the
+        # wire fields of every remote sub-write (only while the
+        # timeout is armed) so a laggard can be re-requested verbatim
+        self.acked_segs: Dict[int, Set[int]] = {}
+        self.sent_subwrites: Dict[Tuple[int, int], Tuple] = {}
+        self.deadline_timer = None
 
 
 class _ReadOp:
@@ -199,6 +209,22 @@ class ECBackend(PGBackend):
         self.subchunk_repairs = 0        # CLAY repairs taken
         self.repair_read_bytes = 0       # bytes those repairs read
         self.repair_whole_bytes = 0      # what whole-chunk would read
+        # sub-write deadlines (osd_ec_subwrite_timeout_ms; 0 disables):
+        # the primary re-requests a laggard shard's sub-write once,
+        # then reports the peer to the monitor like a failed heartbeat
+        try:
+            tmo = host.conf["osd_ec_subwrite_timeout_ms"]
+        except (AttributeError, KeyError, TypeError):
+            tmo = 0.0
+        self.subwrite_timeout_s = (tmo or 0.0) / 1000.0
+        self.subwrite_timeouts = 0       # deadlines that expired
+        self.subwrite_retries = 0        # sub-writes re-requested
+        self.subwrite_peer_reports = 0   # laggards reported to the mon
+        # shard-side dedup of re-requested sub-writes, keyed
+        # (from_osd, tid, seg): True once committed (a duplicate
+        # re-acks — the original ack was lost), False while the first
+        # apply is still in flight (its ack is coming; stay silent)
+        self._recent_subwrites: Dict[Tuple[int, int, int], bool] = {}
         # pay the pool geometry's one-time costs (device kernel
         # compile + the crossover router's CPU-rate probe) NOW, in the
         # background, instead of on the first client op — the
@@ -342,6 +368,7 @@ class ECBackend(PGBackend):
         have absorbed them into its encode fails too (the client is
         told; nothing lands silently)."""
         self.waiting_commit.pop(op.tid, None)
+        self._cancel_deadline(op)
         op.on_all_commit(err)
         self._untrack_pending(op, failed=True)
         for o in self._pipeline:
@@ -535,6 +562,7 @@ class ECBackend(PGBackend):
                 # sub-writes may have landed, but without the final
                 # segment's metadata they are invisible
                 self.waiting_commit.pop(op.tid, None)
+                self._cancel_deadline(op)
                 op.on_all_commit(op.poisoned)
                 op.state = op.DONE
                 continue
@@ -585,15 +613,20 @@ class ECBackend(PGBackend):
             shard: per_shard for shard, osd in
             self.host.acting_shards() if osd is not None}
         self.waiting_commit[op.tid] = op
+        if self.subwrite_timeout_s > 0:
+            self._arm_subwrite_deadline(op, attempt=1,
+                                        delay=self.subwrite_timeout_s)
 
     def _fanout_txns(self, op: _WriteOp,
                      shard_txns: Dict[int, Transaction],
-                     wire_entries: List[dict]) -> None:
+                     wire_entries: List[dict], seg: int = 0) -> None:
         """Send one sub-write per shard.  Remote shards get the
         transaction as encode_parts() fragments — the messenger ships
         them as scatter-gather iovecs, so encoded chunk views never
         round-trip through one big bytes.  The primary's own shard
-        gets the Transaction OBJECT (no encode at all)."""
+        gets the Transaction OBJECT (no encode at all).  ``seg`` is
+        the pipeline segment index, carried on the wire so deadline
+        re-requests dedup per (from, tid, seg)."""
         local_txn: Optional[Transaction] = None
         for shard, osd in [(s, o) for s, o in
                            self.host.acting_shards() if o is not None]:
@@ -601,14 +634,19 @@ class ECBackend(PGBackend):
             if osd == self.host.whoami:
                 local_txn = txn
                 continue
+            parts = txn.encode_parts()
             self.host.send_shard(osd, MOSDECSubOpWrite(
                 pgid=self.host.pgid_str, shard=shard,
                 from_osd=self.host.whoami, tid=op.tid,
-                epoch=self.host.epoch, txn=txn.encode_parts(),
+                epoch=self.host.epoch, txn=parts,
                 log_entries=wire_entries,
                 at_version=op.at_version,
                 trace_id=op.mutation.trace_id,
-                parent_span_id=op.mutation.parent_span_id))
+                parent_span_id=op.mutation.parent_span_id, seg=seg))
+            if self.subwrite_timeout_s > 0:
+                # retained ONLY while a deadline is armed: parts are
+                # views over op.encoded's chunks, so this adds no copy
+                op.sent_subwrites[(shard, seg)] = (parts, wire_entries)
         if local_txn is not None:
             # the primary's own shard goes through the same sub-write
             # handler, local call (reference ECBackend.cc:2086-2092);
@@ -623,7 +661,7 @@ class ECBackend(PGBackend):
             self._apply_sub_write(
                 self.host.own_shard, local_txn, wire_entries,
                 lambda: self._sub_write_committed(
-                    tid, self.host.own_shard))
+                    tid, self.host.own_shard, seg))
 
     # -- pipelined segmented fanout ------------------------------------
     def _start_segmented(self, op: _WriteOp, astart: int, hi: int,
@@ -713,7 +751,7 @@ class ECBackend(PGBackend):
             else:
                 txns = self._segment_txns(op, seg_chunk_off, chunks)
                 wire_entries = []
-            self._fanout_txns(op, txns, wire_entries)
+            self._fanout_txns(op, txns, wire_entries, seg=idx)
             op.segs_sent += 1
         if op.segs_sent >= op.segs_total:
             op.state = op.SENT
@@ -893,10 +931,16 @@ class ECBackend(PGBackend):
             lambda: self.host.on_local_commit(on_commit))
         self.host.store.queue_transactions([txn])
 
-    def _sub_write_committed(self, tid: int, shard: int) -> None:
+    def _sub_write_committed(self, tid: int, shard: int,
+                             seg: int = 0) -> None:
         op = self.waiting_commit.get(tid)
         if op is None:
             return
+        acked = op.acked_segs.setdefault(shard, set())
+        if seg in acked:
+            return      # duplicate ack from a deadline re-request
+        acked.add(seg)
+        op.sent_subwrites.pop((shard, seg), None)
         left = op.pending_commits.get(shard, 0)
         if left <= 1:
             op.pending_commits.pop(shard, None)
@@ -906,6 +950,7 @@ class ECBackend(PGBackend):
             op.pending_commits[shard] = left - 1
         if not op.pending_commits:
             del self.waiting_commit[tid]
+            self._cancel_deadline(op)
             if op.mutation.tracked_op is not None:
                 op.mutation.tracked_op.mark_event(
                     "ec:all_shards_committed")
@@ -914,6 +959,90 @@ class ECBackend(PGBackend):
             # commit order
             op.on_all_commit(0)
             self._complete_op(op)
+
+    # -- sub-write deadlines (osd_ec_subwrite_timeout_ms) --------------
+    def _arm_subwrite_deadline(self, op: _WriteOp, attempt: int,
+                               delay: float) -> None:
+        call_later = getattr(self.host, "call_later", None)
+        if call_later is None:
+            return           # host without timers (unit-test stubs)
+        tid = op.tid
+        op.deadline_timer = call_later(
+            delay, lambda: self._subwrite_deadline(tid, attempt))
+
+    def _cancel_deadline(self, op: _WriteOp) -> None:
+        timer, op.deadline_timer = op.deadline_timer, None
+        op.sent_subwrites.clear()
+        if timer is not None:
+            try:
+                timer.cancel()
+            except Exception:
+                pass
+
+    def _subwrite_deadline(self, tid: int, attempt: int) -> None:
+        """The per-op sub-write deadline expired (fires on a timer
+        thread / the reactor; re-enters the PG under its lock).  First
+        expiry re-requests every outstanding sub-write from the
+        laggard shards — a FRESH message with the retained fields, so
+        the messenger's seq dedup can't swallow it when only the ACK
+        was lost — and re-arms at double the timeout.  Second expiry
+        reports the laggard peers to the monitor like a failed
+        heartbeat; the resulting map change re-peers the PG and the
+        client resends."""
+        lock = getattr(self.host, "lock", None)
+        if lock is None:
+            import contextlib
+            lock = contextlib.nullcontext()
+        with lock:
+            op = self.waiting_commit.get(tid)
+            if op is None or not op.alive or op.deadline_timer is None:
+                return
+            op.deadline_timer = None
+            self.subwrite_timeouts += 1
+            perf = getattr(self.host, "osd_perf", None)
+            if perf is not None:
+                perf.inc("ec_subwrite_timeouts")
+            acting = {s: o for s, o in self.host.acting_shards()}
+            laggards = set(op.pending_commits)
+            if attempt == 1:
+                resent = 0
+                for (shard, seg), (parts, entries) in sorted(
+                        op.sent_subwrites.items()):
+                    if shard not in laggards or \
+                            seg in op.acked_segs.get(shard, ()):
+                        continue
+                    osd = acting.get(shard)
+                    if osd is None or osd == self.host.whoami:
+                        continue
+                    self.host.send_shard(osd, MOSDECSubOpWrite(
+                        pgid=self.host.pgid_str, shard=shard,
+                        from_osd=self.host.whoami, tid=tid,
+                        epoch=self.host.epoch, txn=parts,
+                        log_entries=entries,
+                        at_version=op.at_version,
+                        trace_id=op.mutation.trace_id,
+                        parent_span_id=op.mutation.parent_span_id,
+                        seg=seg))
+                    resent += 1
+                self.subwrite_retries += resent
+                if perf is not None and resent:
+                    perf.inc("ec_subwrite_retries", resent)
+                self._arm_subwrite_deadline(
+                    op, attempt=2, delay=2 * self.subwrite_timeout_s)
+                return
+            reported: Set[int] = set()
+            for shard in laggards:
+                osd = acting.get(shard)
+                if osd is None or osd == self.host.whoami \
+                        or osd in reported:
+                    continue
+                reported.add(osd)
+                report = getattr(self.host, "report_laggard", None)
+                if report is not None:
+                    report(osd, 3 * self.subwrite_timeout_s)
+            self.subwrite_peer_reports += len(reported)
+            if perf is not None and reported:
+                perf.inc("ec_subwrite_peer_reports", len(reported))
 
     # ------------------------------------------------------------------
     # read path (reference objects_read_and_reconstruct)
@@ -1446,17 +1575,44 @@ class ECBackend(PGBackend):
                 # 2063-2068 blkin spans)
                 span.tag("shard", msg.shard).tag(
                     "pgid", msg.pgid).finish()
+            seg = getattr(msg, "seg", 0)
+            key = (msg.from_osd, msg.tid, seg)
+            done = self._recent_subwrites.get(key)
+            if done is not None:
+                # deadline re-request of a sub-write we already have:
+                # committed → re-ack (the original ack was lost);
+                # still applying → stay silent, its ack is coming.
+                # Either way NEVER re-apply (log entries must not
+                # append twice).
+                if done:
+                    self.host.send_shard(
+                        msg.from_osd, MOSDECSubOpWriteReply(
+                            pgid=self.host.pgid_str, shard=msg.shard,
+                            from_osd=self.host.whoami, tid=msg.tid,
+                            epoch=self.host.epoch, seg=seg))
+                return True
+            self._recent_subwrites[key] = False
+            while len(self._recent_subwrites) > 512:
+                self._recent_subwrites.pop(
+                    next(iter(self._recent_subwrites)))
             txn = Transaction.decode(msg.txn)
-            self._apply_sub_write(
-                msg.shard, txn, msg.log_entries,
-                lambda: self.host.send_shard(
-                    msg.from_osd, MOSDECSubOpWriteReply(
-                        pgid=self.host.pgid_str, shard=msg.shard,
-                        from_osd=self.host.whoami, tid=msg.tid,
-                        epoch=self.host.epoch)))
+
+            def _committed(m=msg, k=key, s=seg):
+                self._recent_subwrites[k] = True
+                self.host.send_shard(
+                    m.from_osd, MOSDECSubOpWriteReply(
+                        pgid=self.host.pgid_str, shard=m.shard,
+                        from_osd=self.host.whoami, tid=m.tid,
+                        epoch=self.host.epoch, seg=s))
+            self._apply_sub_write(msg.shard, txn, msg.log_entries,
+                                  _committed)
             return True
         if isinstance(msg, MOSDECSubOpWriteReply):
-            self._sub_write_committed(msg.tid, msg.shard)
+            if faultlib.registry().check_drop(
+                    faultlib.EC_SUBWRITE_ACK):
+                return True  # ack lost: the deadline re-requests
+            self._sub_write_committed(msg.tid, msg.shard,
+                                      getattr(msg, "seg", 0))
             return True
         if isinstance(msg, MOSDECSubOpRead):
             span = self.host.trace_span(
@@ -1584,6 +1740,8 @@ class ECBackend(PGBackend):
         clients resend against the new acting set."""
         for op in self._pipeline:
             op.alive = False         # late encode callbacks must drop
+        for op in self.waiting_commit.values():
+            self._cancel_deadline(op)
         self._pending_objs.clear()
         self.waiting_commit.clear()
         self.in_flight_reads.clear()

@@ -92,6 +92,18 @@ class OSDService:
     def tracer(self):
         return self._osd.tracer
 
+    @property
+    def perf(self):
+        return self._osd.perf
+
+    def call_later(self, delay: float, fn):
+        """Cancellable one-shot timer (EC sub-write deadlines); the
+        crimson OSD substitutes a reactor timer."""
+        return self._osd._call_later(delay, fn)
+
+    def report_laggard(self, osd: int, elapsed: float) -> None:
+        self._osd.report_laggard(osd, elapsed)
+
     def get_osdmap(self) -> OSDMap:
         return self._osd.osdmap
 
@@ -193,6 +205,18 @@ class OSD(Dispatcher):
                       description="batched EC decode calls")
         self.perf.add("ec_dec_batch_coalesced",
                       description="decode requests that shared a call")
+        self.perf.add("ec_subwrite_timeouts",
+                      description="EC sub-write deadlines expired")
+        self.perf.add("ec_subwrite_retries",
+                      description="EC sub-writes re-requested from "
+                      "laggard shards")
+        self.perf.add("ec_subwrite_peer_reports",
+                      description="laggard peers reported to the mon")
+        # process-wide fault injection (utils/faults.py): arm the
+        # registry from fault_injection/_seed; idempotent, so an OSD
+        # restart mid-run keeps the sites' RNG streams
+        from ..utils import faults as faultlib
+        faultlib.configure_from(self.conf)
         # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
         from .batcher import EncodeBatcher
         self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf,
@@ -731,6 +755,12 @@ class OSD(Dispatcher):
         try:
             if prefix == "perf dump":
                 out = self.perf_coll.perf_dump()
+                # fault-injection trip counters ride the same dump so
+                # admin socket / tell / mgr prometheus all see them
+                from ..utils import faults as faultlib
+                counters = faultlib.registry().counters()
+                if counters:
+                    out["faults"] = counters
             elif prefix == "dump_traces":
                 out = {"spans": self.tracer.dump()}
             elif prefix == "dump_historic_ops":
@@ -812,6 +842,31 @@ class OSD(Dispatcher):
         io = self._int_client.open_ioctx(pool.name)
         io._bypass_tier = bypass_tier
         return io
+
+    # ------------------------------------------------------------------
+    # timers + laggard reporting (EC sub-write deadlines)
+    # ------------------------------------------------------------------
+    def _call_later(self, delay: float, fn):
+        """One-shot cancellable timer.  Classic OSDs use a plain
+        threading.Timer; CrimsonOSD overrides this with a reactor
+        timer so deadline continuations run on the reactor thread."""
+        t = threading.Timer(delay, fn)
+        t.daemon = True
+        t.start()
+        return t
+
+    def report_laggard(self, osd: int, elapsed: float) -> None:
+        """A peer sat on an EC sub-write past two deadlines: report it
+        to the monitor exactly like a missed heartbeat (reference
+        MOSDFailure).  Enough distinct reporters mark it down, the map
+        change re-peers the PG and clients resend."""
+        self.log.dout(1, f"osd.{osd} laggard on EC sub-write "
+                      f"({elapsed * 1000:.0f}ms), reporting")
+        try:
+            self.monc.report_failure(osd, self.whoami, elapsed,
+                                     self.osdmap.epoch)
+        except Exception:
+            pass
 
     # ------------------------------------------------------------------
     # heartbeats (reference OSD.cc:5079-5632)

@@ -275,6 +275,25 @@ class PG:
             return None
         return tracer.start(name, trace_id, parent_id)
 
+    @property
+    def osd_perf(self):
+        """The hosting OSD's perf counters (None under test stubs)."""
+        return getattr(self.service, "perf", None)
+
+    def call_later(self, delay: float, fn):
+        """One-shot cancellable timer via the hosting OSD (EC
+        sub-write deadlines); None under hosts without timers."""
+        call = getattr(self.service, "call_later", None)
+        if call is None:
+            return None
+        return call(delay, fn)
+
+    def report_laggard(self, osd: int, elapsed: float) -> None:
+        """Report a peer that sat on a sub-write past its deadline."""
+        rep = getattr(self.service, "report_laggard", None)
+        if rep is not None:
+            rep(osd, elapsed)
+
     def note_object_recovered(self, oid: str, version) -> None:
         """A recovery push committed on THIS shard: durable missing-set
         update (reference recover_got)."""

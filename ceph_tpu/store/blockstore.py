@@ -245,9 +245,9 @@ class BlockStore(ObjectStore):
         return _Extents.load(self._db.get(self._xkey(coll, obj)))
 
     # -- transaction apply ---------------------------------------------
-    def queue_transactions(self, txns: List[Transaction],
-                           on_commit: Optional[Callable[[], None]]
-                           = None) -> None:
+    def _do_queue_transactions(self, txns: List[Transaction],
+                               on_commit: Optional[Callable[[], None]]
+                               = None) -> None:
         with self._lock:
             if self._db is None:
                 raise RuntimeError("store not mounted")

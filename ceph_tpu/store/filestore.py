@@ -172,9 +172,9 @@ class FileStore(ObjectStore):
         self._journal_seq = 0
 
     # -- mutation ----------------------------------------------------------
-    def queue_transactions(self, txns: List[Transaction],
-                           on_commit: Optional[Callable[[], None]] = None
-                           ) -> None:
+    def _do_queue_transactions(self, txns: List[Transaction],
+                               on_commit: Optional[Callable[[], None]] = None
+                               ) -> None:
         with self._lock:
             if self._db is None:
                 raise RuntimeError("store not mounted")

@@ -83,9 +83,9 @@ class MemStore(ObjectStore):
             fin.wait_for_empty()
 
     # -- mutation ----------------------------------------------------------
-    def queue_transactions(self, txns: List[Transaction],
-                           on_commit: Optional[Callable[[], None]] = None
-                           ) -> None:
+    def _do_queue_transactions(self, txns: List[Transaction],
+                               on_commit: Optional[Callable[[], None]] = None
+                               ) -> None:
         with self._lock:
             if not self._mounted:
                 raise RuntimeError("store not mounted")

@@ -23,6 +23,7 @@ import abc
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from ..utils import faults as faultlib
 from ..utils.encoding import Decoder, Encoder
 
 # Collection ids are strings: str(SPGid) for PG collections, "meta" for
@@ -373,13 +374,27 @@ class ObjectStore(abc.ABC):
         """Initialize an empty store (reference ObjectStore::mkfs)."""
 
     # -- mutation ----------------------------------------------------------
-    @abc.abstractmethod
     def queue_transactions(self, txns: List[Transaction],
                            on_commit: Optional[Callable[[], None]] = None
                            ) -> None:
         """Apply atomically; deliver per-transaction on_applied inline
         and on_commit (plus the aggregate callback) via the finisher
-        (reference os/ObjectStore.h:222)."""
+        (reference os/ObjectStore.h:222).
+
+        Template method: the ``store.apply`` injection point
+        (utils/faults.py) gates admission — error mode raises before
+        any mutation, stall sleeps in place like a wedged disk,
+        corrupt mode bit-flips one queued write payload (planted bit
+        rot for the scrub/repair machinery) — then the backend's
+        ``_do_queue_transactions`` applies."""
+        faultlib.registry().store_apply(txns)
+        self._do_queue_transactions(txns, on_commit)
+
+    @abc.abstractmethod
+    def _do_queue_transactions(self, txns: List[Transaction],
+                               on_commit: Optional[Callable[[], None]]
+                               = None) -> None:
+        """Backend apply (see queue_transactions)."""
 
     def apply_transaction(self, txn: Transaction) -> None:
         self.queue_transactions([txn])

@@ -17,10 +17,17 @@ Two pieces:
   marking them out/in mid-workload, always leaving ``min_alive``
   OSDs up; ``settle`` revives everyone and waits for clean.
 
+A third piece rides the fault-injection registry (utils/faults.py):
+``--faults SPEC`` arms named injection points — device dispatch
+errors, socket failures, store stalls — for the whole seeded run, and
+``--chaos`` expands to a canned multi-site schedule.  The integrity
+bar is unchanged: ``verify_all`` must come back empty, i.e. zero
+client-visible errors despite the injected faults.
+
 CLI::
 
     python -m ceph_tpu.tools.thrash --osds 4 --seconds 20 \\
-        --pool-type erasure --seed 7
+        --pool-type erasure --seed 7 --chaos
 """
 from __future__ import annotations
 
@@ -32,6 +39,14 @@ import time
 from typing import Dict, List, Optional
 
 from ..client.rados import RadosError
+from ..utils import faults as faultlib
+
+# the --chaos preset: device dispatch faults force the encode retry/
+# breaker path, socket failures force messenger reconnect/resend,
+# store stalls simulate a slow disk — all in one seeded run
+CHAOS_FAULTS = ("device.dispatch:error:1in20"
+                ",msg.send:error:1in300"
+                ",store.apply:stall:1in50:30")
 
 
 class RadosModel:
@@ -414,9 +429,18 @@ class Thrasher:
 
 def run_thrash(n_osds: int, seconds: float, pool_type: str,
                seed: int, out=sys.stdout, pggrow: bool = False,
-               tiered: bool = False) -> int:
-    from ..cluster import Cluster
-    with Cluster(n_osds=n_osds) as cluster:
+               tiered: bool = False, faults: str = "") -> int:
+    from ..cluster import Cluster, test_config
+    conf = None
+    if faults:
+        # one registry for the whole in-process cluster: reset any
+        # stale schedule, then let Cluster.start's configure_from arm
+        # this run's — deterministically, off the same --seed as the
+        # workload and the thrasher
+        faultlib.registry().reset()
+        conf = test_config(fault_injection=faults,
+                           fault_injection_seed=seed)
+    with Cluster(n_osds=n_osds, conf=conf) as cluster:
         for i in range(n_osds):
             cluster.wait_for_osd_up(i, 30)
         if pool_type == "erasure":
@@ -468,6 +492,13 @@ def run_thrash(n_osds: int, seconds: float, pool_type: str,
         deadline = time.monotonic() + seconds
         while time.monotonic() < deadline:
             model.step()
+        # the fault window closes WITH the workload: settle polls and
+        # verify_all read through fresh client sessions, and faults
+        # were transient by contract — counters survive disarming, so
+        # the schedule's evidence still prints below
+        if faults:
+            for site in faultlib.registry().armed_sites():
+                faultlib.registry().disarm(site)
         took = thrasher.stop_and_settle()
         problems = model.verify_all()
         print(f"ops={model.ops_done} actions={len(thrasher.actions)} "
@@ -475,6 +506,12 @@ def run_thrash(n_osds: int, seconds: float, pool_type: str,
               file=out)
         for a in thrasher.actions:
             print(f"  {a}", file=out)
+        if faults:
+            for site, c in sorted(faultlib.registry()
+                                  .counters().items()):
+                print(f"  fault {site}: trips={c['trips']} "
+                      f"hits={c['hits']}", file=out)
+            faultlib.registry().reset()
         for p in problems:
             print(f"  PROBLEM: {p}", file=out)
         return 1 if problems else 0
@@ -493,9 +530,17 @@ def main(argv=None) -> int:
     p.add_argument("--tiered", action="store_true",
                    help="run the workload through a writeback cache "
                         "tier with promote/flush/evict churn")
+    p.add_argument("--faults", default="", metavar="SPEC",
+                   help="fault-injection schedule, e.g. "
+                        "'device.dispatch:error:1in20' "
+                        "(see utils/faults.py for the grammar)")
+    p.add_argument("--chaos", action="store_true",
+                   help=f"shorthand for --faults '{CHAOS_FAULTS}'")
     ns = p.parse_args(argv)
+    faults = ns.faults or (CHAOS_FAULTS if ns.chaos else "")
     return run_thrash(ns.osds, ns.seconds, ns.pool_type, ns.seed,
-                      pggrow=ns.pggrow, tiered=ns.tiered)
+                      pggrow=ns.pggrow, tiered=ns.tiered,
+                      faults=faults)
 
 
 if __name__ == "__main__":

@@ -164,7 +164,12 @@ def _opts() -> List[Option]:
         Option("objecter_inflight_ops", int, 1024, min=1,
                description="client op window (reference "
                            "objecter_inflight_ops)"),
-        Option("rados_osd_op_timeout", float, 0.0, min=0),
+        Option("rados_osd_op_timeout", float, 30.0, min=0,
+               description="client ops error with ETIMEDOUT after "
+                           "this many seconds (0 = wait forever; "
+                           "reference rados_osd_op_timeout defaults "
+                           "0, here nonzero so a wedged OSD surfaces "
+                           "as an error instead of a hang)"),
         Option("osd_recovery_sleep", float, 0.0, min=0.0),
         Option("osd_heartbeat_interval", float, 1.0, min=0.05,
                description="seconds between peer pings "
@@ -299,6 +304,36 @@ def _opts() -> List[Option]:
         Option("ec_tpu_crossover_min_bytes", int, 64 << 10, min=0,
                description="floor for the learned CPU/device "
                            "crossover threshold"),
+        Option("ec_tpu_device_error_threshold", int, 3, min=1,
+               description="consecutive classified device failures "
+                           "(dispatch or completion) before the "
+                           "EncodeBatcher circuit breaker opens and "
+                           "routes all encode traffic to the "
+                           "coalesced CPU twin; probes re-admit the "
+                           "device when they succeed"),
+        Option("ec_tpu_device_retry_ms", float, 2.0, min=0.0,
+               description="base backoff before retrying a transient "
+                           "device dispatch failure (doubles per "
+                           "attempt, capped; 2 retries max)"),
+        Option("osd_ec_subwrite_timeout_ms", float, 0.0, min=0.0,
+               description="primary re-requests an EC sub-write from "
+                           "a laggard shard after this deadline "
+                           "(once, with 2x backoff), then reports "
+                           "the peer to the monitor (0 disables "
+                           "deadlines)"),
+        # -- fault injection (utils/faults.py registry) --------------------
+        Option("fault_injection", str, "",
+               description="comma-joined fault clauses "
+                           "site:mode:1inN|everyN|once[:stall_ms] "
+                           "arming the process fault registry at "
+                           "daemon/cluster start (sites: "
+                           "device.dispatch device.completion "
+                           "store.apply msg.send msg.recv "
+                           "ec.subwrite_ack; modes: error stall "
+                           "corrupt)"),
+        Option("fault_injection_seed", int, 0,
+               description="deterministic seed for fault-registry "
+                           "site RNGs"),
         Option("osd_scrub_sleep", float, 0.0, min=0.0,
                description="pause between scrub chunks (reference "
                            "osd_scrub_sleep)"),

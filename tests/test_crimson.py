@@ -276,6 +276,68 @@ def test_crimson_lossless_survives_socket_death():
         rb.stop()
 
 
+def test_socket_failure_injection_parity_with_classic():
+    """``ms_inject_socket_failures`` must behave identically on the
+    crimson messenger and the classic one: both consult the SAME
+    fault-registry site (msg.send) before every frame write, both
+    count their trips there, and both survive the injected socket
+    deaths with exactly-once in-order delivery."""
+    from ceph_tpu.msg.messages import MOSDPing
+    from ceph_tpu.msg.messenger import Messenger
+    from ceph_tpu.utils import faults as faultlib
+
+    def run(flavor):
+        faultlib.registry().reset()
+        faultlib.registry().seed_all(13)
+        conf = make_conf(ms_inject_socket_failures=10,
+                         ms_connection_retry_interval=0.02)
+        reactors = []
+        if flavor == "crimson":
+            reactors = [Reactor(), Reactor()]
+            for r in reactors:
+                r.start()
+            ma = CrimsonMessenger("osd.0", conf=conf,
+                                  reactor=reactors[0])
+            mb = CrimsonMessenger("osd.1", conf=conf,
+                                  reactor=reactors[1])
+        else:
+            ma = Messenger("osd.0", conf=conf)
+            mb = Messenger("osd.1", conf=conf)
+        sink = _Capture()
+        mb.add_dispatcher(sink)
+        ma.add_dispatcher(_Capture())
+        try:
+            ma.bind()
+            addr = mb.bind()
+            ma.start()
+            mb.start()
+            conn = ma.connect_to(addr, peer_name="osd.1")
+            n = 60
+            for i in range(n):
+                conn.send_message(MOSDPing(op=MOSDPing.PING,
+                                           from_osd=0, epoch=i))
+            assert sink.wait_n(n, 60), \
+                f"{flavor}: {len(sink.got)}/{n} after injection"
+            epochs = [m.epoch for m, _ in sink.got]
+            assert epochs == list(range(n)), \
+                f"{flavor}: delivery not exactly-once in-order"
+            c = faultlib.registry().counters()[faultlib.MSG_SEND]
+        finally:
+            ma.shutdown()
+            mb.shutdown()
+            for r in reactors:
+                r.stop()
+            faultlib.registry().reset()
+        return c
+
+    classic = run("classic")
+    crimson = run("crimson")
+    # both flavors absorbed the legacy conf into the shared site
+    for flavor, c in (("classic", classic), ("crimson", crimson)):
+        assert c["trips"] >= 1, f"{flavor} never tripped msg.send"
+        assert c["hits"] >= 60, f"{flavor} skipped the injection gate"
+
+
 def test_crimson_messenger_rejects_secure_mode():
     r = Reactor()
     with pytest.raises(ValueError, match="secure"):

@@ -1,0 +1,378 @@
+"""Unified fault-injection framework + degraded-mode hardening.
+
+Covers the fault registry (ceph_tpu/utils/faults.py) as a unit —
+deterministic seeding, rate grammar, modes, counters, legacy
+``ms_inject_socket_failures`` absorption — plus the hardening it
+exists to exercise: the batcher's device circuit breaker and EIO
+error completion, the store.apply gate, the EC sub-write deadline
+re-request path (classic AND crimson), and a seeded tier-1 chaos
+smoke over a live cluster with counters asserted from the exported
+perf dump."""
+import os
+import threading
+import time
+import types
+
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
+from ceph_tpu.ec import registry as ecreg
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.batcher import EncodeBatcher
+from ceph_tpu.store import GHObject, MemStore, Transaction
+from ceph_tpu.utils import faults as faultlib
+from ceph_tpu.utils.faults import (DEVICE_COMPLETION, DEVICE_DISPATCH,
+                                   EC_SUBWRITE_ACK, MSG_SEND,
+                                   STORE_APPLY, InjectedError)
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    """The registry is process-wide state: every test starts and ends
+    disarmed with zeroed counters (and a closed breaker)."""
+    faultlib.registry().reset()
+    EncodeBatcher.reset_learning()
+    yield
+    faultlib.registry().reset()
+    EncodeBatcher.reset_learning()
+
+
+def reg():
+    return faultlib.registry()
+
+
+# ------------------------------------------------------------ registry
+def test_every_n_fires_periodically_and_counts():
+    reg().arm(DEVICE_DISPATCH, mode="error", every=3)
+    trips = 0
+    for _ in range(9):
+        try:
+            reg().hit(DEVICE_DISPATCH)
+        except InjectedError as e:
+            assert e.site == DEVICE_DISPATCH
+            trips += 1
+    assert trips == 3
+    c = reg().counters()[DEVICE_DISPATCH]
+    assert c == {"hits": 9, "trips": 3, "armed": 1}
+    assert reg().trips(DEVICE_DISPATCH) == 3
+
+
+def test_one_in_is_deterministic_for_a_seed():
+    def pattern(seed):
+        reg().reset()
+        reg().arm(MSG_SEND, mode="error", one_in=4, seed=seed)
+        return [reg().check_drop(MSG_SEND) for _ in range(200)]
+
+    a, b = pattern(7), pattern(7)
+    assert a == b, "same seed must replay the same trip pattern"
+    assert any(a), "1-in-4 over 200 checks must trip"
+    assert pattern(8) != a, "different seed, different pattern"
+
+
+def test_sites_draw_independent_rngs():
+    """One site's trip schedule must not depend on how often the
+    other sites were checked (per-site RNG keyed by (seed, name))."""
+    reg().seed_all(3)
+    reg().arm(MSG_SEND, mode="error", one_in=5)
+    lone = [reg().check_drop(MSG_SEND) for _ in range(100)]
+    reg().reset()
+    reg().seed_all(3)
+    reg().arm(MSG_SEND, mode="error", one_in=5)
+    reg().arm(STORE_APPLY, mode="error", one_in=5)
+    mixed = []
+    for _ in range(100):
+        reg().check_drop(STORE_APPLY)    # interleaved traffic
+        mixed.append(reg().check_drop(MSG_SEND))
+    assert mixed == lone
+
+
+def test_one_shot_fires_once_then_disarms():
+    reg().arm(STORE_APPLY, mode="error", one_shot=True)
+    assert STORE_APPLY in reg().armed_sites()
+    with pytest.raises(InjectedError):
+        reg().hit(STORE_APPLY)
+    reg().hit(STORE_APPLY)               # disarmed: no-op
+    assert STORE_APPLY not in reg().armed_sites()
+    assert reg().trips(STORE_APPLY) == 1
+
+
+def test_stall_mode_sleeps_in_place():
+    reg().arm(DEVICE_COMPLETION, mode="stall", every=1, stall_s=0.15)
+    t0 = time.monotonic()
+    reg().hit(DEVICE_COMPLETION)         # must not raise
+    assert time.monotonic() - t0 >= 0.14
+    # check_drop treats a stall as 'slow, not dead'
+    assert reg().check_drop(DEVICE_COMPLETION) is False
+
+
+def test_corrupt_bytes_flips_one_bit():
+    reg().arm(MSG_SEND, mode="corrupt", every=1)
+    data = bytes(range(64))
+    out = reg().corrupt_bytes(MSG_SEND, data)
+    assert out != data
+    diff = [i for i in range(64) if out[i] != data[i]]
+    assert len(diff) == 1
+    assert out[diff[0]] ^ data[diff[0]] == 0x40
+    assert data == bytes(range(64)), "input must not be mutated"
+
+
+def test_store_apply_corrupt_respects_match_predicate():
+    hit_obj = GHObject("victim", 0)
+    miss_obj = GHObject("bystander", 0)
+
+    def only_victim(txns):
+        return any(op[0] == "write" and op[2].oid == "victim"
+                   for t in txns for op in t.ops)
+
+    reg().arm(STORE_APPLY, mode="corrupt", every=1, max_trips=1,
+              match=only_victim)
+    miss = Transaction().write("1.0s0", miss_obj, 0, b"a" * 32)
+    reg().store_apply([miss])
+    assert bytes(miss.ops[0][4]) == b"a" * 32, "non-match untouched"
+    hit = Transaction().write("1.0s0", hit_obj, 0, b"a" * 32)
+    reg().store_apply([hit])
+    flipped = bytes(hit.ops[0][4])
+    assert flipped != b"a" * 32
+    assert sum(x != ord("a") for x in flipped) == 1
+    # max_trips=1: the next matching apply sails through
+    again = Transaction().write("1.0s0", hit_obj, 0, b"b" * 32)
+    reg().store_apply([again])
+    assert bytes(again.ops[0][4]) == b"b" * 32
+
+
+def test_check_send_absorbs_legacy_conf_into_site_counters():
+    # nothing armed: the legacy conf alone drives (and counts) trips
+    assert reg().check_send(MSG_SEND, conf_one_in=1) is True
+    c = reg().counters()[MSG_SEND]
+    assert c["trips"] == 1 and c["armed"] == 0
+    # conf off and nothing armed: never trips
+    assert reg().check_send(MSG_SEND, conf_one_in=0) is False
+
+
+def test_configure_grammar_idempotence_and_errors():
+    reg().configure("device.dispatch:error:every2,"
+                    "store.apply:stall:1in10:250", seed=5)
+    assert set(reg().armed_sites()) == {DEVICE_DISPATCH, STORE_APPLY}
+    site = reg().site(STORE_APPLY)
+    assert site.mode == "stall" and site.stall_s == 0.25
+    with pytest.raises(InjectedError):
+        for _ in range(2):
+            reg().hit(DEVICE_DISPATCH)
+    hits_before = reg().counters()[DEVICE_DISPATCH]["hits"]
+    # identical (spec, seed): a daemon restart must NOT reset RNGs
+    # or counters mid-run
+    reg().configure("device.dispatch:error:every2,"
+                    "store.apply:stall:1in10:250", seed=5)
+    assert reg().counters()[DEVICE_DISPATCH]["hits"] == hits_before
+    for bad in ("device.dispatch:error", "store.apply:error:sometimes"):
+        with pytest.raises(ValueError):
+            reg().configure(bad, seed=0)
+
+
+def test_configure_from_cluster_conf():
+    conf = make_conf(fault_injection="msg.recv:error:1in9",
+                     fault_injection_seed=11)
+    faultlib.configure_from(conf)
+    assert reg().armed_sites() == ["msg.recv"]
+    faultlib.configure_from({})          # no options: ignored
+    assert reg().armed_sites() == ["msg.recv"]
+
+
+# ------------------------------------------------------- store gate
+def test_store_queue_transactions_consults_the_gate():
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+    try:
+        s.queue_transactions([Transaction().create_collection("1.0s0")])
+        reg().arm(STORE_APPLY, mode="error", one_shot=True)
+        t = Transaction().write("1.0s0", GHObject("o", 0), 0, b"data")
+        with pytest.raises(InjectedError):
+            s.queue_transactions([t])
+        # error raised BEFORE any mutation; the retry lands cleanly
+        assert not s.exists("1.0s0", GHObject("o", 0))
+        s.queue_transactions([t])
+        assert s.read("1.0s0", GHObject("o", 0)) == b"data"
+    finally:
+        s.umount()
+
+
+# ------------------------------------------------- batcher hardening
+def codec():
+    return ecreg.instance().factory(
+        "tpu", {"k": "2", "m": "1", "technique": "reed_sol_van"})
+
+
+def make_batcher(**over):
+    conf = {"ec_tpu_batch_stripes": 1024,
+            "ec_tpu_queue_window_us": 1000,
+            "ec_tpu_fallback_cpu": False}
+    conf.update(over)
+    return EncodeBatcher(conf)
+
+
+def encode_one(b, impl, sinfo, data, timeout=30):
+    out = {}
+    done = threading.Event()
+    b.submit(impl, sinfo, data, lambda c: (out.update(c or {"err": None}),
+                                           done.set()))
+    assert done.wait(timeout)
+    return out
+
+
+def test_device_fault_falls_back_to_cpu_twin_bit_exact():
+    """A device whose every dispatch raises must still complete the
+    group — CPU twin, bit-exact — and charge device_errors."""
+    impl = codec()
+    b = make_batcher()
+    try:
+        reg().arm(DEVICE_DISPATCH, mode="error", every=1)
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = os.urandom(2 * 8192)
+        got = encode_one(b, impl, sinfo, data)
+        reg().disarm(DEVICE_DISPATCH)
+        assert got == ecutil.encode(sinfo, impl, data)
+        assert b.device_errors >= 1
+        assert b.calls == 0, "no device call can have succeeded"
+        assert reg().trips(DEVICE_DISPATCH) >= 3, "retries also draw"
+    finally:
+        b.stop()
+
+
+def test_completion_fault_serves_group_from_cpu():
+    """A dispatched handle whose wait() fails is a classified
+    completion failure: the CPU twin serves the riders bit-exactly."""
+    impl = codec()
+    b = make_batcher()
+    try:
+        reg().arm(DEVICE_COMPLETION, mode="error", one_shot=True)
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = os.urandom(3 * 8192)
+        got = encode_one(b, impl, sinfo, data)
+        assert got == ecutil.encode(sinfo, impl, data)
+        assert b.device_errors == 1
+        assert not EncodeBatcher._breaker_open, "1 failure < threshold"
+    finally:
+        b.stop()
+
+
+def test_breaker_opens_after_threshold_and_probe_readmits():
+    impl = codec()
+    b = make_batcher()
+    try:
+        reg().arm(DEVICE_DISPATCH, mode="error", every=1)
+        sinfo = ecutil.StripeInfo(2, 8192)
+        for _ in range(b.device_error_threshold):
+            encode_one(b, impl, sinfo, os.urandom(8192))
+        assert EncodeBatcher._breaker_open, \
+            "consecutive failures must open the breaker"
+        assert EncodeBatcher._breaker_opens == 1
+        reg().disarm(DEVICE_DISPATCH)    # device 'recovers'
+
+        # open breaker: a non-probe group routes to the CPU twin
+        # without touching the (now healthy) device
+        EncodeBatcher._probe_tick = 0
+        calls_before = b.calls
+        encode_one(b, impl, sinfo, os.urandom(8192))
+        assert b.calls == calls_before
+        assert b.cpu_calls >= 1
+        assert EncodeBatcher._breaker_open, "no probe ran yet"
+
+        # force the shared probe tick: the probe reaches the device,
+        # succeeds, and re-admits it
+        EncodeBatcher._probe_tick = b.probe_interval - 1
+        got = encode_one(b, impl, sinfo, os.urandom(2 * 8192))
+        assert not EncodeBatcher._breaker_open, \
+            "successful probe must close the breaker"
+        assert EncodeBatcher._breaker_closes == 1
+        assert b.calls == calls_before + 1
+        assert "err" not in got
+    finally:
+        b.stop()
+
+
+def test_cb_error_fails_undone_requests_with_eio():
+    """_cb_error(reqs) must deliver cb(None) to every request that has
+    not completed — the EC backend turns that into EIO — and never
+    re-fire one that already has."""
+    b = make_batcher()
+    try:
+        seen = []
+        fresh = types.SimpleNamespace(done=False,
+                                      cb=lambda c: seen.append(c))
+        served = types.SimpleNamespace(
+            done=True, cb=lambda c: seen.append("dup"))
+        before = b.encode_errors
+        b._cb_error([fresh, served])
+        assert seen == [None]
+        assert fresh.done is True
+        assert b.encode_errors == before + 1
+    finally:
+        b.stop()
+
+
+# ------------------------------------------------------ cluster level
+def test_chaos_smoke_seeded_device_faults_zero_client_errors():
+    """Tier-1 chaos smoke: 1-in-20 device-dispatch faults, seeded,
+    over a small cluster EC write load — every op must succeed, every
+    byte verify, and the trips must surface in the exported perf
+    dump."""
+    conf = make_conf(fault_injection="device.dispatch:error:1in20",
+                     fault_injection_seed=42,
+                     ec_tpu_fallback_cpu=False)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        c.create_ec_profile("chaos", plugin="tpu", k="2", m="1")
+        c.create_pool("chp", "erasure", erasure_code_profile="chaos")
+        io = c.rados().open_ioctx("chp")
+        blob = os.urandom(32 << 10)
+        for i in range(12):
+            io.write_full(f"c{i}", blob)
+        for i in range(12):
+            assert io.read(f"c{i}") == blob, "client saw bad bytes"
+        cnt = reg().counters()
+        assert cnt[DEVICE_DISPATCH]["hits"] > 0, \
+            "no dispatch ever consulted the armed site"
+        # the registry rides the OSD perf dump (-> admin socket,
+        # ceph tell, mgr prometheus)
+        ret, _, out = c.osds[0]._exec_command({"prefix": "perf dump"})
+        assert ret == 0
+        assert out["faults"][DEVICE_DISPATCH]["armed"] == 1
+        assert out["faults"][DEVICE_DISPATCH]["hits"] == \
+            cnt[DEVICE_DISPATCH]["hits"]
+
+
+@pytest.mark.parametrize("backend", ["classic", "crimson"])
+def test_subwrite_deadline_rerequests_after_dropped_ack(backend):
+    """Drop the first MOSDECSubOpWriteReply delivery: the primary's
+    sub-write deadline must fire, re-request the laggard shard, and
+    complete the write — on the classic (timer thread) and crimson
+    (reactor timer) OSDs alike."""
+    conf = make_conf(osd_ec_subwrite_timeout_ms=400.0,
+                     fault_injection="ec.subwrite_ack:error:once",
+                     fault_injection_seed=1)
+    if backend == "crimson":
+        conf.set("osd_backend", "crimson")
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        c.create_ec_profile("dl", plugin="jerasure", k="2", m="1")
+        c.create_pool("dlp", "erasure", erasure_code_profile="dl")
+        io = c.rados().open_ioctx("dlp")
+        blob = os.urandom(16 << 10)
+        io.write_full("laggard", blob)   # blocks until all shards ack
+        assert io.read("laggard") == blob
+        assert reg().trips(EC_SUBWRITE_ACK) == 1
+        dumps = [c.osds[i]._exec_command({"prefix": "perf dump"})[2]
+                 for i in range(3)]
+        assert sum(d["osd"]["ec_subwrite_timeouts"]
+                   for d in dumps) >= 1, "deadline never fired"
+        assert sum(d["osd"]["ec_subwrite_retries"]
+                   for d in dumps) >= 1, "laggard never re-requested"
+        # follow-up writes are undisturbed (the one-shot is spent,
+        # the re-request dedup left no stuck state)
+        for i in range(4):
+            io.write_full(f"after{i}", blob)
+            assert io.read(f"after{i}") == blob

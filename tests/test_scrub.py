@@ -177,6 +177,65 @@ def test_ec_corrupt_shard_read_survives_and_repairs(cl):
     cl.wait_for_clean(20)
 
 
+def test_ec_injected_write_corruption_scrub_repair_roundtrip(cl):
+    """Fault-registry store.apply corruption: ONE shard write of one
+    object is bit-flipped as it enters the store (in-flight bit rot,
+    not post-hoc file surgery).  The client read must still return
+    good bytes via parity, deep scrub must localize exactly one bad
+    shard, and repair must round-trip back to clean."""
+    from ceph_tpu.utils import faults as faultlib
+
+    cl.create_ec_profile("fin", plugin="jerasure", k="2", m="1")
+    cl.create_pool("finp", "erasure", erasure_code_profile="fin")
+    io = cl.rados().open_ioctx("finp")
+    payload = os.urandom(16384)
+
+    def only_victim(txns):
+        return any(op[0] == "write" and op[2].oid == "fvic"
+                   for t in txns for op in t.ops)
+
+    reg = faultlib.registry()
+    reg.reset()
+    reg.arm(faultlib.STORE_APPLY, mode="corrupt", every=1,
+            max_trips=1, match=only_victim, seed=3)
+    try:
+        io.write_full("fvic", payload)
+        assert reg.trips(faultlib.STORE_APPLY) == 1, \
+            "the write never passed the store gate"
+    finally:
+        reg.reset()
+    cl.wait_for_clean(20)
+
+    # reads reconstruct around the rotten shard
+    assert io.read("fvic") == payload
+
+    pgid, _ = pg_stat_of(cl, "fvic", "finp")
+    ret, rs, _ = cl.mon_command({"prefix": "pg deep-scrub",
+                                 "pgid": pgid})
+    assert ret == 0, rs
+    stat = wait_scrub_errors(
+        cl, pgid, lambda s: s.get("num_scrub_errors", 0) > 0)
+    bad_shards = stat["inconsistent"].get("fvic")
+    assert bad_shards is not None and len(bad_shards) == 1, stat
+
+    ret, rs, _ = cl.mon_command({"prefix": "pg repair", "pgid": pgid})
+    assert ret == 0, rs
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        cl.mon_command({"prefix": "pg deep-scrub", "pgid": pgid})
+        ret, _, out = cl.mon_command({"prefix": "pg dump"})
+        stat = out["pg_stats"].get(pgid, {})
+        if stat.get("num_scrub_errors", 1) == 0 and \
+                stat.get("num_missing", 1) == 0 and \
+                stat.get("last_deep_scrub", 0) > 0:
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError(f"repair never converged: {stat}")
+    assert io.read("fvic") == payload
+    cl.wait_for_clean(20)
+
+
 def test_scrub_concurrent_with_writes_no_false_errors(cl):
     """Scrub must snapshot one committed state: writes racing the
     round queue behind it instead of producing phantom mismatches
