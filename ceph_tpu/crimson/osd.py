@@ -99,10 +99,13 @@ class CrimsonOSD(OSD):
                                 reactor=self.reactor)
 
     def _call_later(self, delay: float, fn):
-        # EC sub-write deadlines fire as reactor timers, so their
-        # re-request/report continuations run on the reactor thread
-        # like every other PG continuation (no extra timer threads)
-        return self.reactor.call_later(delay, fn)
+        # same per-OSD hashed timer wheel as the classic backend, but
+        # the fire is marshalled onto the reactor so re-request/report
+        # continuations run on the reactor thread like every other PG
+        # continuation (no extra timer threads, no cross-thread PG
+        # state access from the wheel)
+        return self.timer_wheel.call_later(
+            delay, lambda: self.reactor.call_soon(fn))
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
@@ -141,6 +144,7 @@ class CrimsonOSD(OSD):
             except Exception:
                 pass
         self.msgr.shutdown()
+        self.timer_wheel.stop()
         self.reactor.stop()
         try:
             self.store.umount()

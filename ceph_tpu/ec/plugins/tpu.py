@@ -34,6 +34,11 @@ from . import jerasure as jr
 
 _SHARED_BACKEND: JaxBackend = None
 
+# (geometry, batch-shape) pairs already compiled+staged by
+# prewarm_geometry — PG activation calls it per PG, the work is
+# per-process
+_PREWARMED_SHAPES: set = set()
+
 
 def shared_backend() -> JaxBackend:
     """One backend per process so jit caches / device matrices are shared
@@ -93,6 +98,32 @@ class TpuCodecMixin:
                 self.core.coding_matrix, data)
         return self.core.backend.apply_bitmatrix_bytes_async(
             self.core.bitmatrix, data, self.w)
+
+    def prewarm_geometry(self, chunk_size: int,
+                         batches=(1,)) -> None:
+        """Make this pool geometry hot before the first client write:
+        preallocate the persistent staging rings for the batch shapes
+        the OSD coalescer dispatches (jax_engine StagingPool) and
+        compile the encode executables by running one zero batch per
+        shape through the real async path.  Idempotent per
+        (geometry, shape) process-wide; synchronous — callers (PG
+        activation) run it on a background thread."""
+        backend = self.core.backend
+        pre = getattr(backend, "prewarm_geometry", None)
+        if pre is not None:
+            pre(self.k, chunk_size, batches=batches, w=self.w)
+        for nb in batches:
+            key = (type(self).__name__, self.k, self.m, self.w,
+                   int(chunk_size), int(nb))
+            if key in _PREWARMED_SHAPES:
+                continue
+            _PREWARMED_SHAPES.add(key)
+            z = np.zeros((max(1, int(nb)), self.k, int(chunk_size)),
+                         dtype=np.uint8)
+            try:
+                self.encode_batch_async(z).wait()
+            except Exception:
+                _PREWARMED_SHAPES.discard(key)  # best-effort
 
     def stage_batch(self, data: np.ndarray):
         """Transfer a stripe batch to device HBM ahead of encode."""

@@ -30,6 +30,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 from collections import OrderedDict
 from typing import Optional
 
@@ -611,6 +612,127 @@ def _bucket_batch(b: int) -> int:
     return 1 << (b - 1).bit_length()
 
 
+class _StageSlot:
+    """One reusable host staging array plus the fence that guards it.
+
+    ``fence`` is the device value computed FROM this slot's last h2d —
+    once it is ready the transfer has necessarily consumed the host
+    bytes, so the array may be overwritten (correct even when the
+    device input buffer was donated to the kernel)."""
+
+    __slots__ = ("host", "fence", "max_l")
+
+    def __init__(self, host: np.ndarray):
+        self.host = host
+        self.fence = None
+        self.max_l = 0          # column high-water mark (pad hygiene)
+
+
+class StagingPool:
+    """Persistent per-shape host staging rings (double-buffered h2d).
+
+    Every batched encode used to pay a fresh ``np.zeros`` + a fresh
+    ``jax.device_put`` allocation.  The pool keeps ``depth`` reusable
+    host arrays per padded [batch, k, L] shape: while slot A's batch
+    is still being consumed on device, slot B is filled and staged —
+    and re-acquiring A blocks only on A's compute fence, which by then
+    has long retired.  Geometry shapes are few (bucketed), so the ring
+    set is bounded; a shape LRU caps worst-case footprint.
+
+    The pool also owns the h2d link estimate: every ``sample_every``-th
+    staging is fenced end-to-end and folded into a warm-transfer EWMA
+    (``h2d_bps``) that the OSD batcher reads for its crossover model —
+    replacing the old one-shot cold ``device_put`` measurement that
+    folded allocator/jit warmup into the link rate.
+    """
+
+    MAX_SHAPES = 16
+
+    def __init__(self, depth: int = 2, sample_every: int = 16):
+        self.depth = max(1, int(depth))
+        self.sample_every = max(1, int(sample_every))
+        self._free: "OrderedDict[tuple, list]" = OrderedDict()
+        self._made: dict = {}
+        self._cv = threading.Condition()
+        self._puts = 0
+        self.hits = 0            # stagings served from a reused array
+        self.allocs = 0          # host staging arrays ever allocated
+        self.h2d_bps = 0.0       # warm-transfer EWMA (fenced samples)
+        self.h2d_samples = 0
+
+    # -- slot checkout -----------------------------------------------
+    def acquire(self, shape: tuple) -> _StageSlot:
+        with self._cv:
+            while True:
+                free = self._free.get(shape)
+                if free is None:
+                    free = self._free[shape] = []
+                self._free.move_to_end(shape)
+                if free:
+                    slot = free.pop()
+                    self.hits += 1
+                    break
+                if self._made.get(shape, 0) < self.depth:
+                    self._made[shape] = self._made.get(shape, 0) + 1
+                    slot = _StageSlot(np.zeros(shape, dtype=np.uint8))
+                    self.allocs += 1
+                    self._evict_locked()
+                    break
+                # both slots in flight: wait for a release (bounded
+                # wait so a lost notify can't wedge the encode path)
+                self._cv.wait(timeout=0.5)
+        fence = slot.fence
+        if fence is not None:
+            slot.fence = None
+            try:
+                fence.block_until_ready()
+            except Exception:
+                pass             # deleted/donated fence == retired
+        return slot
+
+    def release(self, shape: tuple, slot: _StageSlot, fence) -> None:
+        slot.fence = fence
+        with self._cv:
+            self._free.setdefault(shape, []).append(slot)
+            self._cv.notify_all()
+
+    def _evict_locked(self) -> None:
+        # drop the least-recently-used shape's idle ring when the
+        # shape set outgrows the cap (only fully-idle shapes qualify)
+        while len(self._free) > self.MAX_SHAPES:
+            for shape in list(self._free):
+                if len(self._free[shape]) >= self._made.get(shape, 0):
+                    del self._free[shape]
+                    self._made.pop(shape, None)
+                    break
+            else:
+                return
+
+    # -- h2d link estimate -------------------------------------------
+    def should_sample(self) -> bool:
+        self._puts += 1
+        return self._puts % self.sample_every == 1
+
+    def note_h2d(self, nbytes: int, seconds: float) -> None:
+        if seconds <= 0 or nbytes <= 0:
+            return
+        bps = nbytes / seconds
+        self.h2d_bps = bps if self.h2d_bps <= 0 else (
+            0.7 * self.h2d_bps + 0.3 * bps)
+        self.h2d_samples += 1
+
+    def ensure(self, shape: tuple) -> None:
+        """Preallocate a full ring for ``shape`` (prewarm path)."""
+        with self._cv:
+            free = self._free.setdefault(shape, [])
+            self._free.move_to_end(shape)
+            while self._made.get(shape, 0) < self.depth:
+                self._made[shape] = self._made.get(shape, 0) + 1
+                free.append(_StageSlot(np.zeros(shape, dtype=np.uint8)))
+                self.allocs += 1
+            self._evict_locked()
+
+
 class AsyncBatch:
     """Handle to an in-flight batched encode: the device computation and
     the device->host copy are both dispatched; wait() joins and returns
@@ -623,6 +745,10 @@ class AsyncBatch:
         self._batch = batch
         self._L = L
         self._lead = lead
+        # fenced h2d link sample from the staging pool, when this
+        # batch happened to be the sampled one (batcher EWMA feed)
+        self.h2d_bytes = 0
+        self.h2d_seconds = 0.0
 
     def wait(self) -> np.ndarray:
         out = np.asarray(self._dev)[:self._batch, :, :self._L]
@@ -640,9 +766,10 @@ class JaxBackend:
         self.bucket_shapes = bucket_shapes
         self._dev_matrices: dict = {}
         self._chain_lru = ChainLRU(256)
+        self.staging = StagingPool()
 
     def _device_matrix(self, B: np.ndarray) -> jnp.ndarray:
-        key = (B.shape, B.tobytes())
+        key = (B.shape, B.tobytes())  # copycheck: ok - cache key over a tiny coding matrix (k*m bytes), not payload
         hit = self._dev_matrices.get(key)
         if hit is None:
             hit = jnp.asarray(B, dtype=jnp.int8)
@@ -662,6 +789,59 @@ class JaxBackend:
         out = np.zeros((bb, k, Lb), dtype=np.uint8)
         out[:batch, :, :L] = data
         return out, batch, L
+
+    def _staged_put(self, data: np.ndarray, quantum: int):
+        """Pad [batch, k, L] into a persistent staging slot and start
+        its h2d.  Returns ``(dev, batch, L, done, sampled)``; the
+        caller MUST invoke ``done(fence)`` with the device value
+        computed from ``dev`` right after dispatch — the fence is what
+        lets the slot's host bytes be overwritten by a later batch.
+        Every Nth staging is fenced and timed to keep the pool's warm
+        h2d EWMA honest."""
+        batch, k, L = data.shape
+        if not self.bucket_shapes:
+            return jax.device_put(data), batch, L, None, None
+        shape = (_bucket_batch(batch), k, _round_up(L, quantum))
+        slot = self.staging.acquire(shape)
+        host = slot.host
+        host[:batch, :, :L] = data  # copycheck: ok - staging fill into a REUSED persistent buffer (the one h2d copy)
+        if slot.max_l > L:
+            # stale columns from a longer previous batch: packet-layout
+            # kernels mix columns within a super-word window, so the
+            # pad region must stay zero (GF-linear => zeros are inert)
+            host[:, :, L:slot.max_l] = 0
+        slot.max_l = max(slot.max_l, L)
+        sample = None
+        if self.staging.should_sample():
+            t0 = time.monotonic()
+            dev = jax.device_put(host)
+            try:
+                dev.block_until_ready()
+                dt = time.monotonic() - t0
+                self.staging.note_h2d(host.nbytes, dt)
+                sample = (host.nbytes, dt)
+            except Exception:
+                pass
+        else:
+            dev = jax.device_put(host)
+
+        def done(fence, _shape=shape, _slot=slot):
+            self.staging.release(_shape, _slot, fence)
+        return dev, batch, L, done, sample
+
+    def prewarm_geometry(self, k: int, chunk_size: int,
+                         batches=(1,), w: int = 8) -> None:
+        """Preallocate the staging rings a (k, chunk_size) geometry
+        will dispatch, so the first client write after PG activation
+        reuses warm buffers instead of paying fresh allocation.
+        Idempotent and cheap (host-side only); executable compilation
+        is driven by the codec layer, which calls this first."""
+        if not self.bucket_shapes:
+            return
+        quantum = LENGTH_QUANTUM * max(1, w // 8)
+        for nb in batches:
+            self.staging.ensure((_bucket_batch(max(1, int(nb))), k,
+                                 _round_up(chunk_size, quantum)))
 
     def gf8_fast_path(self) -> bool:
         """The XOR-chain compiles once per coding matrix (static
@@ -699,14 +879,22 @@ class JaxBackend:
         """Device-resident byte-domain apply (codec-kernel boundary)."""
         return self.gf8_fn(M)(dev_data)
 
-    def gf8_fn(self, rows: np.ndarray):
+    def gf8_fn(self, rows: np.ndarray, donate: bool = False):
         """Best compiled kernel for an arbitrary GF(2^8) row set over
         [.., C, L] byte chunks, LRU-cached per row set — per-pool
         coding matrices AND per-erasure-signature decode rows (the
         compiled analog of ISA-L's decode-table LRU).  Routing lives
-        in gf8_inner (shared with the mesh path)."""
+        in gf8_inner (shared with the mesh path).  ``donate=True``
+        hands the staged device input to XLA for output aliasing —
+        legal only when output bytes == input bytes (square row set,
+        m == k), so it is silently ignored otherwise."""
         rows = np.asarray(rows, dtype=np.int64)
+        donate = donate and rows.shape[0] == rows.shape[1]
         coeffs = tuple(tuple(int(v) for v in row) for row in rows)
+        if donate:
+            return self._chain_lru.get_or_build(
+                ("gf8don", coeffs),
+                lambda: jax.jit(gf8_inner(rows), donate_argnums=(0,)))
         return self._chain_lru.get_or_build(
             ("gf8", coeffs), lambda: jax.jit(gf8_inner(rows)))
 
@@ -734,7 +922,7 @@ class JaxBackend:
         """Compiled static XOR schedule for a packet-layout bitmatrix
         (cauchy/liberation families), LRU-cached per matrix.  Returns a
         jitted [batch, k, L] -> [batch, R/w, L] callable."""
-        key = ("pkt", B.shape, B.tobytes(), w, packetsize)
+        key = ("pkt", B.shape, B.tobytes(), w, packetsize)  # copycheck: ok - cache key over a tiny bitmatrix, not payload
 
         def build():
             if pallas_packet_mxu_ok(w, packetsize):
@@ -777,11 +965,16 @@ class JaxBackend:
             data = data[None]
         lead = data.shape[:-2] if not squeeze else ()
         data = data.reshape((-1,) + data.shape[-2:])
-        padded, batch, L = self._padded(data, LENGTH_QUANTUM)
-        dev = jax.device_put(padded)
-        out = self.gf8_fn(M)(dev)
+        dev, batch, L, done, sample = self._staged_put(
+            data, LENGTH_QUANTUM)
+        out = self.gf8_fn(M, donate=done is not None)(dev)
         out.copy_to_host_async()
-        return AsyncBatch(out, batch, L, lead)
+        if done is not None:
+            done(out)
+        ab = AsyncBatch(out, batch, L, lead)
+        if sample is not None:
+            ab.h2d_bytes, ab.h2d_seconds = sample
+        return ab
 
     def apply_bitmatrix_bytes(self, B: np.ndarray, data: np.ndarray,
                               w: int) -> np.ndarray:
@@ -816,11 +1009,16 @@ class JaxBackend:
         if data.shape[-1] % wbytes:
             raise ValueError(
                 f"chunk length must be a multiple of {wbytes} for w={w}")
-        padded, batch, L = self._padded(data, LENGTH_QUANTUM * wbytes)
-        dev = jax.device_put(padded)
+        dev, batch, L, done, sample = self._staged_put(
+            data, LENGTH_QUANTUM * wbytes)
         out = _apply_byte_domain(self._device_matrix(B), dev, w)
         out.copy_to_host_async()
-        return AsyncBatch(out, batch, L, lead)
+        if done is not None:
+            done(out)
+        ab = AsyncBatch(out, batch, L, lead)
+        if sample is not None:
+            ab.h2d_bytes, ab.h2d_seconds = sample
+        return ab
 
     def apply_bitmatrix_bytes_device(self, B: np.ndarray, dev_data, w: int):
         """Device-resident apply: input is already a device array (padded

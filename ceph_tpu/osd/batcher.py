@@ -35,6 +35,7 @@ in-order commit contract it must preserve is ECBackend::check_ops
 """
 from __future__ import annotations
 
+import queue
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Tuple
@@ -149,9 +150,17 @@ class EncodeBatcher:
 
     _cpu_bps: Dict[Tuple, float] = {}        # per geometry, shared
     _min_device_bytes: float = 0.0           # learned crossover, shared
+    _pinned_min_device_bytes: float = 0.0    # operator pin (breaker
+                                             # close resets TO this)
     _probe_tick: int = 0                     # shared probe cadence
     _warmed: set = set()                     # geometries prewarmed
-    _h2d_bps: float = 0.0                    # measured link rate, shared
+    _h2d_bps: float = 0.0                    # warm link rate EWMA, shared
+    _dev_bps: Dict[Tuple, float] = {}        # steady-state device
+                                             # throughput EWMA per
+                                             # geometry (compile/outlier
+                                             # rejection in the learner)
+    _last_device_ts: float = time.monotonic()   # last device activity
+    _last_idle_probe_ts: float = time.monotonic()
     # device circuit breaker — class-level like the crossover it
     # guards: the device is a machine property, so one OSD's string
     # of dispatch failures should route EVERY in-process batcher's
@@ -200,9 +209,28 @@ class EncodeBatcher:
         if pin:
             # operator-pinned crossover: routing is deterministic from
             # the first op instead of riding the prewarm/learning race
-            # (probes + big wins can still lower it at runtime)
+            # (probes + big wins can still lower it at runtime).  The
+            # pin is remembered separately so a circuit-breaker close
+            # restores the OPERATOR's crossover, not whatever CPU bias
+            # the learner accumulated while the device was sick.
             EncodeBatcher._min_device_bytes = float(pin)
+            EncodeBatcher._pinned_min_device_bytes = float(pin)
         self.probe_interval = get("ec_tpu_crossover_probe_interval", 16)
+        # a device that served ZERO recent traffic gets re-probed
+        # aggressively (one group per idle period) — the 1-in-N tick
+        # probe alone starves on a lightly loaded OSD where small
+        # batches would otherwise pin the CPU bias forever
+        self.idle_reprobe_s = get("ec_tpu_device_idle_reprobe_s", 2.0)
+        # collection/dispatch of window N+1 overlaps completion of
+        # window N: dispatched groups hand off to a completion worker
+        # through a bounded FIFO (depth = groups genuinely in flight
+        # on the device; the blocking put is the throttle)
+        self.inflight_groups = max(1, get("ec_tpu_inflight_groups", 2))
+        # fresh timestamps: a just-built batcher must not treat
+        # process-lifetime idleness as device idleness (tests build
+        # batchers long after import)
+        EncodeBatcher._last_device_ts = time.monotonic()
+        EncodeBatcher._last_idle_probe_ts = time.monotonic()
         self.crossover_min = get("ec_tpu_crossover_min_bytes", 64 << 10)
         self.device_error_threshold = get(
             "ec_tpu_device_error_threshold", 3)
@@ -287,6 +315,16 @@ class EncodeBatcher:
         self.device_errors = 0       # classified device failures
         self._cpu_twins: Dict[Tuple, object] = {}  # device-failure path
         self._dec_threads: List[threading.Thread] = []
+        # completion worker: joins dispatched groups in FIFO order so
+        # the collector can collect/dispatch the NEXT window while
+        # this window's parity is still in flight (segment N+1's h2d
+        # overlaps segment N's fanout)
+        self._completions: "queue.Queue" = queue.Queue(
+            maxsize=self.inflight_groups)
+        self._comp_thread = threading.Thread(
+            target=self._completion_loop, name="ec-batcher-join",
+            daemon=True)
+        self._comp_thread.start()
         self._thread = threading.Thread(target=self._run,
                                         name="ec-batcher", daemon=True)
         self._thread.start()
@@ -427,10 +465,17 @@ class EncodeBatcher:
                     z = np.zeros((nb, k, sinfo.chunk_size),
                                  dtype=np.uint8)
                     if EncodeBatcher._h2d_bps <= 0:
-                        # measure the link once per process: feeds
-                        # the h2d/device/d2h split of the fenced
-                        # dispatch window (stage_seconds)
+                        # seed the link estimate from a WARM transfer:
+                        # the first device_put pays allocator/runtime
+                        # warmup that is NOT link cost — timing it
+                        # under-states the link by an order of
+                        # magnitude and poisons the h2d/device/d2h
+                        # split AND the overlap model's bottleneck
+                        # leg.  Transfer once cold (discarded), time
+                        # the second.  Real batches keep updating the
+                        # EWMA afterwards (staging-pool samples).
                         try:
+                            jax.block_until_ready(jax.device_put(z))
                             t0 = time.monotonic()
                             jax.block_until_ready(jax.device_put(z))
                             EncodeBatcher._h2d_bps = z.nbytes / max(
@@ -473,6 +518,10 @@ class EncodeBatcher:
             self._cond.notify()
         deadline = time.monotonic() + max(drain, 0.1)
         self._thread.join(timeout=max(drain, 0.1))
+        # the collector queued a sentinel on exit; the completion
+        # worker drains every in-flight group behind it, then stops
+        self._comp_thread.join(
+            timeout=max(0.1, deadline - time.monotonic()))
         for t in self._dec_threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))
 
@@ -522,6 +571,7 @@ class EncodeBatcher:
                 while not self._queues and not self._stop:
                     self._cond.wait()
                 if not self._queues and self._stop:
+                    self._completions.put(None)   # worker: drain + exit
                     return
                 # linger for the (admission-aware) window so concurrent
                 # ops can join, unless the stripe budget is already met
@@ -566,9 +616,12 @@ class EncodeBatcher:
             # dispatch EVERY group's device call before joining any:
             # h2d staging + MXU compute of group B overlap group A's
             # parity d2h and continuations (same double buffering the
-            # bench uses).  A continuation that raises must not kill
-            # the collector — that would wedge every EC write on the
-            # OSD — so each step is fault-isolated to its own ops.
+            # bench uses).  Joins then run on the completion worker —
+            # the collector immediately loops back to collect the
+            # NEXT window, so up to ``inflight_groups`` encode groups
+            # genuinely overlap (segment N+1's h2d during segment N's
+            # fanout); the bounded queue's blocking put is the
+            # throttle.
             groups = []
             for key, reqs in queues.items():
                 if key[0] == "dec":
@@ -580,28 +633,39 @@ class EncodeBatcher:
                     groups.append((key, reqs,
                                    self._dispatch_group(reqs)))
             for key, reqs, handle in groups:
-                try:
-                    if handle == "dec":
-                        self._complete_group_dec(key, reqs)
-                    elif handle == "cpu":
-                        self._complete_group_cpu(reqs)
-                    else:
-                        # loss-direction learning runs on EVERY
-                        # group (raising the threshold is safe even
-                        # when sibling completions inflate dev_time —
-                        # worst case we conservatively route small
-                        # batches to the CPU twin); the win direction
-                        # (lowering it) only trusts single-group
-                        # cycles
-                        self._complete_group(reqs, handle,
-                                             learn=True,
-                                             trust_win=(len(groups)
-                                                        == 1))
-                except Exception:
-                    # fail every rider op that has not completed yet:
-                    # a collector-level fault must surface as EIO on
-                    # the affected ops, never as a hang
-                    self._cb_error(reqs)
+                self._completions.put((key, reqs, handle,
+                                       len(groups)))
+
+    def _completion_loop(self) -> None:
+        """FIFO join of dispatched groups (continuations preserve
+        submission order — the contract ECBackend::check_ops needs).
+        A continuation that raises must not kill the worker — that
+        would wedge every EC write on the OSD — so each group is
+        fault-isolated to its own ops."""
+        while True:
+            item = self._completions.get()
+            if item is None:
+                return
+            key, reqs, handle, ngroups = item
+            try:
+                if handle == "dec":
+                    self._complete_group_dec(key, reqs)
+                elif handle == "cpu":
+                    self._complete_group_cpu(reqs)
+                else:
+                    # loss-direction learning runs on EVERY group
+                    # (raising the threshold is safe even when
+                    # sibling completions inflate dev_time — worst
+                    # case we conservatively route small batches to
+                    # the CPU twin); the win direction (lowering it)
+                    # only trusts single-group cycles
+                    self._complete_group(reqs, handle, learn=True,
+                                         trust_win=(ngroups == 1))
+            except Exception:
+                # fail every rider op that has not completed yet: a
+                # worker-level fault must surface as EIO on the
+                # affected ops, never as a hang
+                self._cb_error(reqs)
 
     def _route_to_cpu(self, key: Tuple, reqs: List[_Req]) -> bool:
         """True when the learned crossover says this batch is too
@@ -610,6 +674,32 @@ class EncodeBatcher:
             return False
         total = sum(r.nbytes for r in reqs)
         if total >= self._min_device_bytes:
+            return False
+        # idle re-probe: a device that served ZERO traffic for a
+        # whole idle period gets one group as a probe IMMEDIATELY —
+        # a learned CPU bias with no device activity behind it is
+        # exactly the misrouting failure mode (every encode on the
+        # twin, crossover never challenged), and on a lightly loaded
+        # OSD the 1-in-N tick below may take minutes to fire.  Rate
+        # limited to one probe per idle period so an actually-slow
+        # device is not hammered.
+        #
+        # A crossover sitting AT (or under) an operator/calibration
+        # pin is not learned bias — it is the measured answer for
+        # this machine, and the pin's contract is DETERMINISTIC
+        # routing (see __init__) — so below-pin groups take the twin
+        # with no probe taxes at all; only a threshold the LEARNER
+        # pushed above the pin (or learned from scratch) gets
+        # challenged by the idle/tick probes below.
+        cls = EncodeBatcher
+        if 0 < cls._pinned_min_device_bytes and \
+                cls._min_device_bytes <= cls._pinned_min_device_bytes:
+            return True
+        now = time.monotonic()
+        if self.idle_reprobe_s > 0 and \
+                now - cls._last_device_ts > self.idle_reprobe_s and \
+                now - cls._last_idle_probe_ts > self.idle_reprobe_s:
+            cls._last_idle_probe_ts = now
             return False
         # periodic probe: route an occasional small batch to the
         # device anyway so the threshold can come back down when the
@@ -657,6 +747,7 @@ class EncodeBatcher:
         run; if this was a probe through an open breaker, re-admit
         the device."""
         cls = EncodeBatcher
+        cls._last_device_ts = time.monotonic()
         if not cls._breaker_failures and not cls._breaker_open:
             return                   # hot path: nothing to clear
         closed = False
@@ -666,8 +757,16 @@ class EncodeBatcher:
                 cls._breaker_open = False
                 cls._breaker_closes += 1
                 closed = True
-        if closed and self.bperf is not None:
-            self.bperf.inc("breaker_close")
+        if closed:
+            # re-admission must come with FRESH routing stats: while
+            # the breaker was open every group encoded on the twin
+            # and the learner could only accumulate CPU bias, so the
+            # crossover snaps back to the operator's pin (or fully
+            # unlearned) and the device gets re-tried on its merits
+            cls._min_device_bytes = cls._pinned_min_device_bytes
+            cls._dev_bps = {}
+            if self.bperf is not None:
+                self.bperf.inc("breaker_close")
 
     def _cb_error(self, reqs=None) -> None:
         """Report a continuation/encode failure.  During shutdown the
@@ -699,9 +798,14 @@ class EncodeBatcher:
         """Forget the shared crossover/rates and breaker state
         (tests; ops can call it after a hardware change)."""
         cls._min_device_bytes = 0.0
+        cls._pinned_min_device_bytes = 0.0
         cls._probe_tick = 0
         cls._cpu_bps = {}
+        cls._dev_bps = {}
         cls._warmed = set()
+        cls._h2d_bps = 0.0
+        cls._last_device_ts = time.monotonic()
+        cls._last_idle_probe_ts = time.monotonic()
         cls.reset_breaker()
 
     @classmethod
@@ -913,27 +1017,68 @@ class EncodeBatcher:
     def _learn_crossover(self, reqs: List[_Req],
                          dev_time: float,
                          trust_win: bool = True) -> None:
-        """Compare the measured device time against the CPU twin's
-        predicted time for the same bytes and move the routing
+        """Compare the device's PIPELINED cost model against the CPU
+        twin's predicted time for the same bytes and move the routing
         threshold: lost -> raise it past this batch size; won big ->
-        lower it."""
+        lower it.
+
+        Two properties matter here (both were misrouting bugs):
+
+        * the fenced ``dev_time`` is a SERIAL h2d + MXU + d2h sum,
+          but in steady state consecutive batches overlap those legs
+          (async dispatch, double-buffered staging) — so the cost the
+          router should compare is ``max(h2d, compute, d2h)``, not
+          the sum.  Judging the device on the serial number makes a
+          device that wins pipelined look like it loses, and 100% of
+          traffic lands on the twin.
+        * a call that paid jit compile (or any one-off stall) must
+          not teach the router: if this call ran far slower than the
+          geometry's own steady-state EWMA predicts, it is an
+          outlier, not a measurement."""
         try:
+            cls = EncodeBatcher
             key = _geometry_key(reqs[0].ec_impl, reqs[0].sinfo)
             total = sum(r.nbytes for r in reqs)
+            m_over_k = (reqs[0].ec_impl.get_coding_chunk_count()
+                        / max(1, reqs[0].ec_impl.get_data_chunk_count()))
             cpu_rate = max(self._cpu_rate(key, reqs[0]), 1.0)
             cpu_pred = total / cpu_rate
-            if dev_time > cpu_pred:
-                # the device LOST: set the crossover where the CPU
-                # would have taken as long as this call did (one
-                # losing measurement teaches the whole region below
-                # it, not just 2x this batch — bursts must not need
-                # a convergence loop)
-                EncodeBatcher._min_device_bytes = max(
+            # split the fenced window into transfer legs (measured
+            # warm link rate) and the compute remainder
+            h2d_s = d2h_s = 0.0
+            if cls._h2d_bps > 0:
+                h2d_s = min(dev_time, total / cls._h2d_bps)
+                d2h_s = min(max(0.0, dev_time - h2d_s),
+                            total * m_over_k / cls._h2d_bps)
+            compute_s = max(0.0, dev_time - h2d_s - d2h_s)
+            # compile/outlier rejection BEFORE the EWMA absorbs it:
+            # against this geometry's steady-state compute rate, a
+            # 5x-slower call is a one-off (jit compile, allocator
+            # stall, scheduler hiccup), not the device's cost
+            rate = cls._dev_bps.get(key, 0.0)
+            if rate > 0 and compute_s > 5.0 * (total / rate) \
+                    and compute_s > 1e-3:
+                return
+            if compute_s > 0:
+                bps = total / compute_s
+                cls._dev_bps[key] = bps if rate <= 0 else (
+                    0.7 * rate + 0.3 * bps)
+            # the PIPELINED device cost: legs overlap across batches,
+            # so the sustained per-batch cost is the slowest leg
+            dev_pipe = max(h2d_s, compute_s, d2h_s) \
+                if (h2d_s or d2h_s) else dev_time
+            if dev_pipe > cpu_pred:
+                # the device LOST even with overlap credited: set the
+                # crossover where the CPU would have taken as long as
+                # this call's bottleneck leg (one losing measurement
+                # teaches the whole region below it, not just 2x this
+                # batch — bursts must not need a convergence loop)
+                cls._min_device_bytes = max(
                     self._min_device_bytes,
-                    dev_time * cpu_rate / 2, self.crossover_min)
-            elif trust_win and dev_time < cpu_pred / 2 and \
+                    dev_pipe * cpu_rate / 2, self.crossover_min)
+            elif trust_win and dev_pipe < cpu_pred / 2 and \
                     self._min_device_bytes > 0:
-                EncodeBatcher._min_device_bytes = min(
+                cls._min_device_bytes = min(
                     self._min_device_bytes, total / 2)
         except Exception:
             pass                     # learning is best-effort
@@ -1031,6 +1176,7 @@ class EncodeBatcher:
             self._device_failure("dispatch")
             return None
         t_disp = time.monotonic()
+        EncodeBatcher._last_device_ts = t_disp
         self.stage_seconds["batch_form"] += t_disp - t_form
         if self.bperf is not None:
             self.bperf.hinc("batch_stripes", batch.shape[0])
@@ -1064,6 +1210,19 @@ class EncodeBatcher:
                     else np.concatenate(parts, axis=0)
                 dev_time = time.monotonic() - t_dispatch
                 self._device_success()
+                # fold any fenced WARM h2d samples the staging pool
+                # took during this batch into the shared link EWMA —
+                # real-traffic measurements keep the h2d/device/d2h
+                # split and the overlap model honest
+                for t in async_tiles:
+                    hb = getattr(t, "h2d_bytes", 0)
+                    hs = getattr(t, "h2d_seconds", 0.0)
+                    if hb and hs > 0:
+                        bps = hb / hs
+                        EncodeBatcher._h2d_bps = bps \
+                            if EncodeBatcher._h2d_bps <= 0 else (
+                                0.7 * EncodeBatcher._h2d_bps
+                                + 0.3 * bps)
             except Exception:
                 # classified completion failure (a dispatched handle
                 # cannot be re-waited, so no retry here — the CPU

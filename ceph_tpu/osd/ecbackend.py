@@ -43,6 +43,7 @@ read-your-own-write hazards.
 """
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from typing import Callable, Dict, List, Optional, Set, Tuple
@@ -236,6 +237,46 @@ class ECBackend(PGBackend):
                 batcher.prewarm(ec_impl, self.sinfo)
             except Exception:
                 pass
+
+    #: geometry keys whose activation prewarm already ran (the work is
+    #: per-process; activation happens per PG)
+    _activation_warmed: Set[tuple] = set()
+
+    def prewarm_geometry(self) -> None:
+        """Make the pool's (k, m, stripe) device executables and
+        staging buffers hot BEFORE the first client write — invoked
+        from PG activation (pg.py _activate).  Construction-time
+        ``batcher.prewarm`` covers the crossover probe and cold
+        compile; this adds the persistent staging rings
+        (jax_engine StagingPool) for the batch shapes the coalescer
+        dispatches, via the codec's prewarm_geometry.  Background
+        thread, idempotent per geometry process-wide."""
+        batcher = getattr(self.host, "encode_batcher", None)
+        if batcher is not None:
+            try:
+                batcher.prewarm(self.ec_impl, self.sinfo)
+            except Exception:
+                pass
+        warm = getattr(self.ec_impl, "prewarm_geometry", None)
+        if warm is None:
+            return
+        key = (type(self.ec_impl).__name__, self.k, self.m,
+               self.sinfo.chunk_size)
+        if key in ECBackend._activation_warmed:
+            return
+        ECBackend._activation_warmed.add(key)
+        ms = max(1, getattr(batcher, "max_stripes", 1) or 1)
+        batches = tuple(sorted({ms, max(1, ms // 2)}))
+        chunk = self.sinfo.chunk_size
+
+        def work():
+            try:
+                warm(chunk, batches=batches)
+            except Exception:
+                pass             # warms are best-effort
+
+        threading.Thread(target=work, name="ec-activate-prewarm",
+                         daemon=True).start()
 
     # ------------------------------------------------------------------
     # write path (reference submit_transaction -> start_rmw -> check_ops)

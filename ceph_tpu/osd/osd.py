@@ -217,6 +217,10 @@ class OSD(Dispatcher):
         # restart mid-run keeps the sites' RNG streams
         from ..utils import faults as faultlib
         faultlib.configure_from(self.conf)
+        # per-OSD hashed timer wheel: EC sub-write deadlines, recovery
+        # pacing (one thread total; see utils/timer_wheel.py)
+        from ..utils.timer_wheel import TimerWheel
+        self.timer_wheel = TimerWheel()
         # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
         from .batcher import EncodeBatcher
         self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf,
@@ -285,6 +289,7 @@ class OSD(Dispatcher):
             self.admin_socket.stop()
         self.encode_batcher.stop(
             drain=self.conf["osd_batcher_drain_timeout"])
+        self.timer_wheel.stop()
         self._recovery_kick.set()
         for q in self._shard_queues:
             q.close()
@@ -657,8 +662,8 @@ class OSD(Dispatcher):
                     # pace WITHOUT blocking the shard worker (a sleep
                     # here would stall queued client ops): defer the
                     # requeue instead
-                    threading.Timer(sleep, self.queue_recovery_item,
-                                    args=(pg,)).start()
+                    self.timer_wheel.call_later(
+                        sleep, lambda pg=pg: self.queue_recovery_item(pg))
                 else:
                     self.queue_recovery_item(pg)
 
@@ -847,13 +852,13 @@ class OSD(Dispatcher):
     # timers + laggard reporting (EC sub-write deadlines)
     # ------------------------------------------------------------------
     def _call_later(self, delay: float, fn):
-        """One-shot cancellable timer.  Classic OSDs use a plain
-        threading.Timer; CrimsonOSD overrides this with a reactor
-        timer so deadline continuations run on the reactor thread."""
-        t = threading.Timer(delay, fn)
-        t.daemon = True
-        t.start()
-        return t
+        """One-shot cancellable timer on the per-OSD hashed timer
+        wheel (utils/timer_wheel.py): O(1) arm/cancel on a single
+        daemon thread instead of one thread per timer — the EC fanout
+        arms k+m of these per segment.  CrimsonOSD shares the same
+        wheel but marshals the fire onto its reactor so deadline
+        continuations keep running on the reactor thread."""
+        return self.timer_wheel.call_later(delay, fn)
 
     def report_laggard(self, osd: int, elapsed: float) -> None:
         """A peer sat on an EC sub-write past two deadlines: report it

@@ -1159,6 +1159,17 @@ class PG:
                     entries=[e.to_dict() for e in entries]))
         self.state = STATE_ACTIVE
         self._peer_notifies.clear()
+        # pool geometry goes hot NOW, not on the first client write:
+        # compile the encode executables and preallocate the device
+        # staging rings for this pool's (k, m, stripe) while the
+        # client is still discovering the map (background thread,
+        # idempotent per geometry)
+        warm = getattr(self.backend, "prewarm_geometry", None)
+        if warm is not None:
+            try:
+                warm()
+            except Exception:
+                pass
         self._requeue_waiting()
         self.service.pg_activated(self)
 
@@ -2367,10 +2378,16 @@ class PG:
         timeout = (op.offset or
                    self.conf["osd_default_notify_timeout"] * 1000) \
             / 1000.0
-        t = threading.Timer(timeout, self._notify_timeout, args=(nid,))
-        t.daemon = True
+        # hosted OSDs supply a wheel timer; stubs without one fall back
+        # to a plain thread timer
+        t = self.call_later(timeout,
+                            lambda: self._notify_timeout(nid))
+        if t is None:
+            t = threading.Timer(timeout, self._notify_timeout,
+                                args=(nid,))
+            t.daemon = True
+            t.start()
         state["timer"] = t
-        t.start()
 
     def _notify_acked(self, nid: int, client: str,
                       cookie: int) -> None:

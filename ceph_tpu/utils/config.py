@@ -315,6 +315,19 @@ def _opts() -> List[Option]:
                description="base backoff before retrying a transient "
                            "device dispatch failure (doubles per "
                            "attempt, capped; 2 retries max)"),
+        Option("ec_tpu_device_idle_reprobe_s", float, 2.0, min=0.0,
+               description="a device with zero traffic for this long "
+                           "gets the next small batch as an immediate "
+                           "probe (one per idle period) instead of "
+                           "waiting out the 1-in-N probe tick — a "
+                           "learned CPU bias must not outlive the "
+                           "condition that taught it (0 disables)"),
+        Option("ec_tpu_inflight_groups", int, 2, min=1,
+               description="encode groups in flight per batcher: the "
+                           "collector dispatches window N+1 while the "
+                           "completion worker joins window N, so h2d "
+                           "staging overlaps fanout (bounded FIFO; "
+                           "continuations stay in submission order)"),
         Option("osd_ec_subwrite_timeout_ms", float, 0.0, min=0.0,
                description="primary re-requests an EC sub-write from "
                            "a laggard shard after this deadline "

@@ -626,3 +626,141 @@ def test_cluster_workload_device_routes_and_window_adapts():
         for i in range(8):
             assert io.read(f"a{i}") == blob
             assert io.read(f"b{i}") == blob
+
+
+# -- PR 5: device-first routing regressions ---------------------------------
+
+
+def test_8mib_k8m4_group_routes_to_device():
+    """The BENCH_r05 misrouting regression: a healthy device with warm
+    geometry must route an 8 MiB k8m4 encode group to the DEVICE
+    (attribution: device calls > 0, batched-twin calls == 0) — with
+    the crossover pinned where the fixed bench calibration pins it
+    when the device wins pipelined (1 MiB)."""
+    k8m4 = ecreg.instance().factory(
+        "tpu", {"k": "8", "m": "4", "technique": "reed_sol_van"})
+    b = make_batcher(ec_tpu_queue_window_us=1000,
+                     ec_tpu_min_device_bytes=1 << 20)
+    try:
+        from ceph_tpu.osd.batcher import _geometry_key
+        sinfo = ecutil.StripeInfo(8, 8 * 16384)      # 128 KiB stripes
+        b.prewarm(k8m4, sinfo)
+        key = _geometry_key(k8m4, sinfo)
+        deadline = time.time() + 20
+        while key not in EncodeBatcher._cpu_bps \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert key in EncodeBatcher._cpu_bps       # geometry is warm
+        # force the staging pool to sample THIS put so the h2d EWMA
+        # provably updates from a real batch transfer
+        k8m4.core.backend.staging._puts = 0
+        data = os.urandom(8 << 20)                   # 64 stripes
+        out = {}
+        done = threading.Event()
+        b.submit(k8m4, sinfo, data,
+                 lambda c: (out.update(c), done.set()))
+        assert done.wait(60)
+        assert b.calls >= 1, \
+            "8 MiB group with a healthy warm device never reached it"
+        assert b.cpu_calls == 0 and b.cpu_reqs == 0, \
+            "8 MiB group misrouted to the batched CPU twin"
+        assert out == ecutil.encode(sinfo, k8m4, data)
+        assert EncodeBatcher._h2d_bps > 0, \
+            "warm h2d EWMA never updated from a real batch transfer"
+    finally:
+        b.stop()
+
+
+def test_idle_device_gets_reprobed_despite_cpu_bias(codec):
+    """A stale learned CPU bias with ZERO recent device traffic is the
+    misrouting failure mode: once the device has been idle past
+    ec_tpu_device_idle_reprobe_s, the next group must go to the
+    device as a probe instead of waiting out the 1-in-N tick."""
+    b = make_batcher(ec_tpu_queue_window_us=1000)
+    try:
+        # absurd learned bias (every batch "too small" for the device)
+        EncodeBatcher._min_device_bytes = 1 << 30
+        # ...but the device has been idle for a long time
+        past = time.monotonic() - 10 * b.idle_reprobe_s
+        EncodeBatcher._last_device_ts = past
+        EncodeBatcher._last_idle_probe_ts = past
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = os.urandom(2 * 8192)
+        done = threading.Event()
+        b.submit(codec, sinfo, data, lambda c: done.set())
+        assert done.wait(30)
+        assert b.calls == 1 and b.cpu_reqs == 0, \
+            "idle device never re-probed; CPU bias locked in"
+        # the probe is rate-limited: an immediate second small batch
+        # (device no longer idle) goes back to the learned route
+        done2 = threading.Event()
+        EncodeBatcher._min_device_bytes = 1 << 30
+        EncodeBatcher._probe_tick = 1   # keep the 1-in-N tick silent
+        b.submit(codec, sinfo, data, lambda c: done2.set())
+        assert done2.wait(30)
+        assert b.cpu_reqs == 1
+    finally:
+        b.stop()
+
+
+def test_breaker_close_resets_learned_crossover(codec):
+    """PR 5 satellite: while the breaker is open every group encodes
+    on the twin, so the learner can only accumulate CPU bias — on
+    close the crossover must snap back to the operator's pin and the
+    per-geometry device EWMAs must be dropped."""
+    b = make_batcher(ec_tpu_min_device_bytes=4096)
+    try:
+        assert EncodeBatcher._pinned_min_device_bytes == 4096
+        # bias accumulated while the device was sick
+        EncodeBatcher._min_device_bytes = 1 << 30
+        EncodeBatcher._dev_bps = {("stale",): 1.0}
+        for _ in range(b.device_error_threshold):
+            b._device_failure("dispatch")
+        assert EncodeBatcher._breaker_open
+        b._device_success()          # re-admission probe completed
+        assert not EncodeBatcher._breaker_open
+        assert EncodeBatcher._min_device_bytes == 4096, \
+            "breaker close must restore the operator's crossover pin"
+        assert EncodeBatcher._dev_bps == {}, \
+            "breaker close must drop stale device-rate EWMAs"
+    finally:
+        b.stop()
+
+
+def test_learn_crossover_uses_pipelined_model_and_rejects_outliers(codec):
+    """Unit-level checks on the rebuilt learner: (a) a serial fenced
+    time whose slowest LEG still beats the CPU must not raise the
+    threshold (pipelined overlap credited); (b) a call 5x slower than
+    the geometry's steady-state EWMA is a compile/outlier and teaches
+    nothing."""
+    from ceph_tpu.osd.batcher import _Req, _geometry_key
+    b = make_batcher()
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = b"\0" * (64 * 8192)               # 512 KiB group
+        req = _Req(codec, sinfo, data, lambda c: None)
+        key = _geometry_key(codec, sinfo)
+        total = float(len(data))
+        # measured machine profile: CPU 1 GB/s, link 2 GB/s — the
+        # transfer legs are a real fraction of the fenced window
+        EncodeBatcher._cpu_bps[key] = 1e9
+        EncodeBatcher._h2d_bps = 2e9
+        cpu_pred = total / 1e9
+        # (a) serial fence = 1.2x the CPU time, but split over
+        # h2d (total/2e9) + d2h + compute, every leg is well under
+        # cpu_pred: the pipelined router must NOT raise the threshold
+        # (the old serial-sum judge did, and misrouted everything)
+        b._learn_crossover([req], dev_time=1.2 * cpu_pred)
+        assert EncodeBatcher._min_device_bytes == 0, \
+            "serial-sum judging regressed: pipelined win raised the " \
+            "crossover"
+        steady = EncodeBatcher._dev_bps.get(key, 0.0)
+        assert steady > 0
+        # (b) a 100x-slower call (jit compile) must be rejected: no
+        # threshold move, EWMA not poisoned
+        b._learn_crossover([req], dev_time=100 * total / steady)
+        assert EncodeBatcher._min_device_bytes == 0
+        assert EncodeBatcher._dev_bps[key] == steady, \
+            "compile outlier absorbed into the steady-state EWMA"
+    finally:
+        b.stop()

@@ -1,0 +1,40 @@
+"""bench.py --assert-floor regression gate.
+
+The slow test runs the real cluster k8m4 bench and holds the write
+throughput at >= 1.0x the jerasure inline baseline — the PR 5
+acceptance floor (the misrouting regression bottomed out at 0.558x).
+The fast test only checks the CLI wiring so tier-1 notices a broken
+flag without paying for a cluster run."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+
+def test_assert_floor_flag_is_wired():
+    out = subprocess.run(
+        [sys.executable, BENCH, "--help"],
+        capture_output=True, text=True, timeout=120, cwd=REPO)
+    assert out.returncode == 0
+    assert "--assert-floor" in out.stdout
+
+
+@pytest.mark.slow
+def test_cluster_k8m4_write_meets_baseline_floor():
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, BENCH, "--only", "cluster_k8m4",
+         "--assert-floor", "1.0"],
+        capture_output=True, text=True, timeout=1800, cwd=REPO,
+        env=env)
+    sys.stdout.write(out.stdout[-4000:])
+    sys.stderr.write(out.stderr[-4000:])
+    assert out.returncode == 0, \
+        "cluster k8m4 write fell below 1.0x the jerasure baseline " \
+        "(or the config failed; see output above)"
+    assert "# --assert-floor ok" in out.stdout

@@ -1,0 +1,73 @@
+"""Hot-path instrumentation overhead guard (tier-1 microbench).
+
+PR 1 put perf counters and PR 4 put fault-injection sites on the EC
+write path; PR 5 makes that path device-hot, where per-op Python
+overhead is the new floor.  These microbenches pin the DISARMED cost
+of both: consulting a fault site with nothing armed and bumping a
+perf counter must stay cheap per call.  Bounds are deliberately
+generous (an order of magnitude over observed) so a loaded CI box
+does not flake — the guard is against accidental O(sites) scans or
+lock pile-ups on the disarmed path, not against microsecond drift."""
+import time
+
+from ceph_tpu.utils import faults
+from ceph_tpu.utils.perf import PerfCounters
+
+N = 20_000
+# generous per-op ceilings (seconds); observed costs are ~100x lower
+FAULT_HIT_CEILING = 20e-6
+PERF_INC_CEILING = 20e-6
+
+
+def _per_op(fn, n=N):
+    # one untimed pass to warm attribute caches / allocator
+    fn()
+    t0 = time.perf_counter()
+    for _ in range(n):
+        fn()
+    return (time.perf_counter() - t0) / n
+
+
+def test_disarmed_fault_site_is_cheap():
+    reg = faults.registry()
+    reg.reset()
+    try:
+        cost = _per_op(lambda: reg.hit("device.dispatch"))
+        assert cost < FAULT_HIT_CEILING, \
+            f"disarmed fault-site hit costs {cost * 1e6:.2f}us/op " \
+            f"(ceiling {FAULT_HIT_CEILING * 1e6:.0f}us)"
+    finally:
+        reg.reset()
+
+
+def test_disarmed_fault_site_stays_cheap_with_other_sites_armed():
+    """Arming an UNRELATED site must not tax every other site's
+    disarmed consult (no O(armed-sites) scan on the hot path)."""
+    reg = faults.registry()
+    reg.reset()
+    try:
+        reg.arm("msg.send", mode="error", one_in=1_000_000_000)
+        cost = _per_op(lambda: reg.hit("device.dispatch"))
+        assert cost < FAULT_HIT_CEILING, \
+            f"disarmed site costs {cost * 1e6:.2f}us/op with an " \
+            f"unrelated site armed"
+    finally:
+        reg.reset()
+
+
+def test_perf_counter_inc_is_cheap():
+    pc = PerfCounters("guard")
+    pc.add("ops")
+    cost = _per_op(lambda: pc.inc("ops"))
+    assert cost < PERF_INC_CEILING, \
+        f"perf inc costs {cost * 1e6:.2f}us/op " \
+        f"(ceiling {PERF_INC_CEILING * 1e6:.0f}us)"
+    assert pc.get("ops") >= N
+
+
+def test_perf_tinc_is_cheap():
+    pc = PerfCounters("guard2")
+    pc.add_time_avg("lat")
+    cost = _per_op(lambda: pc.tinc("lat", 1e-4))
+    assert cost < PERF_INC_CEILING, \
+        f"perf tinc costs {cost * 1e6:.2f}us/op"

@@ -1,0 +1,110 @@
+"""Hashed timer-wheel tests (ceph_tpu/utils/timer_wheel.py).
+
+The wheel replaces per-sub-write ``threading.Timer`` threads on the
+EC fanout deadline path: one daemon thread serves every armed
+deadline on the OSD, so a thousand in-flight sub-writes must not
+mean a thousand timer threads."""
+import threading
+import time
+
+from ceph_tpu.utils.timer_wheel import TimerWheel
+
+
+def test_fires_once_and_in_order_of_deadline():
+    w = TimerWheel(tick_s=0.002, slots=64)
+    try:
+        fired = []
+        w.call_later(0.05, lambda: fired.append("late"))
+        w.call_later(0.01, lambda: fired.append("early"))
+        deadline = time.monotonic() + 5
+        while len(fired) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert fired == ["early", "late"]
+        assert w.pending() == 0
+    finally:
+        w.stop()
+
+
+def test_cancel_prevents_fire():
+    w = TimerWheel(tick_s=0.002, slots=64)
+    try:
+        fired = []
+        h = w.call_later(0.03, lambda: fired.append(1))
+        assert not h.cancelled
+        h.cancel()
+        assert h.cancelled
+        time.sleep(0.1)
+        assert fired == []
+        # cancel is idempotent
+        h.cancel()
+    finally:
+        w.stop()
+
+
+def test_multi_revolution_delay():
+    """A delay longer than one full ring revolution rides the rounds
+    counter: it must fire neither early (first pass over its slot)
+    nor never."""
+    w = TimerWheel(tick_s=0.002, slots=8)   # ring spans 16 ms
+    try:
+        fired = threading.Event()
+        t0 = time.monotonic()
+        w.call_later(0.06, fired.set)       # ~4 revolutions
+        assert fired.wait(5)
+        assert time.monotonic() - t0 >= 0.05
+    finally:
+        w.stop()
+
+
+def test_thousand_timers_one_thread():
+    """Arm/cancel/fire under 1k concurrent deadlines: thread count
+    stays flat (the wheel is ONE thread), every un-cancelled timer
+    fires exactly once, every cancelled one never does."""
+    w = TimerWheel(tick_s=0.002, slots=64)
+    try:
+        # force the wheel thread into existence before baselining
+        warm = threading.Event()
+        w.call_later(0.004, warm.set)
+        assert warm.wait(5)
+        base = threading.active_count()
+
+        lock = threading.Lock()
+        fired = [0]
+
+        def bump():
+            with lock:
+                fired[0] += 1
+
+        # 500 short deadlines that fire, 500 long ones we cancel
+        # (long so cancellation cannot race the fire)
+        firing = [w.call_later(0.01 + (i % 17) * 0.003, bump)
+                  for i in range(500)]
+        doomed = [w.call_later(30.0, bump) for _ in range(500)]
+        # arming 1000 deadlines must not have spawned threads
+        assert threading.active_count() <= base
+        for h in doomed:
+            h.cancel()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with lock:
+                if fired[0] >= 500:
+                    break
+            time.sleep(0.01)
+        time.sleep(0.05)             # catch any late double-fire
+        with lock:
+            assert fired[0] == 500
+        assert threading.active_count() <= base
+        assert w.pending() == 0
+        assert all(h.cancelled for h in doomed)
+        assert firing
+    finally:
+        w.stop()
+
+
+def test_stop_joins_and_clears():
+    w = TimerWheel(tick_s=0.002, slots=16)
+    w.call_later(30.0, lambda: None)
+    w.stop()
+    assert w.pending() == 0
+    for t in threading.enumerate():
+        assert t.name != "timer-wheel" or not t.is_alive()

@@ -330,3 +330,50 @@ def test_jit_cache_reused_across_instances():
     c.encode_batch(np.zeros((2, 3, 256), dtype=np.uint8))
     key = (c.core.bitmatrix.shape, c.core.bitmatrix.tobytes())
     assert key in be._dev_matrices
+
+
+def test_staging_pool_reuses_host_arrays():
+    """PR 5 persistent staging: consecutive async encodes of the same
+    shape must serve their host staging from the preallocated ring
+    (hits, not fresh allocs) and release slots on completion."""
+    reg = ecreg.instance()
+    codec = reg.factory("tpu", {"k": "4", "m": "2"})
+    pool = codec.core.backend.staging
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (4, 4, 4096), dtype=np.uint8)
+    ref = codec.encode_batch(data)
+    a0, h0 = pool.allocs, pool.hits
+    outs = [codec.encode_batch_async(data.copy()).wait()
+            for _ in range(6)]
+    for out in outs:
+        assert np.array_equal(np.asarray(out), ref)
+    # at most ring-depth fresh arrays for this shape; the rest reuse
+    assert pool.allocs - a0 <= 2
+    assert pool.hits - h0 >= 4, \
+        "staging ring never reused a host array across encodes"
+    # every slot came back: the ring is fully idle after the waits
+    shape = next(s for s in pool._free if pool._free[s])
+    assert len(pool._free[shape]) == pool._made[shape]
+
+
+def test_prewarm_geometry_preallocates_and_compiles():
+    """prewarm_geometry() must leave the staging ring allocated for
+    the geometry's padded shape and the encode executable compiled,
+    so the first real write pays neither."""
+    reg = ecreg.instance()
+    codec = reg.factory("tpu", {"k": "2", "m": "1"})
+    pool = codec.core.backend.staging
+    a0 = pool.allocs
+    codec.prewarm_geometry(8192, batches=(4,))
+    assert pool.allocs > a0, \
+        "prewarm_geometry allocated no staging arrays"
+    a1 = pool.allocs
+    # a real write of the prewarmed shape allocates nothing new
+    data = np.zeros((4, 2, 8192), dtype=np.uint8)
+    out = codec.encode_batch_async(data).wait()
+    assert np.asarray(out).shape[1] == 1
+    assert pool.allocs == a1, \
+        "prewarmed shape still paid a fresh staging alloc"
+    # idempotent
+    codec.prewarm_geometry(8192, batches=(4,))
+    assert pool.allocs == a1

@@ -42,6 +42,10 @@ HOT_MODULES = [
     "ceph_tpu/osd/ecbackend.py",
     "ceph_tpu/osd/batcher.py",
     "ceph_tpu/crimson/net.py",
+    # the persistent-staging h2d path: every batched encode funnels
+    # its payload through here, so a stray bytes()/tobytes() would
+    # silently double the host-side cost of every device call
+    "ceph_tpu/ops/jax_engine.py",
 ]
 
 # constructs that materialise a full payload copy
