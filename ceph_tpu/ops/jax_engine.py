@@ -647,6 +647,8 @@ class StagingPool:
     """
 
     MAX_SHAPES = 16
+    STALL_S = 5.0               # acquire() stall cap before the pool
+                                # assumes a slot leaked and grows
 
     def __init__(self, depth: int = 2, sample_every: int = 16):
         self.depth = max(1, int(depth))
@@ -657,11 +659,13 @@ class StagingPool:
         self._puts = 0
         self.hits = 0            # stagings served from a reused array
         self.allocs = 0          # host staging arrays ever allocated
+        self.stall_allocs = 0    # ring grown after an acquire stall
         self.h2d_bps = 0.0       # warm-transfer EWMA (fenced samples)
         self.h2d_samples = 0
 
     # -- slot checkout -----------------------------------------------
     def acquire(self, shape: tuple) -> _StageSlot:
+        deadline = None
         with self._cv:
             while True:
                 free = self._free.get(shape)
@@ -679,7 +683,21 @@ class StagingPool:
                     self._evict_locked()
                     break
                 # both slots in flight: wait for a release (bounded
-                # wait so a lost notify can't wedge the encode path)
+                # wait so a lost notify can't wedge the encode path).
+                # Callers release on failure too, but a ring stalled
+                # past any plausible fence latency means a slot leaked
+                # anyway (e.g. a crashed dispatch path) — grow the
+                # ring by one rather than wedge the OSD write path.
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + self.STALL_S
+                elif now >= deadline:
+                    self._made[shape] = self._made.get(shape, 0) + 1
+                    slot = _StageSlot(np.zeros(shape, dtype=np.uint8))
+                    self.allocs += 1
+                    self.stall_allocs += 1
+                    self._evict_locked()
+                    break
                 self._cv.wait(timeout=0.5)
         fence = slot.fence
         if fence is not None:
@@ -803,27 +821,34 @@ class JaxBackend:
             return jax.device_put(data), batch, L, None, None
         shape = (_bucket_batch(batch), k, _round_up(L, quantum))
         slot = self.staging.acquire(shape)
-        host = slot.host
-        host[:batch, :, :L] = data  # copycheck: ok - staging fill into a REUSED persistent buffer (the one h2d copy)
-        if slot.max_l > L:
-            # stale columns from a longer previous batch: packet-layout
-            # kernels mix columns within a super-word window, so the
-            # pad region must stay zero (GF-linear => zeros are inert)
-            host[:, :, L:slot.max_l] = 0
-        slot.max_l = max(slot.max_l, L)
-        sample = None
-        if self.staging.should_sample():
-            t0 = time.monotonic()
-            dev = jax.device_put(host)
-            try:
-                dev.block_until_ready()
-                dt = time.monotonic() - t0
-                self.staging.note_h2d(host.nbytes, dt)
-                sample = (host.nbytes, dt)
-            except Exception:
-                pass
-        else:
-            dev = jax.device_put(host)
+        try:
+            host = slot.host
+            host[:batch, :, :L] = data  # copycheck: ok - staging fill into a REUSED persistent buffer (the one h2d copy)
+            if slot.max_l > L:
+                # stale columns from a longer previous batch: packet-layout
+                # kernels mix columns within a super-word window, so the
+                # pad region must stay zero (GF-linear => zeros are inert)
+                host[:, :, L:slot.max_l] = 0
+            slot.max_l = max(slot.max_l, L)
+            sample = None
+            if self.staging.should_sample():
+                t0 = time.monotonic()
+                dev = jax.device_put(host)
+                try:
+                    dev.block_until_ready()
+                    dt = time.monotonic() - t0
+                    self.staging.note_h2d(host.nbytes, dt)
+                    sample = (host.nbytes, dt)
+                except Exception:
+                    pass
+            else:
+                dev = jax.device_put(host)
+        except BaseException:
+            # staging/h2d failed before a fence existed: return the
+            # slot with no fence, or the ring leaks a slot per failure
+            # and two failures per shape wedge every later acquire()
+            self.staging.release(shape, slot, None)
+            raise
 
         def done(fence, _shape=shape, _slot=slot):
             self.staging.release(_shape, _slot, fence)
@@ -967,8 +992,15 @@ class JaxBackend:
         data = data.reshape((-1,) + data.shape[-2:])
         dev, batch, L, done, sample = self._staged_put(
             data, LENGTH_QUANTUM)
-        out = self.gf8_fn(M, donate=done is not None)(dev)
-        out.copy_to_host_async()
+        try:
+            out = self.gf8_fn(M, donate=done is not None)(dev)
+            out.copy_to_host_async()
+        except BaseException:
+            # kernel dispatch failed: no fence will ever retire, so
+            # hand the slot back unfenced instead of leaking it
+            if done is not None:
+                done(None)
+            raise
         if done is not None:
             done(out)
         ab = AsyncBatch(out, batch, L, lead)
@@ -1011,8 +1043,15 @@ class JaxBackend:
                 f"chunk length must be a multiple of {wbytes} for w={w}")
         dev, batch, L, done, sample = self._staged_put(
             data, LENGTH_QUANTUM * wbytes)
-        out = _apply_byte_domain(self._device_matrix(B), dev, w)
-        out.copy_to_host_async()
+        try:
+            out = _apply_byte_domain(self._device_matrix(B), dev, w)
+            out.copy_to_host_async()
+        except BaseException:
+            # kernel dispatch failed: no fence will ever retire, so
+            # hand the slot back unfenced instead of leaking it
+            if done is not None:
+                done(None)
+            raise
         if done is not None:
             done(out)
         ab = AsyncBatch(out, batch, L, lead)

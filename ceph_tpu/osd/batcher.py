@@ -159,8 +159,13 @@ class EncodeBatcher:
                                              # throughput EWMA per
                                              # geometry (compile/outlier
                                              # rejection in the learner)
-    _last_device_ts: float = time.monotonic()   # last device activity
-    _last_idle_probe_ts: float = time.monotonic()
+    # shared idle clocks, seeded by the FIRST batcher construction
+    # (None until then): seeding at import would treat process
+    # lifetime as device idleness, while re-seeding on every
+    # construction would reset the idle-reprobe clock for ALL
+    # batchers each time a multi-OSD cluster builds another OSD
+    _last_device_ts: Optional[float] = None     # last device activity
+    _last_idle_probe_ts: Optional[float] = None
     # device circuit breaker — class-level like the crossover it
     # guards: the device is a machine property, so one OSD's string
     # of dispatch failures should route EVERY in-process batcher's
@@ -213,6 +218,10 @@ class EncodeBatcher:
             # pin is remembered separately so a circuit-breaker close
             # restores the OPERATOR's crossover, not whatever CPU bias
             # the learner accumulated while the device was sick.
+            # Deliberately PROCESS-global even though the conf is per
+            # instance: the crossover models the machine's device+link,
+            # so in a multi-OSD process the last-constructed OSD's pin
+            # wins (mixed per-OSD pins in one process are unsupported).
             EncodeBatcher._min_device_bytes = float(pin)
             EncodeBatcher._pinned_min_device_bytes = float(pin)
         self.probe_interval = get("ec_tpu_crossover_probe_interval", 16)
@@ -226,11 +235,11 @@ class EncodeBatcher:
         # through a bounded FIFO (depth = groups genuinely in flight
         # on the device; the blocking put is the throttle)
         self.inflight_groups = max(1, get("ec_tpu_inflight_groups", 2))
-        # fresh timestamps: a just-built batcher must not treat
-        # process-lifetime idleness as device idleness (tests build
-        # batchers long after import)
-        EncodeBatcher._last_device_ts = time.monotonic()
-        EncodeBatcher._last_idle_probe_ts = time.monotonic()
+        # seed the shared idle clocks ONCE (first batcher built, not
+        # at import and not per construction — see the class attrs)
+        if EncodeBatcher._last_device_ts is None:
+            EncodeBatcher._last_device_ts = time.monotonic()
+            EncodeBatcher._last_idle_probe_ts = time.monotonic()
         self.crossover_min = get("ec_tpu_crossover_min_bytes", 64 << 10)
         self.device_error_threshold = get(
             "ec_tpu_device_error_threshold", 3)
@@ -571,8 +580,7 @@ class EncodeBatcher:
                 while not self._queues and not self._stop:
                     self._cond.wait()
                 if not self._queues and self._stop:
-                    self._completions.put(None)   # worker: drain + exit
-                    return
+                    break       # sentinel queued below, OUTSIDE _cond
                 # linger for the (admission-aware) window so concurrent
                 # ops can join, unless the stripe budget is already met
                 deadline = self._first_enqueue + self.dyn_window_s
@@ -635,6 +643,11 @@ class EncodeBatcher:
             for key, reqs, handle in groups:
                 self._completions.put((key, reqs, handle,
                                        len(groups)))
+        # shutdown: queue the completion-worker sentinel with _cond
+        # RELEASED — _completions is bounded, and a blocking put while
+        # holding the cond would deadlock against any continuation
+        # that re-enters submit()/flush() (which take _cond)
+        self._completions.put(None)   # worker: drain + exit
 
     def _completion_loop(self) -> None:
         """FIFO join of dispatched groups (continuations preserve

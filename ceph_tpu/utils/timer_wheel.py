@@ -77,7 +77,13 @@ class TimerWheel:
     # -- arming ------------------------------------------------------
     def call_later(self, delay: float, fn: Callable[[], None]) -> TimerHandle:
         ticks = max(1, int(float(delay) / self.tick_s + 0.999999))
-        rounds, offset = divmod(ticks, self.slots)
+        # offset 0 lands on the cursor's CURRENT slot, which the scan
+        # only revisits after a full revolution — so an exact-multiple
+        # delay (ticks == N*slots) must carry N-1 rounds, not N, or it
+        # fires a whole revolution late.  (ticks - 1) // slots gives
+        # exactly that; non-multiples are unchanged.
+        offset = ticks % self.slots
+        rounds = (ticks - 1) // self.slots
         with self._lock:
             slot = (self._cursor + offset) % self.slots
             h = TimerHandle(fn, rounds)

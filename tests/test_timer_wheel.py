@@ -56,6 +56,25 @@ def test_multi_revolution_delay():
         w.stop()
 
 
+def test_exact_revolution_delay_not_one_revolution_late():
+    """A delay that is an exact multiple of one wheel revolution
+    lands on the cursor's current slot (offset 0); it must fire on
+    the FIRST full pass, not carry a surplus round and fire a whole
+    revolution late (regression: 20 ms on a 20 ms-revolution wheel
+    fired at 40 ms)."""
+    w = TimerWheel(tick_s=0.02, slots=5)    # revolution = 100 ms
+    try:
+        fired = threading.Event()
+        t0 = time.monotonic()
+        w.call_later(0.1, fired.set)        # exactly one revolution
+        assert fired.wait(5)
+        dt = time.monotonic() - t0
+        assert dt >= 0.08                   # not early
+        assert dt < 0.16, f"fired a revolution late ({dt*1e3:.0f} ms)"
+    finally:
+        w.stop()
+
+
 def test_thousand_timers_one_thread():
     """Arm/cancel/fire under 1k concurrent deadlines: thread count
     stays flat (the wheel is ONE thread), every un-cancelled timer

@@ -356,6 +356,65 @@ def test_staging_pool_reuses_host_arrays():
     assert len(pool._free[shape]) == pool._made[shape]
 
 
+def test_staging_slot_released_on_failed_dispatch():
+    """A raise between slot acquire and fence registration (the
+    kernel call in apply_bitmatrix_bytes_async) must hand the slot
+    back to the ring: with depth=2, two leaked slots would wedge
+    every later acquire() for that shape on the batcher collector
+    thread (regression: StagingPool slot leak on exception)."""
+    from ceph_tpu.ops import jax_engine
+    from ceph_tpu.ops.matrix import (
+        reed_sol_vandermonde_coding_matrix, matrix_to_bitmatrix)
+    reg = ecreg.instance()
+    codec = reg.factory("tpu", {"k": "3", "m": "2"})
+    be = codec.core.backend
+    pool = be.staging
+    B = matrix_to_bitmatrix(
+        reed_sol_vandermonde_coding_matrix(3, 2, 8), 8)
+    data = np.zeros((2, 3, 1024), dtype=np.uint8)
+    ref = np.asarray(be.apply_bitmatrix_bytes_async(B, data, 8).wait())
+    shape = (jax_engine._bucket_batch(2), 3,
+             jax_engine._round_up(1024, jax_engine.LENGTH_QUANTUM))
+
+    def boom(*a, **k):
+        raise RuntimeError("injected kernel fault")
+
+    real = jax_engine._apply_byte_domain
+    jax_engine._apply_byte_domain = boom
+    try:
+        for _ in range(2 * pool.depth):   # more failures than slots
+            with pytest.raises(RuntimeError):
+                be.apply_bitmatrix_bytes_async(B, data.copy(), 8)
+    finally:
+        jax_engine._apply_byte_domain = real
+    # every slot came back unfenced: the ring is fully free and no
+    # stall-recovery alloc was needed
+    assert len(pool._free[shape]) == pool._made[shape]
+    assert pool.stall_allocs == 0
+    # and the path still serves, bit-exact
+    out = np.asarray(be.apply_bitmatrix_bytes_async(B, data, 8).wait())
+    assert np.array_equal(out, ref)
+
+
+def test_staging_pool_acquire_stall_grows_ring():
+    """Defense in depth: if a slot DOES leak (a crash path nobody
+    releases), acquire() must not block forever on the batcher
+    collector thread — past STALL_S it grows the ring by one and
+    the write path keeps flowing."""
+    from ceph_tpu.ops.jax_engine import StagingPool
+    pool = StagingPool(depth=1)
+    pool.STALL_S = 0.1                    # instance override: fast test
+    shape = (1, 2, 64)
+    held = pool.acquire(shape)            # the only slot, never released
+    grown = pool.acquire(shape)           # must not wedge
+    assert grown is not held
+    assert pool.stall_allocs == 1
+    assert pool._made[shape] == 2
+    pool.release(shape, grown, None)
+    pool.release(shape, held, None)
+    assert len(pool._free[shape]) == 2
+
+
 def test_prewarm_geometry_preallocates_and_compiles():
     """prewarm_geometry() must leave the staging ring allocated for
     the geometry's padded shape and the encode executable compiled,
