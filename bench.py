@@ -835,6 +835,7 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         # op_commit).  Op-seconds, not wall — concurrent ops overlap
         stages = {"queue_wait": 0.0, "batch_form": 0.0, "h2d": 0.0,
                   "device": 0.0, "d2h": 0.0, "commit": 0.0}
+        critpath_dumps = []
         for osd in c.osds.values():
             b = getattr(osd, "encode_batcher", None)
             if b is not None:
@@ -861,7 +862,23 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                     t_com = ev.get("op_commit", ev.get("done"))
                     if t_enc is not None and t_com is not None:
                         stages["commit"] += max(0.0, t_com - t_enc)
+            cp = getattr(osd, "critpath", None)
+            if cp is not None:
+                critpath_dumps.append(cp.dump())
         stats["stages"] = stages
+        # per-op critical-path budget merged across every primary's
+        # accumulator (utils/critpath.py): which stage bounded the
+        # write stream, cluster-wide
+        from ceph_tpu.utils.critpath import merge_dumps as _cp_merge
+        stats["critical_path"] = _cp_merge(critpath_dumps)
+        # routing expectation from the calibration pin: the trend gate
+        # only treats a collapsed device fraction as a regression when
+        # THIS run's probe said the device should win (None = no pin
+        # was taken, e.g. cpu plugin or calibration failed)
+        pinned = overrides.get("ec_tpu_min_device_bytes")
+        stats["expect_device"] = (None if plugin != "tpu"
+                                  or pinned is None
+                                  else bool(pinned <= (8 << 20)))
         # degraded-mode evidence: fault-site trip counters, the shared
         # device circuit breaker, and the sub-write deadline counters
         # summed over the OSD perf dumps — the chaos soak asserts its
@@ -915,7 +932,8 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
 
 # written by bench_cluster_k8m4; consumed by main()'s --assert-floor
 # regression gate (and importable by the slow test)
-_FLOOR_STATS = {"cluster_k8m4_vs_baseline": None}
+_FLOOR_STATS = {"cluster_k8m4_vs_baseline": None,
+                "cluster_k8m4_attribution": None}
 
 
 def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
@@ -940,13 +958,14 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
     att = st.get("stages") or {}
     opsec = sum(att.values())
     wall = st.get("write_wall_s", 0.0)
+    dev_frac = round((st["reqs"] - st["cpu"]) / max(1, st["reqs"]), 4)
     if opsec > 0 and wall > 0:
         # wall seconds split proportionally to measured op-seconds
         # (ops overlap, so raw op-seconds exceed wall; the split
         # keeps each stage's relative weight and sums to wall)
         scaled = {s: round(wall * v / opsec, 4)
                   for s, v in att.items()}
-        print(json.dumps({
+        att_obj = {
             "metric": "cluster k8m4 write per-stage time attribution"
                       " (wall split over queue_wait/batch_form/h2d/"
                       "device/d2h/commit by tracker+batcher "
@@ -955,19 +974,25 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
             "vs_baseline": round(sum(scaled.values()) / wall, 3),
             "stages": scaled,
             "op_seconds": {s: round(v, 4) for s, v in att.items()},
+            "critical_path": st.get("critical_path"),
             "bytes_copied": st.get("bytes_copied", 0),
             "copied_per_payload": round(
                 st.get("bytes_copied", 0) / (n_objs * obj_bytes), 3),
             "copy_sites": st.get("copy_sites", {}),
             "routing": {"device_reqs": st["reqs"] - st["cpu"],
                         "cpu_twin_reqs": st["cpu"]},
+            "device_encode_fraction": dev_frac,
+            "expect_device": st.get("expect_device"),
             "queue_depth_hwm": st.get("queue_depth_hwm", 0),
             "window_grows": st.get("window_grows", 0),
             "window_cuts": st.get("window_cuts", 0),
             "faults": st.get("faults", {}),
             "breaker": st.get("breaker", {}),
             "subwrite_deadlines": st.get("subwrite", {}),
-        }), flush=True)
+        }
+        print(json.dumps(att_obj), flush=True)
+        # --assert-floor hands this to the tools/perf_trend.py gate
+        _FLOOR_STATS["cluster_k8m4_attribution"] = att_obj
     emit(f"OSD rebuild MB/s (k=8 m=4 pool, kill osd with data loss; "
          f"recovery decodes batched through the OSD coalescer: "
          f"{st['dec_reqs']} decode reqs -> {st['dec_calls']} batched "
@@ -1212,6 +1237,32 @@ def main():
         print(f"# --assert-floor ok: cluster k8m4 write at "
               f"{ratio:.3f}x baseline >= {args.assert_floor:.3f}x",
               flush=True)
+        # perf-trend gate: diff this run's attribution (per-stage
+        # shares, device routing fraction) against the committed
+        # BENCH_r0*.json history — the floor alone missed r05's
+        # routing collapse because throughput "passed" while every
+        # encode rode the CPU twin
+        try:
+            from tools import perf_trend
+        except ImportError:
+            sys.path.insert(0, os.path.dirname(
+                os.path.abspath(__file__)))
+            from tools import perf_trend
+        hist_paths = perf_trend.default_history_paths()
+        if hist_paths:
+            findings = perf_trend.check(
+                _FLOOR_STATS.get("cluster_k8m4_attribution"),
+                perf_trend.load_history(hist_paths),
+                fresh_ratio=ratio)
+            for fnd in findings:
+                print(f"# --assert-floor perf-trend "
+                      f"{fnd['severity'].upper()} [{fnd['check']}]: "
+                      f"{fnd['message']}", file=sys.stderr,
+                      flush=True)
+            if findings:
+                sys.exit(1)
+            print(f"# --assert-floor perf-trend ok vs "
+                  f"{len(hist_paths)} history round(s)", flush=True)
 
 
 if __name__ == "__main__":

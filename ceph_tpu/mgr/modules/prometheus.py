@@ -20,6 +20,17 @@ from . import MgrModule
 
 _QUANTILES = ((0.50, "p50"), (0.95, "p95"), (0.99, "p99"))
 
+# Perf dumps carry values, not counter kinds, so level-style metrics
+# (TYPE_U64 set_ gauges) are recognised by naming convention — the
+# ec_device subsystem's *_now / *_bps / *_hwm occupancy gauges and
+# the staging-pool level samples must not be typed "counter" or
+# rate() over them is nonsense.
+_GAUGE_SUFFIXES = ("_now", "_bps", "_hwm", "_in_flight", "_slots")
+
+
+def _scalar_type(metric: str) -> str:
+    return "gauge" if metric.endswith(_GAUGE_SUFFIXES) else "counter"
+
 
 def _histogram_percentile(bounds: List[float], buckets: List[int],
                           q: float) -> float:
@@ -97,7 +108,8 @@ def render(osdmap, perf: Dict[str, dict]) -> str:
                     families.setdefault(metric, []).append(
                         (daemon, val))
     for metric in sorted(families):
-        lines.append(f"# TYPE {metric} {ftypes.get(metric, 'counter')}")
+        lines.append(
+            f"# TYPE {metric} {ftypes.get(metric) or _scalar_type(metric)}")
         for daemon, val in families[metric]:
             lines.append(f'{metric}{{daemon="{daemon}"}} {val}')
     for metric in sorted(hists):

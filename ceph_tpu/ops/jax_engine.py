@@ -739,6 +739,22 @@ class StagingPool:
             0.7 * self.h2d_bps + 0.3 * bps)
         self.h2d_samples += 1
 
+    def stats(self) -> dict:
+        """Telemetry snapshot for the ``ec_device`` perf subsystem
+        (ring occupancy, stall grows, link EWMA).  ``in_flight`` is
+        the number of checked-out slots across every shape ring —
+        the live h2d/compute occupancy of the staging pool."""
+        with self._cv:
+            made = sum(self._made.values())
+            free = sum(len(v) for v in self._free.values())
+            return {"hits": self.hits, "allocs": self.allocs,
+                    "stall_allocs": self.stall_allocs,
+                    "h2d_bps": self.h2d_bps,
+                    "h2d_samples": self.h2d_samples,
+                    "shapes": len(self._made),
+                    "slots": made,
+                    "in_flight": max(0, made - free)}
+
     def ensure(self, shape: tuple) -> None:
         """Preallocate a full ring for ``shape`` (prewarm path)."""
         with self._cv:

@@ -176,7 +176,8 @@ class EncodeBatcher:
     _breaker_opens: int = 0                  # cumulative open transitions
     _breaker_closes: int = 0                 # cumulative re-admissions
 
-    def __init__(self, conf=None, perf=None, perf_coll=None):
+    def __init__(self, conf=None, perf=None, perf_coll=None,
+                 recorder=None):
         def get(k, d):
             if conf is None:
                 return d
@@ -298,6 +299,77 @@ class EncodeBatcher:
                        description="circuit-breaker re-admissions "
                                    "(successful probe closed it)")
             self.bperf = bp
+        # flight recorder (utils/flight_recorder.py): every routing
+        # verdict / breaker transition / staging stall / encode error
+        # appends one ring event; None under unit-test stubs
+        self.recorder = recorder
+        # "ec_device" perf subsystem — the device-side telemetry PR 5
+        # shipped without: crossover routing verdicts BY REASON,
+        # StagingPool ring occupancy/stall-grows, h2d link EWMA,
+        # inflight-group depth, breaker state.  The timer-wheel
+        # fire-lag histogram lives here too (filled by the OSD's
+        # wheel callback) so one subsystem answers "what did the
+        # device machinery do" in perf dump / prometheus.
+        self.dperf = None
+        if perf_coll is not None:
+            dp = perf_coll.create("ec_device")
+            if "route_device" not in dp._types:
+                for reason, desc in (
+                        ("device", "batches over the crossover -> "
+                                   "device"),
+                        ("pin", "batches under the operator/"
+                                "calibration pin -> twin "
+                                "(deterministic)"),
+                        ("learned", "batches under the LEARNED "
+                                    "crossover -> twin"),
+                        ("idle_probe", "idle-device re-probes forced "
+                                       "to the device"),
+                        ("tick_probe", "1-in-N periodic probes "
+                                       "forced to the device"),
+                        ("breaker_open", "batches the open breaker "
+                                         "routed to the twin"),
+                        ("breaker_probe", "re-admission probes "
+                                          "through the open "
+                                          "breaker")):
+                    dp.add(f"route_{reason}",
+                           description="routing verdicts: " + desc)
+                from ..utils.perf import TYPE_U64
+                for g, desc in (
+                        ("staging_hits", "stagings served from a "
+                                         "reused ring slot"),
+                        ("staging_allocs", "staging arrays ever "
+                                           "allocated"),
+                        ("staging_stall_allocs", "ring grows after "
+                                                 "an acquire stall"),
+                        ("staging_slots", "staging slots live across "
+                                          "all shape rings"),
+                        ("staging_in_flight", "staging slots checked "
+                                              "out right now"),
+                        ("h2d_bps", "h2d link bandwidth EWMA "
+                                    "(bytes/s, fenced samples)"),
+                        ("inflight_groups_now", "encode groups in "
+                                                "flight on the "
+                                                "device"),
+                        ("inflight_groups_hwm", "high-water mark of "
+                                                "in-flight encode "
+                                                "groups"),
+                        ("breaker_open_now", "device circuit breaker "
+                                             "state (1=open)")):
+                    dp.add(g, TYPE_U64, desc)
+                dp.add("breaker_opened",
+                       description="breaker open transitions")
+                dp.add("breaker_closed",
+                       description="breaker close (re-admission) "
+                                   "transitions")
+                dp.add_histogram(
+                    "timer_fire_lag_us",
+                    [100, 500, 1000, 5000, 10000, 25000, 50000,
+                     100000, 500000],
+                    "timer-wheel fire lag vs requested deadline (us)")
+            self.dperf = dp
+        self._route_reason = None    # last verdict's reason code
+        self._staging_stalls_seen = 0
+        self._inflight_hwm = 0
         # cumulative per-stage attribution (seconds of request time
         # spent in each pipeline stage; consumed by bench.py's
         # time-attribution line).  Collector-thread writes only.
@@ -634,15 +706,22 @@ class EncodeBatcher:
             for key, reqs in queues.items():
                 if key[0] == "dec":
                     groups.append((key, reqs, "dec"))
-                elif self._route_to_cpu(key, reqs) \
-                        or self._breaker_blocks():
-                    groups.append((key, reqs, "cpu"))
-                else:
-                    groups.append((key, reqs,
-                                   self._dispatch_group(reqs)))
+                    continue
+                to_cpu = self._route_to_cpu(key, reqs)
+                if not to_cpu and self._breaker_blocks():
+                    to_cpu = True
+                self._note_route(key, reqs, to_cpu)
+                groups.append((key, reqs, "cpu" if to_cpu
+                               else self._dispatch_group(reqs)))
             for key, reqs, handle in groups:
                 self._completions.put((key, reqs, handle,
                                        len(groups)))
+                if self.dperf is not None:
+                    depth = self._completions.qsize()
+                    self.dperf.set("inflight_groups_now", depth)
+                    if depth > self._inflight_hwm:
+                        self._inflight_hwm = depth
+                        self.dperf.set("inflight_groups_hwm", depth)
         # shutdown: queue the completion-worker sentinel with _cond
         # RELEASED — _completions is bounded, and a blocking put while
         # holding the cond would deadlock against any continuation
@@ -684,9 +763,11 @@ class EncodeBatcher:
         """True when the learned crossover says this batch is too
         small to pay the device round trip."""
         if not self.adaptive_cpu or self._min_device_bytes <= 0:
+            self._route_reason = "device"
             return False
         total = sum(r.nbytes for r in reqs)
         if total >= self._min_device_bytes:
+            self._route_reason = "device"
             return False
         # idle re-probe: a device that served ZERO traffic for a
         # whole idle period gets one group as a probe IMMEDIATELY —
@@ -707,12 +788,14 @@ class EncodeBatcher:
         cls = EncodeBatcher
         if 0 < cls._pinned_min_device_bytes and \
                 cls._min_device_bytes <= cls._pinned_min_device_bytes:
+            self._route_reason = "pin"
             return True
         now = time.monotonic()
         if self.idle_reprobe_s > 0 and \
                 now - cls._last_device_ts > self.idle_reprobe_s and \
                 now - cls._last_idle_probe_ts > self.idle_reprobe_s:
             cls._last_idle_probe_ts = now
+            self._route_reason = "idle_probe"
             return False
         # periodic probe: route an occasional small batch to the
         # device anyway so the threshold can come back down when the
@@ -723,7 +806,9 @@ class EncodeBatcher:
         # (per-instance ticks also mean a primary seeing few ops
         # never probes at all)
         EncodeBatcher._probe_tick += 1
-        return EncodeBatcher._probe_tick % self.probe_interval != 0
+        blocked = EncodeBatcher._probe_tick % self.probe_interval != 0
+        self._route_reason = "learned" if blocked else "tick_probe"
+        return blocked
 
     def _breaker_blocks(self) -> bool:
         """True when the open circuit breaker routes this encode
@@ -734,7 +819,29 @@ class EncodeBatcher:
         if not EncodeBatcher._breaker_open:
             return False
         EncodeBatcher._probe_tick += 1
-        return EncodeBatcher._probe_tick % self.probe_interval != 0
+        blocked = EncodeBatcher._probe_tick % self.probe_interval != 0
+        self._route_reason = "breaker_open" if blocked \
+            else "breaker_probe"
+        return blocked
+
+    def _note_route(self, key: Tuple, reqs: List[_Req],
+                    to_cpu: bool) -> None:
+        """Publish one routing verdict: reason-coded counter in the
+        ec_device subsystem + one flight-recorder event.  Collector
+        thread only — no locking beyond the perf counters' own."""
+        reason = self._route_reason or \
+            ("learned" if to_cpu else "device")
+        self._route_reason = None
+        if self.dperf is not None and \
+                f"route_{reason}" in self.dperf._types:
+            self.dperf.inc(f"route_{reason}")
+        rec = self.recorder
+        if rec is not None:
+            rec.note("route", reason=reason,
+                     to="cpu" if to_cpu else "device",
+                     bytes=sum(r.nbytes for r in reqs),
+                     reqs=len(reqs),
+                     crossover=int(EncodeBatcher._min_device_bytes))
 
     def _device_failure(self, kind: str) -> None:
         """Record one classified device failure (post-retry); opens
@@ -752,8 +859,22 @@ class EncodeBatcher:
                 cls._breaker_open = True
                 cls._breaker_opens += 1
                 opened = True
-        if opened and self.bperf is not None:
-            self.bperf.inc("breaker_open")
+        rec = self.recorder
+        if rec is not None:
+            rec.note("device_error", error=kind,
+                     failures=cls._breaker_failures,
+                     breaker_opened=opened)
+        if opened:
+            if self.bperf is not None:
+                self.bperf.inc("breaker_open")
+            if self.dperf is not None:
+                self.dperf.inc("breaker_opened")
+                self.dperf.set("breaker_open_now", 1)
+            # breaker-open is an incident: dump the recent routing/
+            # error evidence while it is still in the ring
+            if rec is not None:
+                rec.note("breaker", state="open", cause=kind)
+                rec.auto_dump("breaker-open")
 
     def _device_success(self) -> None:
         """A device call completed: clear the consecutive-failure
@@ -780,6 +901,13 @@ class EncodeBatcher:
             cls._dev_bps = {}
             if self.bperf is not None:
                 self.bperf.inc("breaker_close")
+            if self.dperf is not None:
+                self.dperf.inc("breaker_closed")
+                self.dperf.set("breaker_open_now", 0)
+            if self.recorder is not None:
+                self.recorder.note("breaker", state="closed",
+                                   crossover=int(
+                                       cls._min_device_bytes))
 
     def _cb_error(self, reqs=None) -> None:
         """Report a continuation/encode failure.  During shutdown the
@@ -797,6 +925,13 @@ class EncodeBatcher:
             self.encode_errors += 1
             if self.bperf is not None:
                 self.bperf.inc("ec_encode_errors")
+            # a client op is about to die with EIO — flight-record
+            # the failure and dump the evidence around it (the chaos
+            # soak's "client error" incident trigger)
+            if self.recorder is not None:
+                self.recorder.note("encode_error",
+                                   reqs=len(reqs or ()))
+                self.recorder.auto_dump("client-encode-error")
         for r in (reqs or ()):
             if r.done:
                 continue
@@ -1199,6 +1334,40 @@ class EncodeBatcher:
                 r.tracked.mark_event("ec:batch_dispatched")
         return (arrs, handles, t_disp)
 
+    def _publish_device_telemetry(self, ec_impl) -> None:
+        """Refresh the ec_device staging/link gauges from the codec's
+        StagingPool after a device completion (completion worker
+        only).  A stall-grow since the last look is an incident-grade
+        event: it means the ring wedged past STALL_S and the pool
+        grew to protect the write path — flight-record it."""
+        dp = self.dperf
+        rec = self.recorder
+        if dp is None and rec is None:
+            return
+        pool = getattr(getattr(getattr(ec_impl, "core", None),
+                               "backend", None), "staging", None)
+        if pool is not None:
+            try:
+                st = pool.stats()
+            except Exception:
+                st = None
+            if st:
+                if dp is not None:
+                    dp.set("staging_hits", st["hits"])
+                    dp.set("staging_allocs", st["allocs"])
+                    dp.set("staging_stall_allocs",
+                           st["stall_allocs"])
+                    dp.set("staging_slots", st["slots"])
+                    dp.set("staging_in_flight", st["in_flight"])
+                if st["stall_allocs"] > self._staging_stalls_seen:
+                    self._staging_stalls_seen = st["stall_allocs"]
+                    if rec is not None:
+                        rec.note("staging", event="stall_grow",
+                                 stall_allocs=st["stall_allocs"],
+                                 slots=st["slots"])
+        if dp is not None:
+            dp.set("h2d_bps", int(EncodeBatcher._h2d_bps))
+
     def _account_queue_wait(self, reqs: List[_Req],
                             now: float) -> None:
         for r in reqs:
@@ -1294,6 +1463,7 @@ class EncodeBatcher:
                 self.bperf.inc("device_reqs", len(reqs))
                 if len(reqs) > 1:
                     self.bperf.inc("coalesced_reqs", len(reqs))
+            self._publish_device_telemetry(reqs[0].ec_impl)
         off = 0
         for r, arr in zip(reqs, arrs):
             p = parity[off:off + r.nstripes]

@@ -1045,6 +1045,13 @@ class ECBackend(PGBackend):
                 perf.inc("ec_subwrite_timeouts")
             acting = {s: o for s, o in self.host.acting_shards()}
             laggards = set(op.pending_commits)
+            recorder = getattr(self.host, "flight_recorder", None)
+            if recorder is not None:
+                recorder.note("subwrite_timeout", tid=tid,
+                              attempt=attempt,
+                              pg=getattr(self.host, "pgid_str", "?"),
+                              laggards=sorted(laggards))
+                recorder.auto_dump("subwrite-timeout")
             if attempt == 1:
                 resent = 0
                 for (shard, seg), (parts, entries) in sorted(

@@ -96,6 +96,10 @@ class OSDService:
     def perf(self):
         return self._osd.perf
 
+    @property
+    def flight_recorder(self):
+        return self._osd.flight_recorder
+
     def call_later(self, delay: float, fn):
         """Cancellable one-shot timer (EC sub-write deadlines); the
         crimson OSD substitutes a reactor timer."""
@@ -221,14 +225,45 @@ class OSD(Dispatcher):
         # pacing (one thread total; see utils/timer_wheel.py)
         from ..utils.timer_wheel import TimerWheel
         self.timer_wheel = TimerWheel()
+        # per-OSD flight recorder: bounded ring of recent routing/
+        # batcher/fault events, dumped via dump_flight_recorder and
+        # auto-dumped on op timeout / breaker-open / client encode
+        # error (utils/flight_recorder.py)
+        from ..utils.flight_recorder import FlightRecorder
+        self.flight_recorder = FlightRecorder(
+            capacity=self.conf["flight_recorder_events"],
+            name=f"osd.{whoami}")
         # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
         from .batcher import EncodeBatcher
-        self.encode_batcher = EncodeBatcher(self.conf, perf=self.perf,
-                                            perf_coll=self.perf_coll)
+        self.encode_batcher = EncodeBatcher(
+            self.conf, perf=self.perf, perf_coll=self.perf_coll,
+            recorder=self.flight_recorder)
+        # timer-wheel fire lag rides the batcher's ec_device
+        # subsystem (one device-machinery surface); tick-scale lag is
+        # normal, so only fires a full revolution late (a wedged
+        # wheel thread) are flight-recorded
+        _dperf = self.encode_batcher.dperf
+        _wheel = self.timer_wheel
+        _late_s = _wheel.tick_s * _wheel.slots
+
+        def _note_fire_lag(lag, _dp=_dperf, _rec=self.flight_recorder,
+                           _late=_late_s):
+            if _dp is not None:
+                _dp.hinc("timer_fire_lag_us", lag * 1e6)
+            if lag > _late:
+                _rec.note("timer", event="late_fire",
+                          lag_ms=round(lag * 1e3, 3))
+        self.timer_wheel.on_fire_lag = _note_fire_lag
         self.op_tracker = OpTracker(
             history_size=self.conf["osd_op_history_size"],
             history_duration=self.conf["osd_op_history_duration"],
             slow_op_warn_threshold=self.conf["osd_op_complaint_time"])
+        # per-op critical-path analysis on every retired op: stage
+        # budget + bounding-stage census, exported as the "critpath"
+        # perf subsystem and the dump_critical_path command
+        from ..utils.critpath import CriticalPathAccum
+        self.critpath = CriticalPathAccum(perf_coll=self.perf_coll)
+        self.op_tracker.on_retire = self.critpath.observe
         from ..utils.tracer import Tracer
         self.tracer = Tracer(f"osd.{whoami}",
                              enabled=self.conf["osd_tracing"],
@@ -247,8 +282,9 @@ class OSD(Dispatcher):
                            "dump_historic_ops",
                            "dump_historic_slow_ops",
                            "dump_blocked_ops", "dump_ops_in_flight",
-                           "dump_slow_ops", "status", "config get",
-                           "config set"):
+                           "dump_slow_ops", "dump_flight_recorder",
+                           "dump_critical_path", "status",
+                           "config get", "config set"):
                 self.admin_socket.register(
                     prefix, self._admin_socket_hook)
 
@@ -779,6 +815,10 @@ class OSD(Dispatcher):
                 out = {"ops": self.op_tracker.dump_ops_in_flight()}
             elif prefix == "dump_slow_ops":
                 out = {"ops": self.op_tracker.slow_ops()}
+            elif prefix == "dump_flight_recorder":
+                out = self.flight_recorder.dump_state()
+            elif prefix == "dump_critical_path":
+                out = self.critpath.dump()
             elif prefix == "status":
                 with self.pg_lock:
                     n_pgs = len(self.pgs)

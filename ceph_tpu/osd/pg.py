@@ -280,6 +280,12 @@ class PG:
         """The hosting OSD's perf counters (None under test stubs)."""
         return getattr(self.service, "perf", None)
 
+    @property
+    def flight_recorder(self):
+        """The hosting OSD's flight recorder (None under test
+        stubs) — backends note routing/fault events into it."""
+        return getattr(self.service, "flight_recorder", None)
+
     def call_later(self, delay: float, fn):
         """One-shot cancellable timer via the hosting OSD (EC
         sub-write deadlines); None under hosts without timers."""

@@ -478,6 +478,10 @@ def _opts() -> List[Option]:
                            "osd_op_history_duration)"),
         Option("trace_keep_spans", int, 512, min=1,
                description="finished spans retained per tracer"),
+        Option("flight_recorder_events", int, 256, min=16,
+               description="bounded ring of recent routing/batcher/"
+                           "fault events kept per OSD for "
+                           "dump_flight_recorder and auto-dumps"),
         Option("admin_socket", str, "",
                description="unix-socket path template for daemon admin "
                            "commands; $name expands to the daemon name "

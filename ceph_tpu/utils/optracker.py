@@ -53,6 +53,11 @@ class OpTracker:
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
         self.history_duration = history_duration
         self.slow_op_warn_threshold = slow_op_warn_threshold
+        # called with each retired op AFTER it moves to history (the
+        # OSD hangs its critical-path accumulator here — analysis
+        # runs post-reply, off the client latency path).  Must not
+        # raise; a broken observer must not break op retirement.
+        self.on_retire = None
 
     def create(self, description: str) -> TrackedOp:
         op = TrackedOp(self, description)
@@ -64,6 +69,12 @@ class OpTracker:
         with self._lock:
             self._in_flight.pop(id(op), None)
             self._history.append(op)
+        cb = self.on_retire
+        if cb is not None:
+            try:
+                cb(op)
+            except Exception:
+                pass
 
     # -- admin socket hooks (reference dump_ops_in_flight etc.) ----------
     def dump_ops_in_flight(self) -> List[Dict]:

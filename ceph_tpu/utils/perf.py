@@ -81,6 +81,18 @@ class PerfCounters:
             self._values[name] += seconds
             self._counts[name] += 1
 
+    def inc_many(self, samples) -> None:
+        """Batch update under ONE lock acquisition: ``samples`` is an
+        iterable of ``(name, by)`` pairs, each applied with inc/tinc
+        semantics (value += by, count += 1).  For hot paths that
+        charge several counters per op (critpath.observe charges one
+        per stage) the per-call lock round-trips dominate."""
+        with self._lock:
+            values, counts = self._values, self._counts
+            for name, by in samples:
+                values[name] += by
+                counts[name] += 1
+
     def hinc(self, name: str, value: float) -> None:
         with self._lock:
             bounds = self._hist_bounds[name]

@@ -34,12 +34,14 @@ class TimerHandle:
     uses (``cancel()``), so call sites need no type switch.
     """
 
-    __slots__ = ("fn", "rounds", "_dead")
+    __slots__ = ("fn", "rounds", "_dead", "deadline")
 
-    def __init__(self, fn: Callable[[], None], rounds: int):
+    def __init__(self, fn: Callable[[], None], rounds: int,
+                 deadline: float = 0.0):
         self.fn: Optional[Callable[[], None]] = fn
         self.rounds = rounds
         self._dead = False
+        self.deadline = deadline    # intended fire time (monotonic)
 
     def cancel(self) -> None:
         self._dead = True
@@ -73,6 +75,14 @@ class TimerWheel:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._fired = 0          # observability: timers actually run
+        # fire-lag observability: how late each timer actually ran
+        # vs its requested deadline (scheduling jitter + tick
+        # quantization + callback head-of-line blocking).  The OSD
+        # points ``on_fire_lag`` at its ec_device fire-lag histogram;
+        # max/total stay here for tests and dumps.
+        self.on_fire_lag: Optional[Callable[[float], None]] = None
+        self.fire_lag_max = 0.0
+        self.fire_lag_total = 0.0
 
     # -- arming ------------------------------------------------------
     def call_later(self, delay: float, fn: Callable[[], None]) -> TimerHandle:
@@ -84,9 +94,10 @@ class TimerWheel:
         # exactly that; non-multiples are unchanged.
         offset = ticks % self.slots
         rounds = (ticks - 1) // self.slots
+        deadline = time.monotonic() + float(delay)
         with self._lock:
             slot = (self._cursor + offset) % self.slots
-            h = TimerHandle(fn, rounds)
+            h = TimerHandle(fn, rounds, deadline)
             self._ring[slot].append(h)
             if self._thread is None and not self._stop.is_set():
                 self._thread = threading.Thread(
@@ -104,7 +115,7 @@ class TimerWheel:
                 if self._stop.wait(delay):
                     break
             next_tick += self.tick_s
-            due: List[Callable[[], None]] = []
+            due: List[tuple] = []
             with self._lock:
                 self._cursor = (self._cursor + 1) % self.slots
                 bucket = self._ring[self._cursor]
@@ -117,10 +128,23 @@ class TimerWheel:
                             h.rounds -= 1
                             keep.append(h)
                         elif h.fn is not None:
-                            due.append(h.fn)
+                            due.append((h.fn, h.deadline))
                     self._ring[self._cursor] = keep
-            for fn in due:
+            for fn, deadline in due:
                 self._fired += 1
+                # lag measured at the moment the callback STARTS, so
+                # a slow earlier callback in the same bucket shows up
+                # as head-of-line lag on the ones behind it
+                lag = max(0.0, time.monotonic() - deadline)
+                self.fire_lag_total += lag
+                if lag > self.fire_lag_max:
+                    self.fire_lag_max = lag
+                cb = self.on_fire_lag
+                if cb is not None:
+                    try:
+                        cb(lag)
+                    except Exception:
+                        pass
                 try:
                     fn()
                 except Exception:       # noqa: BLE001 - timer cbs must not kill the wheel

@@ -116,3 +116,39 @@ class Tracer:
         for d in out:
             d["service"] = self.service
         return out
+
+
+def build_trees(span_dicts: List[Dict]) -> Dict[str, Dict]:
+    """Assemble dumped spans (possibly from SEVERAL daemons' tracers)
+    into per-trace trees for critical-path analysis.
+
+    Returns ``{trace_id: {"roots": [span, ...]}}`` where each span
+    dict gains a ``"children"`` list.  Spans whose parent was sampled
+    away on another daemon surface as additional roots rather than
+    being dropped — a partial tree still attributes time.
+    """
+    trees: Dict[str, Dict] = {}
+    by_id: Dict[tuple, Dict] = {}
+    for s in span_dicts:
+        s = dict(s, children=[])
+        trees.setdefault(s["trace_id"], {"roots": []})
+        by_id[(s["trace_id"], s["span_id"])] = s
+    for key, s in by_id.items():
+        parent = by_id.get((s["trace_id"], s["parent_id"])) \
+            if s.get("parent_id") else None
+        if parent is not None:
+            parent["children"].append(s)
+        else:
+            trees[s["trace_id"]]["roots"].append(s)
+    return trees
+
+
+def slowest_child(span: Dict, name: Optional[str] = None) -> Optional[Dict]:
+    """The child span (optionally filtered by name) with the largest
+    duration — e.g. the slowest-shard ``ec_sub_write`` leg under a
+    primary's ``osd_op`` span."""
+    kids = [c for c in span.get("children", ())
+            if name is None or c["name"] == name]
+    if not kids:
+        return None
+    return max(kids, key=lambda c: c.get("duration_us", 0))

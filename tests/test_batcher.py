@@ -764,3 +764,118 @@ def test_learn_crossover_uses_pipelined_model_and_rejects_outliers(codec):
             "compile outlier absorbed into the steady-state EWMA"
     finally:
         b.stop()
+
+
+def test_route_verdicts_hit_recorder_and_ec_device_counters(codec):
+    """PR 6 tentpole: every routing verdict lands in the flight
+    recorder with a reason code plus the crossover snapshot, and
+    increments the matching ``ec_device`` ``route_*`` counter; the
+    completed device group publishes staging/h2d telemetry."""
+    from ceph_tpu.utils.flight_recorder import FlightRecorder
+    from ceph_tpu.utils.perf import PerfCountersCollection
+
+    coll = PerfCountersCollection()
+    rec = FlightRecorder(capacity=64, name="osd.t")
+    EncodeBatcher.reset_learning()
+    b = EncodeBatcher({"ec_tpu_batch_stripes": 1024,
+                       "ec_tpu_queue_window_us": 1000,
+                       "ec_tpu_min_device_bytes": 1},
+                      perf_coll=coll, recorder=rec)
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = os.urandom(2 * 8192)
+        done = threading.Event()
+        b.submit(codec, sinfo, data, lambda c: done.set())
+        assert done.wait(30)
+        routes = [e for e in rec.dump() if e["kind"] == "route"]
+        assert routes, rec.dump()
+        assert routes[0]["to"] == "device"
+        assert routes[0]["reason"] == "device"
+        assert routes[0]["bytes"] == len(data)
+        assert routes[0]["crossover"] == 1
+        dp = coll.perf_dump()["ec_device"]
+        assert dp["route_device"] >= 1
+        assert dp["route_pin"] == 0
+        # the completed group published the staging-pool and link
+        # telemetry into the same subsystem
+        deadline = time.monotonic() + 10
+        while coll.perf_dump()["ec_device"]["staging_slots"] == 0 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        dp = coll.perf_dump()["ec_device"]
+        assert dp["staging_slots"] >= 1
+        assert dp["staging_hits"] + dp["staging_allocs"] >= 1
+    finally:
+        b.stop()
+
+
+def test_pin_routed_twin_group_is_reason_coded(codec):
+    """A crossover pinned above the group size routes to the twin
+    with reason="pin" — the exact evidence trail the r05 misrouting
+    post-mortem lacked."""
+    from ceph_tpu.utils.flight_recorder import FlightRecorder
+    from ceph_tpu.utils.perf import PerfCountersCollection
+
+    coll = PerfCountersCollection()
+    rec = FlightRecorder(capacity=64, name="osd.t2")
+    EncodeBatcher.reset_learning()
+    b = EncodeBatcher({"ec_tpu_batch_stripes": 1024,
+                       "ec_tpu_queue_window_us": 1000,
+                       "ec_tpu_min_device_bytes": 256 << 20},
+                      perf_coll=coll, recorder=rec)
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        data = os.urandom(2 * 8192)
+        out = {}
+        done = threading.Event()
+        b.submit(codec, sinfo, data,
+                 lambda c: (out.update(c), done.set()))
+        assert done.wait(30)
+        assert out == ecutil.encode(sinfo, codec, data)
+        routes = [e for e in rec.dump() if e["kind"] == "route"]
+        assert routes and routes[0]["to"] == "cpu"
+        assert routes[0]["reason"] == "pin"
+        assert coll.perf_dump()["ec_device"]["route_pin"] >= 1
+    finally:
+        b.stop()
+
+
+def test_breaker_transitions_are_recorded_and_auto_dumped(codec,
+                                                          capsys):
+    """Opening the breaker records the device_error run and the
+    open transition, and auto-dumps the ring to stderr (rate
+    limited); closing records the close with the restored
+    crossover."""
+    from ceph_tpu.utils.flight_recorder import FlightRecorder
+    from ceph_tpu.utils.perf import PerfCountersCollection
+
+    coll = PerfCountersCollection()
+    rec = FlightRecorder(capacity=64, name="osd.t3")
+    EncodeBatcher.reset_learning()
+    b = EncodeBatcher({"ec_tpu_min_device_bytes": 4096},
+                      perf_coll=coll, recorder=rec)
+    try:
+        for _ in range(b.device_error_threshold):
+            b._device_failure("dispatch")
+        assert EncodeBatcher._breaker_open
+        dp = coll.perf_dump()["ec_device"]
+        assert dp["breaker_opened"] == 1
+        assert dp["breaker_open_now"] == 1
+        kinds = [e["kind"] for e in rec.dump()]
+        assert kinds.count("device_error") == b.device_error_threshold
+        opens = [e for e in rec.dump() if e["kind"] == "breaker"
+                 and e["state"] == "open"]
+        assert opens and opens[0]["cause"] == "dispatch"
+        err = capsys.readouterr().err
+        assert "flight-recorder auto-dump [osd.t3] " \
+               "reason=breaker-open" in err
+        b._device_success()
+        assert not EncodeBatcher._breaker_open
+        dp = coll.perf_dump()["ec_device"]
+        assert dp["breaker_closed"] == 1
+        assert dp["breaker_open_now"] == 0
+        closes = [e for e in rec.dump() if e["kind"] == "breaker"
+                  and e["state"] == "closed"]
+        assert closes and closes[0]["crossover"] == 4096
+    finally:
+        b.stop()

@@ -1,0 +1,177 @@
+"""Critical-path analyzer + flight recorder + timer fire-lag tests.
+
+PR 6 tentpole units: utils/critpath.py (per-op stage attribution over
+OpTracker timelines), utils/flight_recorder.py (bounded event ring +
+rate-limited auto-dump), and the timer wheel's fire-lag telemetry.
+"""
+import io
+import time
+
+from ceph_tpu.utils import critpath
+from ceph_tpu.utils.flight_recorder import FlightRecorder
+from ceph_tpu.utils.optracker import OpTracker
+from ceph_tpu.utils.perf import PerfCountersCollection
+from ceph_tpu.utils.timer_wheel import TimerWheel
+
+
+def _timeline(*steps, t0=100.0):
+    """[(dt, event), ...] -> OpTracker-shaped event tuples."""
+    out, t = [(t0, "initiated")], t0
+    for dt, ev in steps:
+        t += dt
+        out.append((t, ev))
+    return out
+
+
+def test_analyze_charges_interval_to_ending_event():
+    ev = _timeline((0.001, "queued_for_pg"),
+                   (0.002, "reached_pg"),
+                   (0.001, "started_write"),
+                   (0.001, "ec:encode_queued"),
+                   (0.003, "ec:batch_dispatched"),
+                   (0.010, "ec:encoded"),
+                   (0.001, "ec:sub_write_sent"),
+                   (0.006, "ec:all_shards_committed"),
+                   (0.001, "op_commit"),
+                   (0.001, "done"))
+    res = critpath.analyze(ev)
+    # stage seconds sum exactly to the op duration
+    assert abs(sum(res["stages"].values()) - res["total"]) < 1e-12
+    assert abs(res["total"] - 0.027) < 1e-9
+    # each interval charged to the stage named by its ENDING event
+    assert abs(res["stages"]["encode"] - 0.010) < 1e-9
+    assert abs(res["stages"]["commit_wait"] - 0.006) < 1e-9
+    assert abs(res["stages"]["pg_queue_wait"] - 0.002) < 1e-9
+    assert res["bounding_stage"] == "encode"
+
+
+def test_analyze_repeated_and_unknown_events():
+    # segmented fanout repeats ec:sub_write_sent; waiting* events
+    # charge to "blocked"; unknown events to "other" — the breakdown
+    # still sums to the duration
+    ev = _timeline((0.002, "ec:sub_write_sent"),
+                   (0.003, "ec:sub_write_sent"),
+                   (0.004, "waiting_for_scrub"),
+                   (0.005, "mystery_event"),
+                   (0.001, "done"))
+    res = critpath.analyze(ev)
+    assert abs(res["stages"]["fanout_send"] - 0.005) < 1e-9
+    assert abs(res["stages"]["blocked"] - 0.004) < 1e-9
+    assert abs(res["stages"]["other"] - 0.005) < 1e-9
+    assert abs(sum(res["stages"].values()) - res["total"]) < 1e-12
+    # dict-shaped events (dump format) parse identically
+    dicts = [{"time": t, "event": e} for t, e in ev]
+    assert critpath.analyze(dicts) == res
+
+
+def test_accum_via_op_tracker_retire_and_perf_export():
+    coll = PerfCountersCollection()
+    accum = critpath.CriticalPathAccum(perf_coll=coll)
+    trk = OpTracker(history_size=8)
+    trk.on_retire = accum.observe
+    op = trk.create("osd_op(write b1)")
+    op.mark_event("queued_for_pg")
+    op.mark_event("reached_pg")
+    op.mark_event("ec:encoded")
+    op.finish()
+    d = accum.dump()
+    assert d["ops"] == 1
+    assert d["slowest_op"]["description"] == "osd_op(write b1)"
+    assert d["bounding_ops"]
+    # dump() rounds each stage to 6 decimals independently: allow
+    # up to 0.5us of rounding drift per stage vs the rounded total
+    assert abs(sum(d["stage_seconds"].values())
+               - d["op_seconds_total"]) < 0.5e-6 * (
+                   len(d["stage_seconds"]) + 1)
+    pd = coll.perf_dump()["critpath"]
+    assert pd["ops"] == 1
+    assert pd["stage_encode"]["avgcount"] == 1
+    bound = d["slowest_op"]["bounding_stage"]
+    assert pd[f"bound_{bound}"] == 1
+    # an op with fewer than 2 events is skipped, not crashed on
+    accum.observe({"events": [{"time": 1.0, "event": "initiated"}]})
+    assert accum.dump()["ops"] == 1
+
+
+def test_merge_dumps_sums_budgets_and_keeps_slowest():
+    a = {"ops": 2, "op_seconds_total": 0.5,
+         "stage_seconds": {"encode": 0.3, "commit_wait": 0.2},
+         "bounding_ops": {"encode": 2},
+         "slowest_op": {"total": 0.3, "stages": {"encode": 0.3},
+                        "bounding_stage": "encode",
+                        "description": "a"}}
+    b = {"ops": 1, "op_seconds_total": 0.9,
+         "stage_seconds": {"encode": 0.1, "msg_recv": 0.8},
+         "bounding_ops": {"msg_recv": 1},
+         "slowest_op": {"total": 0.9, "stages": {"msg_recv": 0.9},
+                        "bounding_stage": "msg_recv",
+                        "description": "b"}}
+    m = critpath.merge_dumps([a, b, None, {}])
+    assert m["ops"] == 3
+    assert abs(m["op_seconds_total"] - 1.4) < 1e-9
+    assert abs(m["stage_seconds"]["encode"] - 0.4) < 1e-9
+    assert m["bounding_ops"] == {"encode": 2, "msg_recv": 1}
+    assert m["slowest_op"]["description"] == "b"
+    # canonical stage order preserved in the merged budget
+    keys = list(m["stage_seconds"])
+    order = [critpath.STAGE_ORDER.index(k) for k in keys]
+    assert order == sorted(order)
+
+
+def test_flight_recorder_ring_bounds_and_order():
+    r = FlightRecorder(capacity=16, name="t")
+    for i in range(40):
+        r.note("route", i=i)
+    evs = r.dump()
+    assert len(evs) == 16                 # bounded
+    assert [e["i"] for e in evs] == list(range(24, 40))  # newest kept
+    assert [e["seq"] for e in evs] == sorted(e["seq"] for e in evs)
+    st = r.dump_state()
+    assert st["recorded"] == 40 and st["capacity"] == 16
+    # reserved keys cannot be shadowed by event fields
+    r.note("breaker", kind="bogus", seq=-1)
+    last = r.dump()[-1]
+    assert last["kind"] == "breaker" and last["seq"] > 0
+
+
+def test_flight_recorder_auto_dump_rate_limited():
+    r = FlightRecorder(capacity=8, name="osd.9",
+                       auto_dump_interval_s=60.0)
+    r.note("subwrite_timeout", tid=7)
+    buf = io.StringIO()
+    assert r.auto_dump("subwrite-timeout", out=buf) is True
+    text = buf.getvalue()
+    assert "auto-dump [osd.9] reason=subwrite-timeout" in text
+    assert '"tid": 7' in text
+    # second trigger inside the interval is suppressed (the event
+    # itself stays in the ring)
+    assert r.auto_dump("subwrite-timeout", out=buf) is False
+    st = r.dump_state()
+    assert st["auto_dumps"] == 1 and st["auto_dump_suppressed"] == 1
+
+
+def test_timer_wheel_reports_fire_lag():
+    lags = []
+    tw = TimerWheel(tick_s=0.005, slots=64)
+    tw.on_fire_lag = lags.append
+    try:
+        import threading
+        done = threading.Event()
+        tw.call_later(0.02, done.set)
+        assert done.wait(5)
+        deadline = time.monotonic() + 5
+        while not lags and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert lags, "fire-lag callback never ran"
+        # lag is non-negative and bounded by a few ticks on an idle
+        # wheel (generous bound: one full second absorbs CI noise)
+        assert 0.0 <= lags[0] < 1.0
+        assert tw.fire_lag_max >= lags[0]
+        assert tw.fire_lag_total >= lags[0]
+        # a broken lag observer must not break timer dispatch
+        tw.on_fire_lag = lambda lag: 1 / 0
+        done2 = threading.Event()
+        tw.call_later(0.01, done2.set)
+        assert done2.wait(5)
+    finally:
+        tw.stop()

@@ -447,3 +447,92 @@ def test_multi_mds_rank_failover():
         assert a.active and a.rank == 0  # rank 0 untouched
         for d in (a, s):
             d.shutdown()
+
+
+def test_cross_rename_tick_retry_keeps_client_reqid():
+    """Regression (PR 5 fix, PR 6 test): a cross-rank rename whose
+    slave round trip times out replies EAGAIN and leaves the prepare
+    pending; the TICK retry re-drives it with ``reqid=None``.  The
+    retry must recover the client reqid journaled in the prepare
+    record, so the committed rename lands in the dedup table and the
+    client's resend gets a dup-hit (result 0) — NOT a re-execute
+    that ENOENTs on the already-moved source."""
+    import threading
+
+    from ceph_tpu.cluster import test_config as _mc
+    from ceph_tpu.msg.messages import MMDSOp
+
+    conf = _mc(mds_beacon_interval=0.2, mds_beacon_grace=30)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_pool("xrm", "replicated", size=2)
+        c.create_pool("xrd", "replicated", size=2)
+        a = MDSDaemon(c.mon_addr, "xrm", "xrd", conf=conf,
+                      name="mds.a").start()
+        b = MDSDaemon(c.mon_addr, "xrm", "xrd", conf=conf,
+                      name="mds.b").start()
+        rc, msg_, _ = c.mon_command({"prefix": "fs set",
+                                     "var": "max_mds", "val": "2"})
+        assert rc == 0, msg_
+        rc, msg_, _ = c.mon_command({"prefix": "fs pin",
+                                     "path": "/b", "rank": "1"})
+        assert rc == 0, msg_
+        _wait_for(lambda: b.active and b.rank == 1, 10,
+                  "standby never took rank 1")
+        _wait_for(lambda: a._pins.get("/b") == 1, 10,
+                  "rank 0 never learned the pin table")
+        fs = MDSClient(c.rados(), None, "xrd")
+        fs.mkdir("/a")
+        fs.mkdir("/b")
+        fs.write_file("/a/f1.bin", b"payload")
+
+        # first slave round trip times out; later calls go through
+        real_peer = a._peer_request
+        fail_once = {"left": 1}
+
+        def flaky_peer(rank, op, args, prep):
+            if op == "peer_link" and fail_once["left"]:
+                fail_once["left"] -= 1
+                raise TimeoutError("injected slave timeout")
+            return real_peer(rank, op, args, prep)
+
+        a._peer_request = flaky_peer
+
+        class _Conn:
+            def __init__(self):
+                self.replies = []
+                self.ev = threading.Event()
+
+            def send_message(self, m):
+                self.replies.append(m)
+                self.ev.set()
+
+        op = MMDSOp(client="xrc", tid=77, op="rename",
+                    args={"old": "/a/f1.bin", "new": "/b/moved.bin"})
+        conn1 = _Conn()
+        a._handle_op(op, conn1)
+        assert conn1.ev.wait(10), "no reply to the first rename"
+        assert conn1.replies[0].result == -11     # EAGAIN
+        assert a._pending_renames, "prepare was not kept"
+        prep = next(iter(a._pending_renames))
+        assert a._pending_renames[prep]["client_reqid"] == \
+            ["xrc", 77], "prepare record lost the client reqid"
+
+        # the tick retry's exact call shape: reqid=None, no conn
+        a._drive_cross_rename(prep, None)
+        assert not a._pending_renames, "retry did not resolve"
+        assert ("xrc", 77) in a._reqids, \
+            "tick retry committed without the recovered reqid"
+
+        # client resend of the SAME (client, tid): dup-hit, result 0
+        conn2 = _Conn()
+        a._handle_op(op, conn2)
+        assert conn2.ev.wait(10), "no reply to the resend"
+        assert conn2.replies[0].result == 0, \
+            f"resend re-executed: {conn2.replies[0].result}"
+        # the rename happened exactly once
+        assert fs.read_file("/b/moved.bin") == b"payload"
+        assert not fs.exists("/a/f1.bin")
+        for d in (a, b):
+            d.shutdown()

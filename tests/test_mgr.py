@@ -67,6 +67,17 @@ def test_prometheus_endpoint(cl):
     assert "ceph_pool_count" in body
     assert "# TYPE ceph_osd_op counter" in body
     assert 'ceph_osd_op_latency_total{daemon=' in body
+    # device-telemetry and critical-path subsystems ride the same
+    # perf dump, so the scrape must carry their families (registered
+    # at OSD boot — present even before traffic)
+    assert 'ceph_ec_device_route_device{daemon="osd.0"}' in body
+    assert "ceph_ec_device_staging_hits" in body
+    assert "# TYPE ceph_ec_device_breaker_open_now gauge" in body
+    assert "# TYPE ceph_ec_device_h2d_bps gauge" in body
+    assert "# TYPE ceph_ec_device_timer_fire_lag_us histogram" in body
+    assert "ceph_critpath_ops" in body
+    assert "ceph_critpath_stage_encode_total" in body
+    assert "ceph_critpath_bound_commit_wait" in body
 
     st = json.loads(urllib.request.urlopen(
         f"http://{host}:{port}/status", timeout=5).read().decode())

@@ -71,3 +71,47 @@ def test_perf_tinc_is_cheap():
     cost = _per_op(lambda: pc.tinc("lat", 1e-4))
     assert cost < PERF_INC_CEILING, \
         f"perf tinc costs {cost * 1e6:.2f}us/op"
+
+
+# PR 6 puts two more always-on pieces near the hot path: the flight
+# recorder (every routing verdict notes one event) and the critical-
+# path accumulator (every retired op gets one analyze pass).  Same
+# bar as the rest of the always-on instrumentation.
+FLIGHT_NOTE_CEILING = 20e-6
+CRITPATH_OBSERVE_CEILING = 20e-6
+
+
+def test_flight_recorder_note_is_cheap():
+    from ceph_tpu.utils.flight_recorder import FlightRecorder
+    r = FlightRecorder(capacity=256, name="guard")
+    cost = _per_op(lambda: r.note("route", reason="device",
+                                  to="device", bytes=1 << 20,
+                                  reqs=4, crossover=1 << 20))
+    assert cost < FLIGHT_NOTE_CEILING, \
+        f"flight-recorder note costs {cost * 1e6:.2f}us/op " \
+        f"(ceiling {FLIGHT_NOTE_CEILING * 1e6:.0f}us)"
+    assert len(r.dump()) == 256       # ring stayed bounded
+
+
+def test_critpath_observe_is_cheap():
+    from ceph_tpu.utils.critpath import CriticalPathAccum
+    from ceph_tpu.utils.perf import PerfCountersCollection
+
+    class _Op:
+        description = "osd_op(write guard)"
+        events = [(0.000, "initiated"), (0.001, "queued_for_pg"),
+                  (0.002, "reached_pg"), (0.003, "started_write"),
+                  (0.004, "ec:encode_queued"),
+                  (0.005, "ec:batch_dispatched"),
+                  (0.009, "ec:encoded"),
+                  (0.010, "ec:sub_write_sent"),
+                  (0.014, "ec:all_shards_committed"),
+                  (0.015, "op_commit"), (0.016, "done")]
+
+    accum = CriticalPathAccum(perf_coll=PerfCountersCollection())
+    op = _Op()
+    cost = _per_op(lambda: accum.observe(op))
+    assert cost < CRITPATH_OBSERVE_CEILING, \
+        f"critical-path observe costs {cost * 1e6:.2f}us/op " \
+        f"(ceiling {CRITPATH_OBSERVE_CEILING * 1e6:.0f}us)"
+    assert accum.dump()["ops"] > N

@@ -1,0 +1,138 @@
+"""tools/perf_trend.py regression-gate tests (PR 6 satellite).
+
+Synthetic BENCH_r0N.json-style history fixtures drive the three gate
+verdicts: clean pass, per-stage regression, and the r05 signature —
+device_encode_fraction collapsing to ~0 while the device demonstrably
+wins — which must fail with a routing-collapse diagnosis.
+"""
+import json
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+from tools import perf_trend  # noqa: E402
+
+
+def _hist_round(tmp_path, n, records):
+    tail = "\n".join(json.dumps(r) for r in records)
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    p.write_text(json.dumps(
+        {"n": n, "cmd": "python bench.py", "rc": 0, "tail": tail,
+         "parsed": records[-1] if records else None}))
+    return str(p)
+
+
+def _attribution(stages, frac, expect=True):
+    return {"metric": "cluster k8m4 write per-stage time attribution"
+                      " (wall split ...)",
+            "value": round(sum(stages.values()), 3), "unit": "s",
+            "vs_baseline": 1.0, "stages": stages,
+            "device_encode_fraction": frac, "expect_device": expect,
+            "routing": {"device_reqs": int(frac * 100),
+                        "cpu_twin_reqs": 100 - int(frac * 100)}}
+
+
+def _cluster(vs):
+    return {"metric": "cluster write MB/s (13-OSD vstart, pool "
+                      "plugin=tpu k=8 m=4, ...)",
+            "value": 25.0 * vs, "unit": "MB/s", "vs_baseline": vs}
+
+
+def _headline(vs):
+    return {"metric": "EC encode GiB/s at the codec boundary "
+                      "(plugin=tpu ...)",
+            "value": 30.0, "unit": "GiB/s", "vs_baseline": vs}
+
+
+@pytest.fixture
+def history(tmp_path):
+    good = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                         "commit": 3.0}, 0.95)
+    return [
+        _hist_round(tmp_path, 1, [_headline(15.0)]),
+        _hist_round(tmp_path, 2,
+                    [_headline(17.0), _cluster(1.0), good]),
+    ]
+
+
+def _run_cli(fresh_path, history):
+    return subprocess.run(
+        [sys.executable, "tools/perf_trend.py",
+         "--fresh", str(fresh_path), "--history", *history],
+        capture_output=True, text=True)
+
+
+def test_fresh_run_matching_history_passes(tmp_path, history):
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(1.05),
+        _attribution({"queue_wait": 1.1, "encode": 2.1,
+                      "commit": 2.9}, 0.97))))
+    r = _run_cli(fresh, history)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert "perf_trend ok" in r.stdout
+
+
+def test_per_stage_regression_fails(tmp_path, history):
+    # queue_wait balloons from 1/6 to ~2/3 of the wall
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text(json.dumps(
+        _attribution({"queue_wait": 12.0, "encode": 2.0,
+                      "commit": 3.0}, 0.95)))
+    r = _run_cli(fresh, history)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "stage-regression" in r.stdout
+    assert "queue_wait" in r.stdout
+
+
+def test_routing_collapse_fails_with_diagnosis(tmp_path, history):
+    # the r05 replay: throughput collapses alongside a device
+    # fraction of ~0 even though calibration expected the device
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(0.55),
+        _attribution({"queue_wait": 1.0, "encode": 6.0,
+                      "commit": 3.0}, 0.0, expect=True))))
+    r = _run_cli(fresh, history)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "routing-collapse" in r.stdout
+    assert "misrouted to the CPU twin" in r.stdout
+    assert "throughput-regression" in r.stdout
+
+
+def test_collapse_detected_via_headline_without_pin(history):
+    # no calibration pin recorded (expect_device=None): the fresh
+    # codec-boundary headline proving the device fast is enough
+    att = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                        "commit": 3.0}, 0.0, expect=None)
+    findings = perf_trend.check(
+        att, perf_trend.load_history(history),
+        fresh_headline_ratio=17.5)
+    assert [f["check"] for f in findings] == ["routing-collapse"]
+    # ... but a CPU-only box (device never proven) must not trip
+    assert perf_trend.check(
+        att, perf_trend.load_history(history),
+        fresh_headline_ratio=0.9) == []
+
+
+def test_twin_expected_run_passes(history):
+    # calibration decided the twin wins (expect_device=False): a low
+    # device fraction is CORRECT routing, not a collapse
+    att = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                        "commit": 3.0}, 0.02, expect=False)
+    assert perf_trend.check(
+        att, perf_trend.load_history(history)) == []
+
+
+def test_no_data_exits_2(tmp_path, history):
+    fresh = tmp_path / "empty.json"
+    fresh.write_text("no metrics here\n")
+    r = _run_cli(fresh, history)
+    assert r.returncode == 2
+    # real committed history must parse end-to-end too
+    paths = perf_trend.default_history_paths()
+    assert paths, "BENCH_r0*.json history missing from the repo"
+    rounds = perf_trend.load_history(paths)
+    assert any(r2["records"] for r2 in rounds)

@@ -375,3 +375,64 @@ def test_split_retries_after_failed_move_txn():
         c.wait_for_clean(90)
         for name, blob in blobs.items():
             assert io.read(name, len(blob)) == blob, name
+
+
+def test_ec_merge_audits_every_folded_shard():
+    """Regression (PR 5 fix): an EC merge may fold chunks from SEVERAL
+    children, each at its own CHILD acting position.  adopt_merge must
+    accumulate ALL distinct folded shards in _merge_source_shards
+    (union across successive merges, persisted) and run the position
+    audit once per distinct shard — the earlier code kept only the
+    first foreign shard, so mispositioned chunks from the other folded
+    children were deferred to scrub instead of recovered now."""
+    import json
+
+    from ceph_tpu.osd.pg import MERGE_SRC_KEY
+
+    conf = make_conf()
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("map2", plugin="jerasure", k="2", m="1")
+        c.create_pool("emp2", "erasure", pg_num=2,
+                      erasure_code_profile="map2")
+        io = c.rados().open_ioctx("emp2")
+        io.write_full("seed-obj", b"x" * 8192)
+        c.wait_for_clean(30)
+
+        # an acting NON-primary EC member: adopt_merge on it records
+        # sources without kicking off a fresh peering round
+        target = None
+        for osd in c.osds.values():
+            for pg in osd.pgs.values():
+                acting = [o for o in pg.acting if o is not None]
+                if (pg.pool.is_erasure() and osd.whoami in acting
+                        and not pg.is_primary() and pg.own_shard >= 0):
+                    target = pg
+                    break
+            if target is not None:
+                break
+        assert target is not None, "no acting non-primary EC member"
+
+        audited = []
+        target._audit_split_shard = \
+            lambda osdmap, src=None: audited.append(src)
+
+        # one merge folding chunks from TWO children (positions 0, 2):
+        # both shards recorded, both audited
+        target.adopt_merge(None, None, merge_pgnum=1,
+                           merged_locs={"a": 0, "b": 2, "c": 0})
+        assert target._merge_source_shards == [0, 2]
+        assert sorted(audited) == [0, 2]
+
+        # a later merge folding shard 1 (and 2 again) unions without
+        # losing the earlier sources or duplicating entries
+        audited.clear()
+        target.adopt_merge(None, None, merge_pgnum=1,
+                           merged_locs={"d": 1, "e": 2})
+        assert target._merge_source_shards == [0, 1, 2]
+        assert sorted(audited) == [1, 2]
+
+        # durably persisted: a restarted holder re-audits every one
+        omap = target.store.omap_get(target.coll, target._meta_obj())
+        assert json.loads(omap[MERGE_SRC_KEY].decode()) == [0, 1, 2]

@@ -731,3 +731,37 @@ def test_blockstore_rmw_over_rot_raises_and_store_survives(tmp_path):
                                                b"fine")])
     assert s2.read(C, obj("other")) == b"fine"
     s2.umount()
+
+
+def test_blockstore_live_apply_rollback_covers_all_exceptions(
+        tmp_path):
+    """Regression (PR 5 fix, PR 6 test): a LIVE transaction that
+    fails with a non-OSError mid-apply (here: a malformed write
+    payload raising TypeError after an earlier write op already
+    allocated blocks) must roll those allocations back — only the
+    replay path may swallow OSErrors, and no path may leak bitmap
+    blocks from a transaction whose batch never commits.  The
+    malformed op passes check_ops (which validates names and
+    existence, not payloads), so the failure lands mid-apply."""
+    s = BlockStore(str(tmp_path / "bsrb"))
+    s.mkfs()
+    s.mount()
+    try:
+        s.queue_transactions([Transaction().create_collection(C)])
+        s.queue_transactions(
+            [Transaction().write(C, obj("keep"), 0, b"k" * 4096)])
+        used_before = s._alloc.used()
+        t = Transaction().write(C, obj("doomed"), 0, b"d" * 8192)
+        t.ops.append(("write", C, obj("doomed"), 0, None))
+        with pytest.raises(TypeError):
+            s.queue_transactions([t])
+        assert s._alloc.used() == used_before, \
+            "failed live apply leaked allocator blocks"
+        # the store stays consistent and writable after the rollback
+        assert not s.exists(C, obj("doomed"))
+        assert s.read(C, obj("keep")) == b"k" * 4096
+        s.queue_transactions(
+            [Transaction().write(C, obj("after"), 0, b"a" * 4096)])
+        assert s.read(C, obj("after")) == b"a" * 4096
+    finally:
+        s.umount()

@@ -139,6 +139,7 @@ def test_ec_write_span_tree_and_stage_timeline(tmp_path):
             # stage timeline: the primary's historic-op dump carries
             # the write pipeline's stage events in pipeline order
             hist = None
+            primary = None
             deadline = time.monotonic() + 15
             while hist is None and time.monotonic() < deadline:
                 for osd in c.osds.values():
@@ -147,6 +148,7 @@ def test_ec_write_span_tree_and_stage_timeline(tmp_path):
                     for opd in osd.op_tracker.dump_historic_ops():
                         if "tree" in opd["description"]:
                             hist = opd
+                            primary = osd
                 if hist is None:
                     time.sleep(0.2)
             assert hist is not None
@@ -172,6 +174,37 @@ def test_ec_write_span_tree_and_stage_timeline(tmp_path):
                 assert isinstance(out["ops"], list), (prefix, out)
             tr = admin_command(sock, "dump_traces")
             assert isinstance(tr["spans"], list)
+            # flight recorder round-trip: an event noted on the
+            # OSD's in-process ring comes back through the admin
+            # socket, ordered by sequence
+            c.osds[0].flight_recorder.note(
+                "route", reason="pin", to="cpu", bytes=8192)
+            fr = admin_command(sock, "dump_flight_recorder")
+            assert fr["name"] == "osd.0" and fr["capacity"] >= 16
+            routes = [e for e in fr["events"]
+                      if e["kind"] == "route"]
+            assert routes and routes[-1]["reason"] == "pin"
+            assert routes[-1]["to"] == "cpu"
+            seqs = [e["seq"] for e in fr["events"]]
+            assert seqs == sorted(seqs)
+            # critical-path round-trip on the PRIMARY (the client
+            # op retired there): stage seconds sum to the op total
+            # and the dominant stage is recorded
+            psock = str(tmp_path) + f"/osd.{primary.whoami}.asok"
+            cp = admin_command(psock, "dump_critical_path")
+            assert cp["ops"] >= 1
+            assert cp["bounding_ops"]
+            assert cp["slowest_op"] is not None
+            so = cp["slowest_op"]
+            assert abs(sum(so["stages"].values())
+                       - so["total"]) < 1e-6
+            assert so["bounding_stage"] in so["stages"]
+            # ... and the same totals ride the perf dump as the
+            # critpath subsystem
+            ppd = admin_command(psock, "perf dump")
+            assert ppd["critpath"]["ops"] >= 1
+            assert ppd["critpath"]["stage_commit_wait"]["avgcount"] \
+                >= 0
         finally:
             client.shutdown()
 

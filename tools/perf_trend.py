@@ -1,0 +1,280 @@
+#!/usr/bin/env python3
+"""Perf-trend regression gate over the bench history.
+
+Diffs a FRESH bench run's k8m4 attribution JSON (the
+``cluster k8m4 write per-stage time attribution`` object bench.py
+prints, now carrying ``critical_path`` and
+``device_encode_fraction``) against the committed ``BENCH_r0*.json``
+history and fails loudly on:
+
+- **routing collapse** — the r05 failure mode: the codec boundary
+  sustains a large device speedup while the cluster routes (nearly)
+  every encode to the CPU twin because the crossover was pinned above
+  every group size.  Caught structurally: ``device_encode_fraction``
+  below the floor while the run's own calibration expected the device
+  to win (``expect_device``), or while the same run's codec-boundary
+  headline shows the device clearly ahead.
+- **per-stage regression** — a pipeline stage's share of the write
+  wall grows by more than the tolerance vs the most recent history
+  round that recorded an attribution breakdown.
+- **throughput regression** — the cluster k8m4 ``vs_baseline`` write
+  ratio drops below ``ratio_tol`` x the best comparable history round
+  (matched on the k=8 m=4 cluster config).
+
+History files are ``{"n", "cmd", "rc", "tail", "parsed"}`` wrappers
+around a captured bench stdout; metric records are re-extracted from
+the embedded JSON lines in ``tail`` (r01-r03 predate the cluster
+configs and r05's tail truncates the attribution line — missing
+records are tolerated, the checks that need them are skipped).
+
+Exit codes: 0 pass, 1 regression, 2 no data / parse error.
+``bench.py --assert-floor`` imports :func:`check` directly and runs
+the same gate on the in-process attribution dict.
+"""
+from __future__ import annotations
+
+import argparse
+import glob as globlib
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+_ATTRIB_PREFIX = "cluster k8m4 write per-stage time attribution"
+_CLUSTER_PREFIX = "cluster write MB/s"
+_HEADLINE_PREFIX = "EC encode GiB/s at the codec boundary"
+_K8M4_MARK = "k=8 m=4"
+
+# defaults, overridable from the CLI
+STAGE_TOL = 0.15          # absolute share-of-wall growth allowed
+RATIO_TOL = 0.8           # fresh ratio must be >= tol * best history
+MIN_DEVICE_FRACTION = 0.5  # below this the routing collapsed
+HEADLINE_DEVICE_WIN = 2.0  # codec vs_baseline that proves the device
+
+
+def _records_from_text(text: str) -> List[Dict]:
+    """Every parseable JSON object line carrying a "metric" field."""
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            obj = json.loads(line)
+        except (ValueError, TypeError):
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            out.append(obj)
+    return out
+
+
+def _round_records(round_obj: Dict) -> List[Dict]:
+    recs = _records_from_text(round_obj.get("tail", "") or "")
+    parsed = round_obj.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed and \
+            not any(r.get("metric") == parsed["metric"] for r in recs):
+        recs.append(parsed)
+    return recs
+
+
+def load_history(paths: List[str]) -> List[Dict]:
+    """-> [{"n": int, "path": str, "records": [...]}] sorted by n."""
+    rounds = []
+    for p in sorted(paths):
+        try:
+            with open(p) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"perf_trend: unreadable history "
+                             f"{p}: {e}")
+        rounds.append({"n": int(obj.get("n", 0)), "path": p,
+                       "records": _round_records(obj)})
+    rounds.sort(key=lambda r: r["n"])
+    return rounds
+
+
+def default_history_paths() -> List[str]:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return sorted(globlib.glob(os.path.join(root, "BENCH_r0*.json")))
+
+
+def _pick(records: List[Dict], prefix: str,
+          mark: Optional[str] = None) -> Optional[Dict]:
+    for r in records:
+        m = r.get("metric", "")
+        if m.startswith(prefix) and (mark is None or mark in m):
+            return r
+    return None
+
+
+def load_fresh(path: str) -> List[Dict]:
+    """Fresh input: a bare attribution object, a history-style
+    wrapper, or a raw bench stdout log — always -> metric records."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError as e:
+        raise SystemExit(f"perf_trend: unreadable fresh input "
+                         f"{path}: {e}")
+    try:
+        obj = json.loads(text)
+    except ValueError:
+        return _records_from_text(text)
+    if isinstance(obj, dict) and "tail" in obj:
+        return _round_records(obj)
+    if isinstance(obj, dict) and "metric" in obj:
+        return [obj]
+    if isinstance(obj, list):
+        return [r for r in obj
+                if isinstance(r, dict) and "metric" in r]
+    return _records_from_text(text)
+
+
+# ---------------------------------------------------------------------
+# the gate
+# ---------------------------------------------------------------------
+def check(attribution: Optional[Dict], history: List[Dict],
+          fresh_ratio: Optional[float] = None,
+          fresh_headline_ratio: Optional[float] = None,
+          stage_tol: float = STAGE_TOL,
+          ratio_tol: float = RATIO_TOL,
+          min_device_fraction: float = MIN_DEVICE_FRACTION) \
+        -> List[Dict]:
+    """-> findings ``[{"check", "severity", "message"}]``; empty =
+    pass.  ``attribution`` is the fresh run's attribution object (may
+    be None — only the ratio check can then run); ``fresh_ratio`` the
+    fresh cluster-write vs_baseline; ``fresh_headline_ratio`` the
+    fresh codec-boundary vs_baseline (device proof for the collapse
+    check when no calibration pin was recorded)."""
+    findings: List[Dict] = []
+
+    # -- routing collapse (the r05 signature) -------------------------
+    if attribution is not None:
+        frac = attribution.get("device_encode_fraction")
+        if frac is None:
+            routing = attribution.get("routing") or {}
+            dev = routing.get("device_reqs")
+            cpu = routing.get("cpu_twin_reqs")
+            if dev is not None and cpu is not None and dev + cpu > 0:
+                frac = dev / (dev + cpu)
+        expect = attribution.get("expect_device")
+        device_proven = expect is True or (
+            expect is None and fresh_headline_ratio is not None
+            and fresh_headline_ratio >= HEADLINE_DEVICE_WIN)
+        if frac is not None and device_proven \
+                and frac < min_device_fraction:
+            why = "calibration pinned the crossover for the device" \
+                if expect is True else \
+                (f"the codec boundary sustains "
+                 f"{fresh_headline_ratio:.1f}x baseline on device")
+            findings.append({
+                "check": "routing-collapse", "severity": "fail",
+                "message":
+                    f"device_encode_fraction {frac:.3f} < "
+                    f"{min_device_fraction:.2f} while {why} — "
+                    f"encode traffic is misrouted to the CPU twin "
+                    f"(r05-style routing collapse: the crossover "
+                    f"threshold sits above every group the cluster "
+                    f"forms; check ec_tpu_min_device_bytes pinning "
+                    f"and the ec_device route_* reason counters)"})
+
+    # -- per-stage share regression -----------------------------------
+    hist_att = None
+    for rnd in reversed(history):
+        hist_att = _pick(rnd["records"], _ATTRIB_PREFIX)
+        if hist_att is not None:
+            break
+    if attribution is not None and hist_att is not None:
+        old_st = hist_att.get("stages") or {}
+        new_st = attribution.get("stages") or {}
+        old_wall = sum(old_st.values())
+        new_wall = sum(new_st.values())
+        if old_wall > 0 and new_wall > 0:
+            for s in sorted(set(old_st) | set(new_st)):
+                old_share = old_st.get(s, 0.0) / old_wall
+                new_share = new_st.get(s, 0.0) / new_wall
+                if new_share > old_share + stage_tol:
+                    findings.append({
+                        "check": "stage-regression",
+                        "severity": "fail",
+                        "message":
+                            f"stage {s!r} grew to {new_share:.0%} of "
+                            f"the write wall (history "
+                            f"{old_share:.0%}, tolerance "
+                            f"+{stage_tol:.0%})"})
+
+    # -- cluster throughput ratio regression --------------------------
+    if fresh_ratio is not None:
+        best = None
+        for rnd in history:
+            rec = _pick(rnd["records"], _CLUSTER_PREFIX, _K8M4_MARK)
+            if rec and isinstance(rec.get("vs_baseline"),
+                                  (int, float)):
+                v = float(rec["vs_baseline"])
+                best = v if best is None else max(best, v)
+        if best is not None and fresh_ratio < ratio_tol * best:
+            findings.append({
+                "check": "throughput-regression", "severity": "fail",
+                "message":
+                    f"cluster k8m4 write at {fresh_ratio:.3f}x "
+                    f"baseline < {ratio_tol:.2f} x best history "
+                    f"{best:.3f}x"})
+    return findings
+
+
+def run(fresh_records: List[Dict], history: List[Dict],
+        stage_tol: float = STAGE_TOL, ratio_tol: float = RATIO_TOL,
+        min_device_fraction: float = MIN_DEVICE_FRACTION) -> int:
+    att = _pick(fresh_records, _ATTRIB_PREFIX)
+    cluster = _pick(fresh_records, _CLUSTER_PREFIX, _K8M4_MARK)
+    headline = _pick(fresh_records, _HEADLINE_PREFIX)
+    if att is None and cluster is None:
+        print("perf_trend: fresh input carries neither an "
+              "attribution object nor a k8m4 cluster metric",
+              file=sys.stderr)
+        return 2
+    findings = check(
+        att, history,
+        fresh_ratio=float(cluster["vs_baseline"])
+        if cluster and isinstance(cluster.get("vs_baseline"),
+                                  (int, float)) else None,
+        fresh_headline_ratio=float(headline["vs_baseline"])
+        if headline and isinstance(headline.get("vs_baseline"),
+                                   (int, float)) else None,
+        stage_tol=stage_tol, ratio_tol=ratio_tol,
+        min_device_fraction=min_device_fraction)
+    for f in findings:
+        print(f"perf_trend {f['severity'].upper()} "
+              f"[{f['check']}]: {f['message']}")
+    if findings:
+        return 1
+    print("perf_trend ok: no regressions vs "
+          f"{len(history)} history round(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--fresh", required=True,
+                    help="fresh run: attribution JSON object, "
+                         "BENCH_r0N.json-style wrapper, or raw bench "
+                         "stdout log")
+    ap.add_argument("--history", nargs="*", default=None,
+                    help="history files (default: BENCH_r0*.json "
+                         "next to the repo root)")
+    ap.add_argument("--stage-tol", type=float, default=STAGE_TOL)
+    ap.add_argument("--ratio-tol", type=float, default=RATIO_TOL)
+    ap.add_argument("--min-device-fraction", type=float,
+                    default=MIN_DEVICE_FRACTION)
+    args = ap.parse_args(argv)
+    paths = args.history if args.history else default_history_paths()
+    if not paths:
+        print("perf_trend: no history files", file=sys.stderr)
+        return 2
+    return run(load_fresh(args.fresh), load_history(paths),
+               stage_tol=args.stage_tol, ratio_tol=args.ratio_tol,
+               min_device_fraction=args.min_device_fraction)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
