@@ -755,7 +755,8 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         c.create_ec_profile("bench", plugin=plugin, k=k, m=m)
         c.create_pool("benchp", "erasure",
                       erasure_code_profile="bench")
-        io = c.rados(timeout=60 * f).open_ioctx("benchp")
+        rad = c.rados(timeout=60 * f)
+        io = rad.open_ioctx("benchp")
         blob = os.urandom(obj_bytes)
         # untimed warmup: first-call compile + the adaptive router's
         # probe must not be billed to steady-state throughput (the
@@ -871,6 +872,27 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         # write stream, cluster-wide
         from ceph_tpu.utils.critpath import merge_dumps as _cp_merge
         stats["critical_path"] = _cp_merge(critpath_dumps)
+        # cluster-path waterfall: the client saw the WHOLE hop ledger
+        # on every reply (client_send .. client_complete); each
+        # primary additionally saw its sub-op round trips.  Raw
+        # accumulator dumps here; bench_cluster_k8m4 shapes them into
+        # the attribution JSON's `waterfall` block
+        from ceph_tpu.utils.hops import merge_dumps as _hops_merge
+        stats["hops_client"] = rad.objecter.hops.dump()
+        stats["hops_subops"] = _hops_merge(
+            [osd.hops.dump() for osd in c.osds.values()
+             if getattr(osd, "hops", None) is not None])
+        # per-daemon self-time from the always-on sampling profiler
+        from ceph_tpu.utils.sampler import global_sampler
+        _smp = global_sampler()
+        stats["profile"] = {
+            "samples": _smp.samples,
+            "hz": _smp.hz,
+            "per_daemon_top": {
+                f"osd.{osd.whoami}": _smp.top_self_time(
+                    prefix=f"osd{osd.whoami}-", n=3)
+                for osd in c.osds.values()},
+        }
         # routing expectation from the calibration pin: the trend gate
         # only treats a collapsed device fraction as a regression when
         # THIS run's probe said the device should win (None = no pin
@@ -990,6 +1012,21 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
             "breaker": st.get("breaker", {}),
             "subwrite_deadlines": st.get("subwrite", {}),
         }
+        # hop-by-hop waterfall over the same wall: the client's
+        # end-to-end ledger view scaled onto measured wall (shares
+        # sum to 1.0, the critpath invariant applied across daemons),
+        # with each primary's sub-op round-trip view alongside
+        from ceph_tpu.utils.hops import waterfall_block
+        hc = st.get("hops_client")
+        if hc and hc.get("ops"):
+            wf = waterfall_block(hc, wall)
+            wf["subops"] = {
+                k: st["hops_subops"].get(k) for k in
+                ("ops", "p50_s", "p99_s")} \
+                if st.get("hops_subops") else {}
+            att_obj["waterfall"] = wf
+        if st.get("profile"):
+            att_obj["profile"] = st["profile"]
         print(json.dumps(att_obj), flush=True)
         # --assert-floor hands this to the tools/perf_trend.py gate
         _FLOOR_STATS["cluster_k8m4_attribution"] = att_obj

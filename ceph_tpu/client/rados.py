@@ -31,6 +31,7 @@ from ..msg.messages import MOSDOp, MOSDOpReply, MWatchNotify, OSDOp
 from ..msg.messenger import Connection, Dispatcher, Messenger
 from ..osd.osdmap import OSDMap, PGid
 from ..utils.config import Config, default_config
+from ..utils.hops import HopAccum
 from ..utils.log import Dout
 
 # reply code the OSD uses for "wrong primary / stale map, refresh and
@@ -128,6 +129,10 @@ class Objecter(Dispatcher):
         # (pool, oid, cookie) -> callback(notifier, payload)
         self.watch_callbacks: Dict[Tuple[int, str, int], Callable] = {}
         self._osd_conns: Dict[int, Connection] = {}
+        # end-to-end waterfall: the client sees the WHOLE ledger when
+        # the reply returns it (client_send .. client_complete), so
+        # the client owns the authoritative per-op hop accumulator
+        self.hops = HopAccum()
         msgr.add_dispatcher(self)
 
     # ------------------------------------------------------------------
@@ -242,12 +247,14 @@ class Objecter(Dispatcher):
         conn = self.msgr.connect_to(addr, lossless=False)
         with self.lock:
             self._osd_conns[primary] = conn
-        conn.send_message(MOSDOp(
+        m = MOSDOp(
             client=self.msgr.name, tid=op.tid, epoch=osdmap.epoch,
             pool=self._route_pool(osdmap, op), oid=op.oid, ops=op.ops,
             pgid_seed=pgid.seed, trace_id=op.trace_id,
             snap_seq=op.snapc[0], snaps=list(op.snapc[1]),
-            snapid=op.snapid, parent_span_id=op.parent_span_id))
+            snapid=op.snapid, parent_span_id=op.parent_span_id)
+        m.stamp_hop("client_send")
+        conn.send_message(m)
 
     def cancel(self, tid: int) -> None:
         """Drop a timed-out/abandoned op from the window (reference
@@ -302,6 +309,10 @@ class Objecter(Dispatcher):
             return True
         with self.lock:
             self._retire(msg.tid)
+        # final hop: the reply carried the op's cumulative ledger back;
+        # close it and fold the completed waterfall into the client view
+        msg.stamp_hop("client_complete")
+        self.hops.observe_wire(msg.hops)
         op.completion._complete(msg)
         return True
 

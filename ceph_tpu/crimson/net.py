@@ -161,6 +161,8 @@ class CrimsonConnection(Connection):
             if self._inject_send_fault():
                 self._io_error(sock, gen)
                 return
+            # stamped BEFORE encode so it rides the wire
+            msg.stamp_hop("wire_sent")
             for part in encode_frame_parts(
                     msg, compressor=self.msgr.compressor,
                     compress_min=self.msgr.compress_min,
@@ -243,6 +245,7 @@ class CrimsonConnection(Connection):
             del buf[:total]
             try:
                 msg = decode_frame_body(mtype, seq, head, payload, crc)
+                msg.stamp_hop("recv")
             except DecodeError:
                 if self.msgr.conf["ms_die_on_bad_msg"]:
                     raise

@@ -109,6 +109,7 @@ class CrimsonOSD(OSD):
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
+        self._sampler_retain()
         self.reactor.start()
         self.msgr.start()
         # maintenance runs as reactor timers on the SAME methods the
@@ -146,6 +147,7 @@ class CrimsonOSD(OSD):
         self.msgr.shutdown()
         self.timer_wheel.stop()
         self.reactor.stop()
+        self._sampler_release()
         try:
             self.store.umount()
         except Exception:
@@ -158,6 +160,7 @@ class CrimsonOSD(OSD):
             f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
             f"{'+'.join(op.op for op in msg.ops)})")
         msg.tracked.mark_event("queued_for_pg")
+        msg.stamp_hop("pg_queued")
         # continuation, not queue hop: the op runs later in this very
         # tick (the ready queue drains to empty), after the reader
         # finishes parsing whatever else the socket delivered

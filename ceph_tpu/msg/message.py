@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import abc
 import struct
+import time
 import zlib
 from typing import Callable, Dict, Optional, Type
 
@@ -46,6 +47,23 @@ class Message(abc.ABC):
     def __init__(self) -> None:
         self.seq = 0                  # connection-stamped
         self.connection = None        # receive side: originating conn
+        # cumulative hop ledger (utils/hops.py): hop name -> absolute
+        # timestamp.  None until the first stamp; data-path messages
+        # carry it as a trailing wire field, everything else keeps it
+        # process-local.
+        self.hops = None
+
+    def stamp_hop(self, name: str, _now=time.time) -> None:
+        """Record a hop timestamp, FIRST stamp wins: replies carry the
+        request's ledger, so the generic messenger stamps on the reply
+        leg (msgr_enqueue/wire_sent/recv) must not clobber the request
+        leg's — the reply leg's wire time reads out of the final
+        client_complete interval instead."""
+        h = self.hops
+        if h is None:
+            h = self.hops = {}
+        if name not in h:
+            h[name] = _now()
 
     @abc.abstractmethod
     def encode_payload(self) -> bytes: ...
@@ -86,9 +104,10 @@ def encode_frame_parts(msg: Message, compressor=None,
     if compressor is not None and plen >= compress_min:
         # compressors need one contiguous input; this join is the
         # price of compression, not of the framing
-        payload = parts[0] if len(parts) == 1 else b"".join(parts)
+        payload = parts[0] if len(parts) == 1 \
+            else b"".join(parts)  # copycheck: ok - compressor needs one contiguous input (copytracked below)
         if not isinstance(payload, bytes):
-            payload = bytes(payload)
+            payload = bytes(payload)  # copycheck: ok - compressor input materialisation
         if len(parts) > 1:
             copytrack.note_copy(plen, "msg.compress_join")
         comp = compressor.compress(payload)
@@ -96,7 +115,7 @@ def encode_frame_parts(msg: Message, compressor=None,
         # is not worth the receiver's decompress cost (reference's
         # required-ratio idea, e.g. compression_required_ratio)
         if len(comp) + 1 < plen - (plen >> 3):
-            parts = [bytes([compressor.numeric_id]) + comp]
+            parts = [bytes([compressor.numeric_id]) + comp]  # copycheck: ok - 1-byte codec id onto already-compressed data
             plen = len(parts[0])
             mtype |= COMPRESSED_FLAG
         else:
@@ -117,7 +136,7 @@ def encode_frame_parts(msg: Message, compressor=None,
 def encode_frame(msg: Message, compressor=None,
                  compress_min: int = 4096,
                  crc_data: bool = True) -> bytes:
-    return b"".join(encode_frame_parts(
+    return b"".join(encode_frame_parts(  # copycheck: ok - joined-frame convenience form; senders use the parts
         msg, compressor=compressor, compress_min=compress_min,
         crc_data=crc_data))
 

@@ -28,6 +28,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ..utils.encoding import Decoder, Encoder
+from ..utils.hops import decode_ledger, encode_ledger
 from .message import Message, register
 
 
@@ -126,6 +127,7 @@ class MOSDOp(Message):
         for op in self.ops:
             op.encode(e)
         e.u64(self.parent_span_id)
+        encode_ledger(e, self.hops)
         return e
 
     def encode_payload(self) -> bytes:
@@ -146,6 +148,7 @@ class MOSDOp(Message):
         m.snapid = d.u64()
         m.ops = [OSDOp.decode(d) for _ in range(d.u32())]
         m.parent_span_id = d.u64()
+        m.hops = decode_ledger(d)
         return m
 
 
@@ -170,6 +173,7 @@ class MOSDOpReply(Message):
         for b in self.out_data:
             e.bytes(b)
         e.bytes(_enc_json(self.extra))
+        encode_ledger(e, self.hops)
         return e
 
     def encode_payload(self) -> bytes:
@@ -184,6 +188,7 @@ class MOSDOpReply(Message):
         m = cls(tid=d.u64(), result=d.i32(), epoch=d.u32())
         m.out_data = [d.bytes() for _ in range(d.u32())]
         m.extra = _dec_json(d.bytes())
+        m.hops = decode_ledger(d)
         return m
 
 
@@ -234,6 +239,7 @@ class MOSDECSubOpWrite(Message):
         e.u64(self.trace_id)
         e.u64(self.parent_span_id)
         e.u32(self.seg)
+        encode_ledger(e, self.hops)
         return e
 
     def encode_payload(self) -> bytes:
@@ -253,6 +259,7 @@ class MOSDECSubOpWrite(Message):
         m.trace_id = d.u64()
         m.parent_span_id = d.u64()
         m.seg = d.u32()
+        m.hops = decode_ledger(d)
         return m
 
 
@@ -281,14 +288,17 @@ class MOSDECSubOpWriteReply(Message):
         e.u64(self.tid).u32(self.epoch).bool(self.committed)
         e.i32(self.result)
         e.u32(self.seg)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDECSubOpWriteReply":
         d = Decoder(buf)
-        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
-                   tid=d.u64(), epoch=d.u32(), committed=d.bool(),
-                   result=d.i32(), seg=d.u32())
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                tid=d.u64(), epoch=d.u32(), committed=d.bool(),
+                result=d.i32(), seg=d.u32())
+        m.hops = decode_ledger(d)
+        return m
 
 
 @register
@@ -418,6 +428,7 @@ class MOSDRepOp(Message):
         e.u32(self.at_version[0]).u64(self.at_version[1])
         e.u64(self.trace_id)
         e.u64(self.parent_span_id)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
@@ -429,6 +440,7 @@ class MOSDRepOp(Message):
         m.at_version = (d.u32(), d.u64())
         m.trace_id = d.u64()
         m.parent_span_id = d.u64()
+        m.hops = decode_ledger(d)
         return m
 
 
@@ -449,13 +461,16 @@ class MOSDRepOpReply(Message):
         e = Encoder()
         e.str(self.pgid).i32(self.from_osd).u64(self.tid)
         e.u32(self.epoch).i32(self.result)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDRepOpReply":
         d = Decoder(buf)
-        return cls(pgid=d.str(), from_osd=d.i32(), tid=d.u64(),
-                   epoch=d.u32(), result=d.i32())
+        m = cls(pgid=d.str(), from_osd=d.i32(), tid=d.u64(),
+                epoch=d.u32(), result=d.i32())
+        m.hops = decode_ledger(d)
+        return m
 
 
 # ---------------------------------------------------------------------------

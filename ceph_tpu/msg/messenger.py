@@ -313,12 +313,17 @@ class Connection:
 
     # -- public API --------------------------------------------------------
     def send_message(self, msg: Message) -> None:
+        msg.stamp_hop("msgr_enqueue")
         with self.lock:
             if self.state == "closed":
                 return                 # dropped, like the reference's
                                        # sends on a closed lossy conn
             self.out_q.append(msg)
+            depth = len(self.out_q)
             self.send_cond.notify_all()
+        st = getattr(self.msgr, "contention", None)
+        if st is not None:
+            st.note_queue_depth("msgr_sendq", depth)
 
     def mark_down(self) -> None:
         """Tear down now; no reset callback (reference mark_down)."""
@@ -461,6 +466,8 @@ class Connection:
                 try:
                     if self._inject_send_fault():
                         raise ConnectionError("injected socket failure")
+                    # stamped BEFORE encode so it rides the wire
+                    msg.stamp_hop("wire_sent")
                     _sendmsg_all(sock, encode_frame_parts(
                         msg, compressor=self.msgr.compressor,
                         compress_min=self.msgr.compress_min,
@@ -486,6 +493,7 @@ class Connection:
                     crc = _read_exact(sock, CRC_LEN)
                     msg = decode_frame_body(mtype, seq, head, payload,
                                             crc)
+                    msg.stamp_hop("recv")
                 except (OSError, ConnectionError, DecodeError) as e:
                     if isinstance(e, DecodeError) and \
                             self.msgr.conf["ms_die_on_bad_msg"]:

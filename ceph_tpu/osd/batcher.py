@@ -177,7 +177,7 @@ class EncodeBatcher:
     _breaker_closes: int = 0                 # cumulative re-admissions
 
     def __init__(self, conf=None, perf=None, perf_coll=None,
-                 recorder=None):
+                 recorder=None, contention=None):
         def get(k, d):
             if conf is None:
                 return d
@@ -377,7 +377,10 @@ class EncodeBatcher:
                               "h2d": 0.0, "device": 0.0, "d2h": 0.0}
         self.compile_count = 0
         self.compile_seconds = 0.0
-        self._cond = threading.Condition()
+        # collector wakeup condition, wait-time instrumented when the
+        # OSD supplies its contention sink (utils/locks.py)
+        from ..utils.locks import TimedCondition
+        self._cond = TimedCondition("batcher_cond", stats=contention)
         self._queues: Dict[Tuple, List] = {}
         self._pending_stripes = 0
         self._first_enqueue = 0.0

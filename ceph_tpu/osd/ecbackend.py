@@ -676,14 +676,16 @@ class ECBackend(PGBackend):
                 local_txn = txn
                 continue
             parts = txn.encode_parts()
-            self.host.send_shard(osd, MOSDECSubOpWrite(
+            sub = MOSDECSubOpWrite(
                 pgid=self.host.pgid_str, shard=shard,
                 from_osd=self.host.whoami, tid=op.tid,
                 epoch=self.host.epoch, txn=parts,
                 log_entries=wire_entries,
                 at_version=op.at_version,
                 trace_id=op.mutation.trace_id,
-                parent_span_id=op.mutation.parent_span_id, seg=seg))
+                parent_span_id=op.mutation.parent_span_id, seg=seg)
+            sub.stamp_hop("client_send")
+            self.host.send_shard(osd, sub)
             if self.subwrite_timeout_s > 0:
                 # retained ONLY while a deadline is armed: parts are
                 # views over op.encoded's chunks, so this adds no copy
@@ -1633,11 +1635,14 @@ class ECBackend(PGBackend):
                 # Either way NEVER re-apply (log entries must not
                 # append twice).
                 if done:
-                    self.host.send_shard(
-                        msg.from_osd, MOSDECSubOpWriteReply(
-                            pgid=self.host.pgid_str, shard=msg.shard,
-                            from_osd=self.host.whoami, tid=msg.tid,
-                            epoch=self.host.epoch, seg=seg))
+                    reack = MOSDECSubOpWriteReply(
+                        pgid=self.host.pgid_str, shard=msg.shard,
+                        from_osd=self.host.whoami, tid=msg.tid,
+                        epoch=self.host.epoch, seg=seg)
+                    if msg.hops:
+                        reack.hops = dict(msg.hops)
+                    reack.stamp_hop("commit_sent")
+                    self.host.send_shard(msg.from_osd, reack)
                 return True
             self._recent_subwrites[key] = False
             while len(self._recent_subwrites) > 512:
@@ -1647,11 +1652,16 @@ class ECBackend(PGBackend):
 
             def _committed(m=msg, k=key, s=seg):
                 self._recent_subwrites[k] = True
-                self.host.send_shard(
-                    m.from_osd, MOSDECSubOpWriteReply(
-                        pgid=self.host.pgid_str, shard=m.shard,
-                        from_osd=self.host.whoami, tid=m.tid,
-                        epoch=self.host.epoch, seg=s))
+                m.stamp_hop("store_apply")
+                reply = MOSDECSubOpWriteReply(
+                    pgid=self.host.pgid_str, shard=m.shard,
+                    from_osd=self.host.whoami, tid=m.tid,
+                    epoch=self.host.epoch, seg=s)
+                # ledger rides the round trip back to the primary
+                if m.hops:
+                    reply.hops = dict(m.hops)
+                reply.stamp_hop("commit_sent")
+                self.host.send_shard(m.from_osd, reply)
             self._apply_sub_write(msg.shard, txn, msg.log_entries,
                                   _committed)
             return True
@@ -1659,6 +1669,12 @@ class ECBackend(PGBackend):
             if faultlib.registry().check_drop(
                     faultlib.EC_SUBWRITE_ACK):
                 return True  # ack lost: the deadline re-requests
+            # sub-op waterfall closes at the primary: charge the
+            # round trip into this OSD's hops view
+            msg.stamp_hop("client_complete")
+            _obs = getattr(self.host, "observe_hops", None)
+            if _obs is not None:
+                _obs(msg.hops)
             self._sub_write_committed(msg.tid, msg.shard,
                                       getattr(msg, "seg", 0))
             return True

@@ -100,6 +100,14 @@ class OSDService:
     def flight_recorder(self):
         return self._osd.flight_recorder
 
+    @property
+    def hops(self):
+        return self._osd.hops
+
+    @property
+    def contention(self):
+        return self._osd.contention
+
     def call_later(self, delay: float, fn):
         """Cancellable one-shot timer (EC sub-write deadlines); the
         crimson OSD substitutes a reactor timer."""
@@ -233,11 +241,34 @@ class OSD(Dispatcher):
         self.flight_recorder = FlightRecorder(
             capacity=self.conf["flight_recorder_events"],
             name=f"osd.{whoami}")
+        # lock/queue contention telemetry ("contention" subsystem):
+        # the PG lock, batcher condition, store mutex and messenger
+        # send queues report wait/hold/depth here; stalls over the
+        # threshold leave a breadcrumb in the flight recorder
+        from ..utils.locks import ContentionStats, TimedLock
+        self.contention = ContentionStats(
+            perf_coll=self.perf_coll, recorder=self.flight_recorder,
+            stall_threshold_s=self.conf["contention_stall_threshold"])
+        self.contention.register_queue("msgr_sendq")
+        self.msgr.contention = self.contention
+        # retrofit the store mutex; a restart on a surviving store
+        # finds it already wrapped and just rebinds the sink
+        st_lock = getattr(store, "_lock", None)
+        if isinstance(st_lock, TimedLock):
+            st_lock.bind(self.contention)
+        elif st_lock is not None:
+            store._lock = TimedLock("store_lock", stats=self.contention,
+                                    inner=st_lock)
+        # cross-daemon hop-ledger accumulator ("hops" subsystem): this
+        # OSD's view of sub-op round trips (the client owns the
+        # end-to-end MOSDOp view)
+        from ..utils.hops import HopAccum
+        self.hops = HopAccum(perf_coll=self.perf_coll)
         # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
         from .batcher import EncodeBatcher
         self.encode_batcher = EncodeBatcher(
             self.conf, perf=self.perf, perf_coll=self.perf_coll,
-            recorder=self.flight_recorder)
+            recorder=self.flight_recorder, contention=self.contention)
         # timer-wheel fire lag rides the batcher's ec_device
         # subsystem (one device-machinery surface); tick-scale lag is
         # normal, so only fires a full revolution late (a wedged
@@ -283,7 +314,8 @@ class OSD(Dispatcher):
                            "dump_historic_slow_ops",
                            "dump_blocked_ops", "dump_ops_in_flight",
                            "dump_slow_ops", "dump_flight_recorder",
-                           "dump_critical_path", "status",
+                           "dump_critical_path", "dump_hops",
+                           "dump_profile", "status",
                            "config get", "config set"):
                 self.admin_socket.register(
                     prefix, self._admin_socket_hook)
@@ -293,10 +325,32 @@ class OSD(Dispatcher):
         reactor-driven messenger here."""
         return Messenger(f"osd.{self.whoami}", conf=self.conf)
 
+    # -- sampling profiler lifecycle (utils/sampler.py) ----------------
+    # refcounted: the process-wide sampler thread runs while any
+    # daemon holds a reference and stops with the last release, so
+    # cluster teardown leaves no sampler thread behind
+    _sampler_held = False
+
+    def _sampler_retain(self) -> None:
+        hz = self.conf["osd_sampler_hz"]
+        if hz <= 0 or self._sampler_held:
+            return
+        from ..utils.sampler import global_sampler
+        global_sampler(hz=hz).retain()
+        self._sampler_held = True
+
+    def _sampler_release(self) -> None:
+        if not self._sampler_held:
+            return
+        self._sampler_held = False
+        from ..utils.sampler import global_sampler
+        global_sampler().release()
+
     # ------------------------------------------------------------------
     # lifecycle (reference OSD::init)
     # ------------------------------------------------------------------
     def start(self) -> None:
+        self._sampler_retain()
         self.msgr.start()
         for shard in range(self._n_shards):
             for t in range(self.conf["osd_op_num_threads_per_shard"]):
@@ -337,6 +391,7 @@ class OSD(Dispatcher):
         self.msgr.shutdown()
         for t in self._workers + self._threads:
             t.join(timeout=5)
+        self._sampler_release()
         try:
             self.store.umount()
         except Exception:
@@ -586,14 +641,17 @@ class OSD(Dispatcher):
     # ------------------------------------------------------------------
     def ms_dispatch(self, conn: Connection, msg) -> bool:
         if isinstance(msg, MOSDOp):
+            msg.stamp_hop("dispatch_queued")
             self._enqueue_op(conn, msg)
             return True
         if isinstance(msg, _BACKEND_MSGS):
             self.perf.inc("subop")
+            msg.stamp_hop("dispatch_queued")
             pgid = PGid.parse(msg.pgid)
             pg = self._lookup_pg(pgid)
             if pg is not None:
                 with pg.lock:
+                    msg.stamp_hop("pg_locked")
                     if pg.pool.is_erasure() and pg.own_shard < 0:
                         # map race: we are not (yet) in this PG's
                         # acting set, so there is no shard collection
@@ -648,6 +706,7 @@ class OSD(Dispatcher):
             f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
             f"{'+'.join(op.op for op in msg.ops)})")
         msg.tracked.mark_event("queued_for_pg")
+        msg.stamp_hop("pg_queued")
         shard = hash(pgid) % self._n_shards
         self._shard_queues[shard].enqueue("client", (conn, msg))
 
@@ -819,6 +878,18 @@ class OSD(Dispatcher):
                 out = self.flight_recorder.dump_state()
             elif prefix == "dump_critical_path":
                 out = self.critpath.dump()
+            elif prefix == "dump_hops":
+                out = self.hops.dump()
+            elif prefix == "dump_profile":
+                from ..utils.sampler import global_sampler
+                s = global_sampler()
+                out = {"samples": s.samples,
+                       "hz": s.hz,
+                       "running": s.running,
+                       "folded": s.dump_folded(
+                           prefix=f"osd{self.whoami}-"),
+                       "self_time": s.top_self_time(
+                           prefix=f"osd{self.whoami}-", n=10)}
             elif prefix == "status":
                 with self.pg_lock:
                     n_pgs = len(self.pgs)

@@ -44,7 +44,6 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..msg.messages import (MOSDOp, MOSDOpReply, MOSDPGLog, MOSDPGNotify,
                             MOSDPGQuery, MOSDPGRemove, OSDOp)
 from ..store.objectstore import GHObject, Transaction
-from ..utils.lockdep import make_lock
 from ..utils.log import Dout
 from .backend import OI_ATTR, Mutation, ObjectInfo, build_pg_backend
 from .ecbackend import ECBackend
@@ -91,7 +90,12 @@ class PG:
         self.service = service
         self.pgid = pgid
         self.pool = pool
-        self.lock = make_lock("pg")
+        # PG lock with contention telemetry when the host provides a
+        # sink (utils/locks.py); bare hosts in unit tests fall back to
+        # an untimed lockdep lock
+        from ..utils.locks import TimedLock
+        self.lock = TimedLock("pg_lock",
+                              stats=getattr(service, "contention", None))
         self.state = STATE_INACTIVE
         self.up: List[Optional[int]] = []
         self.acting: List[Optional[int]] = []
@@ -241,6 +245,13 @@ class PG:
 
     def send_shard(self, osd: int, msg) -> None:
         self.service.send_osd(osd, msg)
+
+    def observe_hops(self, hops) -> None:
+        """Fold a completed sub-op round-trip ledger into this OSD's
+        hops accumulator (bare test hosts have none)."""
+        acc = getattr(self.service, "hops", None)
+        if acc is not None:
+            acc.observe_wire(hops)
 
     def prepare_log_txn(self, txn: Transaction,
                         log_entries: List[dict]) -> None:
@@ -1256,6 +1267,7 @@ class PG:
     # ------------------------------------------------------------------
     def do_request(self, msg: MOSDOp, conn) -> None:
         with self.lock:
+            msg.stamp_hop("pg_locked")
             if getattr(self, "_merged_away", False):
                 # this PG was folded into its split parent (pg merge):
                 # the client refreshes its map and re-targets
@@ -2161,6 +2173,7 @@ class PG:
         tracked = getattr(msg, "tracked", None)
         if tracked is not None:
             tracked.mark_event("op_commit")
+        msg.stamp_hop("store_apply")
         self._inflight_remove(msg.oid)
         if msg.oid not in self.inflight_writes:
             self._pending_versions.pop(msg.oid, None)
@@ -2439,6 +2452,12 @@ class PG:
         reply = MOSDOpReply(tid=msg.tid, result=result,
                             epoch=self.epoch, out_data=list(out_data),
                             extra=extra or {})
+        # carry the op's cumulative hop ledger back so the client can
+        # close the waterfall (reads skip store_apply; charge() skips
+        # absent hops)
+        if msg.hops:
+            reply.hops = dict(msg.hops)
+        reply.stamp_hop("commit_sent")
         conn.send_message(reply)
 
     # ------------------------------------------------------------------

@@ -72,12 +72,14 @@ class ReplicatedBackend(PGBackend):
         for shard, osd in replicas:
             if osd == self.host.whoami:
                 continue
-            self.host.send_shard(osd, MOSDRepOp(
+            rep = MOSDRepOp(
                 pgid=self.host.pgid_str, from_osd=self.host.whoami,
                 tid=op.tid, epoch=self.host.epoch, txn=enc,
                 log_entries=wire_entries, at_version=at_version,
                 trace_id=mutation.trace_id,
-                parent_span_id=mutation.parent_span_id))
+                parent_span_id=mutation.parent_span_id)
+            rep.stamp_hop("client_send")
+            self.host.send_shard(osd, rep)
         tid = op.tid
         self._apply_local(txn, wire_entries,
                           lambda: self._committed(tid, self.host.whoami))
@@ -324,15 +326,26 @@ class ReplicatedBackend(PGBackend):
                 span.tag("pgid", msg.pgid).tag("from",
                                                msg.from_osd).finish()
             txn = Transaction.decode(msg.txn)
-            self._apply_local(
-                txn, msg.log_entries,
-                lambda: self.host.send_shard(
-                    msg.from_osd, MOSDRepOpReply(
-                        pgid=self.host.pgid_str,
-                        from_osd=self.host.whoami, tid=msg.tid,
-                        epoch=self.host.epoch)))
+
+            def _applied(m=msg):
+                m.stamp_hop("store_apply")
+                reply = MOSDRepOpReply(
+                    pgid=self.host.pgid_str,
+                    from_osd=self.host.whoami, tid=m.tid,
+                    epoch=self.host.epoch)
+                # ledger rides the round trip back to the primary
+                if m.hops:
+                    reply.hops = dict(m.hops)
+                reply.stamp_hop("commit_sent")
+                self.host.send_shard(m.from_osd, reply)
+            self._apply_local(txn, msg.log_entries, _applied)
             return True
         if isinstance(msg, MOSDRepOpReply):
+            # replica round-trip waterfall closes at the primary
+            msg.stamp_hop("client_complete")
+            _obs = getattr(self.host, "observe_hops", None)
+            if _obs is not None:
+                _obs(msg.hops)
             self._committed(msg.tid, msg.from_osd)
             return True
         if isinstance(msg, MOSDPGPush):

@@ -482,6 +482,15 @@ def _opts() -> List[Option]:
                description="bounded ring of recent routing/batcher/"
                            "fault events kept per OSD for "
                            "dump_flight_recorder and auto-dumps"),
+        Option("contention_stall_threshold", float, 0.05, min=0.0,
+               description="lock/condition waits at or over this many "
+                           "seconds count as stalls and are noted "
+                           "into the flight recorder"),
+        Option("osd_sampler_hz", float, 67.0, min=0.0,
+               description="wall-clock stack sampler rate for the "
+                           "process-wide profiler behind dump_profile "
+                           "(0 disables; the thread runs while any "
+                           "OSD holds it retained)"),
         Option("admin_socket", str, "",
                description="unix-socket path template for daemon admin "
                            "commands; $name expands to the daemon name "

@@ -78,6 +78,13 @@ def test_prometheus_endpoint(cl):
     assert "ceph_critpath_ops" in body
     assert "ceph_critpath_stage_encode_total" in body
     assert "ceph_critpath_bound_commit_wait" in body
+    # hop-ledger and contention subsystems likewise register at boot
+    assert 'ceph_hops_ops{daemon="osd.0"}' in body
+    assert "# TYPE ceph_hops_store_apply_hist_s histogram" in body
+    assert 'ceph_contention_stalls{daemon="osd.0"}' in body
+    assert "# TYPE ceph_contention_msgr_sendq_depth_now gauge" in body
+    assert "ceph_contention_pg_lock_wait_us_bucket" in body
+    assert "ceph_contention_batcher_cond_wait_us_bucket" in body
 
     st = json.loads(urllib.request.urlopen(
         f"http://{host}:{port}/status", timeout=5).read().decode())

@@ -38,6 +38,11 @@ import tokenize
 
 HOT_MODULES = [
     "ceph_tpu/client/striper.py",
+    # the frame codec and every typed message: the hop ledger rides
+    # here as a trailing field, and its stamping/encoding must never
+    # add a payload copy (ISSUE 7 audit)
+    "ceph_tpu/msg/message.py",
+    "ceph_tpu/msg/messages.py",
     "ceph_tpu/msg/messenger.py",
     "ceph_tpu/osd/ecbackend.py",
     "ceph_tpu/osd/batcher.py",

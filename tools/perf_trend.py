@@ -20,6 +20,10 @@ history and fails loudly on:
 - **throughput regression** — the cluster k8m4 ``vs_baseline`` write
   ratio drops below ``ratio_tol`` x the best comparable history round
   (matched on the k=8 m=4 cluster config).
+- **hop p99 regression** — a wire hop's p99 latency in the
+  attribution's ``waterfall`` block blows past the most recent
+  history round that recorded one.  History rounds predating the hop
+  ledger carry no waterfall and the check is silently skipped.
 
 History files are ``{"n", "cmd", "rc", "tail", "parsed"}`` wrappers
 around a captured bench stdout; metric records are re-extracted from
@@ -50,6 +54,8 @@ STAGE_TOL = 0.15          # absolute share-of-wall growth allowed
 RATIO_TOL = 0.8           # fresh ratio must be >= tol * best history
 MIN_DEVICE_FRACTION = 0.5  # below this the routing collapsed
 HEADLINE_DEVICE_WIN = 2.0  # codec vs_baseline that proves the device
+HOP_P99_FACTOR = 1.5       # fresh hop p99 may grow to this x history
+HOP_P99_SLACK_S = 1e-3     # ...and must also grow by this much abs.
 
 
 def _records_from_text(text: str) -> List[Dict]:
@@ -138,7 +144,8 @@ def check(attribution: Optional[Dict], history: List[Dict],
           fresh_headline_ratio: Optional[float] = None,
           stage_tol: float = STAGE_TOL,
           ratio_tol: float = RATIO_TOL,
-          min_device_fraction: float = MIN_DEVICE_FRACTION) \
+          min_device_fraction: float = MIN_DEVICE_FRACTION,
+          hop_p99_factor: float = HOP_P99_FACTOR) \
         -> List[Dict]:
     """-> findings ``[{"check", "severity", "message"}]``; empty =
     pass.  ``attribution`` is the fresh run's attribution object (may
@@ -203,6 +210,38 @@ def check(attribution: Optional[Dict], history: List[Dict],
                             f"{old_share:.0%}, tolerance "
                             f"+{stage_tol:.0%})"})
 
+    # -- per-hop p99 budget (waterfall block) -------------------------
+    # The waterfall block only exists from the hop-ledger rounds on;
+    # older history (and fresh runs with the ledger disabled) simply
+    # lack it and the check self-skips — no data is never a failure.
+    hist_wf = None
+    for rnd in reversed(history):
+        rec = _pick(rnd["records"], _ATTRIB_PREFIX)
+        if rec is not None and isinstance(rec.get("waterfall"), dict) \
+                and isinstance(rec["waterfall"].get("p99_s"), dict):
+            hist_wf = rec["waterfall"]
+            break
+    fresh_wf = (attribution or {}).get("waterfall") \
+        if attribution is not None else None
+    if isinstance(fresh_wf, dict) and hist_wf is not None:
+        old_p99 = hist_wf.get("p99_s") or {}
+        new_p99 = fresh_wf.get("p99_s") or {}
+        for hop in sorted(new_p99):
+            old = old_p99.get(hop)
+            new = new_p99.get(hop)
+            if not isinstance(old, (int, float)) \
+                    or not isinstance(new, (int, float)):
+                continue
+            if new > old * hop_p99_factor \
+                    and new - old > HOP_P99_SLACK_S:
+                findings.append({
+                    "check": "hop-p99-regression",
+                    "severity": "fail",
+                    "message":
+                        f"hop {hop!r} p99 {new * 1e3:.2f} ms > "
+                        f"{hop_p99_factor:.1f} x history "
+                        f"{old * 1e3:.2f} ms (waterfall budget)"})
+
     # -- cluster throughput ratio regression --------------------------
     if fresh_ratio is not None:
         best = None
@@ -224,7 +263,8 @@ def check(attribution: Optional[Dict], history: List[Dict],
 
 def run(fresh_records: List[Dict], history: List[Dict],
         stage_tol: float = STAGE_TOL, ratio_tol: float = RATIO_TOL,
-        min_device_fraction: float = MIN_DEVICE_FRACTION) -> int:
+        min_device_fraction: float = MIN_DEVICE_FRACTION,
+        hop_p99_factor: float = HOP_P99_FACTOR) -> int:
     att = _pick(fresh_records, _ATTRIB_PREFIX)
     cluster = _pick(fresh_records, _CLUSTER_PREFIX, _K8M4_MARK)
     headline = _pick(fresh_records, _HEADLINE_PREFIX)
@@ -242,7 +282,8 @@ def run(fresh_records: List[Dict], history: List[Dict],
         if headline and isinstance(headline.get("vs_baseline"),
                                    (int, float)) else None,
         stage_tol=stage_tol, ratio_tol=ratio_tol,
-        min_device_fraction=min_device_fraction)
+        min_device_fraction=min_device_fraction,
+        hop_p99_factor=hop_p99_factor)
     for f in findings:
         print(f"perf_trend {f['severity'].upper()} "
               f"[{f['check']}]: {f['message']}")
@@ -266,6 +307,8 @@ def main(argv=None) -> int:
     ap.add_argument("--ratio-tol", type=float, default=RATIO_TOL)
     ap.add_argument("--min-device-fraction", type=float,
                     default=MIN_DEVICE_FRACTION)
+    ap.add_argument("--hop-p99-factor", type=float,
+                    default=HOP_P99_FACTOR)
     args = ap.parse_args(argv)
     paths = args.history if args.history else default_history_paths()
     if not paths:
@@ -273,7 +316,8 @@ def main(argv=None) -> int:
         return 2
     return run(load_fresh(args.fresh), load_history(paths),
                stage_tol=args.stage_tol, ratio_tol=args.ratio_tol,
-               min_device_fraction=args.min_device_fraction)
+               min_device_fraction=args.min_device_fraction,
+               hop_p99_factor=args.hop_p99_factor)
 
 
 if __name__ == "__main__":
