@@ -639,15 +639,18 @@ def machine_factor() -> float:
 
 
 def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
-                 n_osds=3, osd_backend="classic",
+                 n_osds=3, osd_backend=None,
                  fault_spec="", fault_seed=0, mid_run_outage=False,
                  extra_conf=None):
     """One vstart-style run: write MB/s + rebuild MB/s (+ the
-    primary-side batcher's coalescing counters).  ``fault_spec`` arms
-    the process fault registry for the run (see ceph_tpu/utils/faults);
-    ``mid_run_outage`` additionally takes the device hard-down partway
-    through the write phase so the breaker opens, then restores the
-    probabilistic schedule so the probe tick can re-admit it."""
+    primary-side batcher's coalescing counters).  ``osd_backend=None``
+    takes the config default (crimson since the shard-per-core
+    flip); pass "classic"/"crimson" to pin a side of a comparison.
+    ``fault_spec`` arms the process fault registry for the run (see
+    ceph_tpu/utils/faults); ``mid_run_outage`` additionally takes the
+    device hard-down partway through the write phase so the breaker
+    opens, then restores the probabilistic schedule so the probe tick
+    can re-admit it."""
     from ceph_tpu.cluster import Cluster, test_config
     from ceph_tpu.osd.batcher import EncodeBatcher
     from ceph_tpu.utils import faults as faultlib
@@ -657,7 +660,9 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
     faultlib.registry().reset()
     EncodeBatcher.reset_breaker()
     f = machine_factor()
-    overrides = {"osd_backend": osd_backend}
+    overrides = {}
+    if osd_backend:
+        overrides["osd_backend"] = osd_backend
     if fault_spec:
         overrides.update(fault_injection=fault_spec,
                          fault_injection_seed=fault_seed)
@@ -673,8 +678,14 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         # segment of the pipelined fanout; enough PGs that a primary
         # can hold several in-flight encodes (the per-PG pipeline
         # admits one encode at a time)
+        # down->out aging must ALSO be slow here: the test default of
+        # 3 s turns any starvation-induced down mark into an out +
+        # backfill storm that snowballs (crimson heartbeats share the
+        # reactor with the data path, so they run late under load even
+        # with the interleaved-timer drain)
         overrides.update(osd_heartbeat_interval=2.0,
-                         osd_heartbeat_grace=max(12.0, 8.0 * f),
+                         osd_heartbeat_grace=max(20.0, 12.0 * f),
+                         mon_osd_down_out_interval=60.0,
                          osd_pool_default_pg_num=32,
                          ec_tpu_queue_window_us=3000)
     if plugin == "tpu":
@@ -829,7 +840,8 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                  "copy_sites": {k: v["bytes"] for k, v in
                                 snap["sites"].items()},
                  "queue_depth_hwm": 0, "window_grows": 0,
-                 "window_cuts": 0}
+                 "window_cuts": 0,
+                 "group_reqs_hwm": 0, "group_stripes_hwm": 0}
         # per-stage attribution: the batcher's cumulative stage
         # clocks (queue-wait through d2h) plus the commit leg from
         # each primary's op-tracker timeline (ec:encoded ->
@@ -850,6 +862,14 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                     getattr(b, "queue_depth_hwm", 0))
                 stats["window_grows"] += getattr(b, "window_grows", 0)
                 stats["window_cuts"] += getattr(b, "window_cuts", 0)
+                # encode-group occupancy (ISSUE 8): biggest single
+                # dispatched group, cluster-wide
+                stats["group_reqs_hwm"] = max(
+                    stats["group_reqs_hwm"],
+                    getattr(b, "group_reqs_hwm", 0))
+                stats["group_stripes_hwm"] = max(
+                    stats["group_stripes_hwm"],
+                    getattr(b, "group_stripes_hwm", 0))
                 for s in ("queue_wait", "batch_form", "h2d",
                           "device", "d2h"):
                     stages[s] += getattr(b, "stage_seconds",
@@ -872,6 +892,22 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         # write stream, cluster-wide
         from ceph_tpu.utils.critpath import merge_dumps as _cp_merge
         stats["critical_path"] = _cp_merge(critpath_dumps)
+        # shard-per-core telemetry (ISSUE 8): cross-reactor mailbox
+        # traffic + handoff counts; zeros under osd_backend=classic
+        xs = {"xshard_in": 0, "xshard_out": 0, "mailbox_hwm": 0,
+              "handoffs": 0}
+        for osd in c.osds.values():
+            for r in getattr(osd, "reactors", []):
+                xs["xshard_in"] += r.xshard_in
+                xs["xshard_out"] += r.xshard_out
+                xs["mailbox_hwm"] = max(xs["mailbox_hwm"],
+                                        r.mailbox_hwm)
+            try:
+                xs["handoffs"] += osd.perf_coll.create(
+                    "contention").get("xshard_handoff_acquires")
+            except Exception:
+                pass
+        stats["xshard"] = xs
         # cluster-path waterfall: the client saw the WHOLE hop ledger
         # on every reply (client_send .. client_complete); each
         # primary additionally saw its sub-op round trips.  Raw
@@ -929,14 +965,17 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         stats["breaker"]["device_errors"] = dev_err
         stats["breaker"]["encode_errors"] = enc_err
         stats["subwrite"] = sw
-        c.wait_for_clean(30)
+        c.wait_for_clean(max(30.0, 30.0 * f))
         victim = n_osds - 1
         c.kill_osd(victim, lose_data=True)
         c.wait_for_osd_down(victim, 30)
         c.revive_osd(victim)
         c.wait_for_osd_up(victim, 15)
         t0 = time.perf_counter()
-        c.wait_for_clean(120)
+        # machine-scaled: 13 single-core daemons rebuilding 26x8 MiB
+        # through shared reactors legitimately need more wall time on
+        # a slow box; the poll returns as soon as the cluster is clean
+        c.wait_for_clean(max(180.0, 120.0 * f))
         rebuild_s = time.perf_counter() - t0
         for key in ("dec_calls", "dec_reqs", "dec_coalesced"):
             stats[key] = 0
@@ -955,7 +994,8 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
 # written by bench_cluster_k8m4; consumed by main()'s --assert-floor
 # regression gate (and importable by the slow test)
 _FLOOR_STATS = {"cluster_k8m4_vs_baseline": None,
-                "cluster_k8m4_attribution": None}
+                "cluster_k8m4_attribution": None,
+                "cluster_scaling_clients": None}
 
 
 def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
@@ -1008,6 +1048,10 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
             "queue_depth_hwm": st.get("queue_depth_hwm", 0),
             "window_grows": st.get("window_grows", 0),
             "window_cuts": st.get("window_cuts", 0),
+            "group_occupancy": {
+                "reqs_hwm": st.get("group_reqs_hwm", 0),
+                "stripes_hwm": st.get("group_stripes_hwm", 0)},
+            "xshard": st.get("xshard", {}),
             "faults": st.get("faults", {}),
             "breaker": st.get("breaker", {}),
             "subwrite_deadlines": st.get("subwrite", {}),
@@ -1102,6 +1146,121 @@ def bench_cluster_crimson(n_objs=26, obj_bytes=8 << 20):
     }), flush=True)
 
 
+def bench_cluster_scaling(obj_bytes=512 << 10, per_client=2):
+    """Concurrency scaling ladder (ISSUE 8): the same 3-OSD k=2 m=1
+    tpu pool written by 1 / 4 / 16 / 64 CONCURRENT CLIENTS (each its
+    own Rados instance and connections, each streaming ``per_client``
+    aio writes), once under osd_backend=classic and once under
+    crimson shard-per-core.  The classic OSD funnels every client
+    into the sharded op queue + PG lock; the reactor partitioning is
+    supposed to hold throughput flat as the client count grows — the
+    16-client rung is the regression gate (tools/perf_trend.py:
+    >= 0.8x the best recorded round)."""
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.utils.hops import (merge_dumps as _hops_merge,
+                                     waterfall_block)
+    import threading
+
+    levels = (1, 4, 16, 64)
+    f = machine_factor()
+    sides = {}
+    for backend in ("classic", "crimson"):
+        side = {"clients": {}}
+        conf = test_config(osd_backend=backend,
+                           ec_tpu_queue_window_us=1000)
+        with Cluster(n_osds=3, conf=conf) as c:
+            for i in range(3):
+                c.wait_for_osd_up(i, 30)
+            c.create_ec_profile("scale", plugin="tpu", k="2", m="1")
+            c.create_pool("scalep", "erasure",
+                          erasure_code_profile="scale")
+            blob = os.urandom(obj_bytes)
+            # the client fleet is built untimed; levels reuse its
+            # prefix so each rung pays zero setup inside the clock
+            rads = [c.rados(timeout=60 * f)
+                    for _ in range(max(levels))]
+            ios = [r.open_ioctx("scalep") for r in rads]
+            ios[0].write_full("warm", blob)     # compile / prewarm
+            for n in levels:
+                errs = []
+
+                def worker(ci):
+                    try:
+                        comps = [ios[ci].aio_write_full(
+                            f"s{n}-{ci}-{j}", blob)
+                            for j in range(per_client)]
+                        for comp in comps:
+                            rc = comp.wait(120 * f)
+                            if rc != 0:
+                                errs.append(rc)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+                ts = [threading.Thread(target=worker, args=(ci,))
+                      for ci in range(n)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                wall = time.perf_counter() - t0
+                assert not errs, f"scaling rung {n} failed: {errs[:3]}"
+                side["clients"][str(n)] = round(
+                    n * per_client * obj_bytes / 2**20 / wall, 2)
+                if n == 16:
+                    # snapshot the 16-client evidence before the 64
+                    # rung smears it: client-merged hop waterfall +
+                    # the batcher's encode-group occupancy HWM
+                    wf = _hops_merge([r.objecter.hops.dump()
+                                      for r in rads[:16]])
+                    if wf.get("ops"):
+                        side["waterfall_16"] = {
+                            k2: waterfall_block(wf, wall)[k2]
+                            for k2 in ("top_hop", "shares", "p99_s",
+                                       "ops")}
+                    side["group_occupancy_16"] = {
+                        "reqs_hwm": max(
+                            getattr(o.encode_batcher,
+                                    "group_reqs_hwm", 0)
+                            for o in c.osds.values()),
+                        "stripes_hwm": max(
+                            getattr(o.encode_batcher,
+                                    "group_stripes_hwm", 0)
+                            for o in c.osds.values())}
+            xs = {"xshard_in": 0, "xshard_out": 0, "handoffs": 0}
+            for osd in c.osds.values():
+                for r in getattr(osd, "reactors", []):
+                    xs["xshard_in"] += r.xshard_in
+                    xs["xshard_out"] += r.xshard_out
+                try:
+                    xs["handoffs"] += osd.perf_coll.create(
+                        "contention").get("xshard_handoff_acquires")
+                except Exception:
+                    pass
+            side["xshard"] = xs
+        sides[backend] = side
+    cl = sides["classic"]["clients"]
+    cr = sides["crimson"]["clients"]
+    emit(f"cluster write MB/s at 16 concurrent clients (3-OSD k=2 "
+         f"m=1 tpu pool, {per_client}x{obj_bytes >> 10} KiB aio "
+         f"writes per client, osd_backend=crimson shard-per-core; "
+         f"full 1/4/16/64 ladder in the JSON record; baseline=the "
+         f"same rung on osd_backend=classic {cl['16']:.1f} MB/s)",
+         cr["16"], "MB/s", cr["16"] / cl["16"] if cl["16"] else 0.0)
+    print(json.dumps({
+        "metric": "cluster write scaling 1/4/16/64 concurrent "
+                  "clients (classic vs crimson, 3-OSD k=2 m=1; "
+                  "value = crimson 16-client MB/s)",
+        "value": cr["16"], "unit": "MB/s",
+        "vs_baseline": round(cr["16"] / cl["16"], 3)
+        if cl["16"] else 0.0,
+        "classic": sides["classic"],
+        "crimson": sides["crimson"],
+    }), flush=True)
+    # --assert-floor hands this ladder to the perf_trend scaling gate
+    _FLOOR_STATS["cluster_scaling_clients"] = cr
+
+
 def bench_cluster(n_objs=8, obj_bytes=4 << 20):
     """BASELINE config 5: 3-OSD cluster, plugin=tpu pool, 4 MiB
     `rados bench`-style writes + OSD-down rebuild, vs plugin=jerasure
@@ -1193,6 +1352,7 @@ CONFIGS = {
     "cluster": bench_cluster,
     "cluster_k8m4": bench_cluster_k8m4,
     "cluster_crimson": bench_cluster_crimson,
+    "cluster_scaling": bench_cluster_scaling,
     # NORTH STAR last: a single-line consumer reads this one, and
     # running it last maximizes the time the spread sampler has had to
     # catch a quiet tunnel window.
@@ -1290,7 +1450,9 @@ def main():
             findings = perf_trend.check(
                 _FLOOR_STATS.get("cluster_k8m4_attribution"),
                 perf_trend.load_history(hist_paths),
-                fresh_ratio=ratio)
+                fresh_ratio=ratio,
+                fresh_scaling=_FLOOR_STATS.get(
+                    "cluster_scaling_clients"))
             for fnd in findings:
                 print(f"# --assert-floor perf-trend "
                       f"{fnd['severity'].upper()} [{fnd['check']}]: "

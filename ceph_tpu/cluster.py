@@ -202,8 +202,15 @@ class Cluster:
         store.mount()
         if backend is not None:
             self.backend_overrides[osd_id] = backend
+        backend_eff = self.osd_backend(osd_id)
+        if backend_eff == "crimson" and self.conf["ms_secure_mode"]:
+            # the crimson pumps cannot drive the blocking AES-GCM
+            # record layer (CrimsonMessenger refuses); secure-mode
+            # clusters boot classic OSDs even under the crimson
+            # default — see the README migration note
+            backend_eff = "classic"
         cls: type = OSD
-        if self.osd_backend(osd_id) == "crimson":
+        if backend_eff == "crimson":
             from .crimson import CrimsonOSD
             cls = CrimsonOSD
         osd = cls(osd_id, store, self.client_mon_addrs(),

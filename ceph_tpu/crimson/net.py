@@ -22,7 +22,7 @@ but drives it from the reactor.
 from __future__ import annotations
 
 import socket
-from typing import Optional
+from typing import List, Optional
 
 from collections import deque
 
@@ -64,10 +64,15 @@ class CrimsonConnection(Connection):
         self._rbuf = bytearray()
         self._wq: deque = deque()       # pending iovecs (memoryviews)
         self._wants_write = False
+        # shard-per-core (ISSUE 8): each connection pins to ONE
+        # reactor for its whole life — its pumps and inline dispatch
+        # run there; ops for PGs owned by another shard hop over via
+        # submit_to at the dispatch layer, never by sharing the pump
+        self._reactor = msgr.pick_reactor()
 
     @property
     def reactor(self) -> Reactor:
-        return self.msgr.reactor
+        return self._reactor
 
     # -- attach / detach ---------------------------------------------------
     def _attach(self, sock, peer_name, peer_nonce, peer_in_seq):
@@ -111,7 +116,7 @@ class CrimsonConnection(Connection):
 
     def _close(self, reset: bool) -> None:
         super()._close(reset)
-        r = getattr(self.msgr, "reactor", None)
+        r = self._reactor
         if r is None:
             return
         if r.in_reactor():
@@ -284,22 +289,37 @@ class CrimsonConnection(Connection):
 
 
 class CrimsonMessenger(Messenger):
-    """``Messenger`` whose connections pump on a shared reactor.
+    """``Messenger`` whose connections pump on the OSD's reactors.
 
     Accept/handshake/reconnect threads are inherited unchanged — they
     are rare, bounded, and blocking by nature; only the steady-state
-    per-connection pumps move onto the event loop."""
+    per-connection pumps move onto the event loops.  With a shard
+    group (``reactors``), new connections are spread round-robin so
+    the frame parsing and write pumping load shares across shards;
+    each connection stays pinned to its reactor for life."""
 
     conn_class = CrimsonConnection
 
     def __init__(self, name: str, nonce: Optional[int] = None,
-                 conf=None, reactor: Optional[Reactor] = None):
+                 conf=None, reactor: Optional[Reactor] = None,
+                 reactors: Optional[List[Reactor]] = None):
         super().__init__(name, nonce=nonce, conf=conf)
-        if reactor is None:
+        if reactor is None and not reactors:
             raise ValueError("CrimsonMessenger needs a reactor")
         if self.secure_mode:
             raise ValueError(
                 "osd_backend=crimson does not support ms_secure_mode: "
                 "the AES-GCM record layer reads whole records with "
                 "blocking recv and cannot drive a non-blocking pump")
-        self.reactor = reactor
+        self.reactors: List[Reactor] = (
+            list(reactors) if reactors else [reactor])
+        self.reactor = self.reactors[0]
+        self._rr = 0
+
+    def pick_reactor(self) -> Reactor:
+        """Round-robin shard assignment for a new connection.  The
+        counter bump is GIL-atomic enough — a rare double-assignment
+        only skews the balance by one connection."""
+        r = self.reactors[self._rr % len(self.reactors)]
+        self._rr += 1
+        return r

@@ -1,25 +1,34 @@
-"""CrimsonOSD — the classic OSD's logic on a reactor data path.
+"""CrimsonOSD — the classic OSD's logic on a shard-per-core data path.
 
 Same PG/pglog/backend/scrub/recovery code, different execution model
 (reference crimson-osd reuses the osd-side protocol while replacing
 the threading): no sharded op queues, no per-shard worker threads, no
-heartbeat/tick/recovery threads.  One reactor thread runs
+heartbeat/tick/recovery threads.  N reactor threads
+(``crimson_num_reactors``, default min(cores, 4)) split the daemon
+seastar-style:
 
-  * the messenger pumps (``CrimsonConnection``) — frames decode and
-    dispatch inline;
-  * client ops as future chains: ``queued_for_pg`` marks at receipt,
-    a continuation runs the op (the OpTracker stage names of PR 1 —
-    ``queued_for_pg → reached_pg → ec:encode_queued → … → op_commit``
-    — are unchanged, so time-attribution JSON compares backends
-    directly);
-  * maintenance as timers: ``_heartbeat_once`` / ``_tick_once`` /
-    ``_recovery_scan`` are the SAME methods the classic threads call,
-    so heartbeats, mon boot/failure reporting and thrash recovery
-    behave identically by construction;
-  * the EC batcher flush as a tick hook: stripes submitted by ALL PGs
-    during a tick coalesce into one device dispatch when the tick
-    ends (``EncodeBatcher.tick_flush``) instead of each PG's stripes
-    waiting out the time window behind per-PG queue hops.
+  * **PG partitioning** — every PG is statically owned by shard
+    ``hash(pgid) % N``; its client ops, sub-ops, peering, scrub and
+    recovery work all execute on that reactor, so per-PG state is
+    effectively single-threaded and the PG lock is never contended on
+    the data path (it remains as the guard for the cross-shard
+    maintenance walkers: map advance, tick stats, log trim);
+  * **cross-shard handoff** — a message that lands on the wrong
+    reactor (connections are pinned round-robin) hops to the owner
+    via :meth:`Reactor.submit_to` over a lock-free SPSC mailbox and
+    stamps the ``xshard_handoff`` hop; sub-op dispatch, commit fanout
+    and heartbeats never take a cross-shard lock;
+  * **one shared EncodeBatcher** — all shards feed the per-OSD
+    batcher through an MPSC front (:class:`ReactorBatcher` buffers
+    each shard's submissions on its own reactor-local queue and
+    flushes them at tick end), so coalescing windows fill from every
+    PG on the daemon and the window is cut only when every shard has
+    drained — cluster traffic reaches the batched device path instead
+    of fragmenting into per-reactor singleton twin calls;
+  * maintenance as timers on shard 0: ``_heartbeat_once`` /
+    ``_tick_once`` / ``_recovery_scan`` are the SAME methods the
+    classic threads call, so heartbeats, mon boot/failure reporting
+    and thrash recovery behave identically by construction.
 
 Blocking work keeps its classic helper threads: handshakes/reconnect
 (messenger control plane), copy-from / cache promote / flush fetches
@@ -29,52 +38,130 @@ PG state is still lock-protected.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import os
+from collections import deque
+from typing import List, Optional, Tuple
 
-from ..msg.messages import MOSDOp
+from ..msg.messages import (MOSDOp, MOSDPGRemove, MOSDScrub, MRepScrub,
+                            MRepScrubMap)
 from ..msg.messenger import Connection, Messenger
-from ..osd.osd import OSD
+from ..osd.osd import _BACKEND_MSGS, _PEERING_MSGS, OSD
 from ..osd.pg import PG, PGid
 from ..store.objectstore import ObjectStore
-from ..utils.config import Config
+from ..utils.config import Config, default_config
 from .net import CrimsonMessenger
 from .reactor import Reactor
 
 
 class ReactorBatcher:
-    """Batcher facade marshalling completions onto the reactor.
+    """MPSC front for the shared per-OSD batcher.
 
-    EC backends reach the batcher via ``getattr(host, "encode_batcher")``
-    and hand it continuations that re-enter PG code; wrapping the
-    callback with ``call_soon`` makes those continuations run on the
-    reactor thread whether the encode completed on the collector
-    thread, the device callback, or inline."""
+    Every reactor shard buffers its tick's encode/decode submissions
+    on a reactor-local queue (producer and consumer are the SAME
+    thread — submission during the tick, :meth:`shard_tick` at its
+    end), then flushes them into the shared ``EncodeBatcher`` in one
+    burst.  The window cut (``tick_flush``) fires only when no OTHER
+    shard still holds buffered stripes, so a group forming across
+    shards is not chopped by the first shard to finish its tick.
 
-    def __init__(self, inner, reactor: Reactor):
+    Completion callbacks re-enter PG code, so each is marshalled back
+    onto the SUBMITTING shard's reactor — the continuation stays on
+    the PG's owning shard whether the encode completed on the
+    collector thread, the device callback, or inline."""
+
+    def __init__(self, inner, reactors: List[Reactor]):
         self._inner = inner
-        self._reactor = reactor
+        self._reactors = list(reactors)
+        self._pending: List[deque] = [deque() for _ in self._reactors]
 
-    def _marshal(self, cb):
+    def _current_shard(self) -> int:
+        for i, r in enumerate(self._reactors):
+            if r.in_reactor():
+                return i
+        return -1
+
+    def _marshal(self, cb, shard: int):
+        r = self._reactors[shard if shard >= 0 else 0]
+
         def done(result):
-            self._reactor.call_soon(cb, result)
+            r.call_soon(cb, result)
         return done
 
     def submit(self, ec_impl, sinfo, data, cb, tracked=None) -> None:
-        self._inner.submit(ec_impl, sinfo, data, self._marshal(cb),
-                           tracked=tracked)
+        shard = self._current_shard()
+        if shard < 0:
+            # foreign thread (tests, recovery helpers): straight in
+            self._inner.submit(ec_impl, sinfo, data,
+                               self._marshal(cb, 0), tracked=tracked)
+            return
+        self._pending[shard].append(
+            ("enc", (ec_impl, sinfo, data,
+                     self._marshal(cb, shard), tracked)))
 
     def submit_decode(self, ec_impl, sinfo, have, want, cb) -> None:
-        self._inner.submit_decode(ec_impl, sinfo, have, want,
-                                  self._marshal(cb))
+        shard = self._current_shard()
+        if shard < 0:
+            self._inner.submit_decode(ec_impl, sinfo, have, want,
+                                      self._marshal(cb, 0))
+            return
+        self._pending[shard].append(
+            ("dec", (ec_impl, sinfo, have, want,
+                     self._marshal(cb, shard))))
+
+    def shard_tick(self, shard: int) -> None:
+        """Tick hook for ``shard``'s reactor: flush its buffered
+        submissions, then cut the coalescing window iff every shard
+        has drained."""
+        q = self._pending[shard]
+        if q:
+            inner = self._inner
+            while True:
+                try:
+                    kind, a = q.popleft()
+                except IndexError:
+                    break               # shutdown flush raced us
+                if kind == "enc":
+                    inner.submit(a[0], a[1], a[2], a[3], tracked=a[4])
+                else:
+                    inner.submit_decode(*a)
+        for other in self._pending:
+            if other:
+                return
+        self._inner.tick_flush()
+
+    def flush_pending(self) -> None:
+        """Drain every shard's buffer from the caller's thread
+        (shutdown: the reactors may already be winding down)."""
+        for q in self._pending:
+            while True:
+                try:
+                    kind, a = q.popleft()
+                except IndexError:
+                    break
+                if kind == "enc":
+                    self._inner.submit(a[0], a[1], a[2], a[3],
+                                       tracked=a[4])
+                else:
+                    self._inner.submit_decode(*a)
+
+    def stop(self, drain: float = 30.0) -> None:
+        self.flush_pending()
+        self._inner.stop(drain=drain)
 
     def __getattr__(self, name):
-        # prewarm / prefer_cpu / tick_flush / stop / counters pass
-        # straight through
+        # prewarm / prefer_cpu / tick_flush / counters pass straight
+        # through to the shared batcher
         return getattr(self._inner, name)
 
 
+#: message types whose handling mutates one PG's state — these route
+#: to the PG's owning shard before the base dispatch logic runs
+_PG_ROUTED = _BACKEND_MSGS + _PEERING_MSGS + (
+    MOSDPGRemove, MOSDScrub, MRepScrub, MRepScrubMap)
+
+
 class CrimsonOSD(OSD):
-    """Drop-in OSD selected by ``osd_backend=crimson``.
+    """Drop-in OSD selected by ``osd_backend=crimson`` (the default).
 
     Runs in the same cluster as classic OSDs: wire protocol, maps,
     heartbeats and recovery are identical — only the intra-daemon
@@ -87,33 +174,61 @@ class CrimsonOSD(OSD):
                  mon_addr: Tuple[str, int],
                  conf: Optional[Config] = None,
                  addr: Tuple[str, int] = ("127.0.0.1", 0)):
-        # the reactor must exist before super().__init__ calls
+        conf = conf or default_config()
+        n = conf["crimson_num_reactors"] or min(os.cpu_count() or 1, 4)
+        # the reactors must exist before super().__init__ calls
         # _make_messenger
-        self.reactor = Reactor(name=f"crimson-osd{whoami}")
+        self.reactors = Reactor.group(n, name=f"crimson-osd{whoami}")
+        self.reactor = self.reactors[0]      # shard 0: maintenance +
+        self.n_reactors = n                  # single-reactor compat
         super().__init__(whoami, store, mon_addr, conf=conf, addr=addr)
         self.encode_batcher = ReactorBatcher(self.encode_batcher,
-                                             self.reactor)
+                                             self.reactors)
+        # mailbox depth + cross-shard handoff latency ride the PR 7
+        # contention subsystem (mailbox_rN_depth_now/_hwm,
+        # xshard_handoff_acquires/_wait_us)
+        self.contention.register_site("xshard_handoff")
+        for r in self.reactors:
+            site = f"mailbox_r{r.shard}"
+            self.contention.register_queue(site)
+            r.bind_contention(self.contention, site)
 
     def _make_messenger(self) -> Messenger:
         return CrimsonMessenger(f"osd.{self.whoami}", conf=self.conf,
-                                reactor=self.reactor)
+                                reactor=self.reactor,
+                                reactors=self.reactors)
 
     def _call_later(self, delay: float, fn):
         # same per-OSD hashed timer wheel as the classic backend, but
-        # the fire is marshalled onto the reactor so re-request/report
-        # continuations run on the reactor thread like every other PG
+        # the fire is marshalled onto a reactor so re-request/report
+        # continuations run on a reactor thread like every other PG
         # continuation (no extra timer threads, no cross-thread PG
         # state access from the wheel)
         return self.timer_wheel.call_later(
             delay, lambda: self.reactor.call_soon(fn))
 
+    # -- shard routing -----------------------------------------------------
+    def _shard_of(self, pgid: PGid) -> int:
+        return hash(pgid) % self.n_reactors
+
+    def _current_reactor(self) -> Optional[Reactor]:
+        for r in self.reactors:
+            if r.in_reactor():
+                return r
+        return None
+
+    def _pg_created(self, pg: PG) -> None:
+        pg.home_shard = self._shard_of(pg.pgid)
+
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> None:
         self._sampler_retain()
-        self.reactor.start()
+        for r in self.reactors:
+            r.start()
         self.msgr.start()
-        # maintenance runs as reactor timers on the SAME methods the
-        # classic threads drive, so cross-backend behavior is identical
+        # maintenance runs as shard-0 timers on the SAME methods the
+        # classic threads drive, so cross-backend behavior is
+        # identical; per-PG work they queue is routed to owner shards
         self.reactor.call_every(self.conf["osd_heartbeat_interval"],
                                 self._heartbeat_once)
         self.reactor.call_every(self.conf["osd_tick_interval"],
@@ -121,20 +236,24 @@ class CrimsonOSD(OSD):
         self.reactor.call_every(self._RECOVERY_TICK,
                                 self._drain_recovery_kick)
         # the coalescing barrier: ops processed this tick have already
-        # submitted their stripes, so cut the batch window NOW
-        self.reactor.add_tick_hook(self.encode_batcher.tick_flush)
+        # submitted their stripes, so flush each shard's MPSC buffer
+        # and cut the batch window once ALL shards have drained
+        for r in self.reactors:
+            r.add_tick_hook(
+                lambda i=r.shard: self.encode_batcher.shard_tick(i))
         self.monc.subscribe_osdmap()
         self.monc.send_boot(self.whoami, self.my_addr)
         if self.admin_socket is not None:
             self.admin_socket.start()
-        self.log.dout(1, f"booted (crimson), addr {self.my_addr}")
+        self.log.dout(1, f"booted (crimson, {self.n_reactors} "
+                         f"reactor shards), addr {self.my_addr}")
 
     def shutdown(self) -> None:
         self._stop.set()
         if self.admin_socket is not None:
             self.admin_socket.stop()
-        # drain before stopping the reactor: encode completions
-        # marshal onto it and commit chains still send over the msgr
+        # drain before stopping the reactors: encode completions
+        # marshal onto them and commit chains still send over the msgr
         self.encode_batcher.stop(
             drain=self.conf["osd_batcher_drain_timeout"])
         for q in self._shard_queues:
@@ -146,7 +265,8 @@ class CrimsonOSD(OSD):
                 pass
         self.msgr.shutdown()
         self.timer_wheel.stop()
-        self.reactor.stop()
+        for r in self.reactors:
+            r.stop()
         self._sampler_release()
         try:
             self.store.umount()
@@ -154,6 +274,29 @@ class CrimsonOSD(OSD):
             pass
 
     # -- data path ---------------------------------------------------------
+    def ms_dispatch(self, conn: Connection, msg) -> bool:
+        # PG-targeted messages run on the PG's owning shard.  MOSDOp
+        # routes via _enqueue_op below; everything else that mutates
+        # one PG hops here.  Heartbeats, commands and maps stay on
+        # whichever reactor received them — none touch PG state.
+        if self.n_reactors > 1 and isinstance(msg, _PG_ROUTED):
+            shard = self._shard_of(PGid.parse(msg.pgid))
+            cur = self._current_reactor()
+            if cur is None or cur.shard != shard:
+                # stamp before the hop so the ledger stays monotone
+                # (base dispatch re-stamps are first-stamp-wins no-ops)
+                msg.stamp_hop("dispatch_queued")
+                src = cur or self.reactors[shard]
+                src.submit_to(shard, self._dispatch_handoff, conn,
+                              msg, cur is not None)
+                return True
+        return super().ms_dispatch(conn, msg)
+
+    def _dispatch_handoff(self, conn, msg, crossed: bool) -> None:
+        if crossed:
+            msg.stamp_hop("xshard_handoff")
+        OSD.ms_dispatch(self, conn, msg)
+
     def _enqueue_op(self, conn: Connection, msg: MOSDOp) -> None:
         pgid = PGid(msg.pool, msg.pgid_seed)
         msg.tracked = self.op_tracker.create(
@@ -161,26 +304,43 @@ class CrimsonOSD(OSD):
             f"{'+'.join(op.op for op in msg.ops)})")
         msg.tracked.mark_event("queued_for_pg")
         msg.stamp_hop("pg_queued")
-        # continuation, not queue hop: the op runs later in this very
-        # tick (the ready queue drains to empty), after the reader
-        # finishes parsing whatever else the socket delivered
-        f = self.reactor.future()
-        f.then(lambda _: self._run_client_op(conn, msg))
-        f.set_result(None)
+        shard = self._shard_of(pgid)
+        cur = self._current_reactor()
+        if cur is not None and cur.shard != shard:
+            # wrong shard: lock-free mailbox handoff to the owner
+            cur.submit_to(shard, self._run_handoff_op, conn, msg)
+            return
+        # owner shard (or a foreign thread): continuation, not queue
+        # hop — the op runs later in this very tick (the ready queue
+        # drains to empty), after the reader finishes parsing whatever
+        # else the socket delivered
+        (cur or self.reactors[shard]).submit_to(
+            shard, self._run_client_op, conn, msg)
+
+    def _run_handoff_op(self, conn, msg) -> None:
+        msg.stamp_hop("xshard_handoff")
+        self._run_client_op(conn, msg)
 
     def queue_recovery_item(self, pg: PG) -> None:
         with pg.lock:
             if getattr(pg, "_recovery_queued", False):
                 return
             pg._recovery_queued = True
-        self.reactor.call_soon(self._run_recovery_item, pg)
+        self._submit_to_pg(pg, self._run_recovery_item, pg)
 
     def _queue_scrub(self, pg: PG, deep: bool) -> None:
-        self.reactor.call_soon(self._start_scrub, pg, deep)
+        self._submit_to_pg(pg, self._start_scrub, pg, deep)
+
+    def _submit_to_pg(self, pg: PG, fn, *args) -> None:
+        """Run ``fn(*args)`` on ``pg``'s owning shard, from any
+        thread."""
+        shard = self._shard_of(pg.pgid)
+        cur = self._current_reactor()
+        (cur or self.reactors[shard]).submit_to(shard, fn, *args)
 
     def kick_recovery(self) -> None:
         # peering events may kick from foreign threads (mon dispatch
-        # runs on the reactor, store completions may not)
+        # runs on a reactor, store completions may not)
         self.reactor.call_soon(self._recovery_scan)
 
     def _drain_recovery_kick(self) -> None:

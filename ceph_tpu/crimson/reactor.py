@@ -22,6 +22,17 @@ No locks guard reactor-owned state beyond the ready-queue mutex;
 everything else is touched only from the loop thread — that is the
 point of the design (reference: Seastar's shared-nothing reactor,
 crimson/common/).
+
+**Shard groups** (ISSUE 8): reactors peer into a fixed group
+(:meth:`attach_peers`), one shard id each, and cross-shard work moves
+by :meth:`submit_to` — modeled on seastar's ``smp::submit_to`` — over
+lock-free SPSC mailboxes.  Each reactor owns one inbound mailbox per
+peer shard; a mailbox has exactly one producer (the source reactor's
+thread) and one consumer (the owner's loop), so a plain ``deque``
+append/popleft pair is a correct lock-free ring under the GIL.  The
+producer wakes the target's selector only on the empty→non-empty
+transition, keeping the enqueue cost a couple of attribute loads plus
+at most one ``send()``.
 """
 from __future__ import annotations
 
@@ -30,6 +41,7 @@ import selectors
 import socket
 import threading
 import time
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
@@ -109,6 +121,16 @@ class Future:
         return nxt
 
 
+def _resolve_quiet(fut: Future, value: Any,
+                   exc: Optional[BaseException]) -> None:
+    # runs on the future's own reactor; a shutdown race may have
+    # resolved it already, which is not worth killing the loop over
+    try:
+        fut._resolve(value, exc)
+    except RuntimeError:
+        pass
+
+
 class _Timer:
     __slots__ = ("when", "seq", "fn", "args", "cancelled")
 
@@ -151,9 +173,21 @@ class Reactor:
         self._wake_r.setblocking(False)
         self._wake_w.setblocking(False)
         self._sel.register(self._wake_r, selectors.EVENT_READ, None)
+        # shard group (ISSUE 8): a lone reactor is shard 0 of itself;
+        # attach_peers() re-wires these for the N-reactor OSD
+        self.shard = 0
+        self._peers: List["Reactor"] = [self]
+        self._mailboxes: List[deque] = []
+        # telemetry sinks, wired by the OSD (utils/locks.py
+        # ContentionStats); None keeps the drain path dependency-free
+        self.contention = None
+        self.mailbox_site: Optional[str] = None
         # stats surfaced by tests / admin socket
         self.ticks = 0
         self.callbacks_run = 0
+        self.xshard_in = 0           # mailbox items this reactor ran
+        self.xshard_out = 0          # items this reactor sent away
+        self.mailbox_hwm = 0         # max inbound depth seen at drain
 
     # ------------------------------------------------------------- threads
     def start(self) -> None:
@@ -224,6 +258,97 @@ class Reactor:
 
         return _Periodic()  # type: ignore[return-value]
 
+    # ------------------------------------------------------- shard group
+    @classmethod
+    def group(cls, n: int, name: str = "reactor") -> List["Reactor"]:
+        """Build ``n`` peered reactors named ``{name}-r{i}``."""
+        peers = [cls(name=f"{name}-r{i}") for i in range(max(1, n))]
+        for r in peers:
+            r.attach_peers(peers)
+        return peers
+
+    def attach_peers(self, peers: List["Reactor"]) -> None:
+        """Join a shard group; this reactor's shard id is its index.
+        Must run before start() — mailboxes are not resizable live."""
+        self._peers = list(peers)
+        self.shard = self._peers.index(self)
+        self._mailboxes = [deque() for _ in self._peers]
+
+    def bind_contention(self, stats, site: str) -> None:
+        """Export mailbox depth (``{site}_depth_now/_hwm``) and
+        cross-shard handoff latency (``xshard_handoff_wait_us``)
+        through a ContentionStats sink."""
+        self.contention = stats
+        self.mailbox_site = site
+
+    def submit_to(self, shard: int, fn: Callable, *args) -> Future:
+        """Run ``fn(*args)`` on ``shard``'s reactor; seastar's
+        ``smp::submit_to``.  The returned future resolves on THIS
+        reactor with the call's result (or exception), so round-trip
+        continuations stay shard-local at both ends.
+
+        Fast path (calling thread IS this reactor): one lock-free
+        SPSC mailbox append + at most one wake byte.  Same-shard and
+        foreign-thread callers fall back to the locked ready queue —
+        correctness is identical, only the lock-freedom differs."""
+        fut = Future(self)
+        peers = self._peers
+        target = peers[shard] if 0 <= shard < len(peers) else self
+        if target is self:
+            self.call_soon(self._run_submitted, fn, args, fut)
+            return fut
+        if not self.in_reactor():
+            # mailboxes are SPSC — one producer per source shard; a
+            # foreign thread is not that producer
+            target.call_soon(target._run_submitted, fn, args, fut)
+            return fut
+        mb = target._mailboxes[self.shard]
+        was_empty = not mb
+        mb.append((fn, args, fut, time.monotonic()))
+        self.xshard_out += 1
+        if was_empty:
+            target._wake()
+        return fut
+
+    def _run_submitted(self, fn, args, fut: Future) -> None:
+        # target-shard half of submit_to: run, then resolve the reply
+        # future on the CALLER's reactor (its loop runs the chained
+        # callbacks; call_soon is the threadsafe edge)
+        try:
+            res = fn(*args)
+        except BaseException as e:  # noqa: BLE001 — ship to the caller
+            fut._reactor.call_soon(_resolve_quiet, fut, None, e)
+            return
+        fut._reactor.call_soon(_resolve_quiet, fut, res, None)
+
+    def _drain_mailboxes(self) -> None:
+        boxes = self._mailboxes
+        if not boxes:
+            return
+        depth = 0
+        for mb in boxes:
+            depth += len(mb)
+        if not depth:
+            return
+        if depth > self.mailbox_hwm:
+            self.mailbox_hwm = depth
+        stats = self.contention
+        if stats is not None and self.mailbox_site is not None:
+            stats.note_queue_depth(self.mailbox_site, depth)
+        now = time.monotonic()
+        for mb in boxes:
+            # bound the drain to the items present at entry; anything
+            # a producer appends mid-drain waits one tick
+            for _ in range(len(mb)):
+                try:
+                    fn, args, fut, t_enq = mb.popleft()
+                except IndexError:      # pragma: no cover — SPSC
+                    break
+                self.xshard_in += 1
+                if stats is not None:
+                    stats.on_wait("xshard_handoff", now - t_enq)
+                self._run_submitted(fn, args, fut)
+
     def future(self) -> Future:
         return Future(self)
 
@@ -286,6 +411,9 @@ class Reactor:
 
     # ---------------------------------------------------------------- loop
     def _next_timeout(self) -> float:
+        for mb in self._mailboxes:
+            if mb:
+                return 0.0
         with self._ready_lock:
             if self._ready:
                 return 0.0
@@ -327,6 +455,7 @@ class Reactor:
                 except Exception:  # noqa: BLE001 — a conn dying must not
                     pass           # take the whole reactor with it
 
+            self._drain_mailboxes()
             self._run_timers()
             self._drain_ready()
             for hook in self._tick_hooks:
@@ -365,6 +494,7 @@ class Reactor:
         # ops (encode submits, commit chains) still land in the same
         # tick and see the tick-hook flush; bounded to break livelock
         # if a callback perpetually reschedules itself
+        done = 0
         for _ in range(100):
             with self._ready_lock:
                 batch, self._ready = self._ready, []
@@ -376,6 +506,20 @@ class Reactor:
                     fn(*args)
                 except Exception:  # noqa: BLE001
                     pass
+                # timers must not wait out the whole drain: heartbeats
+                # and stats reports are reactor timers now, and under a
+                # write flood a single drain can run seconds of encode
+                # continuations — enough for the mon to declare a LIVE
+                # osd silent and mark it down.  Interleave due timers
+                # every few callbacks so daemon liveness is bounded by
+                # one callback, not one tick.  The unlocked peek at
+                # _timers[0] races only with heappush from call_later
+                # (pops happen on this thread); a stale read just means
+                # one extra or one skipped check.
+                done += 1
+                if not (done & 15) and self._timers and \
+                        self._timers[0].when <= time.monotonic():
+                    self._run_timers()
 
     def _purge_dead(self) -> None:
         for key in list(self._sel.get_map().values()):

@@ -202,6 +202,12 @@ class EncodeBatcher:
         self.window_cuts = 0         # drain-driven shrinks
         self.last_queue_depth = 0    # requests in the last dispatch
         self.queue_depth_hwm = 0
+        # encode-group occupancy (ISSUE 8): the biggest single group
+        # dispatched, in requests and stripes — the shard-per-core
+        # regression bar is "concurrent cluster writes coalesce into
+        # >=k-stripe groups, not per-PG singletons"
+        self.group_reqs_hwm = 0
+        self.group_stripes_hwm = 0
         self.bytes_copied = 0        # full-payload copies inside the
                                      # batcher (gathers/concats)
         # adaptive CPU/device routing (ec_tpu_fallback_cpu): a device
@@ -707,6 +713,11 @@ class EncodeBatcher:
             # throttle.
             groups = []
             for key, reqs in queues.items():
+                if len(reqs) > self.group_reqs_hwm:
+                    self.group_reqs_hwm = len(reqs)
+                gstripes = sum(r.nstripes for r in reqs)
+                if gstripes > self.group_stripes_hwm:
+                    self.group_stripes_hwm = gstripes
                 if key[0] == "dec":
                     groups.append((key, reqs, "dec"))
                     continue

@@ -611,8 +611,13 @@ class OSD(Dispatcher):
             if pool is None:
                 return None
             pg = PG(self.service, pgid, pool)
+            self._pg_created(pg)
             self.pgs[pgid] = pg
             return pg
+
+    def _pg_created(self, pg: PG) -> None:
+        """Backend hook on PG instantiation; the crimson OSD stamps
+        the owning reactor shard here."""
 
     def _lookup_pg(self, pgid: PGid, create: bool = True
                    ) -> Optional[PG]:

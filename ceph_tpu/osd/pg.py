@@ -96,6 +96,12 @@ class PG:
         from ..utils.locks import TimedLock
         self.lock = TimedLock("pg_lock",
                               stats=getattr(service, "contention", None))
+        # shard-per-core (crimson): the reactor shard that owns this
+        # PG's state — every client op, sub-op and recovery item for
+        # the PG executes there (hash(pgid) % n_reactors), so the
+        # lock above is uncontended on the data path.  None on the
+        # classic backend.
+        self.home_shard: Optional[int] = None
         self.state = STATE_INACTIVE
         self.up: List[Optional[int]] = []
         self.acting: List[Optional[int]] = []

@@ -45,13 +45,17 @@ def main(argv: List[str] = None) -> int:
     p.add_argument("--ec-m", type=int, default=1)
     p.add_argument("--ec-plugin", default="tpu")
     p.add_argument("--osd-backend", choices=("classic", "crimson"),
-                   default="classic",
-                   help="OSD execution model: classic sharded thread "
-                        "pools or the crimson single-threaded reactor; "
-                        "use --crimson-osds for a mixed cluster")
+                   default="crimson",
+                   help="OSD execution model (default crimson since "
+                        "the shard-per-core flip): crimson runs N "
+                        "reactor shards with PGs partitioned by "
+                        "hash(pgid) %% N; classic keeps the sharded "
+                        "thread pools; use --crimson-osds for a "
+                        "mixed cluster")
     p.add_argument("--crimson-osds", default="",
                    help="comma-separated OSD ids to run crimson while "
-                        "the rest stay classic (side-by-side compare)")
+                        "the rest follow --osd-backend (side-by-side "
+                        "compare, e.g. with --osd-backend classic)")
     p.add_argument("--out-conf", help="file to write the mon address to "
                    "(default <data-dir>/mon.addr)")
     ns = p.parse_args(argv)

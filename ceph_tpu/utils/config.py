@@ -103,13 +103,19 @@ def _opts() -> List[Option]:
                            "after characterizing the host so routing "
                            "does not depend on the learning race)"),
         # -- osd (reference options.cc:2869-2901,2478,3159) ---------------
-        Option("osd_backend", str, "classic",
+        Option("osd_backend", str, "crimson",
                enum_allowed=("classic", "crimson"),
-               description="OSD execution model: classic sharded "
-                           "thread pools, or the crimson single-"
-                           "threaded reactor (reference crimson-osd); "
-                           "both speak the same wire protocol and can "
-                           "mix within one cluster"),
+               description="OSD execution model: the crimson shard-"
+                           "per-core reactor OSD (default, reference "
+                           "crimson-osd), or the classic sharded "
+                           "thread pools; both speak the same wire "
+                           "protocol and can mix within one cluster"),
+        Option("crimson_num_reactors", int, 0, min=0,
+               description="reactor shards per crimson OSD; PGs are "
+                           "statically partitioned across shards by "
+                           "hash(pgid) mod N and cross-shard work "
+                           "moves over SPSC mailboxes (seastar "
+                           "submit_to).  0 = min(cores, 4)"),
         Option("osd_op_num_shards", int, 5, min=1,
                description="sharded op queue shard count"),
         Option("osd_op_queue", str, "mclock_scheduler",

@@ -34,8 +34,21 @@ HOP_ORDER = (
     "store_apply",      # local store transaction committed
     "commit_sent",      # reply queued back toward the sender
     "client_complete",  # sender observed the commit/completion
+    "xshard_handoff",   # op landed on its PG's owning reactor shard
 )
 HOP_ID: Dict[str, int] = {name: i for i, name in enumerate(HOP_ORDER)}
+
+#: path-position order for interval charging.  HOP_ORDER is wire
+#: format and append-only, so a hop added later (xshard_handoff, wire
+#: id 10) cannot be renumbered into its true position; this tuple is
+#: presentation-only and places each hop where it happens on the
+#: path: the cross-shard mailbox handoff sits between the op being
+#: queued for its PG and the PG logic running.
+CHARGE_ORDER = (
+    "client_send", "msgr_enqueue", "wire_sent", "recv",
+    "dispatch_queued", "pg_queued", "xshard_handoff", "pg_locked",
+    "store_apply", "commit_sent", "client_complete",
+)
 
 #: log-spaced histogram bounds (seconds) for per-hop intervals: the
 #: interesting range spans ~50 us (lock handoff) to seconds (stalls)
@@ -84,13 +97,13 @@ def decode_ledger(d) -> Optional[Dict[str, float]]:
 
 def charge(hops: Dict[str, float]):
     """-> list of (hop_name, interval_seconds) charging each interval
-    to the hop that ends it, iterating hops in canonical order and
+    to the hop that ends it, iterating hops in path order and
     skipping absent ones (a hop a path never visits — e.g. pg_queued
     on a sub-write — charges nothing; its time folds into the next
     present hop, keeping the per-op sum exact)."""
     prev = None
     out = []
-    for name in HOP_ORDER:
+    for name in CHARGE_ORDER:
         t = hops.get(name)
         if t is None:
             continue

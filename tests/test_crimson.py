@@ -377,7 +377,10 @@ def test_crimson_cluster_replicated_and_ec_io():
 
 
 def test_mixed_cluster_classic_and_crimson_side_by_side():
-    conf = make_conf()                 # default classic
+    # ISSUE 8 flipped the default to crimson, so the mixed-cluster
+    # case is now classic-by-override: pin the conf back to classic
+    # and promote one OSD
+    conf = make_conf(osd_backend="classic")
     c = Cluster(n_osds=3, conf=conf)
     c.backend_overrides[1] = "crimson"
     with c:
@@ -393,6 +396,43 @@ def test_mixed_cluster_classic_and_crimson_side_by_side():
             io.write_full(f"o{i}", bytes([i]) * 8192)
         for i in range(8):
             assert io.read(f"o{i}") == bytes([i]) * 8192
+
+
+def test_crimson_is_the_default_backend():
+    """ISSUE 8: a cluster built with NO backend override boots
+    crimson OSDs — and boot/heartbeat/IO behave like they always did
+    (the parity bar for flipping the vstart default)."""
+    with Cluster(n_osds=3, conf=make_conf()) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        assert all(type(o) is CrimsonOSD for o in c.osds.values())
+        c.create_pool("dp", "replicated")
+        io = c.rados().open_ioctx("dp")
+        io.write_full("obj", b"default" * 64)
+        assert io.read("obj") == b"default" * 64
+
+
+def test_crimson_default_kill_revive_recovery_parity():
+    """Crimson-default recovery parity: kill an OSD under the default
+    conf, confirm peers report it down, revive, and rebuild to clean
+    (the classic-thread maintenance path, now on reactor timers, must
+    drive the same outcome)."""
+    with Cluster(n_osds=3, conf=make_conf()) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        c.create_pool("rp", "replicated", size=2)
+        io = c.rados().open_ioctx("rp")
+        for i in range(8):
+            io.write_full(f"o{i}", bytes([i]) * 4096)
+        c.wait_for_clean(30)
+        c.kill_osd(2)
+        c.wait_for_osd_down(2, 30)
+        c.revive_osd(2)
+        assert type(c.osds[2]) is CrimsonOSD
+        c.wait_for_osd_up(2, 15)
+        c.wait_for_clean(60)
+        for i in range(8):
+            assert io.read(f"o{i}") == bytes([i]) * 4096
 
 
 def test_crimson_osd_down_detection_and_rebuild():

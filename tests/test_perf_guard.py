@@ -184,3 +184,42 @@ def test_critpath_observe_is_cheap():
         f"critical-path observe costs {cost * 1e6:.2f}us/op " \
         f"(ceiling {CRITPATH_OBSERVE_CEILING * 1e6:.0f}us)"
     assert accum.dump()["ops"] > N
+
+
+def test_submit_to_enqueue_is_cheap():
+    """ISSUE 8: the cross-shard mailbox enqueue is the per-op cost of
+    PG-to-reactor partitioning — a couple of attribute loads, one
+    deque append, and (amortized to ~nothing here) a wake byte.  It
+    must stay lock-free cheap or shard routing eats the win."""
+    from ceph_tpu.crimson.reactor import Reactor
+
+    peers = Reactor.group(2, name="pg-guard")
+    # measure ON shard 0's thread — that is the SPSC fast path; the
+    # target is never started, so nothing drains and the wake fires
+    # only on the first (empty->non-empty) append
+    peers[0].start()
+    try:
+        out = []
+        import threading
+        done = threading.Event()
+
+        def measure():
+            r0 = peers[0]
+            t0 = time.perf_counter()
+            for _ in range(N):
+                r0.submit_to(1, _noop)
+            out.append((time.perf_counter() - t0) / N)
+            done.set()
+
+        peers[0].call_soon(measure)
+        assert done.wait(30)
+        cost = out[0]
+        assert cost < 20e-6, \
+            f"submit_to enqueue costs {cost * 1e6:.2f}us/op " \
+            f"(ceiling 20us)"
+    finally:
+        peers[0].stop()
+
+
+def _noop():
+    pass

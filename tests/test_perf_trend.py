@@ -136,3 +136,50 @@ def test_no_data_exits_2(tmp_path, history):
     assert paths, "BENCH_r0*.json history missing from the repo"
     rounds = perf_trend.load_history(paths)
     assert any(r2["records"] for r2 in rounds)
+
+
+def _scaling(mbps16, clients=None):
+    cl = clients or {"1": 60.0, "4": 55.0, "16": mbps16, "64": 30.0}
+    return {"metric": "cluster write scaling 1/4/16/64 concurrent "
+                      "clients (classic vs crimson, 3-OSD k=2 m=1; "
+                      "value = crimson 16-client MB/s)",
+            "value": cl["16"], "unit": "MB/s", "vs_baseline": 2.5,
+            "classic": {"clients": {"16": cl["16"] / 2.5}},
+            "crimson": {"clients": cl}}
+
+
+def test_scaling_gate_skips_without_history(history):
+    """Rounds predating the cluster_scaling ladder must not fail the
+    gate (ISSUE 8 self-skip contract)."""
+    findings = perf_trend.check(
+        None, perf_trend.load_history(history),
+        fresh_scaling={"16": 1.0})
+    assert not [f for f in findings
+                if f["check"] == "scaling-regression"]
+
+
+def test_scaling_gate_fails_on_16_client_regression(tmp_path,
+                                                    history):
+    hist = history + [_hist_round(tmp_path, 3, [_scaling(42.0)])]
+    findings = perf_trend.check(
+        None, perf_trend.load_history(hist),
+        fresh_scaling={"16": 20.0})         # < 0.8 x 42.0
+    assert [f for f in findings
+            if f["check"] == "scaling-regression"]
+    # at tolerance, it passes
+    findings = perf_trend.check(
+        None, perf_trend.load_history(hist),
+        fresh_scaling={"16": 40.0})         # >= 0.8 x 42.0
+    assert not findings
+
+
+def test_scaling_gate_runs_from_cli_fresh_records(tmp_path, history):
+    hist = history + [_hist_round(tmp_path, 3, [_scaling(42.0)])]
+    good = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                         "commit": 3.0}, 0.95)
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.0), _cluster(1.0), good, _scaling(18.0))))
+    r = _run_cli(fresh, hist)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "scaling-regression" in r.stdout

@@ -1,0 +1,338 @@
+"""Shard-per-core OSD (ISSUE 8): reactor groups, submit_to handoff,
+mailbox wakeup/telemetry, the shared-batcher MPSC front, and PG→shard
+affinity on a live crimson cluster.
+
+The contract under test: cross-shard work moves over lock-free SPSC
+mailboxes with FIFO per source→target pair and the reply future
+resolving on the CALLER's reactor; an idle target wakes immediately
+(no polling latency); every PG-targeted op executes on the reactor
+``hash(pgid) % N`` owns, stamping ``xshard_handoff`` when it had to
+hop; and all shards feed ONE EncodeBatcher whose completion callbacks
+marshal back to the submitting shard.
+"""
+import threading
+import time
+
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
+from ceph_tpu.crimson import CrimsonOSD, Reactor
+from ceph_tpu.crimson.osd import ReactorBatcher
+from ceph_tpu.osd.pg import PG
+from ceph_tpu.utils.locks import ContentionStats
+from ceph_tpu.utils.perf import PerfCountersCollection
+
+
+def _start_group(n, name="tshard"):
+    peers = Reactor.group(n, name=name)
+    for r in peers:
+        r.start()
+    return peers
+
+
+def _stop_group(peers):
+    for r in peers:
+        r.stop()
+
+
+# ------------------------------------------------------------ submit_to
+def test_group_wiring_shard_ids_and_mailboxes():
+    peers = Reactor.group(3, name="g")
+    assert [r.shard for r in peers] == [0, 1, 2]
+    for r in peers:
+        assert r._peers == peers
+        # one inbound SPSC mailbox per peer shard
+        assert len(r._mailboxes) == 3
+    # a lone reactor is shard 0 of a group of itself
+    lone = Reactor()
+    assert lone.shard == 0 and lone._peers == [lone]
+
+
+def test_submit_to_round_trip_fifo_and_reply_shard():
+    """Items submitted r0→r1 run FIFO on shard 1's thread; each reply
+    future resolves back on shard 0's thread, in submission order."""
+    peers = _start_group(2)
+    try:
+        ran, resolved = [], []
+        done = threading.Event()
+
+        def work(i):
+            ran.append((i, threading.current_thread().name))
+            return i * 10
+
+        def kick():
+            for i in range(8):
+                fut = peers[0].submit_to(1, work, i)
+                fut.add_done_callback(
+                    lambda f: (resolved.append(
+                        (f.result(), threading.current_thread().name)),
+                        done.set() if len(resolved) == 8 else None))
+
+        peers[0].call_soon(kick)
+        assert done.wait(5)
+        assert [i for i, _ in ran] == list(range(8)), "target FIFO"
+        assert all(name == "tshard-r1" for _, name in ran)
+        assert [v for v, _ in resolved] == [i * 10 for i in range(8)]
+        assert all(name == "tshard-r0" for _, name in resolved)
+        assert peers[0].xshard_out == 8 and peers[1].xshard_in == 8
+    finally:
+        _stop_group(peers)
+
+
+def test_submit_to_exception_travels_back_to_caller():
+    peers = _start_group(2)
+    try:
+        got = []
+        done = threading.Event()
+
+        def boom():
+            raise ValueError("shard says no")
+
+        def kick():
+            peers[0].submit_to(1, boom).add_done_callback(
+                lambda f: (got.append(f.exception()), done.set()))
+
+        peers[0].call_soon(kick)
+        assert done.wait(5)
+        assert isinstance(got[0], ValueError)
+    finally:
+        _stop_group(peers)
+
+
+def test_submit_to_same_shard_and_foreign_thread():
+    peers = _start_group(2)
+    try:
+        # same shard: plain continuation, still resolves
+        done = threading.Event()
+        peers[0].call_soon(
+            lambda: peers[0].submit_to(0, lambda: 7).add_done_callback(
+                lambda f: done.set() if f.result() == 7 else None))
+        assert done.wait(5)
+        # foreign thread (this test) is not any shard's SPSC producer:
+        # falls back to the locked ready queue, same semantics
+        fut = peers[0].submit_to(1, lambda: threading.current_thread().name)
+        deadline = time.monotonic() + 5
+        while not fut.done() and time.monotonic() < deadline:
+            time.sleep(0.001)
+        assert fut.result() == "tshard-r1"
+        # the fallback never touched a mailbox
+        assert peers[1].xshard_in == 0
+    finally:
+        _stop_group(peers)
+
+
+def test_mailbox_wakes_a_sleeping_reactor():
+    """An idle target must pop out of its selector wait on the
+    empty→non-empty mailbox transition — round-trip latency is far
+    below one _IDLE_WAIT (0.05 s), let alone the two a polling drain
+    would cost."""
+    peers = _start_group(2)
+    try:
+        time.sleep(0.2)          # both reactors deep in idle waits
+        best = None
+        for _ in range(5):
+            done = threading.Event()
+
+            def kick():
+                t0 = time.monotonic()
+                peers[0].submit_to(1, lambda: None).add_done_callback(
+                    lambda f: (durations.append(time.monotonic() - t0),
+                               done.set()))
+
+            durations = []
+            peers[0].call_soon(kick)
+            assert done.wait(5)
+            best = durations[0] if best is None else min(best,
+                                                         durations[0])
+            time.sleep(0.06)     # let them go idle again
+        assert best < 0.045, f"no wakeup: best round-trip {best:.3f}s"
+    finally:
+        _stop_group(peers)
+
+
+def test_mailbox_telemetry_depth_and_handoff_latency():
+    """bind_contention surfaces mailbox depth gauges and the
+    xshard_handoff wait histogram through the PR 7 contention
+    subsystem."""
+    coll = PerfCountersCollection()
+    st = ContentionStats(perf_coll=coll)
+    st.register_site("xshard_handoff")
+    peers = Reactor.group(2, name="tm")
+    for r in peers:
+        site = f"mailbox_r{r.shard}"
+        st.register_queue(site)
+        r.bind_contention(st, site)
+        r.start()
+    try:
+        done = threading.Event()
+
+        def kick():
+            futs = [peers[0].submit_to(1, lambda: None)
+                    for _ in range(6)]
+            futs[-1].add_done_callback(lambda f: done.set())
+
+        peers[0].call_soon(kick)
+        assert done.wait(5)
+        cp = coll.create("contention")
+        assert cp.get("xshard_handoff_acquires") == 6
+        assert sum(cp.dump()["xshard_handoff_wait_us"]["buckets"]) == 6
+        # all 6 were appended in one callback, so the drain saw a
+        # multi-item mailbox at least once
+        assert cp.get("mailbox_r1_depth_hwm") >= 2
+        assert peers[1].mailbox_hwm >= 2
+    finally:
+        _stop_group(peers)
+
+
+# ------------------------------------------------------- ReactorBatcher
+class _FakeBatcher:
+    """Records submissions + window cuts; completes inline."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.submits = []
+        self.decodes = []
+        self.flushes = 0
+
+    def submit(self, ec_impl, sinfo, data, cb, tracked=None):
+        with self.lock:
+            self.submits.append(data)
+        cb(("encoded", data))
+
+    def submit_decode(self, ec_impl, sinfo, have, want, cb):
+        with self.lock:
+            self.decodes.append(want)
+        cb(("decoded", want))
+
+    def tick_flush(self):
+        with self.lock:
+            self.flushes += 1
+
+    def stop(self, drain=30.0):
+        pass
+
+
+def test_reactor_batcher_marshals_completion_to_submitting_shard():
+    peers = _start_group(2, name="tb")
+    inner = _FakeBatcher()
+    rb = ReactorBatcher(inner, peers)
+    for r in peers:
+        r.add_tick_hook(lambda i=r.shard: rb.shard_tick(i))
+    try:
+        results = []
+        done = threading.Event()
+
+        def submit_from(shard, tag):
+            def cb(result):
+                results.append(
+                    (tag, threading.current_thread().name))
+                if len(results) == 2:
+                    done.set()
+            rb.submit(None, None, tag, cb)
+
+        peers[0].call_soon(submit_from, 0, "s0")
+        peers[1].call_soon(submit_from, 1, "s1")
+        assert done.wait(5)
+        # both shards' stripes reached the ONE shared inner batcher
+        assert sorted(inner.submits) == ["s0", "s1"]
+        # each completion ran on its submitting shard's reactor
+        shards = dict(results)
+        assert shards["s0"] == "tb-r0" and shards["s1"] == "tb-r1"
+        assert inner.flushes > 0, "window cut after shards drained"
+    finally:
+        _stop_group(peers)
+
+
+def test_reactor_batcher_foreign_thread_passthrough_and_flush():
+    peers = Reactor.group(2, name="tf")      # never started
+    inner = _FakeBatcher()
+    rb = ReactorBatcher(inner, peers)
+    got = []
+    rb.submit(None, None, "direct", got.append)
+    # foreign submit went straight through; cb marshalled to shard 0
+    assert inner.submits == ["direct"]
+    # buffered work (simulated: stuff the pending queue) drains via
+    # flush_pending from a non-reactor thread at shutdown
+    rb._pending[1].append(("enc", (None, None, "late",
+                                   lambda r: got.append(r), None)))
+    rb.flush_pending()
+    assert inner.submits == ["direct", "late"]
+    assert not rb._pending[1]
+
+
+# -------------------------------------------------------- live cluster
+def test_cluster_pg_to_reactor_affinity(monkeypatch):
+    """Every client op executes on the reactor shard that owns its PG
+    (thread name suffix ``-r{hash(pgid) % N}``), wrong-shard arrivals
+    hop through the mailboxes, and the handoff surfaces in the
+    contention counters."""
+    seen = []
+    orig = PG.do_request
+
+    def spy(self, msg, conn):
+        seen.append((threading.current_thread().name, self.home_shard))
+        return orig(self, msg, conn)
+
+    monkeypatch.setattr(PG, "do_request", spy)
+    conf = make_conf(osd_backend="crimson", crimson_num_reactors=2)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        assert all(type(o) is CrimsonOSD and o.n_reactors == 2
+                   for o in c.osds.values())
+        c.create_ec_profile("ps", plugin="tpu", k="2", m="1")
+        c.create_pool("shardp", "erasure", erasure_code_profile="ps")
+        io = c.rados().open_ioctx("shardp")
+        cs = [io.aio_write_full(f"o{i}", bytes([i]) * 16384)
+              for i in range(16)]
+        for comp in cs:
+            assert comp.wait(30) == 0
+        assert len(seen) >= 16
+        for name, home in seen:
+            assert home is not None
+            assert name.endswith(f"-r{home}"), \
+                f"op ran on {name}, PG owned by shard {home}"
+        # with round-robin connection pinning and 2 shards, some ops
+        # landed on the wrong reactor and crossed a mailbox
+        hops = sum(o.perf_coll.create("contention")
+                   .get("xshard_handoff_acquires")
+                   for o in c.osds.values())
+        xin = sum(r.xshard_in for o in c.osds.values()
+                  for r in o.reactors)
+        assert hops > 0 and xin > 0
+        for i in range(16):
+            assert io.read(f"o{i}") == bytes([i]) * 16384
+
+
+def test_concurrent_cluster_writes_coalesce_multi_stripe_groups():
+    """The shared-batcher regression bar: concurrent cluster writes
+    from many PGs (and both reactor shards) must dispatch as
+    multi-request, >=k-stripe encode groups — not fragment into
+    per-PG singleton calls."""
+    import os as _os
+    conf = make_conf(osd_backend="crimson", crimson_num_reactors=2,
+                     ec_tpu_queue_window_us=5000)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        k = 2
+        c.create_ec_profile("pc", plugin="tpu", k=str(k), m="1")
+        c.create_pool("coalp", "erasure", erasure_code_profile="pc")
+        io = c.rados().open_ioctx("coalp")
+        blob = _os.urandom(64 << 10)
+        cs = [io.aio_write_full(f"o{i}", blob) for i in range(32)]
+        for comp in cs:
+            assert comp.wait(30) == 0
+        greqs = max(o.encode_batcher.group_reqs_hwm
+                    for o in c.osds.values())
+        gstripes = max(o.encode_batcher.group_stripes_hwm
+                       for o in c.osds.values())
+        coalesced = sum(o.encode_batcher.reqs_coalesced
+                        for o in c.osds.values())
+        assert greqs >= 2, "no cross-op group formed"
+        assert gstripes >= k, \
+            f"largest group only {gstripes} stripes (< k={k})"
+        assert coalesced >= 2
+        for i in range(4):
+            assert io.read(f"o{i}") == blob

@@ -21,9 +21,10 @@ from ceph_tpu.msg.message import (HEADER_LEN, decode_frame_body,
                                   decode_frame_header, encode_frame)
 from ceph_tpu.msg.messenger import Dispatcher
 from ceph_tpu.utils.encoding import Decoder, Encoder
-from ceph_tpu.utils.hops import (HOP_BOUNDS, HOP_ORDER, HopAccum,
-                                 charge, decode_ledger, encode_ledger,
-                                 merge_dumps, waterfall_block)
+from ceph_tpu.utils.hops import (CHARGE_ORDER, HOP_BOUNDS, HOP_ORDER,
+                                 HopAccum, charge, decode_ledger,
+                                 encode_ledger, merge_dumps,
+                                 waterfall_block)
 
 
 def _carriers():
@@ -319,8 +320,11 @@ def _write_and_wall(c, pool, n=8, size=8192):
 def _assert_waterfall(c, rad, wall, n):
     d = rad.objecter.hops.dump()
     assert d["ops"] >= n
-    # the end-to-end MOSDOp path visits every hop after client_send
-    assert set(d["hop_counts"]) >= set(HOP_ORDER[1:])
+    # the end-to-end MOSDOp path visits every hop after client_send;
+    # xshard_handoff is conditional — it only appears when an op
+    # lands on a reactor shard that doesn't own its PG
+    assert set(d["hop_counts"]) >= \
+        set(HOP_ORDER[1:]) - {"xshard_handoff"}
     # exactness: charged op-seconds are each op's own wall; serial
     # writes keep their sum within the measured client wall (slack for
     # time.time granularity and the final reply race)
@@ -483,3 +487,39 @@ def test_timed_lock_counts_and_stall_flight_recording():
     st.note_queue_depth("q", 3)
     st.note_queue_depth("q", 1)
     assert cp.get("q_depth_now") == 1 and cp.get("q_depth_hwm") == 3
+
+
+# ---------------------------------------------------------------- ISSUE 8
+
+
+def test_xshard_hop_wire_id_stable():
+    """xshard_handoff was appended to HOP_ORDER after the ledger
+    shipped: its wire id (list index) is 10, forever — the wire tuple
+    is append-only, and CHARGE_ORDER exists precisely so the hop can
+    still sit at its true path position."""
+    assert HOP_ORDER.index("xshard_handoff") == 10
+    assert set(CHARGE_ORDER) == set(HOP_ORDER)
+    # presentation order: the mailbox handoff happens after the op is
+    # queued for its PG and before the PG logic runs
+    i = CHARGE_ORDER.index
+    assert i("pg_queued") < i("xshard_handoff") < i("pg_locked")
+
+
+def test_charge_places_xshard_between_queue_and_lock():
+    """A ledger with a cross-shard handoff charges the mailbox dwell
+    to xshard_handoff and only the post-handoff wait to pg_locked,
+    with the exactness invariant intact."""
+    hops = {"client_send": 0.0, "msgr_enqueue": 0.001,
+            "wire_sent": 0.002, "recv": 0.010,
+            "dispatch_queued": 0.011, "pg_queued": 0.012,
+            "xshard_handoff": 0.030, "pg_locked": 0.031,
+            "store_apply": 0.090, "commit_sent": 0.091,
+            "client_complete": 0.100}
+    charged = dict(charge(hops))
+    assert charged["xshard_handoff"] == pytest.approx(0.018)
+    assert charged["pg_locked"] == pytest.approx(0.001)
+    assert sum(charged.values()) == pytest.approx(0.100)
+    # and it round-trips the wire like any other hop
+    e = Encoder()
+    encode_ledger(e, hops)
+    assert decode_ledger(Decoder(e.build())) == hops

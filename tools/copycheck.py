@@ -51,6 +51,11 @@ HOT_MODULES = [
     # its payload through here, so a stray bytes()/tobytes() would
     # silently double the host-side cost of every device call
     "ceph_tpu/ops/jax_engine.py",
+    # the shard-per-core hot path (ISSUE 8): every cross-shard op
+    # crosses the mailbox enqueue/drain, and every encode submission
+    # crosses the MPSC batcher front — both must stay copy-free
+    "ceph_tpu/crimson/reactor.py",
+    "ceph_tpu/crimson/osd.py",
 ]
 
 # constructs that materialise a full payload copy

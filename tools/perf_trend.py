@@ -47,6 +47,7 @@ from typing import Dict, List, Optional
 _ATTRIB_PREFIX = "cluster k8m4 write per-stage time attribution"
 _CLUSTER_PREFIX = "cluster write MB/s"
 _HEADLINE_PREFIX = "EC encode GiB/s at the codec boundary"
+_SCALING_PREFIX = "cluster write scaling"
 _K8M4_MARK = "k=8 m=4"
 
 # defaults, overridable from the CLI
@@ -56,6 +57,7 @@ MIN_DEVICE_FRACTION = 0.5  # below this the routing collapsed
 HEADLINE_DEVICE_WIN = 2.0  # codec vs_baseline that proves the device
 HOP_P99_FACTOR = 1.5       # fresh hop p99 may grow to this x history
 HOP_P99_SLACK_S = 1e-3     # ...and must also grow by this much abs.
+SCALING_TOL = 0.8          # 16-client MB/s >= tol * best history
 
 
 def _records_from_text(text: str) -> List[Dict]:
@@ -142,17 +144,23 @@ def load_fresh(path: str) -> List[Dict]:
 def check(attribution: Optional[Dict], history: List[Dict],
           fresh_ratio: Optional[float] = None,
           fresh_headline_ratio: Optional[float] = None,
+          fresh_scaling: Optional[Dict] = None,
           stage_tol: float = STAGE_TOL,
           ratio_tol: float = RATIO_TOL,
           min_device_fraction: float = MIN_DEVICE_FRACTION,
-          hop_p99_factor: float = HOP_P99_FACTOR) \
+          hop_p99_factor: float = HOP_P99_FACTOR,
+          scaling_tol: float = SCALING_TOL) \
         -> List[Dict]:
     """-> findings ``[{"check", "severity", "message"}]``; empty =
     pass.  ``attribution`` is the fresh run's attribution object (may
     be None — only the ratio check can then run); ``fresh_ratio`` the
     fresh cluster-write vs_baseline; ``fresh_headline_ratio`` the
     fresh codec-boundary vs_baseline (device proof for the collapse
-    check when no calibration pin was recorded)."""
+    check when no calibration pin was recorded); ``fresh_scaling``
+    the crimson client-ladder dict ({"1": MB/s, ...}) from the
+    cluster_scaling config — compared at the 16-client rung against
+    the best history round that recorded one (rounds predating the
+    ladder silently skip the check)."""
     findings: List[Dict] = []
 
     # -- routing collapse (the r05 signature) -------------------------
@@ -258,6 +266,30 @@ def check(attribution: Optional[Dict], history: List[Dict],
                     f"cluster k8m4 write at {fresh_ratio:.3f}x "
                     f"baseline < {ratio_tol:.2f} x best history "
                     f"{best:.3f}x"})
+
+    # -- concurrency-scaling regression (16-client rung) --------------
+    # History rounds predating the cluster_scaling ladder record no
+    # scaling metric; the check self-skips until one exists.
+    if fresh_scaling:
+        fresh16 = fresh_scaling.get("16")
+        best16 = None
+        for rnd in history:
+            rec = _pick(rnd["records"], _SCALING_PREFIX)
+            if rec is None:
+                continue
+            v = ((rec.get("crimson") or {}).get("clients")
+                 or {}).get("16")
+            if isinstance(v, (int, float)):
+                best16 = v if best16 is None else max(best16, v)
+        if isinstance(fresh16, (int, float)) and best16 is not None \
+                and fresh16 < scaling_tol * best16:
+            findings.append({
+                "check": "scaling-regression", "severity": "fail",
+                "message":
+                    f"16-client cluster write at {fresh16:.1f} MB/s "
+                    f"< {scaling_tol:.2f} x best history "
+                    f"{best16:.1f} MB/s (shard-per-core concurrency "
+                    f"ladder)"})
     return findings
 
 
@@ -268,6 +300,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
     att = _pick(fresh_records, _ATTRIB_PREFIX)
     cluster = _pick(fresh_records, _CLUSTER_PREFIX, _K8M4_MARK)
     headline = _pick(fresh_records, _HEADLINE_PREFIX)
+    scaling = _pick(fresh_records, _SCALING_PREFIX)
     if att is None and cluster is None:
         print("perf_trend: fresh input carries neither an "
               "attribution object nor a k8m4 cluster metric",
@@ -281,6 +314,8 @@ def run(fresh_records: List[Dict], history: List[Dict],
         fresh_headline_ratio=float(headline["vs_baseline"])
         if headline and isinstance(headline.get("vs_baseline"),
                                    (int, float)) else None,
+        fresh_scaling=((scaling.get("crimson") or {}).get("clients")
+                       if scaling else None),
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
         hop_p99_factor=hop_p99_factor)
