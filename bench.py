@@ -824,8 +824,12 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                 io.write_full(f"chaos{i}", blob[:256 << 10])
             regi.disarm(faultlib.STORE_APPLY)
             if fault_spec and "device.dispatch" in fault_spec:
+                # deterministic periodic (every=) rather than
+                # Bernoulli (one_in=): the rebuild's decode dispatches
+                # must trip >=1 fault so chaos_soak's recovery-class
+                # SLO burn assertion is not a coin flip
                 regi.arm(faultlib.DEVICE_DISPATCH, mode="error",
-                         one_in=20)
+                         every=20)
             else:
                 regi.disarm(faultlib.DEVICE_DISPATCH)
             EncodeBatcher._probe_tick = -1
@@ -965,6 +969,18 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         stats["breaker"]["device_errors"] = dev_err
         stats["breaker"]["encode_errors"] = enc_err
         stats["subwrite"] = sw
+        # -- timed read-back (ISSUE 9): every object back through the
+        # MOSDOp read path; the client's read-side hop accumulator is
+        # the `read_waterfall` attribution source, the merged OSD view
+        # carries the shard_read/decode hops
+        t0 = time.perf_counter()
+        rcomps = [io.aio_read(f"b{i}") for i in range(n_objs)]
+        assert all(cp.wait(60 * f) == 0 for cp in rcomps)
+        stats["read_wall_s"] = time.perf_counter() - t0
+        stats["hops_client_read"] = rad.objecter.hops_read.dump()
+        stats["hops_read_osd"] = _hops_merge(
+            [osd.hops_read.dump() for osd in c.osds.values()
+             if getattr(osd, "hops_read", None) is not None])
         c.wait_for_clean(max(30.0, 30.0 * f))
         victim = n_osds - 1
         c.kill_osd(victim, lose_data=True)
@@ -985,6 +1001,20 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                 stats["dec_calls"] += b.dec_calls
                 stats["dec_reqs"] += b.dec_reqs
                 stats["dec_coalesced"] += b.dec_coalesced
+        # recovery-side waterfall: push/pull round trips + decode
+        # windows + scrub, accumulated on each OSD's hops_recovery
+        # during the rebuild just measured
+        stats["rebuild_wall_s"] = rebuild_s
+        stats["hops_recovery"] = _hops_merge(
+            [osd.hops_recovery.dump() for osd in c.osds.values()
+             if getattr(osd, "hops_recovery", None) is not None])
+        # cluster SLO view (ISSUE 9): per-class burn merged across
+        # every OSD's engine; chaos_soak asserts zero burn fault-free
+        # and nonzero recovery burn under the fault schedule
+        from ceph_tpu.mgr.slo import SLOEngine as _SLO
+        stats["slo"] = _SLO.merge_dumps(
+            [osd.slo.dump() for osd in c.osds.values()
+             if getattr(osd, "slo", None) is not None])
         total_mb = n_objs * obj_bytes / 2**20
         # the rebuild recovers the warmup objects too: count them
         rebuilt_mb = (n_objs + 2) * obj_bytes / 2**20
@@ -1069,6 +1099,24 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
                 ("ops", "p50_s", "p99_s")} \
                 if st.get("hops_subops") else {}
             att_obj["waterfall"] = wf
+        # read/recovery waterfalls (ISSUE 9): the client's read-side
+        # ledger over the read-back wall and the OSDs' recovery-side
+        # ledgers (pushes/pulls/decode/scrub) over the rebuild wall —
+        # same shares-sum-to-1.0 contract as the write block
+        hr = st.get("hops_client_read")
+        if hr and hr.get("ops"):
+            rwf = waterfall_block(hr, st.get("read_wall_s", 0.0))
+            rwf["shard_reads"] = {
+                k: st["hops_read_osd"].get(k) for k in
+                ("ops", "p50_s", "p99_s")} \
+                if st.get("hops_read_osd") else {}
+            att_obj["read_waterfall"] = rwf
+        hv = st.get("hops_recovery")
+        if hv and hv.get("ops"):
+            att_obj["recovery"] = waterfall_block(
+                hv, st.get("rebuild_wall_s", 0.0))
+        if st.get("slo"):
+            att_obj["slo"] = st["slo"]
         if st.get("profile"):
             att_obj["profile"] = st["profile"]
         print(json.dumps(att_obj), flush=True)
@@ -1321,6 +1369,23 @@ def bench_chaos_soak(n_objs=26, obj_bytes=8 << 20):
     assert ratio >= 0.5, \
         (f"degraded throughput {w_ch:.1f} MB/s fell below half of "
          f"fault-free {w_ff:.1f} MB/s")
+    # SLO acceptance (ISSUE 9): a fault-free run burns zero error
+    # budget in every class; the chaos run burns recovery budget
+    # (decode device faults fell back to the CPU twin) but stays
+    # client-clean — degraded, not broken
+    slo_ff = st_ff.get("slo") or {}
+    for cls, row in slo_ff.items():
+        assert row.get("burn", 0.0) == 0.0, \
+            (f"fault-free run burned {cls} error budget: {row}")
+    slo_ch = st.get("slo") or {}
+    rec_burn = (slo_ch.get("recovery") or {}).get("burn", 0.0)
+    assert rec_burn > 0.0, \
+        (f"chaos run shows no recovery-class budget burn: {slo_ch}")
+    for cls in ("client_read", "client_write"):
+        errs = (slo_ch.get(cls) or {}).get("errors", 0)
+        assert errs == 0, \
+            (f"chaos run leaked {errs} {cls} errors to clients: "
+             f"{slo_ch.get(cls)}")
     emit(f"chaos soak write MB/s (13-OSD k=8 m=4, seeded 1-in-20 "
          f"device-dispatch faults + mid-run device outage with one "
          f"OSD's store wedged; {dd.get('trips', 0)} faults tripped over "
@@ -1341,6 +1406,7 @@ def bench_chaos_soak(n_objs=26, obj_bytes=8 << 20):
         "breaker": brk,
         "subwrite_deadlines": st.get("subwrite", {}),
         "fault_free_breaker": st_ff.get("breaker", {}),
+        "slo": {"fault_free": slo_ff, "chaos": slo_ch},
     }), flush=True)
 
 

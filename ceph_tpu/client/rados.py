@@ -133,6 +133,10 @@ class Objecter(Dispatcher):
         # the reply returns it (client_send .. client_complete), so
         # the client owns the authoritative per-op hop accumulator
         self.hops = HopAccum()
+        # read-class ops keep their own accumulator: read waterfalls
+        # visit different hops (read_queued/shard_read/decode_*) and
+        # folding them into the write view would skew both
+        self.hops_read = HopAccum(subsystem="hops_read")
         msgr.add_dispatcher(self)
 
     # ------------------------------------------------------------------
@@ -312,9 +316,21 @@ class Objecter(Dispatcher):
         # final hop: the reply carried the op's cumulative ledger back;
         # close it and fold the completed waterfall into the client view
         msg.stamp_hop("client_complete")
-        self.hops.observe_wire(msg.hops)
+        if getattr(op, "is_write", True):
+            self.hops.observe_wire(msg.hops)
+        else:
+            self.hops_read.observe_wire(msg.hops)
         op.completion._complete(msg)
         return True
+
+    def trace_bundle(self) -> dict:
+        """Client half of the unified trace surface (the OSD side is
+        ``dump_trace``; tools/trace_export.py merges both): recent
+        end-to-end MOSDOp ledgers by op class."""
+        return {"daemon": "client",
+                "ledgers": {"write": self.hops.recent(),
+                            "read": self.hops_read.recent()},
+                "ops": [], "flight": {}, "reactors": [], "folded": []}
 
     def linger_submit(self, pool: int, oid: str,
                       ops: List[OSDOp]) -> Tuple[int, Completion]:

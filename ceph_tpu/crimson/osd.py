@@ -302,6 +302,11 @@ class CrimsonOSD(OSD):
         msg.tracked = self.op_tracker.create(
             f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
             f"{'+'.join(op.op for op in msg.ops)})")
+        # class tag consumed by SLOEngine.observe_op at retirement
+        # (same contract as the classic OSD's _enqueue_op)
+        msg.tracked.slo_class = "client_write" \
+            if any(PG._op_is_write(op) for op in msg.ops) \
+            else "client_read"
         msg.tracked.mark_event("queued_for_pg")
         msg.stamp_hop("pg_queued")
         shard = self._shard_of(pgid)

@@ -188,6 +188,14 @@ class Reactor:
         self.xshard_in = 0           # mailbox items this reactor ran
         self.xshard_out = 0          # items this reactor sent away
         self.mailbox_hwm = 0         # max inbound depth seen at drain
+        # per-shard utilization telemetry (dump_trace counter tracks):
+        # busy_s accumulates non-wait loop time; every
+        # _UTIL_SAMPLE_TICKS ticks one (wall_ts, util, loop_lag_s)
+        # sample lands in a bounded ring — the PR 8 open question
+        # ("is multi-shard scaling real?") reads straight off these
+        self.busy_s = 0.0
+        self.loop_lag_s = 0.0        # latest wait overshoot observed
+        self.util_samples: deque = deque(maxlen=512)
 
     # ------------------------------------------------------------- threads
     def start(self) -> None:
@@ -409,7 +417,22 @@ class Reactor:
                             pass
                 break
 
+    def util_dump(self) -> List[Dict[str, float]]:
+        """Snapshot of the utilization ring (any thread; the reactor
+        appends concurrently, so retry the racy iteration)."""
+        snap: List[Tuple[float, float, float]] = []
+        for _ in range(3):
+            try:
+                snap = list(self.util_samples)
+                break
+            except RuntimeError:
+                continue
+        return [{"ts": ts, "util": u, "loop_lag_s": lag}
+                for ts, u, lag in snap]
+
     # ---------------------------------------------------------------- loop
+    _UTIL_SAMPLE_TICKS = 64
+
     def _next_timeout(self) -> float:
         for mb in self._mailboxes:
             if mb:
@@ -426,13 +449,21 @@ class Reactor:
         return self._IDLE_WAIT
 
     def _run(self) -> None:
+        win_t0 = time.monotonic()
+        win_busy = 0.0
         while not self._stop_flag:
+            timeout = self._next_timeout()
+            t_wait = time.monotonic()
             try:
-                events = self._sel.select(self._next_timeout())
+                events = self._sel.select(timeout)
             except OSError:
                 # a watched fd died outside unregister(); purge and retry
                 self._purge_dead()
                 continue
+            t_work = time.monotonic()
+            # loop lag: how far past the requested wait the selector
+            # returned — GIL/scheduler pressure, not IO latency
+            self.loop_lag_s = max(0.0, (t_work - t_wait) - timeout)
             for key, mask in events:
                 if key.fileobj is self._wake_r:
                     try:
@@ -464,6 +495,17 @@ class Reactor:
                 except Exception:  # noqa: BLE001
                     pass
             self.ticks += 1
+            t_end = time.monotonic()
+            busy = t_end - t_work
+            self.busy_s += busy
+            win_busy += busy
+            if not (self.ticks % self._UTIL_SAMPLE_TICKS):
+                wall = t_end - win_t0
+                if wall > 0:
+                    self.util_samples.append(
+                        (time.time(), min(1.0, win_busy / wall),
+                         self.loop_lag_s))
+                win_t0, win_busy = t_end, 0.0
         # drop whatever is left; the OSD is shutting down
         try:
             self._sel.close()

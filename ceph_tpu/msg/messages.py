@@ -335,6 +335,7 @@ class MOSDECSubOpRead(Message):
         e.str_list(self.attrs_to_read)
         e.bool(self.for_recovery)
         e.u64(self.trace_id).u64(self.parent_span_id)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
@@ -347,6 +348,7 @@ class MOSDECSubOpRead(Message):
         m.for_recovery = d.bool()
         m.trace_id = d.u64()
         m.parent_span_id = d.u64()
+        m.hops = decode_ledger(d)
         return m
 
 
@@ -382,6 +384,7 @@ class MOSDECSubOpReadReply(Message):
         e.u32(len(self.errors))
         for oid, err in self.errors:
             e.str(oid).i32(err)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
@@ -393,6 +396,7 @@ class MOSDECSubOpReadReply(Message):
                      for _ in range(d.u32())]
         m.attrs = [(d.str(), d.str_bytes_map()) for _ in range(d.u32())]
         m.errors = [(d.str(), d.i32()) for _ in range(d.u32())]
+        m.hops = decode_ledger(d)
         return m
 
 
@@ -522,6 +526,7 @@ class MOSDPGPush(Message):
         e.u32(self.epoch).u32(len(self.pushes))
         for p in self.pushes:
             p.encode(e)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
@@ -530,6 +535,7 @@ class MOSDPGPush(Message):
         m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
                 epoch=d.u32())
         m.pushes = [PushOp.decode(d) for _ in range(d.u32())]
+        m.hops = decode_ledger(d)
         return m
 
 
@@ -554,13 +560,16 @@ class MOSDPGPull(Message):
         e = Encoder()
         e.str(self.pgid).i32(self.shard).i32(self.from_osd)
         e.u32(self.epoch).str_list(self.oids)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDPGPull":
         d = Decoder(buf)
-        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
-                   epoch=d.u32(), oids=d.str_list())
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                epoch=d.u32(), oids=d.str_list())
+        m.hops = decode_ledger(d)
+        return m
 
 
 @register
@@ -581,13 +590,16 @@ class MOSDPGPushReply(Message):
         e = Encoder()
         e.str(self.pgid).i32(self.shard).i32(self.from_osd)
         e.u32(self.epoch).str_list(self.oids)
+        encode_ledger(e, self.hops)
         return e.build()
 
     @classmethod
     def decode_payload(cls, buf: bytes) -> "MOSDPGPushReply":
         d = Decoder(buf)
-        return cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
-                   epoch=d.u32(), oids=d.str_list())
+        m = cls(pgid=d.str(), shard=d.i32(), from_osd=d.i32(),
+                epoch=d.u32(), oids=d.str_list())
+        m.hops = decode_ledger(d)
+        return m
 
 
 # ---------------------------------------------------------------------------

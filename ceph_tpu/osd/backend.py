@@ -211,9 +211,12 @@ class PGBackend(abc.ABC):
 
     @abc.abstractmethod
     def objects_read(self, oid: str, offset: int, length: int,
-                     cb: Callable[[int, bytes], None]) -> None:
+                     cb: Callable[[int, bytes], None],
+                     trace=(0, 0), hop_msg=None) -> None:
         """Read a logical extent; EC reconstructs from shards.  cb gets
-        (0, data) or (-errno, b"") (reference
+        (0, data) or (-errno, b"").  ``hop_msg`` (the client-facing
+        MOSDOp, when the read serves one) collects the read-side hop
+        ledger: read_queued / shard_read / decode windows (reference
         objects_read_and_reconstruct, ECBackend.cc:2345)."""
 
     @abc.abstractmethod

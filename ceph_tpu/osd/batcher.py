@@ -309,6 +309,11 @@ class EncodeBatcher:
         # verdict / breaker transition / staging stall / encode error
         # appends one ring event; None under unit-test stubs
         self.recorder = recorder
+        # decode-side device-fault hook (OSD wires this to the SLO
+        # engine's recovery-class error feed): called once per
+        # classified decode device failure, after the CPU-twin
+        # fallback is already queued.  Must not raise.
+        self.on_decode_fault = None
         # "ec_device" perf subsystem — the device-side telemetry PR 5
         # shipped without: crossover routing verdicts BY REASON,
         # StagingPool ring occupancy/stall-grows, h2d link EWMA,
@@ -372,6 +377,25 @@ class EncodeBatcher:
                     [100, 500, 1000, 5000, 10000, 25000, 50000,
                      100000, 500000],
                     "timer-wheel fire lag vs requested deadline (us)")
+            if "dec_route_device" not in dp._types:
+                # decode-route verdicts, mirroring route_* for the
+                # read/recovery side (registered under their own
+                # guard: dperf instances created by older sessions
+                # predate these counters)
+                for reason, desc in (
+                        ("device", "decode batches over the "
+                                   "crossover -> device"),
+                        ("learned", "decode batches under the "
+                                    "LEARNED crossover -> twin"),
+                        ("breaker_open", "decode batches the open "
+                                         "breaker routed to the "
+                                         "twin"),
+                        ("breaker_probe", "decode re-admission "
+                                          "probes through the open "
+                                          "breaker")):
+                    dp.add(f"dec_route_{reason}",
+                           description="decode routing verdicts: "
+                                       + desc)
             self.dperf = dp
         self._route_reason = None    # last verdict's reason code
         self._staging_stalls_seen = 0
@@ -878,6 +902,13 @@ class EncodeBatcher:
             rec.note("device_error", error=kind,
                      failures=cls._breaker_failures,
                      breaker_opened=opened)
+        if kind == "decode":
+            hook = self.on_decode_fault
+            if hook is not None:
+                try:
+                    hook()
+                except Exception:
+                    pass             # telemetry must not kill decode
         if opened:
             if self.bperf is not None:
                 self.bperf.inc("breaker_open")
@@ -1080,6 +1111,16 @@ class EncodeBatcher:
             except Exception:
                 impl = None
         on_twin = impl is not None
+        # publish the verdict (and consume _route_reason so a decode
+        # probe through the breaker cannot leak its reason into the
+        # next encode group's _note_route)
+        reason = self._route_reason
+        self._route_reason = None
+        if reason is None:
+            reason = "learned" if on_twin else "device"
+        if self.dperf is not None and \
+                f"dec_route_{reason}" in self.dperf._types:
+            self.dperf.inc(f"dec_route_{reason}")
         if impl is None:
             impl = reqs[0].ec_impl
         if on_twin:
@@ -1246,6 +1287,24 @@ class EncodeBatcher:
             pass                     # learning is best-effort
 
     # -- decode-side routing (consumed by ECBackend reads/recovery) ----
+    def route_decode(self, nbytes: int) -> bool:
+        """prefer_cpu() with the measurement the encode side has had
+        since PR 5: one reason-coded ``dec_route_*`` verdict counter
+        per call (device / learned / breaker_open) so perf dump and
+        prometheus answer WHERE decode traffic actually ran.  True
+        means the caller should take the CPU twin."""
+        if EncodeBatcher._breaker_open:
+            reason, to_cpu = "breaker_open", True
+        elif (self.adaptive_cpu and self._min_device_bytes > 0
+                and nbytes < self._min_device_bytes):
+            reason, to_cpu = "learned", True
+        else:
+            reason, to_cpu = "device", False
+        if self.dperf is not None and \
+                f"dec_route_{reason}" in self.dperf._types:
+            self.dperf.inc(f"dec_route_{reason}")
+        return to_cpu
+
     def prefer_cpu(self, nbytes: int) -> bool:
         """Should a ``nbytes``-sized codec call avoid the device?
         Shares the encode path's learned crossover — the fixed

@@ -1099,7 +1099,8 @@ class ECBackend(PGBackend):
     # ------------------------------------------------------------------
     def objects_read(self, oid: str, offset: int, length: int,
                      cb: Callable[[int, bytes], None],
-                     trace: Tuple[int, int] = (0, 0)) -> None:
+                     trace: Tuple[int, int] = (0, 0),
+                     hop_msg=None) -> None:
         info = self.get_object_info(oid)
         if info is None:
             cb(-2, b"")                  # -ENOENT
@@ -1142,30 +1143,41 @@ class ECBackend(PGBackend):
                 cb(-5, b"")
                 return
             try:
+                # client-facing decode window rides the op's ledger:
+                # degraded reads reconstruct here, healthy reads
+                # concat — either way the interval is the decode leg
+                if hop_msg is not None:
+                    hop_msg.stamp_hop("decode_dispatch")
                 nbytes = sum(len(v) for v in received.values())
                 data = ecutil.decode_concat(
                     self.sinfo, self._decode_impl(nbytes), received)
+                if hop_msg is not None:
+                    hop_msg.stamp_hop("decode_complete")
             except Exception:
                 cb(-5, b"")
                 return
             lo = offset - astart
             cb(0, data[lo:lo + length])
 
+        if hop_msg is not None:
+            hop_msg.stamp_hop("read_queued")
         self._start_read(oid, chunk_off, chunk_len, shards, reads_done,
                          need=need, trace=trace)
 
     def _decode_impl(self, nbytes: int):
         """Decode through the CPU twin when the OSD batcher's learned
         crossover says a device round trip of this size loses (same
-        economics as the encode side; bit-exact either way)."""
+        economics as the encode side; bit-exact either way).  Every
+        verdict is counted (``dec_route_*``) so the decode routing is
+        as auditable as the encode side's."""
         batcher = getattr(self.host, "encode_batcher", None)
         if batcher is not None and \
-                hasattr(self.ec_impl, "encode_batch_async") and \
-                batcher.prefer_cpu(nbytes):
-            try:
-                return batcher.cpu_twin(self.ec_impl, self.sinfo)
-            except Exception:
-                pass
+                hasattr(self.ec_impl, "encode_batch_async"):
+            if batcher.route_decode(nbytes):
+                try:
+                    return batcher.cpu_twin(self.ec_impl, self.sinfo)
+                except Exception:
+                    pass
         return self.ec_impl
 
     def _min_read_shards(self, want: Set[int],
@@ -1202,10 +1214,12 @@ class ECBackend(PGBackend):
                     tried: Optional[Set[int]] = None,
                     ranges: Optional[Dict[int, List[Tuple[int, int]]]]
                     = None, need: Optional[int] = None,
-                    trace: Tuple[int, int] = (0, 0)) -> None:
+                    trace: Tuple[int, int] = (0, 0),
+                    for_recovery: bool = False) -> None:
         rop = _ReadOp(self.new_tid(), oid, chunk_off, chunk_len,
                       dict(shards), cb, tried, ranges, need)
         rop.trace = trace
+        rop.for_recovery = for_recovery
         self.in_flight_reads[rop.tid] = rop
         for shard, osd in shards.items():
             extents = rop.ranges.get(shard,
@@ -1228,13 +1242,19 @@ class ECBackend(PGBackend):
                     piece = b"".join(parts)  # copycheck: ok - multi-extent read reassembly
                 self._read_piece(rop, shard, piece, err)
             else:
-                self.host.send_shard(osd, MOSDECSubOpRead(
+                sub = MOSDECSubOpRead(
                     pgid=self.host.pgid_str, shard=shard,
                     from_osd=self.host.whoami, tid=rop.tid,
                     epoch=self.host.epoch,
                     reads=[(oid, off, length)
                            for off, length in extents],
-                    trace_id=trace[0], parent_span_id=trace[1]))
+                    for_recovery=for_recovery,
+                    trace_id=trace[0], parent_span_id=trace[1])
+                # sub-read round trip opens its own ledger (mirrors
+                # the sub-write path); the reply closes it at this
+                # primary into the read/recovery accumulator
+                sub.stamp_hop("client_send")
+                self.host.send_shard(osd, sub)
 
     def _local_chunk_read(self, oid: str, shard: int, off: int,
                           length: int) -> Tuple[bytes, int]:
@@ -1309,7 +1329,9 @@ class ECBackend(PGBackend):
                 self._start_read(rop.oid, rop.chunk_off, rop.chunk_len,
                                  retry, rop.cb,
                                  tried=rop.tried | set(retry),
-                                 trace=getattr(rop, "trace", (0, 0)))
+                                 trace=getattr(rop, "trace", (0, 0)),
+                                 for_recovery=getattr(
+                                     rop, "for_recovery", False))
                 return
         rop.cb(rop.received, rop.errors)
 
@@ -1366,10 +1388,12 @@ class ECBackend(PGBackend):
         self.attr_fetches[tid] = (rec,)
         # attrs_to_read carries object names (reference ECSubRead
         # attrs_to_read is a set of hobjects)
-        self.host.send_shard(osd, MOSDECSubOpRead(
+        fetch = MOSDECSubOpRead(
             pgid=self.host.pgid_str, shard=shard,
             from_osd=self.host.whoami, tid=tid, epoch=self.host.epoch,
-            reads=[], attrs_to_read=[oid], for_recovery=True))
+            reads=[], attrs_to_read=[oid], for_recovery=True)
+        fetch.stamp_hop("client_send")
+        self.host.send_shard(osd, fetch)
 
     def _attr_fetch_done(self, rec: _RecoveryOp,
                          attrs: Dict[str, bytes]) -> None:
@@ -1426,7 +1450,7 @@ class ECBackend(PGBackend):
         def read_next() -> None:
             length = min(win, shard_len - state["off"])
             self._start_read(oid, state["off"], length, shards,
-                             reads_done)
+                             reads_done, for_recovery=True)
 
         def reads_done(received: Dict[int, bytes],
                        errors: Dict[int, int]) -> None:
@@ -1436,6 +1460,10 @@ class ECBackend(PGBackend):
                 self.recovery_ops.pop(oid, None)
                 rec.cb(-5)
                 return
+            # the decode window gets its own two-stamp ledger
+            # (decode_dispatch -> decode_complete) charged into the
+            # recovery waterfall when the decode lands
+            state["dec_t0"] = time.time()
             # recovery decodes ride the OSD's cross-op batcher: every
             # object of a rebuild lost the SAME shard (one erasure
             # signature), so concurrent recovery ops coalesce into one
@@ -1473,6 +1501,13 @@ class ECBackend(PGBackend):
                 decoded(dec)
 
         def decoded(dec) -> None:
+            t0 = state.pop("dec_t0", None)
+            if t0 is not None:
+                _obs = getattr(self.host, "observe_hops", None)
+                if _obs is not None:
+                    _obs({"decode_dispatch": t0,
+                          "decode_complete": time.time()},
+                         kind="recovery")
             if dec is None:
                 self.recovery_ops.pop(oid, None)
                 rec.cb(-5)
@@ -1548,7 +1583,7 @@ class ECBackend(PGBackend):
             self._push_recovered(rec, attrs, dec)
 
         self._start_read(oid, 0, shard_len, shards, reads_done,
-                         ranges=ranges)
+                         ranges=ranges, for_recovery=True)
         return True
 
     def _push_recovered(self, rec: _RecoveryOp, attrs: Dict[str, bytes],
@@ -1567,10 +1602,12 @@ class ECBackend(PGBackend):
                                  lambda s=shard: self._push_acked(
                                      rec.oid, s))
             else:
-                self.host.send_shard(osd, MOSDPGPush(
+                pmsg = MOSDPGPush(
                     pgid=self.host.pgid_str, shard=shard,
                     from_osd=self.host.whoami, epoch=self.host.epoch,
-                    pushes=[push]))
+                    pushes=[push])
+                pmsg.stamp_hop("client_send")
+                self.host.send_shard(osd, pmsg)
 
     def _apply_push(self, shard: int, push: PushOp,
                     on_commit: Callable[[], None]) -> None:
@@ -1688,7 +1725,13 @@ class ECBackend(PGBackend):
             self._handle_sub_read(msg)
             return True
         if isinstance(msg, MOSDECSubOpReadReply):
+            # sub-read waterfall closes at the primary, split by WHY
+            # the read ran (client-facing reconstruction vs recovery)
             if msg.tid in self.attr_fetches:
+                msg.stamp_hop("client_complete")
+                _obs = getattr(self.host, "observe_hops", None)
+                if _obs is not None:
+                    _obs(msg.hops, kind="recovery")
                 (rec,) = self.attr_fetches.pop(msg.tid)
                 attrs = dict(msg.attrs[0][1]) if msg.attrs else {}
                 self._attr_fetch_done(rec, attrs)
@@ -1696,6 +1739,12 @@ class ECBackend(PGBackend):
             rop = self.in_flight_reads.get(msg.tid)
             if rop is None:
                 return True
+            msg.stamp_hop("client_complete")
+            _obs = getattr(self.host, "observe_hops", None)
+            if _obs is not None:
+                _obs(msg.hops,
+                     kind="recovery" if getattr(rop, "for_recovery",
+                                                False) else "read")
             if msg.errors:
                 self._read_piece(rop, msg.shard, b"",
                                  msg.errors[0][1])
@@ -1713,16 +1762,27 @@ class ECBackend(PGBackend):
                             b for _, _, b in msg.buffers), 0)
             return True
         if isinstance(msg, MOSDPGPush):
+            def _push_done(p, m=msg):
+                # recovery write landed: ledger rides the ack back to
+                # the primary (same shape as the sub-write round trip)
+                m.stamp_hop("store_apply")
+                ack = MOSDPGPushReply(
+                    pgid=self.host.pgid_str, shard=m.shard,
+                    from_osd=self.host.whoami,
+                    epoch=self.host.epoch, oids=[p.oid])
+                if m.hops:
+                    ack.hops = dict(m.hops)
+                ack.stamp_hop("commit_sent")
+                self.host.send_shard(m.from_osd, ack)
             for push in msg.pushes:
-                self._apply_push(
-                    msg.shard, push,
-                    lambda p=push: self.host.send_shard(
-                        msg.from_osd, MOSDPGPushReply(
-                            pgid=self.host.pgid_str, shard=msg.shard,
-                            from_osd=self.host.whoami,
-                            epoch=self.host.epoch, oids=[p.oid])))
+                self._apply_push(msg.shard, push,
+                                 lambda p=push: _push_done(p))
             return True
         if isinstance(msg, MOSDPGPushReply):
+            msg.stamp_hop("client_complete")
+            _obs = getattr(self.host, "observe_hops", None)
+            if _obs is not None:
+                _obs(msg.hops, kind="recovery")
             for oid in msg.oids:
                 self._push_acked(oid, msg.shard)
             return True
@@ -1749,6 +1809,12 @@ class ECBackend(PGBackend):
                 reply.attrs.append((oid, attrs))
             except FileNotFoundError:
                 reply.errors.append((oid, -2))
+        # local chunk service complete: the interval since pg_locked is
+        # the shard's read work, and the ledger rides the reply home
+        msg.stamp_hop("shard_read")
+        if msg.hops:
+            reply.hops = dict(msg.hops)
+        reply.stamp_hop("commit_sent")
         self.host.send_shard(msg.from_osd, reply)
 
     def inflight_writes(self) -> int:

@@ -105,6 +105,18 @@ class OSDService:
         return self._osd.hops
 
     @property
+    def hops_read(self):
+        return self._osd.hops_read
+
+    @property
+    def hops_recovery(self):
+        return self._osd.hops_recovery
+
+    @property
+    def slo(self):
+        return self._osd.slo
+
+    @property
     def contention(self):
         return self._osd.contention
 
@@ -259,11 +271,18 @@ class OSD(Dispatcher):
         elif st_lock is not None:
             store._lock = TimedLock("store_lock", stats=self.contention,
                                     inner=st_lock)
-        # cross-daemon hop-ledger accumulator ("hops" subsystem): this
-        # OSD's view of sub-op round trips (the client owns the
-        # end-to-end MOSDOp view)
+        # cross-daemon hop-ledger accumulators: this OSD's view of
+        # sub-op round trips, split by op class so the read/recovery
+        # waterfall doesn't smear into the write one ("hops" = write
+        # sub-ops, "hops_read" = client-facing shard reads,
+        # "hops_recovery" = pushes/pulls + scrub windows; the client
+        # owns the end-to-end MOSDOp views)
         from ..utils.hops import HopAccum
         self.hops = HopAccum(perf_coll=self.perf_coll)
+        self.hops_read = HopAccum(perf_coll=self.perf_coll,
+                                  subsystem="hops_read")
+        self.hops_recovery = HopAccum(perf_coll=self.perf_coll,
+                                      subsystem="hops_recovery")
         # cross-op TPU stripe coalescer (SURVEY §3.1 batching point)
         from .batcher import EncodeBatcher
         self.encode_batcher = EncodeBatcher(
@@ -294,7 +313,22 @@ class OSD(Dispatcher):
         # perf subsystem and the dump_critical_path command
         from ..utils.critpath import CriticalPathAccum
         self.critpath = CriticalPathAccum(perf_coll=self.perf_coll)
-        self.op_tracker.on_retire = self.critpath.observe
+        # per-op-class SLO accounting (mgr/slo.py): client classes
+        # feed from op retirement, recovery/scrub from their own
+        # completion paths; both observers are chained post-reply and
+        # must not raise
+        from ..mgr.slo import SLOEngine
+        self.slo = SLOEngine(conf=self.conf, perf_coll=self.perf_coll)
+
+        def _on_retire(op, _cp=self.critpath.observe,
+                       _slo=self.slo.observe_op):
+            _cp(op)
+            _slo(op)
+        self.op_tracker.on_retire = _on_retire
+        # decode device faults burn recovery-class budget even though
+        # the CPU-twin fallback keeps the op itself successful
+        self.encode_batcher.on_decode_fault = \
+            lambda: self.slo.note_error("recovery")
         from ..utils.tracer import Tracer
         self.tracer = Tracer(f"osd.{whoami}",
                              enabled=self.conf["osd_tracing"],
@@ -315,6 +349,7 @@ class OSD(Dispatcher):
                            "dump_blocked_ops", "dump_ops_in_flight",
                            "dump_slow_ops", "dump_flight_recorder",
                            "dump_critical_path", "dump_hops",
+                           "dump_slo", "dump_trace",
                            "dump_profile", "status",
                            "config get", "config set"):
                 self.admin_socket.register(
@@ -710,6 +745,10 @@ class OSD(Dispatcher):
         msg.tracked = self.op_tracker.create(
             f"osd_op({msg.client}.{msg.tid} {pgid} {msg.oid} "
             f"{'+'.join(op.op for op in msg.ops)})")
+        # class tag consumed by SLOEngine.observe_op at retirement
+        msg.tracked.slo_class = "client_write" \
+            if any(PG._op_is_write(op) for op in msg.ops) \
+            else "client_read"
         msg.tracked.mark_event("queued_for_pg")
         msg.stamp_hop("pg_queued")
         shard = hash(pgid) % self._n_shards
@@ -884,7 +923,15 @@ class OSD(Dispatcher):
             elif prefix == "dump_critical_path":
                 out = self.critpath.dump()
             elif prefix == "dump_hops":
+                # write view at top level (back-compat), read/recovery
+                # class views nested
                 out = self.hops.dump()
+                out["read"] = self.hops_read.dump()
+                out["recovery"] = self.hops_recovery.dump()
+            elif prefix == "dump_slo":
+                out = self.slo.dump()
+            elif prefix == "dump_trace":
+                out = self._trace_bundle()
             elif prefix == "dump_profile":
                 from ..utils.sampler import global_sampler
                 s = global_sampler()
@@ -910,6 +957,39 @@ class OSD(Dispatcher):
         except Exception as e:
             retcode, rs = -22, str(e)
         return retcode, rs, out
+
+    def _trace_bundle(self) -> dict:
+        """Raw material for tools/trace_export.py (one bundle per
+        daemon, merged into a single Perfetto trace): recent hop
+        ledgers by op class, optracker stage timelines, flight-
+        recorder events, per-shard reactor utilization samples
+        (crimson; classic OSDs report none), and the sampler's folded
+        stacks for this daemon."""
+        reactors = []
+        for r in getattr(self, "reactors", []) or []:
+            reactors.append({"shard": r.shard,
+                             "ticks": r.ticks,
+                             "busy_s": r.busy_s,
+                             "loop_lag_s": r.loop_lag_s,
+                             "util": r.util_dump()})
+        folded = {}
+        try:
+            from ..utils.sampler import global_sampler
+            folded = global_sampler().dump_folded(
+                prefix=f"osd{self.whoami}-")
+        except Exception:
+            pass
+        return {
+            "daemon": f"osd.{self.whoami}",
+            "ledgers": {"write": self.hops.recent(),
+                        "read": self.hops_read.recent(),
+                        "recovery": self.hops_recovery.recent()},
+            "ops": (self.op_tracker.dump_historic_ops()
+                    + self.op_tracker.dump_ops_in_flight()),
+            "flight": self.flight_recorder.dump_state(),
+            "reactors": reactors,
+            "folded": folded,
+        }
 
     def _handle_command(self, conn: Connection, msg: MCommand) -> None:
         retcode, rs, out = self._exec_command(msg.cmd)

@@ -252,10 +252,15 @@ class PG:
     def send_shard(self, osd: int, msg) -> None:
         self.service.send_osd(osd, msg)
 
-    def observe_hops(self, hops) -> None:
+    def observe_hops(self, hops, kind: str = "write") -> None:
         """Fold a completed sub-op round-trip ledger into this OSD's
-        hops accumulator (bare test hosts have none)."""
-        acc = getattr(self.service, "hops", None)
+        hops accumulator for the given op class — "write" (sub-write
+        round trips), "read" (client-facing shard reads) or "recovery"
+        (pushes/pulls, recovery reads, decode/scrub windows).  Bare
+        test hosts have no accumulators."""
+        attr = {"read": "hops_read",
+                "recovery": "hops_recovery"}.get(kind, "hops")
+        acc = getattr(self.service, attr, None)
         if acc is not None:
             acc.observe_wire(hops)
 
@@ -2245,7 +2250,8 @@ class PG:
                         out_data[i] = data
                         run(i + 1)
                 length = op.length if op.length else (1 << 62)
-                self.backend.objects_read(oid, op.offset, length, cb)
+                self.backend.objects_read(oid, op.offset, length, cb,
+                                          hop_msg=msg)
                 return
             if o == "call":
                 # read-only class method (reference CLS_METHOD_RD):
@@ -2452,6 +2458,12 @@ class PG:
         # here); finish() is idempotent
         tracked = getattr(msg, "tracked", None)
         if tracked is not None:
+            # SLO error classification: infrastructure failures burn
+            # budget; client-semantic errnos (ENOENT, EEXIST, ENODATA,
+            # EOPNOTSUPP, ECANCELED, ETIMEDOUT-on-notify) do not — a
+            # read of a nonexistent object is a correct answer
+            if result < 0 and result not in (-2, -17, -61, -95, -125):
+                tracked.slo_ok = False
             tracked.finish()
         if conn is None:
             return
@@ -2695,7 +2707,11 @@ class PG:
 
     def _on_recovered(self, oid: str, res: int) -> None:
         with self.lock:
-            self.recovering.pop(oid, None)
+            t0 = self.recovering.pop(oid, None)
+            slo = getattr(self.service, "slo", None)
+            if slo is not None and t0 is not None:
+                slo.observe("recovery", time.monotonic() - t0,
+                            ok=(res == 0))
             if res == 0:
                 need = self.missing_objects().get(oid, (1 << 30, 0))
                 if self.missing.is_missing(oid):

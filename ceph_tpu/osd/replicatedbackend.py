@@ -167,7 +167,10 @@ class ReplicatedBackend(PGBackend):
     # read path: local, the primary holds a full copy
     # ------------------------------------------------------------------
     def objects_read(self, oid: str, offset: int, length: int,
-                     cb: Callable[[int, bytes], None]) -> None:
+                     cb: Callable[[int, bytes], None],
+                     trace=(0, 0), hop_msg=None) -> None:
+        if hop_msg is not None:
+            hop_msg.stamp_hop("read_queued")
         obj = GHObject(oid, -1)
         try:
             data = self.host.store.read(self.host.coll, obj, offset,
@@ -180,6 +183,10 @@ class ReplicatedBackend(PGBackend):
             # — scrub repair-via-recovery re-homes a good replica
             cb(-5, b"")
             return
+        if hop_msg is not None:
+            # replicated reads are local: the store call above IS the
+            # shard read (no sub-op round trip, no decode window)
+            hop_msg.stamp_hop("shard_read")
         cb(0, data)
 
     # ------------------------------------------------------------------

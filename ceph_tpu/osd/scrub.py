@@ -72,6 +72,9 @@ class Scrubber:
             return False
         self.active = True
         self.started_at = time.monotonic()
+        # wall-clock twin of started_at: ledger stamps are absolute
+        # wall time (cross-daemon alignable), monotonic is not
+        self._started_wall = time.time()
         self.deep = deep
         self.repair = repair
         self.tid += 1
@@ -189,6 +192,19 @@ class Scrubber:
             # reference osd_scrub_auto_repair: scrub-found errors go
             # straight to repair without an operator `pg repair`
             self._repair(inconsistent)
+        # the whole round as one synthetic ledger interval: pg_locked
+        # (round start) -> scrub_window (compare done), charged to the
+        # recovery-class accumulator + scrub SLO class
+        t0 = getattr(self, "_started_wall", 0.0)
+        if t0:
+            obs = getattr(pg, "observe_hops", None)
+            if obs is not None:
+                obs({"pg_locked": t0, "scrub_window": now},
+                    kind="recovery")
+            slo = getattr(pg.service, "slo", None)
+            if slo is not None:
+                slo.observe("scrub", max(0.0, now - t0),
+                            ok=(self.errors == 0))
         pg.requeue_scrub_waiters()
         pg.service.kick_recovery(pg)
 

@@ -191,6 +191,27 @@ def _opts() -> List[Option]:
         Option("osd_op_complaint_time", float, 30.0, min=0.1,
                description="ops in flight longer than this surface as "
                            "slow ops (reference osd_op_complaint_time)"),
+        # -- SLO engine (mgr/slo.py: per-op-class latency targets +
+        #    error budgets; generous defaults — the SLO gate flags
+        #    pathology, not ordinary slowness on a loaded test box) ---
+        Option("slo_client_read_p99_ms", float, 30000.0, min=0.0,
+               description="client read-class latency target in ms; "
+                           "slower ops burn error budget "
+                           "(0 disables the latency gate)"),
+        Option("slo_client_write_p99_ms", float, 30000.0, min=0.0,
+               description="client write-class latency target (ms, "
+                           "0 disables)"),
+        Option("slo_recovery_p99_ms", float, 60000.0, min=0.0,
+               description="recovery-class per-object latency target "
+                           "(ms, 0 disables)"),
+        Option("slo_scrub_p99_ms", float, 120000.0, min=0.0,
+               description="scrub-class per-round latency target "
+                           "(ms, 0 disables)"),
+        Option("slo_error_budget", float, 0.001, min=0.000001,
+               description="allowed bad-op fraction per class; "
+                           "burn rate = observed bad fraction / "
+                           "this budget (1.0 = burning exactly the "
+                           "budget)"),
         Option("osd_tracing", bool, False,
                description="record blkin-style spans for traced ops "
                            "(reference osd_blkin_trace_all)"),

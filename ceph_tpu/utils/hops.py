@@ -19,6 +19,7 @@ entirely (old peers) and skip unknown ids (newer peers).
 from __future__ import annotations
 
 import threading
+from collections import deque
 from typing import Dict, List, Optional
 
 #: canonical hop order along the write path.  Wire id == list index —
@@ -35,8 +36,22 @@ HOP_ORDER = (
     "commit_sent",      # reply queued back toward the sender
     "client_complete",  # sender observed the commit/completion
     "xshard_handoff",   # op landed on its PG's owning reactor shard
+    # -- read/recovery-side hops (ISSUE 9); same append-only rule --
+    "read_queued",      # read handed to the backend's fan-out
+    "shard_read",       # shard served its local chunk read
+    "decode_dispatch",  # reconstruction decode handed to the batcher
+    "decode_complete",  # decoded payload back on the op path
+    "scrub_window",     # one deep-scrub window walked + hashed
 )
 HOP_ID: Dict[str, int] = {name: i for i, name in enumerate(HOP_ORDER)}
+
+#: hops only some paths visit: the write-path waterfall tests assert
+#: full hop coverage MINUS this set (xshard only under multi-reactor
+#: crimson; the read/recovery/scrub hops never on a pure write)
+CONDITIONAL_HOPS = frozenset((
+    "xshard_handoff", "read_queued", "shard_read",
+    "decode_dispatch", "decode_complete", "scrub_window",
+))
 
 #: path-position order for interval charging.  HOP_ORDER is wire
 #: format and append-only, so a hop added later (xshard_handoff, wire
@@ -44,10 +59,16 @@ HOP_ID: Dict[str, int] = {name: i for i, name in enumerate(HOP_ORDER)}
 #: presentation-only and places each hop where it happens on the
 #: path: the cross-shard mailbox handoff sits between the op being
 #: queued for its PG and the PG logic running.
+#: the read-side hops slot between the PG logic running and the store/
+#: reply legs: a degraded read queues its shard fan-out (read_queued),
+#: shards serve chunks (shard_read), reconstruction decodes
+#: (decode_dispatch -> decode_complete), then the reply leaves
+#: (commit_sent).  scrub_window closes a synthetic scrub ledger.
 CHARGE_ORDER = (
     "client_send", "msgr_enqueue", "wire_sent", "recv",
     "dispatch_queued", "pg_queued", "xshard_handoff", "pg_locked",
-    "store_apply", "commit_sent", "client_complete",
+    "read_queued", "shard_read", "decode_dispatch", "decode_complete",
+    "store_apply", "commit_sent", "client_complete", "scrub_window",
 )
 
 #: log-spaced histogram bounds (seconds) for per-hop intervals: the
@@ -135,21 +156,28 @@ class HopAccum:
 
     Keeps its own histogram state so ledger-observing clients need no
     perf-counter plumbing; when given a ``perf_coll`` it additionally
-    registers a ``hops`` subsystem (one histogram + time-avg per hop,
-    plus an op counter) so the intervals surface in ``perf dump`` and
-    as ``ceph_hops_*`` prometheus families.
+    registers a perf subsystem (one histogram + time-avg per hop, plus
+    an op counter) so the intervals surface in ``perf dump`` and as
+    ``ceph_{subsystem}_*`` prometheus families.  ``subsystem`` names
+    that registration so one daemon can run several accumulators
+    (write sub-ops / client reads / recovery) side by side.
     """
 
-    def __init__(self, perf_coll=None):
+    RECENT_LEDGERS = 256
+
+    def __init__(self, perf_coll=None, subsystem: str = "hops"):
         self._lock = threading.Lock()
         self.ops = 0
         self.op_seconds = 0.0
         self.hop_seconds: Dict[str, float] = {}
         self.hop_counts: Dict[str, int] = {}
         self._buckets: Dict[str, List[int]] = {}
+        # bounded ring of raw ledgers for the trace exporter: absolute
+        # wall-clock stamps, so per-op slices line up across daemons
+        self._recent: deque = deque(maxlen=self.RECENT_LEDGERS)
         self.hperf = None
         if perf_coll is not None:
-            hp = perf_coll.create("hops")
+            hp = perf_coll.create(subsystem)
             # two daemons may share a collection (tests); register once
             if "ops" not in hp._types:
                 hp.add("ops", description="ledger-bearing ops observed")
@@ -173,6 +201,7 @@ class HopAccum:
         bisect = _bisect
         with self._lock:
             self.ops += 1
+            self._recent.append(dict(hops))
             hop_seconds, hop_counts = self.hop_seconds, self.hop_counts
             buckets = self._buckets
             for name, dt in charged:
@@ -206,6 +235,12 @@ class HopAccum:
         out["p99_s"] = {k: _percentile(HOP_BOUNDS, v, 0.99)
                         for k, v in buckets.items()}
         return out
+
+    def recent(self) -> List[Dict[str, float]]:
+        """Raw ledgers of the most recent observed ops (bounded ring),
+        for the unified trace exporter's per-op tracks."""
+        with self._lock:
+            return [dict(h) for h in self._recent]
 
 
 def _bisect(bounds: List[float], value: float) -> int:

@@ -223,3 +223,75 @@ def test_submit_to_enqueue_is_cheap():
 
 def _noop():
     pass
+
+
+# ISSUE 9 adds two pieces: the SLO engine observes every retired op
+# (hot path, same 20us bar) and the unified trace export merges every
+# daemon's bundles (offline tool, but `dump_trace | trace_export` on
+# a full bench cluster must stay interactive).
+SLO_OBSERVE_CEILING = 20e-6
+TRACE_EXPORT_CEILING = 5.0
+
+
+def test_slo_observe_is_cheap():
+    from ceph_tpu.mgr.slo import SLOEngine
+    from ceph_tpu.utils.perf import PerfCountersCollection
+    eng = SLOEngine(perf_coll=PerfCountersCollection())
+    cost = _per_op(lambda: eng.observe("client_write", 0.004))
+    assert cost < SLO_OBSERVE_CEILING, \
+        f"SLO observe costs {cost * 1e6:.2f}us/op " \
+        f"(ceiling {SLO_OBSERVE_CEILING * 1e6:.0f}us)"
+    assert eng.dump()["client_write"]["ops"] > N
+
+
+def test_trace_export_13_daemons_stays_interactive():
+    """One client + 12 OSDs with full RECENT_LEDGERS-deep rings per
+    class, historic ops, flight events and reactor samples — the
+    k8m4 bench cluster's worth of bundles must export and serialize
+    well inside the 5s interactive bar."""
+    import json
+
+    from ceph_tpu.utils.hops import HopAccum
+    from tools.trace_export import export_bundles
+    depth = HopAccum.RECENT_LEDGERS
+
+    def bundle(i):
+        t0 = 1000.0 + i
+        led = lambda off: {
+            "client_send": t0 + off, "recv": t0 + off + 0.002,
+            "pg_locked": t0 + off + 0.003,
+            "store_apply": t0 + off + 0.006,
+            "commit_sent": t0 + off + 0.007,
+            "client_complete": t0 + off + 0.008}
+        return {
+            "daemon": "client" if i == 0 else f"osd.{i - 1}",
+            "ledgers": {cls: [led(j * 0.01)
+                              for j in range(depth)]
+                        for cls in ("write", "read", "recovery")},
+            "ops": [{"description": f"osd_op({j})",
+                     "initiated_at": t0 + j,
+                     "events": [{"time": t0 + j, "event": "initiated"},
+                                {"time": t0 + j + 0.01,
+                                 "event": "done"}]}
+                    for j in range(64)],
+            "flight": {"events": [{"time": t0 + j * 0.1, "mono": j,
+                                   "kind": "route", "site": "s"}
+                                  for j in range(128)]},
+            "reactors": [{"shard": s, "ticks": 640, "busy_s": 1.0,
+                          "loop_lag_s": 0.001,
+                          "util": [{"ts": t0 + j, "util": 0.5,
+                                    "loop_lag_s": 0.001}
+                                   for j in range(32)]}
+                         for s in range(2)],
+            "folded": [f"d{i};a;b {j}" for j in range(16)]}
+
+    bundles = [bundle(i) for i in range(13)]
+    t0 = time.perf_counter()
+    trace = export_bundles(bundles)
+    text = json.dumps(trace)
+    elapsed = time.perf_counter() - t0
+    assert elapsed < TRACE_EXPORT_CEILING, \
+        f"13-daemon trace export took {elapsed:.2f}s " \
+        f"(ceiling {TRACE_EXPORT_CEILING:.0f}s)"
+    assert len({e["pid"] for e in trace["traceEvents"]}) == 13
+    assert len(text) > 1 << 20        # it actually carried the data

@@ -1,0 +1,209 @@
+"""Unified Perfetto trace export (ISSUE 9): lane packing, ledger
+slicing, the structural contract of the merged trace_event JSON, and
+the live-cluster acceptance run — an EC write + degraded read whose
+``dump_trace`` bundles (client + every surviving OSD) export to a
+trace loadable in ui.perfetto.dev unmodified.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
+from ceph_tpu.mgr.slo import SLOEngine
+from tools.trace_export import (_Lanes, _ledger_slices,
+                                export_bundles, main as export_main)
+
+
+# ------------------------------------------------------------- units
+def test_lane_packing_never_overlaps():
+    lanes = _Lanes()
+    placed = []                      # (lane, start, end)
+    for start, end in ((0.0, 1.0), (0.5, 2.0), (1.0, 1.5),
+                       (1.6, 3.0), (2.1, 2.2)):
+        placed.append((lanes.place(start, end), start, end))
+    for lane, s, e in placed:
+        for lane2, s2, e2 in placed:
+            if lane == lane2 and (s, e) != (s2, e2):
+                assert e <= s2 or e2 <= s, \
+                    f"lane {lane} overlaps: ({s},{e}) vs ({s2},{e2})"
+
+
+def test_ledger_slices_follow_charge_order():
+    led = {"client_send": 10.0, "recv": 10.010,
+           "read_queued": 10.011, "decode_dispatch": 10.030,
+           "decode_complete": 10.031, "client_complete": 10.040}
+    start, end, spans = _ledger_slices(led)
+    assert (start, end) == (10.0, 10.040)
+    names = [n for n, _, _ in spans]
+    assert names == ["recv", "read_queued", "decode_dispatch",
+                     "decode_complete", "client_complete"]
+    # each interval is charged to its ENDING hop — intervals abut
+    for (_, s1, e1), (_, s2, e2) in zip(spans, spans[1:]):
+        assert e1 == s2
+    assert _ledger_slices({"recv": 1.0}) is None
+    assert _ledger_slices({}) is None
+
+
+def _synthetic_bundle(name, t0=1000.0, with_reactor=False):
+    led = {"client_send": t0, "recv": t0 + 0.01,
+           "store_apply": t0 + 0.03, "client_complete": t0 + 0.04}
+    b = {"daemon": name,
+         "ledgers": {"write": [led],
+                     "read": [{"client_send": t0 + 0.1,
+                               "recv": t0 + 0.11,
+                               "shard_read": t0 + 0.12,
+                               "client_complete": t0 + 0.13}]},
+         "ops": [{"description": "osd_op(write)",
+                  "initiated_at": t0,
+                  "events": [{"time": t0, "event": "initiated"},
+                             {"time": t0 + 0.02, "event": "queued"},
+                             {"time": t0 + 0.04, "event": "done"}]}],
+         "flight": {"events": [{"time": t0 + 0.005, "mono": 1.0,
+                                "kind": "lock_stall", "site": "x"}]},
+         "reactors": [], "folded": [f"{name};f;g 3"]}
+    if with_reactor:
+        b["reactors"] = [{"shard": 0, "ticks": 128, "busy_s": 0.5,
+                          "loop_lag_s": 0.001,
+                          "util": [{"ts": t0 + 0.02, "util": 0.7,
+                                    "loop_lag_s": 0.001}]}]
+    return b
+
+
+def test_export_bundles_structure():
+    trace = export_bundles([
+        _synthetic_bundle("client"),
+        _synthetic_bundle("osd.0", with_reactor=True)])
+    # the trace_event contract: top-level dict, JSON round-trippable
+    assert set(trace) == {"traceEvents", "displayTimeUnit",
+                          "otherData"}
+    again = json.loads(json.dumps(trace))
+    assert again["displayTimeUnit"] == "ms"
+    evs = trace["traceEvents"]
+    names = {(e["pid"], e["args"]["name"]) for e in evs
+             if e["ph"] == "M" and e["name"] == "process_name"}
+    assert names == {(1, "client"), (2, "osd.0")}
+    # hop slices: enclosing op + nested hops, rebased to >= 0 us
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert any(e["name"] == "write_op" for e in xs)
+    assert any(e["name"] == "read_op" for e in xs)
+    assert any(e["name"] == "shard_read" for e in xs)
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    # optracker stage slices + flight instants + reactor counters
+    assert any(e["cat"] == "optracker" and e["name"] == "queued"
+               for e in xs)
+    assert any(e["ph"] == "i" and e["name"] == "lock_stall"
+               for e in evs)
+    cs = [e for e in evs if e["ph"] == "C"]
+    assert {e["name"] for e in cs} == {"reactor0_util",
+                                       "reactor0_loop_lag_ms"}
+    assert trace["otherData"]["client_folded"] == ["client;f;g 3"]
+    # thread tracks are named and sorted
+    tn = [e for e in evs
+          if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert {e["args"]["name"] for e in tn} >= \
+        {"write ops", "read ops", "optracker", "flight recorder"}
+
+
+def test_export_cli_roundtrip(tmp_path):
+    paths = []
+    for i, b in enumerate([_synthetic_bundle("client"),
+                           _synthetic_bundle("osd.0")]):
+        p = tmp_path / f"b{i}.json"
+        p.write_text(json.dumps(b))
+        paths.append(str(p))
+    out = str(tmp_path / "trace.json")
+    assert export_main(paths + ["--out", out]) == 0
+    with open(out) as f:
+        trace = json.load(f)
+    assert len({e["pid"] for e in trace["traceEvents"]}) == 2
+    assert export_main([str(tmp_path / "missing.json"),
+                        "--out", out]) == 2
+
+
+# ------------------------------------------- live cluster acceptance
+def test_trace_export_live_ec_write_degraded_read():
+    """The acceptance run: EC write + degraded read on a live vstart
+    cluster; the merged export carries the client process plus every
+    surviving OSD (primary + shards), per-class op tracks, and the
+    crimson reactor utilization counters — and dump_slo shows zero
+    client burn on this fault-free path."""
+    from ceph_tpu.tools import ceph_cli
+    with Cluster(n_osds=4, conf=make_conf()) as c:
+        for i in range(4):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("te", plugin="tpu", k="2", m="1")
+        c.create_pool("tep", "erasure", erasure_code_profile="te")
+        rad = c.rados(timeout=60)
+        io = rad.open_ioctx("tep")
+        for i in range(6):
+            io.write_full(f"t{i}", os.urandom(8192))
+        c.kill_osd(3)
+        c.wait_for_osd_down(3, 30)
+        for i in range(6):
+            assert len(io.read(f"t{i}")) == 8192
+
+        # -- dump_slo: admin round trip + zero client burn ---------
+        merged = []
+        for osd_id in range(3):
+            ret, _, slo = c.osds[osd_id]._exec_command(
+                {"prefix": "dump_slo"})
+            assert ret == 0
+            assert set(slo) == set(SLOEngine.CLASSES)
+            merged.append(slo)
+        cluster_slo = SLOEngine.merge_dumps(merged)
+        for cls in ("client_read", "client_write"):
+            assert cluster_slo[cls]["burn"] == 0.0, cluster_slo
+        # every degraded read retired on a surviving primary; some
+        # writes retired on the since-killed osd.3 and their samples
+        # died with it
+        assert cluster_slo["client_read"]["ops"] >= 6
+        assert cluster_slo["client_write"]["ops"] >= 1
+
+        # -- dump_trace: one bundle per daemon -> one trace --------
+        bundles = [rad.objecter.trace_bundle()]
+        for osd_id in range(3):
+            ret, _, bundle = c.osds[osd_id]._exec_command(
+                {"prefix": "dump_trace"})
+            assert ret == 0
+            assert bundle["daemon"] == f"osd.{osd_id}"
+            assert set(bundle["ledgers"]) == {"write", "read",
+                                              "recovery"}
+            bundles.append(bundle)
+        # both admin commands also round-trip through the CLI
+        host, port = c.mon_addr
+        for cmd in ("dump_slo", "dump_trace"):
+            assert ceph_cli.main(["-m", f"{host}:{port}", "--format",
+                                  "json", "tell", "osd.0", cmd]) == 0
+
+        trace = export_bundles(bundles)
+        # Perfetto-loadable: plain trace_event JSON, no NaN/Inf
+        text = json.dumps(trace, allow_nan=False)
+        evs = json.loads(text)["traceEvents"]
+        procs = {e["args"]["name"] for e in evs
+                 if e["ph"] == "M" and e["name"] == "process_name"}
+        # client + primary + shard OSDs: every surviving daemon
+        assert procs == {"client", "osd.0", "osd.1", "osd.2"}
+        xs = [e for e in evs if e["ph"] == "X"]
+        assert any(e.get("cat") == "write" for e in xs)
+        assert any(e.get("cat") == "read" for e in xs)
+        assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+        # reactor utilization counters rode in (crimson default);
+        # the reactor samples every 64 ticks so give the loop a beat
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            cs = [e for e in evs if e["ph"] == "C"
+                  and e["name"].startswith("reactor")]
+            if cs:
+                break
+            time.sleep(0.5)
+            bundles = [rad.objecter.trace_bundle()]
+            for osd_id in range(3):
+                _, _, bundle = c.osds[osd_id]._exec_command(
+                    {"prefix": "dump_trace"})
+                bundles.append(bundle)
+            evs = export_bundles(bundles)["traceEvents"]
+        assert cs, "no reactor utilization counters in the export"
+        assert any(e["name"].endswith("_loop_lag_ms") for e in cs)

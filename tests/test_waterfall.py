@@ -21,10 +21,10 @@ from ceph_tpu.msg.message import (HEADER_LEN, decode_frame_body,
                                   decode_frame_header, encode_frame)
 from ceph_tpu.msg.messenger import Dispatcher
 from ceph_tpu.utils.encoding import Decoder, Encoder
-from ceph_tpu.utils.hops import (CHARGE_ORDER, HOP_BOUNDS, HOP_ORDER,
-                                 HopAccum, charge, decode_ledger,
-                                 encode_ledger, merge_dumps,
-                                 waterfall_block)
+from ceph_tpu.utils.hops import (CHARGE_ORDER, CONDITIONAL_HOPS,
+                                 HOP_BOUNDS, HOP_ORDER, HopAccum,
+                                 charge, decode_ledger, encode_ledger,
+                                 merge_dumps, waterfall_block)
 
 
 def _carriers():
@@ -44,6 +44,18 @@ def _carriers():
                     log_entries=[], at_version=(3, 2)),
         M.MOSDRepOpReply(pgid="2.0", from_osd=2, tid=5, epoch=3,
                          result=0),
+        M.MOSDECSubOpRead(pgid="1.2", shard=1, from_osd=0, tid=9,
+                          epoch=4, reads=[("obj", 0, 4096)],
+                          attrs_to_read=["_"], for_recovery=True),
+        M.MOSDECSubOpReadReply(pgid="1.2", shard=1, from_osd=3, tid=9,
+                               epoch=4, buffers=[("obj", 0, b"d")],
+                               attrs=[("obj", {"_": b"v"})],
+                               errors=[("gone", -2)]),
+        M.MOSDPGPush(pgid="1.2", shard=2, from_osd=0, epoch=4),
+        M.MOSDPGPull(pgid="1.2", shard=2, from_osd=1, epoch=4,
+                     oids=["obj"]),
+        M.MOSDPGPushReply(pgid="1.2", shard=2, from_osd=2, epoch=4,
+                          oids=["obj"]),
     ]
 
 
@@ -320,11 +332,12 @@ def _write_and_wall(c, pool, n=8, size=8192):
 def _assert_waterfall(c, rad, wall, n):
     d = rad.objecter.hops.dump()
     assert d["ops"] >= n
-    # the end-to-end MOSDOp path visits every hop after client_send;
-    # xshard_handoff is conditional — it only appears when an op
-    # lands on a reactor shard that doesn't own its PG
+    # the end-to-end MOSDOp WRITE path visits every hop after
+    # client_send except the conditional ones (xshard_handoff only
+    # appears on cross-shard handoffs; the read/decode/scrub hops
+    # belong to the other op classes)
     assert set(d["hop_counts"]) >= \
-        set(HOP_ORDER[1:]) - {"xshard_handoff"}
+        set(HOP_ORDER[1:]) - CONDITIONAL_HOPS
     # exactness: charged op-seconds are each op's own wall; serial
     # writes keep their sum within the measured client wall (slack for
     # time.time granularity and the final reply race)
@@ -523,3 +536,117 @@ def test_charge_places_xshard_between_queue_and_lock():
     e = Encoder()
     encode_ledger(e, hops)
     assert decode_ledger(Decoder(e.build())) == hops
+
+
+# ---------------------------------------------------------------- ISSUE 9
+
+
+def test_read_hop_wire_ids_stable():
+    """The read/recovery hops were appended after the write-path
+    ledger shipped: their wire ids (list indices) are 11..15 forever,
+    and CHARGE_ORDER slots them at their true path positions."""
+    assert [HOP_ORDER.index(h) for h in
+            ("read_queued", "shard_read", "decode_dispatch",
+             "decode_complete", "scrub_window")] == [11, 12, 13, 14, 15]
+    assert set(CHARGE_ORDER) == set(HOP_ORDER)
+    i = CHARGE_ORDER.index
+    assert i("pg_locked") < i("read_queued") < i("shard_read") \
+        < i("decode_dispatch") < i("decode_complete") \
+        < i("store_apply")
+    assert CHARGE_ORDER[-1] == "scrub_window"
+    # every read/decode/scrub hop is conditional: write-path ledgers
+    # never carry them and the coverage asserts must not demand them
+    assert {"read_queued", "shard_read", "decode_dispatch",
+            "decode_complete", "scrub_window"} <= CONDITIONAL_HOPS
+
+
+def test_charge_read_path_ledger():
+    """A client-facing EC read ledger charges the shard fan-out wait
+    to decode_dispatch and the reconstruction to decode_complete,
+    with the exactness invariant intact."""
+    hops = {"client_send": 0.0, "msgr_enqueue": 0.001,
+            "wire_sent": 0.002, "recv": 0.010,
+            "dispatch_queued": 0.011, "pg_queued": 0.012,
+            "pg_locked": 0.013, "read_queued": 0.014,
+            "decode_dispatch": 0.050, "decode_complete": 0.055,
+            "commit_sent": 0.056, "client_complete": 0.060}
+    charged = dict(charge(hops))
+    assert charged["decode_dispatch"] == pytest.approx(0.036)
+    assert charged["decode_complete"] == pytest.approx(0.005)
+    assert "store_apply" not in charged
+    assert sum(charged.values()) == pytest.approx(0.060)
+
+
+def _read_and_assert_waterfall(c, rad, io, n, size):
+    """The read-side acceptance invariant: serial reads' charged
+    op-seconds stay within the measured client wall and the waterfall
+    shares sum to 1.0."""
+    t0 = time.time()
+    for i in range(n):
+        assert len(io.read(f"wf{i}")) == size
+    wall = time.time() - t0
+    d = rad.objecter.hops_read.dump()
+    assert d["ops"] >= n
+    assert {"recv", "pg_locked", "read_queued", "decode_dispatch",
+            "decode_complete", "commit_sent",
+            "client_complete"} <= set(d["hop_counts"])
+    assert "store_apply" not in d["hop_counts"]  # reads never apply
+    assert 0 < d["op_seconds"] <= wall * 1.25
+    wf = waterfall_block(d, wall)
+    assert abs(wf["sum_of_shares"] - 1.0) <= 0.05
+    assert abs(wf["vs_wall"] - 1.0) <= 0.05
+    assert wf["top_hop"] in HOP_ORDER
+    return wf
+
+
+@pytest.mark.parametrize("backend", ["classic", "crimson"])
+def test_cluster_read_waterfall_invariant(backend):
+    """vstart EC read-back: the client's read-side accumulator covers
+    the queue/shard/decode hops and its shares sum to the measured
+    read wall — under BOTH OSD execution models."""
+    with Cluster(n_osds=4,
+                 conf=make_conf(osd_backend=backend)) as c:
+        for i in range(4):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("rwf", plugin="tpu", k="2", m="1")
+        c.create_pool("rwfp", "erasure", erasure_code_profile="rwf")
+        rad = c.rados(timeout=60)
+        io = rad.open_ioctx("rwfp")
+        import os
+        for i in range(8):
+            io.write_full(f"wf{i}", os.urandom(8192))
+        _read_and_assert_waterfall(c, rad, io, 8, 8192)
+        # writes stayed out of the read accumulator and vice versa
+        assert rad.objecter.hops.dump()["ops"] >= 8
+        assert "read_queued" not in \
+            rad.objecter.hops.dump()["hop_counts"]
+        # each primary closed its sub-read round trips into its own
+        # read-side accumulator, shard_read charged by the remote
+        sub = merge_dumps([o.hops_read.dump()
+                           for o in c.osds.values() if o is not None])
+        assert sub["ops"] > 0
+        assert "shard_read" in sub["hop_counts"]
+
+
+def test_degraded_read_waterfall_one_osd_down():
+    """One OSD down, no recovery window: every read still answers
+    (reconstruct from surviving shards) and the read waterfall
+    invariant holds on the degraded path."""
+    with Cluster(n_osds=4, conf=make_conf()) as c:
+        for i in range(4):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("dwf", plugin="tpu", k="2", m="1")
+        c.create_pool("dwfp", "erasure", erasure_code_profile="dwf")
+        rad = c.rados(timeout=60)
+        io = rad.open_ioctx("dwfp")
+        import os
+        for i in range(6):
+            io.write_full(f"wf{i}", os.urandom(8192))
+        c.kill_osd(3)
+        c.wait_for_osd_down(3, 30)
+        wf = _read_and_assert_waterfall(c, rad, io, 6, 8192)
+        # the shard-wait (fan-out to surviving shards) leg is visible
+        # in the degraded waterfall; decode itself can round to 0 on
+        # 8 KiB objects but must be present
+        assert wf["hop_seconds"]["decode_dispatch"] > 0.0
+        assert "decode_complete" in wf["hop_seconds"]

@@ -24,6 +24,16 @@ history and fails loudly on:
   attribution's ``waterfall`` block blows past the most recent
   history round that recorded one.  History rounds predating the hop
   ledger carry no waterfall and the check is silently skipped.
+- **read-path hop p99 regression** — same budget applied to the
+  ``read_waterfall`` block (the client-facing read ledger: queue /
+  shard_read / decode hops).  Rounds predating the read ledger
+  silently skip.
+- **SLO regression** — the attribution's ``slo`` block (per-class
+  error-budget burn merged across every OSD) must show ZERO
+  client-class burn on a bench run (bench runs are fault-free), and
+  no recovery/scrub-class *errors* where the most recent
+  SLO-carrying history round had none.  Rounds predating the SLO
+  engine silently skip.
 
 History files are ``{"n", "cmd", "rc", "tail", "parsed"}`` wrappers
 around a captured bench stdout; metric records are re-extracted from
@@ -218,20 +228,26 @@ def check(attribution: Optional[Dict], history: List[Dict],
                             f"{old_share:.0%}, tolerance "
                             f"+{stage_tol:.0%})"})
 
-    # -- per-hop p99 budget (waterfall block) -------------------------
-    # The waterfall block only exists from the hop-ledger rounds on;
-    # older history (and fresh runs with the ledger disabled) simply
-    # lack it and the check self-skips — no data is never a failure.
-    hist_wf = None
-    for rnd in reversed(history):
-        rec = _pick(rnd["records"], _ATTRIB_PREFIX)
-        if rec is not None and isinstance(rec.get("waterfall"), dict) \
-                and isinstance(rec["waterfall"].get("p99_s"), dict):
-            hist_wf = rec["waterfall"]
-            break
-    fresh_wf = (attribution or {}).get("waterfall") \
-        if attribution is not None else None
-    if isinstance(fresh_wf, dict) and hist_wf is not None:
+    # -- per-hop p99 budgets (waterfall + read_waterfall blocks) ------
+    # A waterfall block only exists from the hop-ledger rounds on
+    # (read_waterfall one PR later); older history (and fresh runs
+    # with the ledger disabled) simply lack it and the check
+    # self-skips — no data is never a failure.
+    def _hist_block(key: str) -> Optional[Dict]:
+        for rnd in reversed(history):
+            rec = _pick(rnd["records"], _ATTRIB_PREFIX)
+            if rec is not None and isinstance(rec.get(key), dict) \
+                    and isinstance(rec[key].get("p99_s"), dict):
+                return rec[key]
+        return None
+
+    for key, label in (("waterfall", "write"),
+                       ("read_waterfall", "read")):
+        hist_wf = _hist_block(key)
+        fresh_wf = (attribution or {}).get(key) \
+            if attribution is not None else None
+        if not isinstance(fresh_wf, dict) or hist_wf is None:
+            continue
         old_p99 = hist_wf.get("p99_s") or {}
         new_p99 = fresh_wf.get("p99_s") or {}
         for hop in sorted(new_p99):
@@ -243,12 +259,54 @@ def check(attribution: Optional[Dict], history: List[Dict],
             if new > old * hop_p99_factor \
                     and new - old > HOP_P99_SLACK_S:
                 findings.append({
-                    "check": "hop-p99-regression",
+                    "check": f"{label}-hop-p99-regression",
                     "severity": "fail",
                     "message":
-                        f"hop {hop!r} p99 {new * 1e3:.2f} ms > "
+                        f"{label}-path hop {hop!r} p99 "
+                        f"{new * 1e3:.2f} ms > "
                         f"{hop_p99_factor:.1f} x history "
-                        f"{old * 1e3:.2f} ms (waterfall budget)"})
+                        f"{old * 1e3:.2f} ms ({key} budget)"})
+
+    # -- SLO regression (per-class error-budget burn) -----------------
+    # Bench runs are fault-free: ANY client-class burn in the fresh
+    # run is a regression outright.  Recovery/scrub classes tolerate
+    # latency breaches (machine-speed noise) but not errors appearing
+    # where the most recent SLO-carrying history round had none.
+    # Rounds predating the SLO engine carry no `slo` block and the
+    # history half self-skips.
+    fresh_slo = (attribution or {}).get("slo") \
+        if attribution is not None else None
+    if isinstance(fresh_slo, dict):
+        for cls in ("client_read", "client_write"):
+            row = fresh_slo.get(cls) or {}
+            burn = row.get("burn", 0.0)
+            if isinstance(burn, (int, float)) and burn > 0:
+                findings.append({
+                    "check": "slo-regression", "severity": "fail",
+                    "message":
+                        f"{cls} burned error budget on a fault-free "
+                        f"bench run (burn {burn:.3f}, "
+                        f"{row.get('breaches', 0)} breaches / "
+                        f"{row.get('errors', 0)} errors over "
+                        f"{row.get('ops', 0)} ops)"})
+        hist_slo = None
+        for rnd in reversed(history):
+            rec = _pick(rnd["records"], _ATTRIB_PREFIX)
+            if rec is not None and isinstance(rec.get("slo"), dict):
+                hist_slo = rec["slo"]
+                break
+        if hist_slo is not None:
+            for cls in ("recovery", "scrub"):
+                new_err = (fresh_slo.get(cls) or {}).get("errors", 0)
+                old_err = (hist_slo.get(cls) or {}).get("errors", 0)
+                if isinstance(new_err, (int, float)) and new_err > 0 \
+                        and not old_err:
+                    findings.append({
+                        "check": "slo-regression", "severity": "fail",
+                        "message":
+                            f"{cls}-class errors appeared "
+                            f"({new_err}) where the last SLO-carrying "
+                            f"history round had none"})
 
     # -- cluster throughput ratio regression --------------------------
     if fresh_ratio is not None:

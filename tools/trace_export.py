@@ -1,0 +1,267 @@
+#!/usr/bin/env python3
+"""Unified Chrome/Perfetto trace export across every daemon.
+
+Merges the per-daemon ``dump_trace`` bundles (``ceph tell osd.N
+dump_trace`` — recent hop ledgers by op class, optracker stage
+timelines, flight-recorder events, per-shard reactor utilization
+samples, sampler folded stacks) plus the client's objecter bundle
+into ONE ``trace_event`` JSON loadable in ``ui.perfetto.dev`` (or
+``chrome://tracing``) unmodified:
+
+- one *process* per daemon (client, each OSD), named via ``M``
+  metadata events;
+- per-op tracks: every recent hop ledger becomes an enclosing op
+  slice plus nested per-hop slices (``X`` complete events, charged to
+  the hop that ends each interval — the same rule as
+  ``utils/hops.charge``), lane-packed so concurrent ops never overlap
+  on one thread track;
+- optracker timelines: per-op stage slices between consecutive
+  ``mark_event`` stamps;
+- flight-recorder events as instants (``i``);
+- per-shard reactor utilization + loop-lag counter tracks (``C``),
+  which is the PR 8 "is multi-shard scaling real?" readout.
+
+Hop ledgers use absolute wall-clock stamps, so slices from different
+daemons line up on one timeline without clock translation.  All
+timestamps are rebased to the earliest event and emitted in
+microseconds (the trace_event contract).
+
+Usage::
+
+    ceph tell osd.0 dump_trace > osd0.json   # one bundle per daemon
+    python tools/trace_export.py --out trace.json osd0.json osd1.json
+
+``bench.py`` and the tier-1 structural test import
+:func:`export_bundles` directly on live in-process bundles.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+try:
+    from ceph_tpu.utils.hops import CHARGE_ORDER
+except ImportError:                     # invoked as a script from tools/
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    from ceph_tpu.utils.hops import CHARGE_ORDER
+
+#: thread-id bases per track family (per daemon process); lanes for
+#: concurrent ops fan out upward from the base
+_TID_BASE = {"write": 100, "read": 200, "recovery": 300,
+             "optracker": 400, "flight": 500, "reactor": 600}
+_MAX_LANES = 64          # overlap-packing cap per track family
+
+
+class _Lanes:
+    """Greedy interval packing: assign each op the first lane whose
+    previous op already ended, so slices on one Perfetto thread track
+    never overlap (overlapping X events render broken)."""
+
+    def __init__(self) -> None:
+        self._ends: List[float] = []
+
+    def place(self, start: float, end: float) -> int:
+        for i, e in enumerate(self._ends):
+            if start >= e:
+                self._ends[i] = end
+                return i
+        if len(self._ends) < _MAX_LANES:
+            self._ends.append(end)
+            return len(self._ends) - 1
+        # saturated: reuse the lane that frees up first
+        i = min(range(len(self._ends)), key=lambda j: self._ends[j])
+        self._ends[i] = end
+        return i
+
+
+def _ledger_slices(ledger: Dict[str, float]):
+    """-> (start, end, [(hop, t_start, t_end)]) in charge order, or
+    None for degenerate ledgers."""
+    stamps = [(name, ledger[name]) for name in CHARGE_ORDER
+              if name in ledger]
+    if len(stamps) < 2:
+        return None
+    spans = []
+    prev_t = stamps[0][1]
+    for name, t in stamps[1:]:
+        if t >= prev_t:
+            spans.append((name, prev_t, t))
+            prev_t = t
+    if not spans:
+        return None
+    return stamps[0][1], prev_t, spans
+
+
+def export_bundles(bundles: List[Dict]) -> Dict:
+    """Merge daemon bundles -> Chrome trace_event JSON dict."""
+    events: List[Dict] = []
+    other: Dict[str, object] = {}
+    # pass 1: find the rebase origin across every timestamped source
+    t0: Optional[float] = None
+
+    def _see(ts: Optional[float]) -> None:
+        nonlocal t0
+        if isinstance(ts, (int, float)) and ts > 0:
+            t0 = ts if t0 is None else min(t0, ts)
+
+    for b in bundles:
+        for ledgers in (b.get("ledgers") or {}).values():
+            for led in ledgers or []:
+                for ts in led.values():
+                    _see(ts)
+        for op in b.get("ops") or []:
+            _see(op.get("initiated_at"))
+        for ev in (b.get("flight") or {}).get("events") or []:
+            _see(ev.get("time"))
+        for r in b.get("reactors") or []:
+            for s in r.get("util") or []:
+                _see(s.get("ts"))
+    if t0 is None:
+        t0 = 0.0
+
+    def us(ts: float) -> float:
+        return round((ts - t0) * 1e6, 1)
+
+    for pid, b in enumerate(bundles, start=1):
+        daemon = b.get("daemon", f"daemon.{pid}")
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": daemon}})
+        events.append({"ph": "M", "name": "process_sort_index",
+                       "pid": pid, "tid": 0,
+                       "args": {"sort_index": pid}})
+        named_tids: Dict[int, str] = {}
+
+        # -- per-op hop-ledger tracks ------------------------------
+        for cls, ledgers in sorted((b.get("ledgers") or {}).items()):
+            base = _TID_BASE.get(cls, 900)
+            lanes = _Lanes()
+            for led in ledgers or []:
+                sl = _ledger_slices(led)
+                if sl is None:
+                    continue
+                start, end, spans = sl
+                tid = base + lanes.place(start, end)
+                named_tids.setdefault(tid, f"{cls} ops")
+                events.append({"ph": "X", "name": f"{cls}_op",
+                               "cat": cls, "pid": pid, "tid": tid,
+                               "ts": us(start),
+                               "dur": round((end - start) * 1e6, 1)})
+                for hop, hs, he in spans:
+                    events.append({
+                        "ph": "X", "name": hop, "cat": cls,
+                        "pid": pid, "tid": tid, "ts": us(hs),
+                        "dur": round((he - hs) * 1e6, 1)})
+
+        # -- optracker stage timelines -----------------------------
+        lanes = _Lanes()
+        base = _TID_BASE["optracker"]
+        for op in b.get("ops") or []:
+            evs = [(e.get("time"), e.get("event"))
+                   for e in op.get("events") or []
+                   if isinstance(e.get("time"), (int, float))]
+            if len(evs) < 2:
+                continue
+            evs.sort(key=lambda te: te[0])
+            start, end = evs[0][0], evs[-1][0]
+            tid = base + lanes.place(start, end)
+            named_tids.setdefault(tid, "optracker")
+            events.append({"ph": "X", "name":
+                           (op.get("description") or "op")[:80],
+                           "cat": "optracker", "pid": pid, "tid": tid,
+                           "ts": us(start),
+                           "dur": round((end - start) * 1e6, 1)})
+            prev_t = evs[0][0]
+            for t, name in evs[1:]:
+                if t > prev_t:
+                    events.append({
+                        "ph": "X", "name": str(name),
+                        "cat": "optracker", "pid": pid, "tid": tid,
+                        "ts": us(prev_t),
+                        "dur": round((t - prev_t) * 1e6, 1)})
+                prev_t = t
+
+        # -- flight-recorder instants ------------------------------
+        tid = _TID_BASE["flight"]
+        fl = (b.get("flight") or {}).get("events") or []
+        if fl:
+            named_tids.setdefault(tid, "flight recorder")
+        for ev in fl:
+            ts = ev.get("time")
+            if not isinstance(ts, (int, float)):
+                continue
+            args = {k: v for k, v in ev.items()
+                    if k not in ("time", "mono")}
+            events.append({"ph": "i", "name": str(ev.get("kind", "ev")),
+                           "cat": "flight", "pid": pid, "tid": tid,
+                           "ts": us(ts), "s": "p", "args": args})
+
+        # -- per-shard reactor utilization counters ----------------
+        for r in b.get("reactors") or []:
+            shard = r.get("shard", 0)
+            for s in r.get("util") or []:
+                ts = s.get("ts")
+                if not isinstance(ts, (int, float)):
+                    continue
+                events.append({
+                    "ph": "C", "name": f"reactor{shard}_util",
+                    "pid": pid, "tid": 0, "ts": us(ts),
+                    "args": {"util": round(s.get("util", 0.0), 4)}})
+                events.append({
+                    "ph": "C", "name": f"reactor{shard}_loop_lag_ms",
+                    "pid": pid, "tid": 0, "ts": us(ts),
+                    "args": {"lag": round(
+                        s.get("loop_lag_s", 0.0) * 1e3, 3)}})
+
+        for tid, name in sorted(named_tids.items()):
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": name}})
+            events.append({"ph": "M", "name": "thread_sort_index",
+                           "pid": pid, "tid": tid,
+                           "args": {"sort_index": tid}})
+
+        folded = b.get("folded")
+        if folded:
+            other[f"{daemon}_folded"] = folded
+
+    events.sort(key=lambda e: (e.get("ts", 0), e.get("pid", 0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms",
+            "otherData": other}
+
+
+def write_trace(bundles: List[Dict], path: str) -> Dict:
+    trace = export_bundles(bundles)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("bundles", nargs="+",
+                    help="per-daemon dump_trace JSON files")
+    ap.add_argument("--out", default="trace.json",
+                    help="output trace_event JSON path")
+    args = ap.parse_args(argv)
+    bundles = []
+    for p in args.bundles:
+        try:
+            with open(p) as f:
+                bundles.append(json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"trace_export: unreadable bundle {p}: {e}",
+                  file=sys.stderr)
+            return 2
+    trace = write_trace(bundles, args.out)
+    n_procs = len({e["pid"] for e in trace["traceEvents"]})
+    print(f"trace_export: {len(trace['traceEvents'])} events across "
+          f"{n_procs} process(es) -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
