@@ -1015,6 +1015,36 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         stats["slo"] = _SLO.merge_dumps(
             [osd.slo.dump() for osd in c.osds.values()
              if getattr(osd, "slo", None) is not None])
+        # device waterfall (ISSUE 10): per-phase ledger + overlap
+        # engine merged across every OSD's batcher; the memory
+        # snapshot dedupes shared backends (in-process daemons can
+        # share one JaxBackend, summing would double-count)
+        from ceph_tpu.utils.device_ledger import (
+            merge_dumps as _dev_merge)
+        stats["device_ledger"] = _dev_merge(
+            [osd.encode_batcher.ledger_accum.dump()
+             for osd in c.osds.values()
+             if getattr(osd, "encode_batcher", None) is not None])
+        mem_total: dict = {}
+        seen_backends = set()
+        for osd in c.osds.values():
+            be = getattr(getattr(osd, "encode_batcher", None),
+                         "_last_backend", None)
+            if be is None or id(be) in seen_backends:
+                continue
+            seen_backends.add(id(be))
+            try:
+                for k2, v2 in be.memory_stats().items():
+                    mem_total[k2] = mem_total.get(k2, 0) + v2
+            except Exception:
+                pass
+        stats["device_memory"] = mem_total
+        # cluster health verdict (ISSUE 10): every daemon's named
+        # checks merged into the one-look HEALTH_* line
+        from ceph_tpu.mgr import health as _healthlib
+        stats["health"] = _healthlib.merge(
+            [osd._exec_command({"prefix": "dump_health"})[2]
+             for osd in c.osds.values()])
         total_mb = n_objs * obj_bytes / 2**20
         # the rebuild recovers the warmup objects too: count them
         rebuilt_mb = (n_objs + 2) * obj_bytes / 2**20
@@ -1115,6 +1145,23 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
         if hv and hv.get("ops"):
             att_obj["recovery"] = waterfall_block(
                 hv, st.get("rebuild_wall_s", 0.0))
+        # device waterfall (ISSUE 10): sub-dispatch phase shares over
+        # the slice of wall the stage attribution already charges to
+        # the device (h2d+device+d2h) — shares sum to 1.0 of batcher
+        # device wall, with the overlap engine's verdict alongside
+        dl = st.get("device_ledger")
+        if dl and dl.get("groups"):
+            from ceph_tpu.utils.device_ledger import (
+                device_waterfall_block)
+            dev_wall = (scaled.get("h2d", 0.0)
+                        + scaled.get("device", 0.0)
+                        + scaled.get("d2h", 0.0))
+            dwf = device_waterfall_block(dl, round(dev_wall, 6))
+            if st.get("device_memory"):
+                dwf["memory"] = st["device_memory"]
+            att_obj["device_waterfall"] = dwf
+        if st.get("health"):
+            att_obj["health"] = st["health"]
         if st.get("slo"):
             att_obj["slo"] = st["slo"]
         if st.get("profile"):
@@ -1246,6 +1293,14 @@ def bench_cluster_scaling(obj_bytes=512 << 10, per_client=2):
 
                 ts = [threading.Thread(target=worker, args=(ci,))
                       for ci in range(n)]
+                react0 = {}
+                if n == 64:
+                    # reactor clocks are cumulative; baseline them so
+                    # the saturation snapshot reflects THIS rung only
+                    for o in c.osds.values():
+                        for r0 in getattr(o, "reactors", []):
+                            react0[(o.whoami, r0.shard)] = (
+                                r0.busy_s, r0.loop_lag_s)
                 t0 = time.perf_counter()
                 for t in ts:
                     t.start()
@@ -1275,6 +1330,44 @@ def bench_cluster_scaling(obj_bytes=512 << 10, per_client=2):
                             getattr(o.encode_batcher,
                                     "group_stripes_hwm", 0)
                             for o in c.osds.values())}
+                if n == 64:
+                    # reactor-saturation snapshot (ISSUE 10): the one
+                    # rung where classic still beats crimson — is a
+                    # shard pegged, lagging its loop, or backed up on
+                    # its mailbox, and which hop pays for it?
+                    shards = []
+                    for o in c.osds.values():
+                        for r0 in getattr(o, "reactors", []):
+                            b0, l0 = react0.get(
+                                (o.whoami, r0.shard), (0.0, 0.0))
+                            busy = max(0.0, r0.busy_s - b0)
+                            shards.append({
+                                "osd": o.whoami,
+                                "shard": r0.shard,
+                                "util": round(busy / wall, 4)
+                                if wall > 0 else 0.0,
+                                "busy_s": round(busy, 4),
+                                "loop_lag_s": round(max(
+                                    0.0, r0.loop_lag_s - l0), 6),
+                                "mailbox_hwm": r0.mailbox_hwm})
+                    wf64 = _hops_merge([r.objecter.hops.dump()
+                                        for r in rads])
+                    hs64 = wf64.get("hop_seconds") or {}
+                    side["reactor_saturation_64"] = {
+                        "shards": shards,
+                        "util_max": max(
+                            (s["util"] for s in shards),
+                            default=0.0),
+                        "loop_lag_max_s": max(
+                            (s["loop_lag_s"] for s in shards),
+                            default=0.0),
+                        "mailbox_hwm": max(
+                            (s["mailbox_hwm"] for s in shards),
+                            default=0),
+                        "top_hop": max(
+                            hs64.items(),
+                            key=lambda kv: kv[1])[0]
+                        if hs64 else None}
             xs = {"xshard_in": 0, "xshard_out": 0, "handoffs": 0}
             for osd in c.osds.values():
                 for r in getattr(osd, "reactors", []):

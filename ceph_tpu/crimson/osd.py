@@ -149,8 +149,12 @@ class ReactorBatcher:
         self._inner.stop(drain=drain)
 
     def __getattr__(self, name):
-        # prewarm / prefer_cpu / tick_flush / counters pass straight
-        # through to the shared batcher
+        # prewarm / prefer_cpu / tick_flush / counters — and the
+        # device-waterfall surface (device_dump / device_trace_block /
+        # ledger_accum, consumed by dump_device and the trace bundle)
+        # — pass straight through to the shared batcher: the phase
+        # ledger is stamped on the collector/device threads, so the
+        # shard front adds nothing to observe
         return getattr(self._inner, name)
 
 

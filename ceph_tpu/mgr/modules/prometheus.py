@@ -25,7 +25,11 @@ _QUANTILES = ((0.50, "p50"), (0.95, "p95"), (0.99, "p99"))
 # ec_device subsystem's *_now / *_bps / *_hwm occupancy gauges and
 # the staging-pool level samples must not be typed "counter" or
 # rate() over them is nonsense.
-_GAUGE_SUFFIXES = ("_now", "_bps", "_hwm", "_in_flight", "_slots")
+_GAUGE_SUFFIXES = ("_now", "_bps", "_hwm", "_in_flight", "_slots",
+                   # memory-accounting + pipeline-efficiency gauges
+                   # (ec_device: staging ring peak bytes, compile
+                   # cache occupancy, overlap engine verdict)
+                   "_peak", "_entries", "_frac")
 
 
 def _scalar_type(metric: str) -> str:

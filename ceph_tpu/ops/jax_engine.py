@@ -662,6 +662,8 @@ class StagingPool:
         self.stall_allocs = 0    # ring grown after an acquire stall
         self.h2d_bps = 0.0       # warm-transfer EWMA (fenced samples)
         self.h2d_samples = 0
+        self.host_bytes = 0      # live host-ring footprint (all rings)
+        self.host_bytes_peak = 0
 
     # -- slot checkout -----------------------------------------------
     def acquire(self, shape: tuple) -> _StageSlot:
@@ -680,6 +682,7 @@ class StagingPool:
                     self._made[shape] = self._made.get(shape, 0) + 1
                     slot = _StageSlot(np.zeros(shape, dtype=np.uint8))
                     self.allocs += 1
+                    self._note_alloc_locked(slot.host.nbytes)
                     self._evict_locked()
                     break
                 # both slots in flight: wait for a release (bounded
@@ -696,6 +699,7 @@ class StagingPool:
                     slot = _StageSlot(np.zeros(shape, dtype=np.uint8))
                     self.allocs += 1
                     self.stall_allocs += 1
+                    self._note_alloc_locked(slot.host.nbytes)
                     self._evict_locked()
                     break
                 self._cv.wait(timeout=0.5)
@@ -714,12 +718,19 @@ class StagingPool:
             self._free.setdefault(shape, []).append(slot)
             self._cv.notify_all()
 
+    def _note_alloc_locked(self, nbytes: int) -> None:
+        self.host_bytes += int(nbytes)
+        if self.host_bytes > self.host_bytes_peak:
+            self.host_bytes_peak = self.host_bytes
+
     def _evict_locked(self) -> None:
         # drop the least-recently-used shape's idle ring when the
         # shape set outgrows the cap (only fully-idle shapes qualify)
         while len(self._free) > self.MAX_SHAPES:
             for shape in list(self._free):
                 if len(self._free[shape]) >= self._made.get(shape, 0):
+                    for s in self._free[shape]:
+                        self.host_bytes -= s.host.nbytes
                     del self._free[shape]
                     self._made.pop(shape, None)
                     break
@@ -753,7 +764,9 @@ class StagingPool:
                     "h2d_samples": self.h2d_samples,
                     "shapes": len(self._made),
                     "slots": made,
-                    "in_flight": max(0, made - free)}
+                    "in_flight": max(0, made - free),
+                    "host_bytes": self.host_bytes,
+                    "host_bytes_peak": self.host_bytes_peak}
 
     def ensure(self, shape: tuple) -> None:
         """Preallocate a full ring for ``shape`` (prewarm path)."""
@@ -762,8 +775,10 @@ class StagingPool:
             self._free.move_to_end(shape)
             while self._made.get(shape, 0) < self.depth:
                 self._made[shape] = self._made.get(shape, 0) + 1
-                free.append(_StageSlot(np.zeros(shape, dtype=np.uint8)))
+                slot = _StageSlot(np.zeros(shape, dtype=np.uint8))
+                free.append(slot)
                 self.allocs += 1
+                self._note_alloc_locked(slot.host.nbytes)
             self._evict_locked()
 
 
@@ -774,7 +789,8 @@ class AsyncBatch:
     overlap host->device staging, MXU compute, and device->host parity
     fetch across consecutive stripe batches."""
 
-    def __init__(self, dev_out, batch: int, L: int, lead: tuple):
+    def __init__(self, dev_out, batch: int, L: int, lead: tuple,
+                 ledger: Optional[dict] = None):
         self._dev = dev_out
         self._batch = batch
         self._L = L
@@ -783,8 +799,33 @@ class AsyncBatch:
         # batch happened to be the sampled one (batcher EWMA feed)
         self.h2d_bytes = 0
         self.h2d_seconds = 0.0
+        # device-phase ledger (utils/device_ledger): absolute stamps,
+        # finalized by wait(); keyed by JAX device id so lanes are
+        # mesh-ready for the multichip promotion
+        self.ledger = ledger
+        if ledger is not None and "device" not in ledger:
+            try:
+                ledger["device"] = next(iter(dev_out.devices())).id
+            except Exception:
+                ledger["device"] = 0
 
     def wait(self) -> np.ndarray:
+        led = self.ledger
+        if led is not None:
+            # split the join into its real phases: compute fence,
+            # then the d2h materialisation, then the zero-copy trim
+            try:
+                self._dev.block_until_ready()
+            except Exception:
+                pass             # deleted/donated output == retired
+            led["compute_done"] = time.time()
+            host = np.asarray(self._dev)
+            led["d2h_done"] = time.time()
+            out = host[:self._batch, :, :self._L]
+            out = out.reshape(self._lead + out.shape[-2:])
+            led["deliver"] = time.time()
+            led["bytes"] = out.nbytes
+            return out
         out = np.asarray(self._dev)[:self._batch, :, :self._L]
         return out.reshape(self._lead + out.shape[-2:])
 
@@ -810,6 +851,27 @@ class JaxBackend:
             self._dev_matrices[key] = hit
         return hit
 
+    def memory_stats(self) -> dict:
+        """Footprint snapshot for the memory-accounting gauges: host
+        staging rings, device-resident coding matrices (per-geometry),
+        and compiled-executable cache occupancy."""
+        dev_matrix_bytes = 0
+        for m in list(self._dev_matrices.values()):
+            try:
+                dev_matrix_bytes += int(m.nbytes)
+            except Exception:
+                pass
+        st = self.staging.stats()
+        return {
+            "staging_host_bytes": st["host_bytes"],
+            "staging_host_bytes_peak": st["host_bytes_peak"],
+            "staging_slots": st["slots"],
+            "dev_matrix_bytes": dev_matrix_bytes,
+            "dev_matrix_entries": len(self._dev_matrices),
+            "compile_cache_entries": len(self._chain_lru._d),
+            "compile_cache_cap": self._chain_lru.cap,
+        }
+
     def _padded(self, data: np.ndarray, quantum: int):
         """Pad [batch, k, L] to bucketed [batch', k, L'] (zeros are
         harmless: the code is GF-linear)."""
@@ -826,17 +888,26 @@ class JaxBackend:
 
     def _staged_put(self, data: np.ndarray, quantum: int):
         """Pad [batch, k, L] into a persistent staging slot and start
-        its h2d.  Returns ``(dev, batch, L, done, sampled)``; the
-        caller MUST invoke ``done(fence)`` with the device value
+        its h2d.  Returns ``(dev, batch, L, done, sampled, ledger)``;
+        the caller MUST invoke ``done(fence)`` with the device value
         computed from ``dev`` right after dispatch — the fence is what
         lets the slot's host bytes be overwritten by a later batch.
         Every Nth staging is fenced and timed to keep the pool's warm
-        h2d EWMA honest."""
+        h2d EWMA honest.  ``ledger`` carries the device-phase stamps
+        accrued so far (stage_acquire/h2d_*); AsyncBatch finalizes it."""
         batch, k, L = data.shape
         if not self.bucket_shapes:
-            return jax.device_put(data), batch, L, None, None
+            ledger = {"stage_acquire": time.time()}
+            ledger["h2d_start"] = ledger["stage_acquire"]
+            dev = jax.device_put(data)
+            ledger["h2d_done"] = time.time()
+            return dev, batch, L, None, None, ledger
         shape = (_bucket_batch(batch), k, _round_up(L, quantum))
         slot = self.staging.acquire(shape)
+        # ledger origin: the slot is ours (ring fence retired).  The
+        # interval ending at h2d_start is the host fill; h2d_done is
+        # exact on fenced samples, dispatch-time otherwise.
+        ledger = {"stage_acquire": time.time()}
         try:
             host = slot.host
             host[:batch, :, :L] = data  # copycheck: ok - staging fill into a REUSED persistent buffer (the one h2d copy)
@@ -847,6 +918,7 @@ class JaxBackend:
                 host[:, :, L:slot.max_l] = 0
             slot.max_l = max(slot.max_l, L)
             sample = None
+            ledger["h2d_start"] = time.time()
             if self.staging.should_sample():
                 t0 = time.monotonic()
                 dev = jax.device_put(host)
@@ -859,6 +931,7 @@ class JaxBackend:
                     pass
             else:
                 dev = jax.device_put(host)
+            ledger["h2d_done"] = time.time()
         except BaseException:
             # staging/h2d failed before a fence existed: return the
             # slot with no fence, or the ring leaks a slot per failure
@@ -868,7 +941,7 @@ class JaxBackend:
 
         def done(fence, _shape=shape, _slot=slot):
             self.staging.release(_shape, _slot, fence)
-        return dev, batch, L, done, sample
+        return dev, batch, L, done, sample, ledger
 
     def prewarm_geometry(self, k: int, chunk_size: int,
                          batches=(1,), w: int = 8) -> None:
@@ -1006,10 +1079,11 @@ class JaxBackend:
             data = data[None]
         lead = data.shape[:-2] if not squeeze else ()
         data = data.reshape((-1,) + data.shape[-2:])
-        dev, batch, L, done, sample = self._staged_put(
+        dev, batch, L, done, sample, ledger = self._staged_put(
             data, LENGTH_QUANTUM)
         try:
             out = self.gf8_fn(M, donate=done is not None)(dev)
+            ledger["compute_start"] = time.time()
             out.copy_to_host_async()
         except BaseException:
             # kernel dispatch failed: no fence will ever retire, so
@@ -1019,7 +1093,7 @@ class JaxBackend:
             raise
         if done is not None:
             done(out)
-        ab = AsyncBatch(out, batch, L, lead)
+        ab = AsyncBatch(out, batch, L, lead, ledger)
         if sample is not None:
             ab.h2d_bytes, ab.h2d_seconds = sample
         return ab
@@ -1057,10 +1131,11 @@ class JaxBackend:
         if data.shape[-1] % wbytes:
             raise ValueError(
                 f"chunk length must be a multiple of {wbytes} for w={w}")
-        dev, batch, L, done, sample = self._staged_put(
+        dev, batch, L, done, sample, ledger = self._staged_put(
             data, LENGTH_QUANTUM * wbytes)
         try:
             out = _apply_byte_domain(self._device_matrix(B), dev, w)
+            ledger["compute_start"] = time.time()
             out.copy_to_host_async()
         except BaseException:
             # kernel dispatch failed: no fence will ever retire, so
@@ -1070,7 +1145,7 @@ class JaxBackend:
             raise
         if done is not None:
             done(out)
-        ab = AsyncBatch(out, batch, L, lead)
+        ab = AsyncBatch(out, batch, L, lead, ledger)
         if sample is not None:
             ab.h2d_bytes, ab.h2d_seconds = sample
         return ab

@@ -45,6 +45,7 @@ import numpy as np
 from . import ecutil
 from ..utils import copytrack
 from ..utils import faults as faultlib
+from ..utils.device_ledger import DeviceLedgerAccum, overlap_stats
 
 
 class _Req:
@@ -251,6 +252,11 @@ class EncodeBatcher:
         self.device_error_threshold = get(
             "ec_tpu_device_error_threshold", 3)
         self.device_retry_s = get("ec_tpu_device_retry_ms", 2.0) / 1e3
+        # device-phase stall threshold: an h2d or compute-fence phase
+        # exceeding this flight-records a device_stall (+ rate-limited
+        # auto-dump), mirroring the lock_stall path
+        self.phase_stall_s = get(
+            "ec_tpu_device_phase_stall_ms", 250.0) / 1e3
         self.prewarm_enabled = get("osd_ec_prewarm", True)
         self.cpu_reqs = 0                        # routed to CPU twin
         self.perf = perf
@@ -396,7 +402,39 @@ class EncodeBatcher:
                     dp.add(f"dec_route_{reason}",
                            description="decode routing verdicts: "
                                        + desc)
+            if "staging_host_bytes_now" not in dp._types:
+                # memory-accounting + overlap gauges (ISSUE 10),
+                # registered under their own guard: dperf instances
+                # created by older sessions predate these
+                from ..utils.perf import TYPE_U64
+                for g, desc in (
+                        ("staging_host_bytes_now", "host staging ring "
+                                                   "footprint (bytes)"),
+                        ("staging_host_bytes_peak", "peak host staging "
+                                                    "ring footprint"),
+                        ("dev_matrix_bytes_now", "device-resident "
+                                                 "coding matrix bytes "
+                                                 "(per-geometry cache)"),
+                        ("compile_cache_entries", "compiled-executable "
+                                                  "cache occupancy"),
+                        ("pipeline_overlap_frac", "fraction of window "
+                                                  "wall where group "
+                                                  "N+1 h2d overlaps "
+                                                  "group N compute")):
+                    dp.add(g, TYPE_U64, desc)
+                dp.add("device_phase_stalls",
+                       description="device phases (h2d / compute "
+                                   "fence) that exceeded "
+                                   "ec_tpu_device_phase_stall_ms")
             self.dperf = dp
+        # device-phase ledger accumulator (utils/device_ledger):
+        # per-group stage_acquire..deliver stamps harvested from each
+        # AsyncBatch at completion, plus the overlap engine over its
+        # recent ring.  Dumped via the OSD's dump_device command and
+        # bench's device_waterfall block.
+        self.ledger_accum = DeviceLedgerAccum(perf_coll)
+        self._ledger_completions = 0
+        self._last_backend = None    # codec backend seen at completion
         self._route_reason = None    # last verdict's reason code
         self._staging_stalls_seen = 0
         self._inflight_hwm = 0
@@ -1029,6 +1067,7 @@ class EncodeBatcher:
         through ONE batched kernel call on the _BatchTwin (native C++
         when available) — the coalescing win survives CPU routing."""
         t_form = time.monotonic()
+        t_wall = time.time()
         self._account_queue_wait(reqs, t_form)
         for r in reqs:
             if r.tracked is not None:
@@ -1050,6 +1089,19 @@ class EncodeBatcher:
             # twin encode is pure compute: no transfer legs
             self.stage_seconds["device"] += \
                 time.monotonic() - t_form
+            # twin groups still fold into the device waterfall: a
+            # coarse two-stamp ledger keyed device=-1 (host), so
+            # dump_device and the bench attribution account for every
+            # group regardless of routing.  No h2d/d2h stamps — the
+            # whole interval charges to the compute fence — and the
+            # overlap engine ignores negative device ids (a host
+            # group has no transfer to hide under compute).
+            t_done = time.time()
+            self._observe_device_ledger(
+                {"stage_acquire": t_wall, "compute_start": t_wall,
+                 "compute_done": t_done, "deliver": t_done,
+                 "device": -1, "bytes": int(batch.nbytes),
+                 "stripes": int(batch.shape[0]), "group": "encode"})
             if self.bperf is not None:
                 self.bperf.hinc("batch_stripes", batch.shape[0])
                 self.bperf.inc("cpu_reqs", len(reqs))
@@ -1160,7 +1212,21 @@ class EncodeBatcher:
             try:
                 if not on_twin:
                     faultlib.registry().hit(faultlib.DEVICE_DISPATCH)
+                t0 = time.time()
                 rec = impl.decode_batch(present, cs)
+                # decode_batch is a fenced synchronous call, so the
+                # group ledger is coarse: the whole interval charges
+                # to the compute fence.  Still keyed and accumulated
+                # like encode groups so the read path shows up in
+                # the device waterfall; twin-routed groups carry
+                # device=-1 (host lane, excluded from overlap).
+                t1 = time.time()
+                led = {"stage_acquire": t0, "compute_start": t0,
+                       "compute_done": t1, "deliver": t1,
+                       "group": "decode"}
+                if on_twin:
+                    led["device"] = -1
+                self._observe_device_ledger(led)
                 if not on_twin:
                     self._device_success()
             except Exception:
@@ -1415,10 +1481,15 @@ class EncodeBatcher:
         grew to protect the write path — flight-record it."""
         dp = self.dperf
         rec = self.recorder
+        backend = getattr(getattr(ec_impl, "core", None),
+                          "backend", None)
+        if backend is not None and hasattr(backend, "memory_stats"):
+            # remembered so dump_device can report memory accounting
+            # even on a daemon with no perf plumbing (unit stubs)
+            self._last_backend = backend
         if dp is None and rec is None:
             return
-        pool = getattr(getattr(getattr(ec_impl, "core", None),
-                               "backend", None), "staging", None)
+        pool = getattr(backend, "staging", None)
         if pool is not None:
             try:
                 st = pool.stats()
@@ -1440,6 +1511,95 @@ class EncodeBatcher:
                                  slots=st["slots"])
         if dp is not None:
             dp.set("h2d_bps", int(EncodeBatcher._h2d_bps))
+            if self._last_backend is not None and \
+                    "staging_host_bytes_now" in dp._types:
+                try:
+                    mem = self._last_backend.memory_stats()
+                except Exception:
+                    mem = None
+                if mem:
+                    dp.set("staging_host_bytes_now",
+                           mem["staging_host_bytes"])
+                    dp.set("staging_host_bytes_peak",
+                           mem["staging_host_bytes_peak"])
+                    dp.set("dev_matrix_bytes_now",
+                           mem["dev_matrix_bytes"])
+                    dp.set("compile_cache_entries",
+                           mem["compile_cache_entries"])
+
+    def _observe_device_ledger(self, led) -> None:
+        """Fold one completed group's device-phase ledger into the
+        accumulator; stall-check the h2d and compute-fence phases
+        (the two that bound the pipeline), mirroring lock_stall.
+        Completion-worker only.  Must not raise."""
+        if not led:
+            return
+        try:
+            self.ledger_accum.observe(led)
+        except Exception:
+            return
+        self._ledger_completions += 1
+        dp = self.dperf
+        if dp is not None and self._ledger_completions % 32 == 0 and \
+                "pipeline_overlap_frac" in dp._types:
+            # periodic refresh: sorting the 256-deep recent ring on
+            # every completion is not free, 1-in-32 is
+            try:
+                ov = overlap_stats(self.ledger_accum.recent())
+                dp.set("pipeline_overlap_frac",
+                       ov["pipeline_overlap_frac"])
+            except Exception:
+                pass
+        stall = self.phase_stall_s
+        if stall <= 0:
+            return
+        for phase, a, b in (("h2d", "h2d_start", "h2d_done"),
+                            ("fence", "compute_start",
+                             "compute_done")):
+            ta, tb = led.get(a), led.get(b)
+            if ta is None or tb is None or tb - ta < stall:
+                continue
+            if dp is not None and "device_phase_stalls" in dp._types:
+                dp.inc("device_phase_stalls")
+            rec = self.recorder
+            if rec is not None:
+                rec.note("device_stall", phase=phase,
+                         ms=round((tb - ta) * 1e3, 3),
+                         device=led.get("device", 0),
+                         bytes=led.get("bytes", 0))
+                rec.auto_dump("device-phase-stall")
+
+    def device_dump(self) -> dict:
+        """``dump_device`` admin-command payload: the per-phase
+        waterfall (with p50/p99 + overlap verdict), memory
+        accounting, and the batcher's coarse stage split."""
+        dump = self.ledger_accum.dump()
+        mem = None
+        backend = self._last_backend
+        if backend is not None:
+            try:
+                mem = backend.memory_stats()
+            except Exception:
+                mem = None
+        return {
+            "ledger": dump,
+            "overlap": dump.get("overlap"),
+            "memory": mem,
+            "stage_seconds": dict(self.stage_seconds),
+            "breaker_open": bool(EncodeBatcher._breaker_open),
+        }
+
+    def device_trace_block(self) -> dict:
+        """Raw recent group ledgers (+ memory snapshot) for the
+        unified trace exporter's per-device phase lanes."""
+        mem = None
+        backend = self._last_backend
+        if backend is not None:
+            try:
+                mem = backend.memory_stats()
+            except Exception:
+                mem = None
+        return {"ledgers": self.ledger_accum.recent(), "memory": mem}
 
     def _account_queue_wait(self, reqs: List[_Req],
                             now: float) -> None:
@@ -1536,6 +1696,11 @@ class EncodeBatcher:
                 self.bperf.inc("device_reqs", len(reqs))
                 if len(reqs) > 1:
                     self.bperf.inc("coalesced_reqs", len(reqs))
+            # harvest each tile's device-phase ledger (finalized by
+            # AsyncBatch.wait above): feeds the phase accumulator,
+            # the overlap engine, and the stall flight recorder
+            for t in async_tiles:
+                self._observe_device_ledger(getattr(t, "ledger", None))
             self._publish_device_telemetry(reqs[0].ec_impl)
         off = 0
         for r, arr in zip(reqs, arrs):

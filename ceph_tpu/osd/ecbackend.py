@@ -1149,10 +1149,36 @@ class ECBackend(PGBackend):
                 if hop_msg is not None:
                     hop_msg.stamp_hop("decode_dispatch")
                 nbytes = sum(len(v) for v in received.values())
-                data = ecutil.decode_concat(
-                    self.sinfo, self._decode_impl(nbytes), received)
+                impl = self._decode_impl(nbytes)
+                t0 = time.time()
+                data = ecutil.decode_concat(self.sinfo, impl, received)
                 if hop_msg is not None:
                     hop_msg.stamp_hop("decode_complete")
+                # a degraded read that reconstructed on the DEVICE
+                # (routing kept the tpu impl, not the twin, and a data
+                # shard was actually missing) is a device group like
+                # any batched decode: fold a coarse two-stamp ledger
+                # into the batcher's accumulator so dump_device and
+                # the overlap engine see client-path reconstruction
+                # alongside the batcher's own traffic
+                if impl is self.ec_impl and \
+                        hasattr(impl, "encode_batch_async"):
+                    try:
+                        k = impl.get_data_chunk_count()
+                        if any(i not in received for i in range(k)):
+                            obs = getattr(
+                                getattr(self.host, "encode_batcher",
+                                        None),
+                                "_observe_device_ledger", None)
+                            if obs is not None:
+                                t1 = time.time()
+                                obs({"stage_acquire": t0,
+                                     "compute_start": t0,
+                                     "compute_done": t1,
+                                     "deliver": t1, "bytes": nbytes,
+                                     "group": "decode"})
+                    except Exception:
+                        pass
             except Exception:
                 cb(-5, b"")
                 return

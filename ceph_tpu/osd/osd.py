@@ -350,7 +350,8 @@ class OSD(Dispatcher):
                            "dump_slow_ops", "dump_flight_recorder",
                            "dump_critical_path", "dump_hops",
                            "dump_slo", "dump_trace",
-                           "dump_profile", "status",
+                           "dump_profile", "dump_device",
+                           "dump_health", "status",
                            "config get", "config set"):
                 self.admin_socket.register(
                     prefix, self._admin_socket_hook)
@@ -942,6 +943,10 @@ class OSD(Dispatcher):
                            prefix=f"osd{self.whoami}-"),
                        "self_time": s.top_self_time(
                            prefix=f"osd{self.whoami}-", n=10)}
+            elif prefix == "dump_device":
+                out = self.encode_batcher.device_dump()
+            elif prefix == "dump_health":
+                out = self._health_dump()
             elif prefix == "status":
                 with self.pg_lock:
                     n_pgs = len(self.pgs)
@@ -957,6 +962,34 @@ class OSD(Dispatcher):
         except Exception as e:
             retcode, rs = -22, str(e)
         return retcode, rs, out
+
+    def _health_dump(self) -> dict:
+        """``dump_health``: this daemon's view of the named cluster
+        health checks (mgr/health.py); bench merges every daemon's
+        view into the one-look HEALTH_* line."""
+        from ..mgr import health as healthlib
+        slow = blocked = 0
+        try:
+            slow = len(self.op_tracker.slow_ops())
+            blocked = len(self.op_tracker.dump_blocked_ops())
+        except Exception:
+            pass
+        down = [o for o, info in self.osdmap.osds.items()
+                if not info.up]
+        with self.pg_lock:
+            total_pgs = len(self.pgs)
+            degraded = sum(1 for pg in self.pgs.values()
+                           if pg.state != STATE_ACTIVE)
+        checks = healthlib.checks_from_signals(
+            breaker_open=getattr(self.encode_batcher,
+                                 "_breaker_open", False),
+            slo=self.slo.dump(),
+            slow_ops=slow, blocked_ops=blocked,
+            down_osds=down,
+            degraded_pgs=degraded, total_pgs=total_pgs)
+        out = healthlib.summarize(checks)
+        out["daemon"] = f"osd.{self.whoami}"
+        return out
 
     def _trace_bundle(self) -> dict:
         """Raw material for tools/trace_export.py (one bundle per
@@ -988,6 +1021,7 @@ class OSD(Dispatcher):
                     + self.op_tracker.dump_ops_in_flight()),
             "flight": self.flight_recorder.dump_state(),
             "reactors": reactors,
+            "device": self.encode_batcher.device_trace_block(),
             "folded": folded,
         }
 

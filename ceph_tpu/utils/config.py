@@ -342,6 +342,13 @@ def _opts() -> List[Option]:
                description="base backoff before retrying a transient "
                            "device dispatch failure (doubles per "
                            "attempt, capped; 2 retries max)"),
+        Option("ec_tpu_device_phase_stall_ms", float, 250.0, min=0.0,
+               description="device-phase stall threshold: an h2d or "
+                           "compute-fence phase of one encode/decode "
+                           "group exceeding this flight-records a "
+                           "device_stall event and rate-limit "
+                           "auto-dumps (mirrors lock_stall; 0 "
+                           "disables)"),
         Option("ec_tpu_device_idle_reprobe_s", float, 2.0, min=0.0,
                description="a device with zero traffic for this long "
                            "gets the next small batch as an immediate "

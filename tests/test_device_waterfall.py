@@ -1,0 +1,322 @@
+"""Device waterfall (ISSUE 10): the sub-dispatch phase ledger, the
+overlap-efficiency engine, the ``dump_device`` surface, and the trace
+exporter's per-device lanes.
+
+The invariant under test is the hop ledger's, pushed one layer down:
+charging each inter-stamp interval to the phase that ENDS it makes the
+per-group phase sum equal the group wall exactly — on synthetic
+ledgers, on partial (CPU-twin / decode) ledgers, and on real ledgers
+harvested from an encode through the batcher on the CPU backend.
+Partial-bundle merges (a daemon that died mid-dump) must degrade
+gracefully in the exporter, never KeyError.
+"""
+import json
+import os
+import threading
+
+import pytest
+
+from ceph_tpu.ec import registry as ecreg
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.batcher import EncodeBatcher
+from ceph_tpu.utils.device_ledger import (PHASE_ORDER,
+                                          DeviceLedgerAccum,
+                                          charge_phases,
+                                          device_waterfall_block,
+                                          merge_dumps, overlap_stats)
+from tools.trace_export import export_bundles
+
+
+def _led(t0, device=0, **over):
+    led = {"stage_acquire": t0, "h2d_start": t0 + 0.001,
+           "h2d_done": t0 + 0.003, "compute_start": t0 + 0.004,
+           "compute_done": t0 + 0.010, "d2h_done": t0 + 0.012,
+           "deliver": t0 + 0.013, "device": device,
+           "bytes": 1 << 20, "group": "encode"}
+    led.update(over)
+    return led
+
+
+# ------------------------------------------------------------- units
+def test_charge_phases_sum_equals_group_wall():
+    led = _led(1000.0)
+    charged = charge_phases(led)
+    # every interval charged to the phase ending it; meta fields
+    # (device, bytes, group) never appear as phases
+    assert [n for n, _ in charged] == list(PHASE_ORDER[1:])
+    assert sum(dt for _, dt in charged) == \
+        led["deliver"] - led["stage_acquire"]
+
+
+def test_charge_phases_partial_ledger_stays_exact():
+    # the coarse decode ledger: whole interval charges to the fence
+    led = {"stage_acquire": 5.0, "compute_start": 5.0,
+           "compute_done": 5.02, "deliver": 5.02, "group": "decode"}
+    charged = charge_phases(led)
+    wall = led["deliver"] - led["stage_acquire"]
+    assert sum(dt for _, dt in charged) == wall
+    assert dict(charged)["compute_done"] == wall
+    assert charge_phases({"compute_done": 1.0}) == []
+    assert charge_phases({}) == []
+
+
+def test_overlap_stats_exact_fraction():
+    # group B's h2d (10.004..10.008) under group A's compute
+    # (10.002..10.010): overlap 4 ms of a 20 ms window -> 0.2
+    a = _led(10.0, h2d_start=10.0, h2d_done=10.002,
+             compute_start=10.002, compute_done=10.010,
+             d2h_done=10.011, deliver=10.012)
+    b = _led(10.004, h2d_start=10.004, h2d_done=10.008,
+             compute_start=10.010, compute_done=10.018,
+             d2h_done=10.019, deliver=10.020)
+    ov = overlap_stats([a, b])
+    assert ov["pairs"] == 1 and ov["groups"] == 2
+    assert ov["devices"] == [0]
+    assert abs(ov["overlap_s"] - 0.004) < 1e-9
+    assert abs(ov["window_wall_s"] - 0.020) < 1e-9
+    assert abs(ov["pipeline_overlap_frac"] - 0.2) < 1e-3
+
+
+def test_overlap_stats_bubble_census_names_bounding_phase():
+    # B's compute starts 6 ms after A's ends; most of the gap is
+    # covered by B's h2d interval -> h2d_done bounds the pipeline
+    a = _led(20.0, compute_start=20.002, compute_done=20.004,
+             d2h_done=20.005, deliver=20.006)
+    b = _led(20.004, h2d_start=20.004, h2d_done=20.009,
+             compute_start=20.010, compute_done=20.012,
+             d2h_done=20.013, deliver=20.014)
+    ov = overlap_stats([a, b])
+    assert ov["bounding_phase"] == "h2d_done"
+    assert abs(sum(ov["bubble_s"].values()) - 0.006) < 1e-6
+    # devices never pairing (different ids) produce no bubbles
+    assert overlap_stats([_led(1.0, device=0),
+                          _led(1.0, device=1)])["pairs"] == 0
+    assert overlap_stats([]) == overlap_stats([{}])
+
+
+def test_twin_groups_fold_in_but_stay_out_of_overlap():
+    # a CPU-twin group (device=-1, no h2d/d2h stamps) folds into the
+    # phase accounting but the overlap engine skips it: it has no
+    # transfer to hide under compute, and its wall must not dilute
+    # the per-device window
+    a = _led(10.0, h2d_start=10.0, h2d_done=10.002,
+             compute_start=10.002, compute_done=10.010,
+             d2h_done=10.011, deliver=10.012)
+    b = _led(10.004, h2d_start=10.004, h2d_done=10.008,
+             compute_start=10.010, compute_done=10.018,
+             d2h_done=10.019, deliver=10.020)
+    twin = {"stage_acquire": 10.0, "compute_start": 10.0,
+            "compute_done": 10.5, "deliver": 10.5,
+            "device": -1, "bytes": 1 << 20, "group": "encode"}
+    ov = overlap_stats([a, b, twin])
+    assert ov["groups"] == 2 and ov["devices"] == [0]
+    assert ov == overlap_stats([a, b])   # 0.5 s twin wall: no dilution
+    accum = DeviceLedgerAccum()
+    for led in (a, b, twin):
+        accum.observe(led)
+    dump = accum.dump()
+    assert dump["groups"] == 3           # ...but it IS a counted group
+    assert abs(sum(dump["phase_seconds"].values())
+               - dump["group_seconds"]) < 1e-9
+
+
+def test_accum_dump_and_waterfall_block():
+    accum = DeviceLedgerAccum()
+    for j in range(8):
+        accum.observe(_led(100.0 + j * 0.02))
+    accum.observe(None)                      # tolerated, not counted
+    accum.observe({"bytes": 4096})           # stamp-free: not counted
+    dump = accum.dump()
+    assert dump["groups"] == 8
+    # accumulated phase seconds == accumulated group walls (the
+    # invariant, summed)
+    assert abs(sum(dump["phase_seconds"].values())
+               - dump["group_seconds"]) < 1e-9
+    assert abs(dump["group_seconds"] - 8 * 0.013) < 1e-6
+    assert set(dump["p99_s"]) == set(PHASE_ORDER[1:])
+    blk = device_waterfall_block(dump, wall_s=2.0)
+    assert blk["sum_of_shares"] == pytest.approx(1.0, abs=1e-3)
+    assert blk["vs_wall"] == pytest.approx(1.0, abs=1e-3)
+    # compute dominates the synthetic ledger (6 ms of 13 ms)
+    assert blk["top_phase"] == "compute_done"
+    assert abs(sum(blk["scaled_s"].values()) - 2.0) < 1e-2
+
+
+def test_merge_dumps_pools_devices_and_recomputes_frac():
+    a, b = DeviceLedgerAccum(), DeviceLedgerAccum()
+    for j in range(4):
+        a.observe(_led(50.0 + j * 0.02, device=0))
+        b.observe(_led(80.0 + j * 0.02, device=1))
+    merged = merge_dumps([a.dump(), b.dump(), None, {}])
+    assert merged["groups"] == 8
+    assert merged["overlap"]["devices"] == [0, 1]
+    assert 0.0 <= merged["overlap"]["pipeline_overlap_frac"] <= 1.0
+    assert abs(sum(merged["phase_seconds"].values())
+               - merged["group_seconds"]) < 1e-9
+
+
+# --------------------------------------- live batcher on CPU backend
+def test_encode_through_batcher_harvests_exact_ledger():
+    """An encode through the real batcher (CPU JAX backend) must leave
+    a complete device ledger in the accumulator whose charged phases
+    sum to the group wall exactly, and dump_device must report the
+    staging/compile-cache memory block."""
+    codec = ecreg.instance().factory(
+        "tpu", {"k": "2", "m": "1", "technique": "reed_sol_van"})
+    EncodeBatcher.reset_learning()
+    b = EncodeBatcher({"ec_tpu_batch_stripes": 1024,
+                       "ec_tpu_queue_window_us": 30_000})
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        done = threading.Event()
+        b.submit(codec, sinfo, os.urandom(8 * 8192),
+                 lambda chunks: done.set())
+        assert done.wait(30)
+        recent = b.ledger_accum.recent()
+        assert recent, "no device ledger harvested"
+        for led in recent:
+            stamps = [led[p] for p in PHASE_ORDER if p in led]
+            assert len(stamps) >= 2
+            assert sum(dt for _, dt in charge_phases(led)) == \
+                pytest.approx(stamps[-1] - stamps[0], abs=1e-9)
+        dump = b.device_dump()
+        assert dump["ledger"]["groups"] >= 1
+        assert dump["overlap"]["groups"] >= 1
+        mem = dump["memory"]
+        assert mem is not None
+        assert mem["staging_host_bytes_peak"] >= \
+            mem["staging_host_bytes"] > 0
+        assert mem["dev_matrix_entries"] >= 1
+        # the trace block feeds the exporter the same ring
+        blk = b.device_trace_block()
+        assert blk["ledgers"] and blk["memory"] is not None
+    finally:
+        b.stop()
+
+
+def test_twin_routed_encode_still_carries_a_ledger():
+    """Deterministic twin routing (pinned crossover) must still fold
+    a coarse device=-1 ledger — the bench waterfall has to account
+    for every group even on a box where nothing reaches the device."""
+    codec = ecreg.instance().factory(
+        "tpu", {"k": "2", "m": "1", "technique": "reed_sol_van"})
+    EncodeBatcher.reset_learning()
+    b = EncodeBatcher({"ec_tpu_batch_stripes": 1024,
+                       "ec_tpu_queue_window_us": 30_000,
+                       "ec_tpu_min_device_bytes": 1 << 40})
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        done = threading.Event()
+        b.submit(codec, sinfo, os.urandom(8 * 8192),
+                 lambda chunks: done.set())
+        assert done.wait(30)
+        recent = b.ledger_accum.recent()
+        assert recent, "twin group left no ledger"
+        twin_leds = [l for l in recent if l.get("device") == -1]
+        assert twin_leds and twin_leds[0]["group"] == "encode"
+        for led in twin_leds:
+            assert "h2d_start" not in led and "d2h_done" not in led
+            assert sum(dt for _, dt in charge_phases(led)) == \
+                pytest.approx(led["deliver"] - led["stage_acquire"],
+                              abs=1e-9)
+        dump = b.device_dump()
+        assert dump["ledger"]["groups"] >= 1
+        # overlap window stays empty: the host lane is excluded
+        assert dump["overlap"]["groups"] == 0
+    finally:
+        b.stop()
+        EncodeBatcher.reset_learning()
+
+
+# --------------------------------------------- trace export device lanes
+def _device_bundle(name, t0=1000.0):
+    return {"daemon": name,
+            "ledgers": {"write": [{"client_send": t0,
+                                   "recv": t0 + 0.01,
+                                   "client_complete": t0 + 0.05}]},
+            "ops": [], "flight": {"events": []}, "reactors": [],
+            "device": {
+                "ledgers": [
+                    _led(t0 + 0.011),
+                    _led(t0 + 0.016),
+                    _led(t0 + 0.021, device=1),
+                    {"stage_acquire": t0 + 0.03,
+                     "compute_start": t0 + 0.03,
+                     "compute_done": t0 + 0.04,
+                     "deliver": t0 + 0.04, "group": "decode"},
+                    {"stage_acquire": t0 + 0.05,
+                     "compute_start": t0 + 0.05,
+                     "compute_done": t0 + 0.06,
+                     "deliver": t0 + 0.06, "device": -1,
+                     "group": "encode"}],
+                "memory": {"staging_host_bytes": 1 << 16,
+                           "staging_host_bytes_peak": 1 << 17}},
+            "folded": []}
+
+
+def test_export_device_lanes_round_trip():
+    trace = export_bundles([_device_bundle("osd.0")])
+    evs = json.loads(json.dumps(trace, allow_nan=False))["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # enclosing group slices + nested phase slices, one tid band per
+    # device id, nested under the daemon's cluster-hop tracks
+    assert any(e["name"] == "encode_group" and e["cat"] == "device"
+               for e in xs)
+    assert any(e["name"] == "decode_group" for e in xs)
+    for phase in PHASE_ORDER[1:]:
+        assert any(e["name"] == phase and e.get("cat") == "device"
+                   for e in xs), phase
+    dev_tids = {e["tid"] for e in xs if e.get("cat") == "device"}
+    assert any(700 <= t < 732 for t in dev_tids)      # device 0 band
+    assert any(732 <= t < 764 for t in dev_tids)      # device 1 band
+    assert any(668 <= t < 700 for t in dev_tids)      # cpu-twin band
+    tn = {e["args"]["name"] for e in evs
+          if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert {"device0 phases", "device1 phases",
+            "cpu-twin phases"} <= tn
+    cs = {e["name"] for e in evs if e["ph"] == "C"}
+    assert {"device0_groups_in_flight", "device0_overlap_frac",
+            "staging_host_bytes"} <= cs
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+
+
+def test_export_partial_bundles_degrade_gracefully():
+    """A daemon that died mid-dump can truncate any sub-block: the
+    merge must degrade (skip what is missing), never KeyError."""
+    t0 = 2000.0
+    bundles = [
+        None,                                   # bundle lost entirely
+        {"daemon": "osd.0"},                    # everything missing
+        {"daemon": "osd.1", "ledgers": None, "ops": None,
+         "flight": None, "reactors": None, "folded": None,
+         "device": None},
+        {"daemon": "osd.2",
+         "ledgers": {"write": ["garbage", None,
+                               {"client_send": t0,
+                                "recv": t0 + 0.01}]},
+         "ops": [None, {"description": "x"}],
+         "flight": {"events": ["nope"]},
+         "reactors": [{"shard": 0, "util": "truncated"}],
+         "device": {"ledgers": "truncated", "memory": []}},
+        {"daemon": "osd.3",
+         "device": {"ledgers": [None, {"bytes": 4096},
+                                {"stage_acquire": "oops"},
+                                _led(t0 + 0.02)],
+                    "memory": None}},
+    ]
+    trace = export_bundles(bundles)
+    evs = json.loads(json.dumps(trace, allow_nan=False))["traceEvents"]
+    # the intact pieces still exported...
+    assert any(e["ph"] == "X" and e["name"] == "recv" for e in evs)
+    assert any(e["ph"] == "X" and e["name"] == "encode_group"
+               for e in evs)
+    # ...and the meta-only device ledger never polluted the rebase
+    # origin (bytes=4096 is not a timestamp: all event ts stay small)
+    assert all(e["ts"] >= 0 for e in evs if "ts" in e)
+    assert all(e["ts"] < 10 * 60 * 1e6 for e in evs if "ts" in e)
+
+
+def test_export_empty_bundle_list():
+    trace = export_bundles([])
+    assert trace["traceEvents"] == []
+    assert json.loads(json.dumps(trace)) is not None

@@ -428,3 +428,48 @@ def test_prometheus_histogram_roundtrip():
             < 1e-9
     # plain counters from the same subsystem still render
     assert 'ceph_ec_batcher_h2d_bytes{daemon="osd.0"} 4096' in body
+
+
+# ------------------------------------------- ISSUE 10: health checks
+def test_health_checks_and_cluster_merge():
+    from ceph_tpu.mgr import health
+
+    ok = health.checks_from_signals(
+        breaker_open=False, slo=None, slow_ops=0, blocked_ops=0,
+        down_osds=[], degraded_pgs=0, total_pgs=8)
+    s = health.summarize(ok)
+    assert s["status"] == "HEALTH_OK"
+    assert set(s["checks"]) >= {"EC_BREAKER_OPEN", "SLO_BURN",
+                                "SLOW_OPS", "OSD_DOWN"}
+    bad = health.checks_from_signals(
+        breaker_open=True,
+        slo={"client_write": {"burn": 12.0}}, slow_ops=3,
+        blocked_ops=1, down_osds=[2], degraded_pgs=4, total_pgs=8)
+    s2 = health.summarize(bad)
+    assert s2["status"] == "HEALTH_ERR"
+    for name in ("EC_BREAKER_OPEN", "SLO_BURN", "OSD_DOWN"):
+        assert name in s2["line"]
+    # cluster merge: worst severity wins, counts sum, down sets union
+    warn = health.checks_from_signals(
+        breaker_open=False, slo={"client_write": {"burn": 1.5}},
+        slow_ops=2, blocked_ops=0, down_osds=[5], degraded_pgs=0,
+        total_pgs=8)
+    merged = health.merge([{"checks": ok}, {"checks": warn},
+                           {"checks": bad}, None])
+    assert merged["status"] == "HEALTH_ERR"
+    assert merged["checks"]["SLOW_OPS"]["slow"] == 5
+    assert merged["checks"]["OSD_DOWN"]["down"] == [2, 5]
+    assert merged["checks"]["EC_BREAKER_OPEN"]["daemons_firing"] == 1
+
+
+def test_dump_health_admin_round_trip(cl):
+    for osd_id in range(3):
+        ret, _, out = cl.osds[osd_id]._exec_command(
+            {"prefix": "dump_health"})
+        assert ret == 0
+        assert out["daemon"] == f"osd.{osd_id}"
+        assert out["status"] in ("HEALTH_OK", "HEALTH_WARN",
+                                 "HEALTH_ERR")
+        # a healthy fixture cluster: breaker closed, no OSDs down
+        assert out["checks"]["EC_BREAKER_OPEN"]["severity"] == "ok"
+        assert out["checks"]["OSD_DOWN"]["severity"] == "ok"

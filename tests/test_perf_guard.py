@@ -295,3 +295,53 @@ def test_trace_export_13_daemons_stays_interactive():
         f"(ceiling {TRACE_EXPORT_CEILING:.0f}s)"
     assert len({e["pid"] for e in trace["traceEvents"]}) == 13
     assert len(text) > 1 << 20        # it actually carried the data
+
+
+# ISSUE 10 extends the ledger discipline into the device: every
+# completed encode/decode group folds its phase ledger into the
+# accumulator on the completion worker (same 20us bar as the hop
+# stamp), and dump_device merges a bench cluster's worth of
+# accumulators — 13 daemons x a full recent ring — which must stay
+# well inside an interactive admin-socket round trip.
+DEVICE_LEDGER_OBSERVE_CEILING = 20e-6
+DEVICE_DUMP_CEILING = 0.050
+
+
+def _device_led(t0):
+    return {"stage_acquire": t0, "h2d_start": t0 + 1e-5,
+            "h2d_done": t0 + 1.2e-4, "compute_start": t0 + 1.3e-4,
+            "compute_done": t0 + 6e-4, "d2h_done": t0 + 7e-4,
+            "deliver": t0 + 8e-4, "device": 0, "bytes": 1 << 20}
+
+
+def test_device_ledger_observe_is_cheap():
+    from ceph_tpu.utils.device_ledger import DeviceLedgerAccum
+    accum = DeviceLedgerAccum()
+    led = _device_led(1000.0)
+    cost = _per_op(lambda: accum.observe(led))
+    assert cost < DEVICE_LEDGER_OBSERVE_CEILING, \
+        f"device-ledger observe costs {cost * 1e6:.2f}us/op " \
+        f"(ceiling {DEVICE_LEDGER_OBSERVE_CEILING * 1e6:.0f}us)"
+    assert accum.groups > N           # and the ring stayed bounded
+    assert len(accum.recent()) == DeviceLedgerAccum.RECENT_LEDGERS
+
+
+def test_device_dump_13_daemons_stays_interactive():
+    from ceph_tpu.utils.device_ledger import (DeviceLedgerAccum,
+                                              merge_dumps)
+    depth = DeviceLedgerAccum.RECENT_LEDGERS
+    accums = []
+    for d in range(13):
+        a = DeviceLedgerAccum()
+        for j in range(depth):
+            a.observe(_device_led(1000.0 + d + j * 1e-3))
+        accums.append(a)
+    merge_dumps([a.dump() for a in accums])      # warm
+    t0 = time.perf_counter()
+    merged = merge_dumps([a.dump() for a in accums])
+    elapsed = time.perf_counter() - t0
+    assert elapsed < DEVICE_DUMP_CEILING, \
+        f"13-daemon device dump+merge took {elapsed * 1e3:.1f}ms " \
+        f"(ceiling {DEVICE_DUMP_CEILING * 1e3:.0f}ms)"
+    assert merged["groups"] == 13 * depth
+    assert merged["overlap"]["pipeline_overlap_frac"] >= 0.0

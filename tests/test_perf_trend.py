@@ -183,3 +183,104 @@ def test_scaling_gate_runs_from_cli_fresh_records(tmp_path, history):
     r = _run_cli(fresh, hist)
     assert r.returncode == 1, (r.stdout, r.stderr)
     assert "scaling-regression" in r.stdout
+
+
+# ---------------------------------------- ISSUE 10: device-path gates
+def _dwf(frac, p99=None, groups=120):
+    return {"groups": groups, "wall_s": 1.0,
+            "phase_seconds": {"h2d_done": 0.3, "compute_done": 0.5,
+                              "d2h_done": 0.2},
+            "shares": {"h2d_done": 0.3, "compute_done": 0.5,
+                       "d2h_done": 0.2},
+            "p99_s": p99 or {"h2d_done": 0.002,
+                             "compute_done": 0.005},
+            "sum_of_shares": 1.0, "top_phase": "compute_done",
+            "pipeline_overlap_frac": frac,
+            "bounding_phase": "h2d_done",
+            "bubble_s": {"h2d_done": 0.05}, "devices": [0]}
+
+
+def _att_with_dwf(frac, dwf, expect=True):
+    att = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                        "commit": 3.0}, frac, expect=expect)
+    att["device_waterfall"] = dwf
+    return att
+
+
+def test_overlap_gate_skips_without_device_history(history):
+    """History rounds predating the device ledger carry no
+    device_waterfall; the overlap and device-p99 gates self-skip."""
+    findings = perf_trend.check(
+        _att_with_dwf(0.95, _dwf(0.0)),
+        perf_trend.load_history(history))
+    assert not [f for f in findings
+                if f["check"] in ("overlap-collapse",
+                                  "device-phase-p99-regression")]
+
+
+def test_overlap_gate_fails_on_collapse(tmp_path, history):
+    hist = history + [_hist_round(
+        tmp_path, 3, [_att_with_dwf(0.95, _dwf(0.6))])]
+    rounds = perf_trend.load_history(hist)
+    findings = perf_trend.check(
+        _att_with_dwf(0.95, _dwf(0.05)), rounds)
+    assert [f for f in findings if f["check"] == "overlap-collapse"]
+    assert "h2d no longer hides under compute" in \
+        [f for f in findings
+         if f["check"] == "overlap-collapse"][0]["message"]
+    # at tolerance (>= 0.5 x 0.6) it passes
+    assert not [f for f in
+                perf_trend.check(_att_with_dwf(0.95, _dwf(0.35)),
+                                 rounds)
+                if f["check"] == "overlap-collapse"]
+
+
+def test_overlap_gate_cpu_only_box_does_not_trip(tmp_path, history):
+    """The non-trip case: a CPU-only box legitimately reports overlap
+    0 — calibration expected the twin and zero requests routed to the
+    device — and must NOT fail the floor even though history (from a
+    TPU box) carries a healthy overlap."""
+    hist = history + [_hist_round(
+        tmp_path, 3, [_att_with_dwf(0.95, _dwf(0.6))])]
+    att = _att_with_dwf(0.0, _dwf(0.0), expect=False)
+    assert att["routing"]["device_reqs"] == 0
+    findings = perf_trend.check(att, perf_trend.load_history(hist))
+    assert not [f for f in findings
+                if f["check"] == "overlap-collapse"], findings
+
+
+def test_device_phase_p99_gate(tmp_path, history):
+    hist = history + [_hist_round(
+        tmp_path, 3, [_att_with_dwf(0.95, _dwf(0.6))])]
+    rounds = perf_trend.load_history(hist)
+    # h2d_done p99 blows 5x past history (and > 1 ms absolute)
+    bad = _dwf(0.6, p99={"h2d_done": 0.010, "compute_done": 0.005})
+    findings = perf_trend.check(_att_with_dwf(0.95, bad), rounds)
+    hits = [f for f in findings
+            if f["check"] == "device-phase-p99-regression"]
+    assert len(hits) == 1 and "h2d_done" in hits[0]["message"]
+    # a fresh run that routed no groups to the device self-skips
+    empty = _dwf(0.0, p99={"h2d_done": 0.010}, groups=0)
+    assert not [f for f in
+                perf_trend.check(
+                    _att_with_dwf(0.0, empty, expect=False), rounds)
+                if f["check"] == "device-phase-p99-regression"]
+
+
+def test_overlap_gate_runs_from_cli(tmp_path, history):
+    hist = history + [_hist_round(
+        tmp_path, 3, [_att_with_dwf(0.95, _dwf(0.6))])]
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.0), _cluster(1.0),
+        _att_with_dwf(0.95, _dwf(0.05)))))
+    r = _run_cli(fresh, hist)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "overlap-collapse" in r.stdout
+    # --overlap-tol 0 disables the floor
+    r = subprocess.run(
+        [sys.executable, "tools/perf_trend.py",
+         "--fresh", str(fresh), "--history", *hist,
+         "--overlap-tol", "0"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, (r.stdout, r.stderr)

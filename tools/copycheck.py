@@ -49,7 +49,10 @@ HOT_MODULES = [
     "ceph_tpu/crimson/net.py",
     # the persistent-staging h2d path: every batched encode funnels
     # its payload through here, so a stray bytes()/tobytes() would
-    # silently double the host-side cost of every device call
+    # silently double the host-side cost of every device call.  The
+    # device phase ledger (ISSUE 10) stamps time.time() floats along
+    # this same path — stamps are scalars, never payload slices, so
+    # the ledger must stay invisible to this audit
     "ceph_tpu/ops/jax_engine.py",
     # the shard-per-core hot path (ISSUE 8): every cross-shard op
     # crosses the mailbox enqueue/drain, and every encode submission

@@ -28,6 +28,18 @@ history and fails loudly on:
   ``read_waterfall`` block (the client-facing read ledger: queue /
   shard_read / decode hops).  Rounds predating the read ledger
   silently skip.
+- **device-phase p99 regression** — the same budget applied to the
+  ``device_waterfall`` block (the sub-dispatch phase ledger:
+  stage_acquire / h2d / compute fence / d2h / deliver).  Rounds
+  predating the device ledger silently skip, as does a fresh run
+  that routed no groups to the device.
+- **pipeline-overlap collapse** — the overlap engine's verdict
+  (``pipeline_overlap_frac``: fraction of the device window where
+  group N+1's h2d hides under group N's compute) falls below
+  ``overlap_tol`` x the best overlap any history round achieved.
+  Gated on the fresh run actually expecting / using the device: a
+  CPU-only box reports ``expect_device`` false and zero
+  ``device_reqs`` and must NOT trip on its overlap of 0.
 - **SLO regression** — the attribution's ``slo`` block (per-class
   error-budget burn merged across every OSD) must show ZERO
   client-class burn on a bench run (bench runs are fault-free), and
@@ -68,6 +80,7 @@ HEADLINE_DEVICE_WIN = 2.0  # codec vs_baseline that proves the device
 HOP_P99_FACTOR = 1.5       # fresh hop p99 may grow to this x history
 HOP_P99_SLACK_S = 1e-3     # ...and must also grow by this much abs.
 SCALING_TOL = 0.8          # 16-client MB/s >= tol * best history
+OVERLAP_TOL = 0.5          # fresh overlap frac >= tol * best history
 
 
 def _records_from_text(text: str) -> List[Dict]:
@@ -159,7 +172,8 @@ def check(attribution: Optional[Dict], history: List[Dict],
           ratio_tol: float = RATIO_TOL,
           min_device_fraction: float = MIN_DEVICE_FRACTION,
           hop_p99_factor: float = HOP_P99_FACTOR,
-          scaling_tol: float = SCALING_TOL) \
+          scaling_tol: float = SCALING_TOL,
+          overlap_tol: float = OVERLAP_TOL) \
         -> List[Dict]:
     """-> findings ``[{"check", "severity", "message"}]``; empty =
     pass.  ``attribution`` is the fresh run's attribution object (may
@@ -267,6 +281,78 @@ def check(attribution: Optional[Dict], history: List[Dict],
                         f"{hop_p99_factor:.1f} x history "
                         f"{old * 1e3:.2f} ms ({key} budget)"})
 
+    # -- device-phase p99 budgets (device_waterfall block) ------------
+    # The wire-hop budget applied one layer down: the sub-dispatch
+    # phase ledger stamped inside the batcher/engine (stage_acquire /
+    # h2d / compute fence / d2h / deliver).  Rounds predating the
+    # device ledger carry no device_waterfall block and self-skip; a
+    # fresh run that routed zero groups to the device (CPU-only box)
+    # has no phase p99s worth budgeting and also self-skips.
+    fresh_dwf = (attribution or {}).get("device_waterfall") \
+        if attribution is not None else None
+    hist_dwf = _hist_block("device_waterfall")
+    if isinstance(fresh_dwf, dict) and fresh_dwf.get("groups") \
+            and hist_dwf is not None:
+        old_p99 = hist_dwf.get("p99_s") or {}
+        new_p99 = fresh_dwf.get("p99_s") or {}
+        for phase in sorted(new_p99):
+            old = old_p99.get(phase)
+            new = new_p99.get(phase)
+            if not isinstance(old, (int, float)) \
+                    or not isinstance(new, (int, float)):
+                continue
+            if new > old * hop_p99_factor \
+                    and new - old > HOP_P99_SLACK_S:
+                findings.append({
+                    "check": "device-phase-p99-regression",
+                    "severity": "fail",
+                    "message":
+                        f"device phase {phase!r} p99 "
+                        f"{new * 1e3:.2f} ms > "
+                        f"{hop_p99_factor:.1f} x history "
+                        f"{old * 1e3:.2f} ms (device_waterfall "
+                        f"budget)"})
+
+    # -- pipeline-overlap collapse ------------------------------------
+    # The overlap engine's headline: the fraction of the per-device
+    # window where the next group's h2d transfer hides under the
+    # current group's compute.  Losing it (double-buffering broken,
+    # staging ring serialized) shows up long before throughput does.
+    # Only meaningful when the run actually drives the device — a
+    # CPU-only box legitimately reports overlap 0 alongside
+    # expect_device False / zero device_reqs and must NOT trip.
+    # History rounds without an overlap verdict self-skip.
+    if isinstance(fresh_dwf, dict):
+        new_frac = fresh_dwf.get("pipeline_overlap_frac")
+        expect = (attribution or {}).get("expect_device")
+        routing = (attribution or {}).get("routing") or {}
+        dev_reqs = routing.get("device_reqs")
+        device_active = expect is True or (
+            isinstance(dev_reqs, (int, float)) and dev_reqs > 0)
+        best_frac = None
+        for rnd in history:
+            rec = _pick(rnd["records"], _ATTRIB_PREFIX)
+            dwf = rec.get("device_waterfall") \
+                if rec is not None else None
+            v = dwf.get("pipeline_overlap_frac") \
+                if isinstance(dwf, dict) else None
+            if isinstance(v, (int, float)) and v > 0:
+                best_frac = v if best_frac is None \
+                    else max(best_frac, v)
+        if device_active and best_frac is not None \
+                and isinstance(new_frac, (int, float)) \
+                and new_frac < overlap_tol * best_frac:
+            findings.append({
+                "check": "overlap-collapse", "severity": "fail",
+                "message":
+                    f"pipeline_overlap_frac {new_frac:.3f} < "
+                    f"{overlap_tol:.2f} x best history "
+                    f"{best_frac:.3f} — h2d no longer hides under "
+                    f"compute (bounding phase "
+                    f"{fresh_dwf.get('bounding_phase')!r}; check the "
+                    f"staging ring depth and the async dispatch "
+                    f"lead)"})
+
     # -- SLO regression (per-class error-budget burn) -----------------
     # Bench runs are fault-free: ANY client-class burn in the fresh
     # run is a regression outright.  Recovery/scrub classes tolerate
@@ -354,7 +440,8 @@ def check(attribution: Optional[Dict], history: List[Dict],
 def run(fresh_records: List[Dict], history: List[Dict],
         stage_tol: float = STAGE_TOL, ratio_tol: float = RATIO_TOL,
         min_device_fraction: float = MIN_DEVICE_FRACTION,
-        hop_p99_factor: float = HOP_P99_FACTOR) -> int:
+        hop_p99_factor: float = HOP_P99_FACTOR,
+        overlap_tol: float = OVERLAP_TOL) -> int:
     att = _pick(fresh_records, _ATTRIB_PREFIX)
     cluster = _pick(fresh_records, _CLUSTER_PREFIX, _K8M4_MARK)
     headline = _pick(fresh_records, _HEADLINE_PREFIX)
@@ -376,7 +463,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
                        if scaling else None),
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
-        hop_p99_factor=hop_p99_factor)
+        hop_p99_factor=hop_p99_factor, overlap_tol=overlap_tol)
     for f in findings:
         print(f"perf_trend {f['severity'].upper()} "
               f"[{f['check']}]: {f['message']}")
@@ -402,6 +489,7 @@ def main(argv=None) -> int:
                     default=MIN_DEVICE_FRACTION)
     ap.add_argument("--hop-p99-factor", type=float,
                     default=HOP_P99_FACTOR)
+    ap.add_argument("--overlap-tol", type=float, default=OVERLAP_TOL)
     args = ap.parse_args(argv)
     paths = args.history if args.history else default_history_paths()
     if not paths:
@@ -410,7 +498,8 @@ def main(argv=None) -> int:
     return run(load_fresh(args.fresh), load_history(paths),
                stage_tol=args.stage_tol, ratio_tol=args.ratio_tol,
                min_device_fraction=args.min_device_fraction,
-               hop_p99_factor=args.hop_p99_factor)
+               hop_p99_factor=args.hop_p99_factor,
+               overlap_tol=args.overlap_tol)
 
 
 if __name__ == "__main__":

@@ -44,16 +44,31 @@ from typing import Dict, List, Optional
 
 try:
     from ceph_tpu.utils.hops import CHARGE_ORDER
+    from ceph_tpu.utils.device_ledger import PHASE_ORDER
 except ImportError:                     # invoked as a script from tools/
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     from ceph_tpu.utils.hops import CHARGE_ORDER
+    from ceph_tpu.utils.device_ledger import PHASE_ORDER
 
 #: thread-id bases per track family (per daemon process); lanes for
 #: concurrent ops fan out upward from the base
 _TID_BASE = {"write": 100, "read": 200, "recovery": 300,
-             "optracker": 400, "flight": 500, "reactor": 600}
+             "optracker": 400, "flight": 500, "reactor": 600,
+             "device": 700}
 _MAX_LANES = 64          # overlap-packing cap per track family
+_DEVICE_LANE_STRIDE = 32  # tid span per JAX device id (mesh-ready)
+
+
+def _as_dict(v) -> Dict:
+    """Partial-bundle armor: a daemon that died mid-dump can leave
+    any sub-block missing, null, or truncated to a non-dict; degrade
+    to empty instead of KeyError/TypeError-ing the whole export."""
+    return v if isinstance(v, dict) else {}
+
+
+def _as_list(v) -> List:
+    return v if isinstance(v, list) else []
 
 
 class _Lanes:
@@ -96,6 +111,24 @@ def _ledger_slices(ledger: Dict[str, float]):
     return stamps[0][1], prev_t, spans
 
 
+def _device_phase_slices(ledger: Dict[str, float]):
+    """-> (start, end, [(phase, t_start, t_end)]) in device phase
+    order (charge-to-ending-phase), or None for degenerate ledgers."""
+    stamps = [(name, ledger[name]) for name in PHASE_ORDER
+              if isinstance(ledger.get(name), (int, float))]
+    if len(stamps) < 2:
+        return None
+    spans = []
+    prev_t = stamps[0][1]
+    for name, t in stamps[1:]:
+        if t >= prev_t:
+            spans.append((name, prev_t, t))
+            prev_t = t
+    if not spans:
+        return None
+    return stamps[0][1], prev_t, spans
+
+
 def export_bundles(bundles: List[Dict]) -> Dict:
     """Merge daemon bundles -> Chrome trace_event JSON dict."""
     events: List[Dict] = []
@@ -109,17 +142,24 @@ def export_bundles(bundles: List[Dict]) -> Dict:
             t0 = ts if t0 is None else min(t0, ts)
 
     for b in bundles:
-        for ledgers in (b.get("ledgers") or {}).values():
-            for led in ledgers or []:
-                for ts in led.values():
+        b = _as_dict(b)
+        for ledgers in _as_dict(b.get("ledgers")).values():
+            for led in _as_list(ledgers):
+                for ts in _as_dict(led).values():
                     _see(ts)
-        for op in b.get("ops") or []:
-            _see(op.get("initiated_at"))
-        for ev in (b.get("flight") or {}).get("events") or []:
-            _see(ev.get("time"))
-        for r in b.get("reactors") or []:
-            for s in r.get("util") or []:
-                _see(s.get("ts"))
+        for op in _as_list(b.get("ops")):
+            _see(_as_dict(op).get("initiated_at"))
+        for ev in _as_list(_as_dict(b.get("flight")).get("events")):
+            _see(_as_dict(ev).get("time"))
+        for r in _as_list(b.get("reactors")):
+            for s in _as_list(_as_dict(r).get("util")):
+                _see(_as_dict(s).get("ts"))
+        for led in _as_list(_as_dict(b.get("device")).get("ledgers")):
+            led = _as_dict(led)
+            # phase stamps only: device ledgers carry meta fields
+            # (device id, payload bytes) that are NOT timestamps
+            for name in PHASE_ORDER:
+                _see(led.get(name))
     if t0 is None:
         t0 = 0.0
 
@@ -127,7 +167,8 @@ def export_bundles(bundles: List[Dict]) -> Dict:
         return round((ts - t0) * 1e6, 1)
 
     for pid, b in enumerate(bundles, start=1):
-        daemon = b.get("daemon", f"daemon.{pid}")
+        b = _as_dict(b)
+        daemon = b.get("daemon") or f"daemon.{pid}"
         events.append({"ph": "M", "name": "process_name", "pid": pid,
                        "tid": 0, "args": {"name": daemon}})
         events.append({"ph": "M", "name": "process_sort_index",
@@ -136,10 +177,12 @@ def export_bundles(bundles: List[Dict]) -> Dict:
         named_tids: Dict[int, str] = {}
 
         # -- per-op hop-ledger tracks ------------------------------
-        for cls, ledgers in sorted((b.get("ledgers") or {}).items()):
+        for cls, ledgers in sorted(_as_dict(b.get("ledgers")).items()):
             base = _TID_BASE.get(cls, 900)
             lanes = _Lanes()
-            for led in ledgers or []:
+            for led in _as_list(ledgers):
+                if not isinstance(led, dict):
+                    continue
                 sl = _ledger_slices(led)
                 if sl is None:
                     continue
@@ -159,10 +202,12 @@ def export_bundles(bundles: List[Dict]) -> Dict:
         # -- optracker stage timelines -----------------------------
         lanes = _Lanes()
         base = _TID_BASE["optracker"]
-        for op in b.get("ops") or []:
+        for op in _as_list(b.get("ops")):
+            op = _as_dict(op)
             evs = [(e.get("time"), e.get("event"))
-                   for e in op.get("events") or []
-                   if isinstance(e.get("time"), (int, float))]
+                   for e in _as_list(op.get("events"))
+                   if isinstance(e, dict)
+                   and isinstance(e.get("time"), (int, float))]
             if len(evs) < 2:
                 continue
             evs.sort(key=lambda te: te[0])
@@ -186,7 +231,9 @@ def export_bundles(bundles: List[Dict]) -> Dict:
 
         # -- flight-recorder instants ------------------------------
         tid = _TID_BASE["flight"]
-        fl = (b.get("flight") or {}).get("events") or []
+        fl = [e for e in
+              _as_list(_as_dict(b.get("flight")).get("events"))
+              if isinstance(e, dict)]
         if fl:
             named_tids.setdefault(tid, "flight recorder")
         for ev in fl:
@@ -200,9 +247,12 @@ def export_bundles(bundles: List[Dict]) -> Dict:
                            "ts": us(ts), "s": "p", "args": args})
 
         # -- per-shard reactor utilization counters ----------------
-        for r in b.get("reactors") or []:
+        for r in _as_list(b.get("reactors")):
+            r = _as_dict(r)
             shard = r.get("shard", 0)
-            for s in r.get("util") or []:
+            for s in _as_list(r.get("util")):
+                if not isinstance(s, dict):
+                    continue
                 ts = s.get("ts")
                 if not isinstance(ts, (int, float)):
                     continue
@@ -215,6 +265,97 @@ def export_bundles(bundles: List[Dict]) -> Dict:
                     "pid": pid, "tid": 0, "ts": us(ts),
                     "args": {"lag": round(
                         s.get("loop_lag_s", 0.0) * 1e3, 3)}})
+
+        # -- per-device phase lanes + pipeline counters ------------
+        # every recent device-group ledger becomes an enclosing
+        # {encode,decode}_group slice plus nested per-phase slices
+        # (charge-to-ending-phase, same rule as the hop tracks), one
+        # tid band per JAX device id so a mesh shows one lane set per
+        # chip.  Two derived counter tracks per device: groups in
+        # flight (staging occupancy) and the fraction of each h2d
+        # hidden under the previous group's compute (pipeline
+        # overlap — the PR 5 double-buffering readout).
+        dev_block = _as_dict(b.get("device"))
+        by_dev: Dict[int, List] = {}
+        for led in _as_list(dev_block.get("ledgers")):
+            if not isinstance(led, dict):
+                continue
+            sl = _device_phase_slices(led)
+            if sl is None:
+                continue
+            try:
+                dev = int(led.get("device", 0) or 0)
+            except (TypeError, ValueError):
+                dev = 0
+            by_dev.setdefault(dev, []).append((led, sl))
+        for dev, items in sorted(by_dev.items()):
+            # device -1 is the host lane (CPU-twin groups): it sits
+            # one stride below the device band and gets its own name
+            base = _TID_BASE["device"] + dev * _DEVICE_LANE_STRIDE
+            label = f"device{dev}" if dev >= 0 else "cpu_twin"
+            lanes = _Lanes()
+            items.sort(key=lambda it: it[1][0])
+            occ_edges: List = []
+            for led, (start, end, spans) in items:
+                tid = base + min(lanes.place(start, end),
+                                 _DEVICE_LANE_STRIDE - 1)
+                named_tids.setdefault(
+                    tid, f"device{dev} phases" if dev >= 0
+                    else "cpu-twin phases")
+                gname = str(led.get("group", "encode")) + "_group"
+                events.append({
+                    "ph": "X", "name": gname, "cat": "device",
+                    "pid": pid, "tid": tid, "ts": us(start),
+                    "dur": round((end - start) * 1e6, 1),
+                    "args": {"device": dev,
+                             "bytes": led.get("bytes", 0)}})
+                for phase, hs, he in spans:
+                    events.append({
+                        "ph": "X", "name": phase, "cat": "device",
+                        "pid": pid, "tid": tid, "ts": us(hs),
+                        "dur": round((he - hs) * 1e6, 1)})
+                occ_edges.append((start, 1))
+                occ_edges.append((end, -1))
+            occ_edges.sort()
+            running = 0
+            for ets, delta in occ_edges:
+                running += delta
+                events.append({
+                    "ph": "C",
+                    "name": f"{label}_groups_in_flight",
+                    "pid": pid, "tid": 0, "ts": us(ets),
+                    "args": {"groups": running}})
+            prev = None
+            for led, _sl in items:
+                if prev is not None:
+                    try:
+                        ov = max(0.0,
+                                 min(led["h2d_done"],
+                                     prev["compute_done"])
+                                 - max(led["h2d_start"],
+                                       prev["compute_start"]))
+                        h2d = max(1e-9,
+                                  led["h2d_done"] - led["h2d_start"])
+                        events.append({
+                            "ph": "C",
+                            "name": f"{label}_overlap_frac",
+                            "pid": pid, "tid": 0,
+                            "ts": us(led["h2d_start"]),
+                            "args": {"frac": round(
+                                min(1.0, ov / h2d), 4)}})
+                    except (KeyError, TypeError):
+                        pass
+                prev = led
+        mem = _as_dict(dev_block.get("memory"))
+        if mem and by_dev:
+            last_ts = max(end for items in by_dev.values()
+                          for _, (start, end, spans) in items)
+            events.append({
+                "ph": "C", "name": "staging_host_bytes",
+                "pid": pid, "tid": 0, "ts": us(last_ts),
+                "args": {"bytes": mem.get("staging_host_bytes", 0),
+                         "peak": mem.get("staging_host_bytes_peak",
+                                         0)}})
 
         for tid, name in sorted(named_tids.items()):
             events.append({"ph": "M", "name": "thread_name",
