@@ -995,12 +995,34 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
         rebuild_s = time.perf_counter() - t0
         for key in ("dec_calls", "dec_reqs", "dec_coalesced"):
             stats[key] = 0
+        # decode-path evidence (ISSUE 11): the collect-time decode
+        # router's verdict counters plus the raw ledgers of every
+        # group the completion loop tagged group=="decode" — the
+        # rebuild config's attribution and the perf-trend
+        # dec-routing-collapse gate read exactly these
+        dec_routes = {}
+        dec_ledgers = []
         for osd in c.osds.values():
             b = getattr(osd, "encode_batcher", None)
             if b is not None:
                 stats["dec_calls"] += b.dec_calls
                 stats["dec_reqs"] += b.dec_reqs
                 stats["dec_coalesced"] += b.dec_coalesced
+                for led in b.ledger_accum.recent():
+                    if led.get("group") == "decode":
+                        dec_ledgers.append(led)
+                dp = getattr(b, "dperf", None)
+                if dp is not None:
+                    for r in ("device", "pin", "learned",
+                              "idle_probe", "tick_probe",
+                              "breaker_open", "breaker_probe"):
+                        try:
+                            dec_routes[r] = dec_routes.get(r, 0) + \
+                                dp.get(f"dec_route_{r}")
+                        except Exception:
+                            pass
+        stats["dec_routes"] = dec_routes
+        stats["decode_ledgers"] = dec_ledgers
         # recovery-side waterfall: push/pull round trips + decode
         # windows + scrub, accumulated on each OSD's hops_recovery
         # during the rebuild just measured
@@ -1055,7 +1077,8 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
 # regression gate (and importable by the slow test)
 _FLOOR_STATS = {"cluster_k8m4_vs_baseline": None,
                 "cluster_k8m4_attribution": None,
-                "cluster_scaling_clients": None}
+                "cluster_scaling_clients": None,
+                "rebuild_attribution": None}
 
 
 def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
@@ -1503,6 +1526,204 @@ def bench_chaos_soak(n_objs=26, obj_bytes=8 << 20):
     }), flush=True)
 
 
+def bench_rebuild(n_objs=26, obj_bytes=8 << 20):
+    """Rebuild as a first-class scenario (ISSUE 11): the cluster_k8m4
+    OSD-loss recovery, but the attribution record is DECODE-side.
+    The write phase exists only to seed data; the JSON record carries
+    the decode groups' seven-phase device waterfall (refolded from
+    just the ledgers the completion loop tagged ``group=="decode"``,
+    so encode groups from the write phase cannot dilute the shares),
+    the collect-time decode router's ``dec_route_*`` verdicts, the
+    client read-back waterfall, and the recovery hop waterfall over
+    the rebuild wall.  Baseline is plugin=jerasure inline per-window
+    decode on the same host."""
+    w_tpu, r_tpu, st = _cluster_run("tpu", n_objs, obj_bytes,
+                                    k="8", m="4", n_osds=13)
+    w_cpu, r_cpu, _ = _cluster_run("jerasure", n_objs, obj_bytes,
+                                   k="8", m="4", n_osds=13)
+    emit(f"OSD rebuild MB/s (k=8 m=4 pool, kill osd with data loss; "
+         f"recovery decodes ride the batched Vandermonde-inverse "
+         f"device pipeline: {st['dec_reqs']} decode reqs -> "
+         f"{st['dec_calls']} batched calls, {st['dec_coalesced']} "
+         f"coalesced; baseline=plugin-jerasure per-window inline "
+         f"decode {r_cpu:.1f} MB/s)", r_tpu, "MB/s",
+         r_tpu / r_cpu if r_cpu else 0.0)
+    from ceph_tpu.utils.device_ledger import (DeviceLedgerAccum,
+                                              device_waterfall_block)
+    from ceph_tpu.utils.hops import waterfall_block
+    acc = DeviceLedgerAccum()
+    for led in st.get("decode_ledgers") or ():
+        acc.observe(led)
+    dl = acc.dump()
+    rwall = st.get("rebuild_wall_s", 0.0)
+    routes = st.get("dec_routes") or {}
+    dev_groups = sum(routes.get(r, 0) for r in
+                     ("device", "idle_probe", "tick_probe",
+                      "breaker_probe"))
+    cpu_groups = sum(routes.get(r, 0) for r in
+                     ("pin", "learned", "breaker_open"))
+    att = {
+        "metric": "rebuild decode attribution (decode-group device "
+                  "waterfall + read/recovery hop waterfalls + "
+                  "dec_route_* verdicts over the k=8 m=4 OSD-loss "
+                  "rebuild)",
+        "value": round(r_tpu, 2), "unit": "MB/s",
+        "vs_baseline": round(r_tpu / r_cpu, 3) if r_cpu else 0.0,
+        "rebuild_mbps": {"tpu": round(r_tpu, 2),
+                         "jerasure": round(r_cpu, 2)},
+        "rebuild_wall_s": round(rwall, 3),
+        "decode_batcher": {"reqs": st["dec_reqs"],
+                           "calls": st["dec_calls"],
+                           "coalesced": st["dec_coalesced"]},
+        "dec_routes": routes,
+        "routing": {"device_reqs": dev_groups,
+                    "cpu_twin_reqs": cpu_groups},
+        "device_decode_fraction": round(
+            dev_groups / max(1, dev_groups + cpu_groups), 4),
+        "expect_device": st.get("expect_device"),
+    }
+    if dl.get("groups"):
+        # decode-only phase shares scaled onto the rebuild wall:
+        # which device phase the recovery stream's decode time went to
+        att["device_waterfall"] = device_waterfall_block(
+            dl, round(rwall, 6))
+    hr = st.get("hops_client_read")
+    if hr and hr.get("ops"):
+        rwf = waterfall_block(hr, st.get("read_wall_s", 0.0))
+        if st.get("hops_read_osd"):
+            rwf["shard_reads"] = {
+                k: st["hops_read_osd"].get(k)
+                for k in ("ops", "p50_s", "p99_s")}
+        att["read_waterfall"] = rwf
+    hv = st.get("hops_recovery")
+    if hv and hv.get("ops"):
+        att["recovery"] = waterfall_block(hv, rwall)
+    print(json.dumps(att), flush=True)
+    # --assert-floor hands these to the perf_trend rebuild gates
+    _FLOOR_STATS["rebuild_attribution"] = att
+    return r_tpu / r_cpu if r_cpu else 0.0
+
+
+def bench_scrub(n_objs=24, obj_bytes=4 << 20):
+    """Deep-scrub throughput (ISSUE 11): write a 3-OSD k=2 m=1 tpu
+    pool, deep-scrub every PG with GF syndrome checks on, and time
+    the pass.  The EC backend checksums each shard's objects in
+    ``ec_tpu_scrub_window_bytes`` windows through ONE batched
+    linear-CRC apply per window (ops/crclinear: CRC32C as a GF(2)
+    bitmatrix, syndrome bands folded into the same matmul) instead
+    of a per-object CRC loop.  The headline is checksum MB/s inside
+    the scrub windows (the ``scrub_window`` hop's charged seconds —
+    store reads and messaging excluded on both sides); baseline is
+    the per-chunk host CRC kernel over the same byte volume."""
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.osd import ecutil as osd_ecutil
+    from ceph_tpu.utils.hops import merge_dumps as _hops_merge
+
+    f = machine_factor()
+    # same anti-starvation grace as _cluster_run: windowed CRC work
+    # stalls single-core daemons long enough that the test-default
+    # heartbeat grace fabricates down marks mid-scrub, and a remap
+    # then parks the scrub forever
+    with Cluster(n_osds=3,
+                 conf=test_config(osd_deep_scrub_syndrome=True,
+                                  osd_heartbeat_interval=2.0,
+                                  osd_heartbeat_grace=max(20.0,
+                                                          12.0 * f),
+                                  mon_osd_down_out_interval=60.0)) \
+            as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        c.create_ec_profile("scr", plugin="tpu", k="2", m="1")
+        c.create_pool("scrp", "erasure", erasure_code_profile="scr")
+        io = c.rados(timeout=60 * f).open_ioctx("scrp")
+        blob = os.urandom(obj_bytes)
+        comps = [io.aio_write_full(f"s{i}", blob)
+                 for i in range(n_objs)]
+        assert all(cp.wait(60 * f) == 0 for cp in comps)
+        c.wait_for_clean(max(30.0, 30.0 * f))
+        ret, _, out = c.mon_command({"prefix": "pg dump"})
+        assert ret == 0
+        pgids = sorted(out["pg_stats"])
+        t0 = time.perf_counter()
+        for pgid in pgids:
+            ret, rs, _ = c.mon_command({"prefix": "pg deep-scrub",
+                                        "pgid": pgid})
+            assert ret == 0, rs
+        deadline = time.monotonic() + max(120.0, 90.0 * f)
+        while time.monotonic() < deadline:
+            ret, _, out = c.mon_command({"prefix": "pg dump"})
+            stats_by_pg = out["pg_stats"]
+            if all(stats_by_pg.get(p, {}).get("last_deep_scrub", 0)
+                   > 0 for p in pgids):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError("deep scrub never finished on every PG")
+        wall = time.perf_counter() - t0
+        agg = {"windows": 0, "device_windows": 0, "crc_bytes": 0,
+               "syndrome_errors": 0, "scrub_errors": 0}
+        for osd in c.osds.values():
+            for pg in osd.pgs.values():
+                be = getattr(pg, "backend", None)
+                agg["windows"] += getattr(be, "scrub_windows", 0)
+                agg["device_windows"] += getattr(
+                    be, "scrub_device_windows", 0)
+                agg["crc_bytes"] += getattr(be, "scrub_crc_bytes", 0)
+                sc = getattr(pg, "scrubber", None)
+                agg["syndrome_errors"] += getattr(
+                    sc, "syndrome_errors", 0)
+        for p in pgids:
+            agg["scrub_errors"] += stats_by_pg.get(p, {}).get(
+                "num_scrub_errors", 0)
+        hops = _hops_merge(
+            [osd.hops_recovery.dump() for osd in c.osds.values()
+             if getattr(osd, "hops_recovery", None) is not None])
+    crc_s = (hops.get("hop_seconds") or {}).get("scrub_window", 0.0)
+    crc_mbps = (agg["crc_bytes"] / 2**20 / crc_s) if crc_s > 0 else 0.0
+    # baseline: the per-chunk host CRC kernel (what build_scrub_map
+    # ran before the windowed path) over the same byte volume
+    shard = blob[:obj_bytes // 2]
+    reps = max(1, agg["crc_bytes"] // max(1, len(shard)))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        osd_ecutil.chunk_crc(shard)
+    base_s = time.perf_counter() - t0
+    base_mbps = reps * len(shard) / 2**20 / base_s if base_s > 0 \
+        else 0.0
+    ratio = crc_mbps / base_mbps if base_mbps else 0.0
+    emit(f"deep-scrub checksum MB/s (3-OSD k=2 m=1 tpu pool, "
+         f"{n_objs}x{obj_bytes >> 20} MiB objects, GF syndrome "
+         f"checks on; {agg['windows']} batched linear-CRC windows, "
+         f"{agg['device_windows']} device-applied, "
+         f"{agg['crc_bytes'] >> 20} MiB checksummed in {crc_s:.3f} s "
+         f"of window time over a {wall:.1f} s scrub pass; "
+         f"baseline=per-chunk host CRC kernel {base_mbps:.1f} MB/s)",
+         crc_mbps, "MB/s", ratio)
+    print(json.dumps({
+        "metric": "deep-scrub window attribution (batched linear-CRC "
+                  "+ GF syndrome scrub over every PG; checksum MB/s "
+                  "inside scrub windows vs per-chunk host CRC)",
+        "value": round(crc_mbps, 2), "unit": "MB/s",
+        "vs_baseline": round(ratio, 3),
+        "scrub_wall_s": round(wall, 3),
+        "window_seconds": round(crc_s, 4),
+        "windows": agg["windows"],
+        "device_windows": agg["device_windows"],
+        "crc_bytes": agg["crc_bytes"],
+        "syndrome_errors": agg["syndrome_errors"],
+        "scrub_errors": agg["scrub_errors"],
+        "scrub_window_hop": {
+            k: hops.get(k) for k in ("ops", "p50_s", "p99_s")
+            if hops.get(k) is not None},
+        "baseline_host_crc_mbps": round(base_mbps, 2),
+    }), flush=True)
+    assert agg["scrub_errors"] == 0, \
+        f"clean pool scrubbed dirty: {agg}"
+    assert agg["syndrome_errors"] == 0, \
+        f"clean pool raised syndrome errors: {agg}"
+    return ratio
+
+
 CONFIGS = {
     "roofline": bench_roofline,
     "rs_k2m1": lambda: bench_encode_rs(2, 1, 4 << 10, 1024),
@@ -1523,6 +1744,12 @@ EXTRA_CONFIGS = {
     # opt-in (--only chaos_soak): two full k8m4 runs, excluded from
     # the default sweep to keep its wall time unchanged
     "chaos_soak": bench_chaos_soak,
+    # opt-in (--only rebuild / --only scrub): the decode-pipeline
+    # scenarios (ISSUE 11) — rebuild reruns the k8m4 pair with a
+    # decode-side attribution record; scrub drives a full deep-scrub
+    # pass with syndrome checks on
+    "rebuild": bench_rebuild,
+    "scrub": bench_scrub,
 }
 CONFIGS_ALL = dict(CONFIGS, **EXTRA_CONFIGS)
 
@@ -1611,7 +1838,9 @@ def main():
                 perf_trend.load_history(hist_paths),
                 fresh_ratio=ratio,
                 fresh_scaling=_FLOOR_STATS.get(
-                    "cluster_scaling_clients"))
+                    "cluster_scaling_clients"),
+                fresh_rebuild=_FLOOR_STATS.get(
+                    "rebuild_attribution"))
             for fnd in findings:
                 print(f"# --assert-floor perf-trend "
                       f"{fnd['severity'].upper()} [{fnd['check']}]: "

@@ -50,6 +50,36 @@ def shared_backend() -> JaxBackend:
     return _SHARED_BACKEND
 
 
+class _DecodeHandle:
+    """AsyncBatch wrapper for a decode group: ``wait()`` splits the
+    combined-recovery-row output [B, E, L] back into per-erased-chunk
+    arrays.  Exposes the underlying seven-phase DeviceLedger and h2d
+    sample so the OSD batcher folds decode groups into the same
+    waterfall/crossover machinery as encode groups."""
+
+    __slots__ = ("_ab", "_erased")
+
+    def __init__(self, ab, erased):
+        self._ab = ab
+        self._erased = tuple(erased)
+
+    @property
+    def ledger(self):
+        return getattr(self._ab, "ledger", None)
+
+    @property
+    def h2d_bytes(self):
+        return getattr(self._ab, "h2d_bytes", 0)
+
+    @property
+    def h2d_seconds(self):
+        return getattr(self._ab, "h2d_seconds", 0.0)
+
+    def wait(self) -> Dict[int, np.ndarray]:
+        out = self._ab.wait()
+        return {e: out[..., i, :] for i, e in enumerate(self._erased)}
+
+
 class TpuCodecMixin:
     """Overrides the backend and adds the batched API."""
 
@@ -98,6 +128,75 @@ class TpuCodecMixin:
                 self.core.coding_matrix, data)
         return self.core.backend.apply_bitmatrix_bytes_async(
             self.core.bitmatrix, data, self.w)
+
+    def decode_async_supported(self) -> bool:
+        """True when this geometry can ride the async device decode
+        pipeline (combined recovery rows need a GF coding matrix;
+        the async staging path is byte-domain w=8)."""
+        core = self.core
+        return (core.layout == "byte" and core.w == 8
+                and core.coding_matrix is not None)
+
+    def decode_batch_async(self, present: Mapping[int, np.ndarray],
+                           chunk_len: int) -> _DecodeHandle:
+        """Non-blocking decode_batch: one staged device dispatch
+        reconstructs EVERY missing chunk id for the batch.  The
+        per-erasure-signature combined recovery rows (CodecCore
+        `_recovery_rows` — inverse map for data erasures, encode row
+        composed through it for parity erasures) make reconstruction a
+        single matmul, so decode groups pipeline through the same
+        StagingPool rings and inflight-group machinery as encode —
+        the decode twin of encode_batch_async."""
+        if not self.decode_async_supported():
+            raise ValueError("async device decode needs a byte-domain "
+                             "w=8 GF coding matrix")
+        core = self.core
+        n = self.k + self.m
+        avail = sorted(i for i in present if i < n)
+        if len(avail) < self.k:
+            raise ValueError(
+                f"need {self.k} chunks, have {len(avail)}")
+        erased = tuple(i for i in range(n) if i not in present)
+        chosen = tuple(avail[:self.k])
+        rows_gf, _ = core._recovery_rows(chosen, erased)
+        stack = np.stack(
+            [np.asarray(present[i], dtype=np.uint8)
+             .reshape(-1, int(chunk_len)) for i in chosen], axis=1)
+        return _DecodeHandle(
+            core.backend.apply_gf8_rows_async(rows_gf, stack), erased)
+
+    def prewarm_decode(self, chunk_size: int, batches=(1,)) -> None:
+        """Make the common recovery signatures hot before the first
+        rebuild window: host-side combined recovery rows for every
+        single-erasure signature, the staging ring for the window
+        shape, and one compiled decode executable (each signature is
+        its own jit key, so the first window of any *other* signature
+        still pays one compile — but single erasures dominate real
+        recovery).  Idempotent per (geometry, chunk_size)."""
+        if not self.decode_async_supported():
+            return
+        core = self.core
+        n = self.k + self.m
+        try:
+            for e in range(n):
+                chosen = tuple(i for i in range(n) if i != e)[:self.k]
+                core._recovery_rows(chosen, (e,))
+        except Exception:
+            return
+        pre = getattr(core.backend, "prewarm_geometry", None)
+        if pre is not None:
+            pre(self.k, chunk_size, batches=batches, w=self.w)
+        key = ("dec", type(self).__name__, self.k, self.m, self.w,
+               int(chunk_size))
+        if key in _PREWARMED_SHAPES:
+            return
+        _PREWARMED_SHAPES.add(key)
+        z = {i: np.zeros((1, int(chunk_size)), dtype=np.uint8)
+             for i in range(n) if i != 0}
+        try:
+            self.decode_batch_async(z, int(chunk_size)).wait()
+        except Exception:
+            _PREWARMED_SHAPES.discard(key)  # best-effort
 
     def prewarm_geometry(self, chunk_size: int,
                          batches=(1,)) -> None:

@@ -297,6 +297,22 @@ class CodecCore:
             return {e: np.zeros(shape, dtype=np.uint8) for e in erased}
         chosen = avail[:self.k]
         out: dict[int, np.ndarray] = {}
+        if self.coding_matrix is not None:
+            # combined recovery rows: ONE matrix maps the chosen k
+            # survivors straight to every erased chunk (data AND
+            # parity), so the whole reconstruction is a single apply
+            # — one device dispatch per batch instead of a decode
+            # apply chained into a re-encode apply
+            rows_gf, rows_bits = self._recovery_rows(tuple(chosen),
+                                                     tuple(erased))
+            stack = np.stack([present[i] for i in chosen], axis=-2)
+            if self.gf8_decode_fast():
+                dec = self.backend.apply_gf8_rows(rows_gf, stack)
+            else:
+                dec = self._apply(rows_bits, rows_gf, stack)
+            for idx, e in enumerate(erased):
+                out[e] = dec[..., idx, :]
+            return out
         data_erased = [e for e in erased if e < self.k]
         if data_erased:
             rows_gf, rows_bits = self._decode_rows(tuple(chosen),
@@ -325,6 +341,31 @@ class CodecCore:
             for idx, e in enumerate(coding_erased):
                 out[e] = enc[..., idx, :]
         return out
+
+    def _recovery_rows(self, chosen: tuple, erased: tuple):
+        """(GF rows, bit rows) mapping the chosen k survivors to EVERY
+        erased chunk id — data rows come straight from the inverse map
+        R (chosen -> data), parity row e >= k composes the encode row
+        through it: coding_matrix[e-k] · R over GF(2^w).  Cached per
+        erasure signature; this is the matrix the device decode
+        pipeline jit-caches per (geometry, erasure-set)."""
+        key = ("rec", chosen, erased)
+        hit = self._decode_cache.get(key)
+        if hit is not None:
+            return hit
+        if self.coding_matrix is None:
+            raise ValueError("combined recovery rows need a GF "
+                             "coding matrix")
+        R = make_decoding_matrix(self.coding_matrix, self.w,
+                                 list(chosen))
+        f = gf(self.w)
+        rows = [R[e] if e < self.k else
+                f.matmul(self.coding_matrix[e - self.k][None, :], R)[0]
+                for e in erased]
+        rows_gf = np.stack(rows, axis=0).astype(np.int64)
+        rows_bits = matrix_to_bitmatrix(rows_gf, self.w)
+        self._decode_cache[key] = (rows_gf, rows_bits)
+        return rows_gf, rows_bits
 
     def _decode_rows(self, chosen: tuple, data_erased: tuple):
         """(GF rows or None, bit rows) mapping chosen chunks -> erased data

@@ -1098,6 +1098,44 @@ class JaxBackend:
             ab.h2d_bytes, ab.h2d_seconds = sample
         return ab
 
+    def apply_gf8_rows_async(self, rows: np.ndarray,
+                             data: np.ndarray) -> "AsyncBatch":
+        """Non-blocking apply_gf8_rows — the decode twin of
+        apply_gf8_matrix_async.  Per-erasure-signature inverse rows
+        ride the same staging rings, signature-cached kernels, and
+        device-phase ledger as encode, so the OSD batcher can pipeline
+        recovery decode groups exactly like encode groups.  Donation
+        is legal only for square row sets (gf8_fn enforces it), which
+        decode hits whenever len(erased) == k."""
+        if not self.gf8_fast_path():
+            from .matrix import matrix_to_bitmatrix
+            return self.apply_bitmatrix_bytes_async(
+                matrix_to_bitmatrix(np.asarray(rows, dtype=np.int64),
+                                    8), data, 8)
+        squeeze = data.ndim == 2
+        if squeeze:
+            data = data[None]
+        lead = data.shape[:-2] if not squeeze else ()
+        data = data.reshape((-1,) + data.shape[-2:])
+        dev, batch, L, done, sample, ledger = self._staged_put(
+            data, LENGTH_QUANTUM)
+        try:
+            out = self.gf8_fn(rows, donate=done is not None)(dev)
+            ledger["compute_start"] = time.time()
+            out.copy_to_host_async()
+        except BaseException:
+            # kernel dispatch failed: no fence will ever retire, so
+            # hand the slot back unfenced instead of leaking it
+            if done is not None:
+                done(None)
+            raise
+        if done is not None:
+            done(out)
+        ab = AsyncBatch(out, batch, L, lead, ledger)
+        if sample is not None:
+            ab.h2d_bytes, ab.h2d_seconds = sample
+        return ab
+
     def apply_bitmatrix_bytes(self, B: np.ndarray, data: np.ndarray,
                               w: int) -> np.ndarray:
         squeeze = data.ndim == 2

@@ -86,6 +86,7 @@ class _DecReq:
         self.done = False
         total = ecutil.nbytes_of(next(iter(have.values())))
         self.nstripes = total // sinfo.chunk_size
+        self.t_enq = time.monotonic()
 
 
 class _BatchTwin:
@@ -153,6 +154,10 @@ class EncodeBatcher:
     _min_device_bytes: float = 0.0           # learned crossover, shared
     _pinned_min_device_bytes: float = 0.0    # operator pin (breaker
                                              # close resets TO this)
+    _dec_min_device_bytes: float = 0.0       # decode-side crossover;
+                                             # 0 = not yet learned ->
+                                             # seeded from the encode
+                                             # EWMA (_dec_min_bytes)
     _probe_tick: int = 0                     # shared probe cadence
     _warmed: set = set()                     # geometries prewarmed
     _h2d_bps: float = 0.0                    # warm link rate EWMA, shared
@@ -399,6 +404,23 @@ class EncodeBatcher:
                         ("breaker_probe", "decode re-admission "
                                           "probes through the open "
                                           "breaker")):
+                    dp.add(f"dec_route_{reason}",
+                           description="decode routing verdicts: "
+                                       + desc)
+            if "dec_route_pin" not in dp._types:
+                # the full reason ladder for the collect-time decode
+                # router (ISSUE 11): decode groups now route BEFORE
+                # dispatch like encode groups, so the pin and the
+                # probe taxes apply to them too
+                for reason, desc in (
+                        ("pin", "decode batches under the operator/"
+                                "calibration pin -> twin "
+                                "(deterministic)"),
+                        ("idle_probe", "idle-device decode re-probes "
+                                       "forced to the device"),
+                        ("tick_probe", "1-in-N periodic decode "
+                                       "probes forced to the "
+                                       "device")):
                     dp.add(f"dec_route_{reason}",
                            description="decode routing verdicts: "
                                        + desc)
@@ -781,7 +803,13 @@ class EncodeBatcher:
                 if gstripes > self.group_stripes_hwm:
                     self.group_stripes_hwm = gstripes
                 if key[0] == "dec":
-                    groups.append((key, reqs, "dec"))
+                    # decode groups route + dispatch HERE like encode
+                    # groups (ISSUE 11): the async handle rides the
+                    # same bounded completion queue, so decode honors
+                    # ec_tpu_inflight_groups and pipelines its h2d
+                    # under the previous group's compute
+                    groups.append((key, reqs,
+                                   self._route_dec_group(key, reqs)))
                     continue
                 to_cpu = self._route_to_cpu(key, reqs)
                 if not to_cpu and self._breaker_blocks():
@@ -818,6 +846,13 @@ class EncodeBatcher:
             try:
                 if handle == "dec":
                     self._complete_group_dec(key, reqs)
+                elif handle == "dec_cpu":
+                    self._complete_group_dec_twin(key, reqs)
+                elif isinstance(handle, tuple) and handle \
+                        and handle[0] == "decdev":
+                    self._complete_group_dec_dev(
+                        key, reqs, handle,
+                        trust_win=(ngroups == 1))
                 elif handle == "cpu":
                     self._complete_group_cpu(reqs)
                 else:
@@ -981,6 +1016,7 @@ class EncodeBatcher:
             # crossover snaps back to the operator's pin (or fully
             # unlearned) and the device gets re-tried on its merits
             cls._min_device_bytes = cls._pinned_min_device_bytes
+            cls._dec_min_device_bytes = 0.0   # re-seed from encode
             cls._dev_bps = {}
             if self.bperf is not None:
                 self.bperf.inc("breaker_close")
@@ -1030,6 +1066,7 @@ class EncodeBatcher:
         (tests; ops can call it after a hardware change)."""
         cls._min_device_bytes = 0.0
         cls._pinned_min_device_bytes = 0.0
+        cls._dec_min_device_bytes = 0.0
         cls._probe_tick = 0
         cls._cpu_bps = {}
         cls._dev_bps = {}
@@ -1155,8 +1192,8 @@ class EncodeBatcher:
         total = sum(sum(ecutil.nbytes_of(v) for v in r.have.values())
                     for r in reqs)
         impl = None
-        if (self.adaptive_cpu and self._min_device_bytes > 0 and
-                total < self._min_device_bytes) or \
+        if (self.adaptive_cpu and self._dec_min_bytes() > 0 and
+                total < self._dec_min_bytes()) or \
                 self._breaker_blocks():
             try:
                 impl = self.cpu_twin(reqs[0].ec_impl, sinfo)
@@ -1283,6 +1320,336 @@ class EncodeBatcher:
             except Exception:
                 self._cb_error()
 
+    # -- decode device pipeline (ISSUE 11 tentpole) --------------------
+    def _dec_min_bytes(self) -> float:
+        """The decode-side crossover threshold.  Decode keeps its own
+        learned value (recovery moves k survivor chunks IN per erased
+        chunk OUT, so its transfer economics differ from encode's
+        k-in/m-out), but until decode groups have taught it anything
+        it is SEEDED from the encode EWMA — the device and link are
+        the same hardware, so encode's measurement beats flying
+        blind on the first rebuild window."""
+        cls = EncodeBatcher
+        if cls._dec_min_device_bytes > 0:
+            return cls._dec_min_device_bytes
+        return cls._min_device_bytes
+
+    def _route_dec_group(self, key: Tuple, reqs: List[_DecReq]):
+        """Collect-time routing + dispatch for one decode group.
+        Returns the completion-queue handle:
+
+        * ``("decdev", handles, t_disp, in_bytes)`` — async device
+          dispatch in flight (joined by _complete_group_dec_dev);
+        * ``"dec_cpu"`` — routed to the CPU twin (verdict already
+          published);
+        * ``"dec"`` — legacy completion-time path for codecs without
+          the async decode API (routing happens there)."""
+        impl = reqs[0].ec_impl
+        sup = getattr(impl, "decode_async_supported", None)
+        if sup is None or not hasattr(impl, "decode_batch_async"):
+            return "dec"
+        try:
+            if not sup():
+                return "dec"
+        except Exception:
+            return "dec"
+        to_cpu = self._route_to_cpu_dec(key, reqs)
+        if not to_cpu and self._breaker_blocks():
+            to_cpu = True
+        self._note_route_dec(key, reqs, to_cpu)
+        if to_cpu:
+            return "dec_cpu"
+        handle = self._dispatch_group_dec(key, reqs)
+        if handle is None:
+            return "dec_cpu"         # dispatch failed: twin serves
+        return ("decdev",) + handle
+
+    def _route_to_cpu_dec(self, key: Tuple,
+                          reqs: List[_DecReq]) -> bool:
+        """_route_to_cpu with the decode-side crossover: same
+        pin/idle-probe/tick-probe ladder (shared probe cadence and
+        idle clocks — the device is one machine property), judged
+        against _dec_min_bytes()."""
+        if not self.adaptive_cpu:
+            self._route_reason = "device"
+            return False
+        thr = self._dec_min_bytes()
+        if thr <= 0:
+            self._route_reason = "device"
+            return False
+        cs = reqs[0].sinfo.chunk_size
+        total = sum(r.nstripes * cs * len(r.have) for r in reqs)
+        if total >= thr:
+            self._route_reason = "device"
+            return False
+        cls = EncodeBatcher
+        if 0 < cls._pinned_min_device_bytes and \
+                thr <= cls._pinned_min_device_bytes:
+            self._route_reason = "pin"
+            return True
+        now = time.monotonic()
+        if self.idle_reprobe_s > 0 and \
+                now - cls._last_device_ts > self.idle_reprobe_s and \
+                now - cls._last_idle_probe_ts > self.idle_reprobe_s:
+            cls._last_idle_probe_ts = now
+            self._route_reason = "idle_probe"
+            return False
+        cls._probe_tick += 1
+        blocked = cls._probe_tick % self.probe_interval != 0
+        self._route_reason = "learned" if blocked else "tick_probe"
+        return blocked
+
+    def _note_route_dec(self, key: Tuple, reqs: List[_DecReq],
+                        to_cpu: bool) -> None:
+        """Publish one decode routing verdict (dec_route_* counter +
+        flight-recorder event).  Collector thread only."""
+        reason = self._route_reason or \
+            ("learned" if to_cpu else "device")
+        self._route_reason = None
+        if self.dperf is not None and \
+                f"dec_route_{reason}" in self.dperf._types:
+            self.dperf.inc(f"dec_route_{reason}")
+        rec = self.recorder
+        if rec is not None:
+            cs = reqs[0].sinfo.chunk_size
+            rec.note("dec_route", reason=reason,
+                     to="cpu" if to_cpu else "device",
+                     bytes=sum(r.nstripes * cs * len(r.have)
+                               for r in reqs),
+                     reqs=len(reqs),
+                     crossover=int(self._dec_min_bytes()))
+
+    def _dispatch_group_dec(self, key: Tuple, reqs: List[_DecReq]):
+        """Issue the async device decode for one (geometry,
+        erasure-signature) group: concat every request's survivor
+        chunks into one [B, cs] stack per shard id and dispatch
+        tile-by-tile through decode_batch_async (signature-cached
+        combined recovery rows, StagingPool staging, full seven-phase
+        ledger).  Returns (handles, t_disp, in_bytes) or None on
+        dispatch failure."""
+        t_form = time.monotonic()
+        self._account_queue_wait(reqs, t_form)
+        sinfo = reqs[0].sinfo
+        cs = sinfo.chunk_size
+        have_ids = key[2]
+        try:
+            present = {
+                s: (np.concatenate(
+                    [ecutil.as_stripe_array(r.have[s], r.nstripes,
+                                            1, cs)
+                     .reshape(r.nstripes, cs) for r in reqs], axis=0)
+                    if len(reqs) > 1 else
+                    ecutil.as_stripe_array(
+                        reqs[0].have[s], reqs[0].nstripes, 1, cs)
+                    .reshape(-1, cs))
+                for s in have_ids}
+            if len(reqs) > 1:
+                self._note_copy(sum(v.nbytes
+                                    for v in present.values()),
+                                "batcher.dec_batch_concat")
+        except Exception:
+            # malformed request payload: NOT a device fault (must not
+            # trip the breaker) — the twin path fails the bad rider
+            # per-request and still serves its group-mates
+            return None
+        nstripes = sum(r.nstripes for r in reqs)
+        in_bytes = sum(v.nbytes for v in present.values())
+        tile = max(1, self.max_stripes)
+        handles = None
+        delay = self.device_retry_s
+        for attempt in range(3):
+            try:
+                faultlib.registry().hit(faultlib.DEVICE_DISPATCH)
+                handles = [
+                    reqs[0].ec_impl.decode_batch_async(
+                        {s: v[i:i + tile]
+                         for s, v in present.items()}, cs)
+                    for i in range(0, nstripes, tile)]
+                break
+            except Exception:
+                handles = None
+                if attempt < 2 and delay > 0:
+                    time.sleep(min(delay, 0.1))
+                    delay *= 2
+        if handles is None:
+            self._device_failure("dispatch")
+            return None
+        t_disp = time.monotonic()
+        EncodeBatcher._last_device_ts = t_disp
+        self.stage_seconds["batch_form"] += t_disp - t_form
+        if self.bperf is not None:
+            self.bperf.hinc("batch_stripes", nstripes)
+            self.bperf.inc("h2d_bytes", in_bytes)
+        return (handles, t_disp, in_bytes)
+
+    def _complete_group_dec_twin(self, key: Tuple,
+                                 reqs: List[_DecReq]) -> None:
+        """Execute a decode group the collect-time router already
+        sent to the CPU (verdict published there — no re-routing)."""
+        impl = None
+        try:
+            impl = self.cpu_twin(reqs[0].ec_impl, reqs[0].sinfo)
+        except Exception:
+            impl = None
+        on_twin = impl is not None
+        if impl is None:
+            impl = reqs[0].ec_impl
+        self._exec_group_dec(key, reqs, impl, on_twin)
+
+    def _complete_group_dec_dev(self, key: Tuple,
+                                reqs: List[_DecReq], handle,
+                                trust_win: bool = True) -> None:
+        """Join one in-flight device decode group: the decode twin of
+        _complete_group.  Harvests the seven-phase ledgers, folds h2d
+        samples into the link EWMA, teaches the decode crossover, and
+        splits the reconstructed [B, cs] stacks back to each rider's
+        callback.  Device trouble falls the WHOLE group back to the
+        batched CPU twin — zero client errors."""
+        _tag, handles, t_disp, in_bytes = handle
+        sinfo = reqs[0].sinfo
+        missing = key[3]
+        rec = None
+        dev_time = None
+        out_bytes = 0
+        try:
+            faultlib.registry().hit(faultlib.DEVICE_COMPLETION)
+            parts = [h.wait() for h in handles]
+            rec = parts[0] if len(parts) == 1 else {
+                e: np.concatenate([p[e] for p in parts], axis=0)
+                for e in parts[0]}
+            out_bytes = sum(v.nbytes for v in rec.values())
+            dev_time = time.monotonic() - t_disp
+            self._device_success()
+            for h in handles:
+                hb = getattr(h, "h2d_bytes", 0)
+                hs = getattr(h, "h2d_seconds", 0.0)
+                if hb and hs > 0:
+                    bps = hb / hs
+                    EncodeBatcher._h2d_bps = bps \
+                        if EncodeBatcher._h2d_bps <= 0 else (
+                            0.7 * EncodeBatcher._h2d_bps + 0.3 * bps)
+        except Exception:
+            rec = None
+            self._device_failure("completion")
+        if rec is None:
+            self._complete_group_dec_twin(key, reqs)
+            return
+        if self.adaptive_cpu:
+            self._learn_crossover_dec(key, reqs, dev_time, in_bytes,
+                                      out_bytes, trust_win=trust_win)
+        self.dec_calls += 1
+        self.dec_reqs += len(reqs)
+        if len(reqs) > 1:
+            self.dec_coalesced += len(reqs)
+        if self.perf is not None:
+            self.perf.inc("ec_dec_batch_calls")
+            if len(reqs) > 1:
+                self.perf.inc("ec_dec_batch_coalesced", len(reqs))
+        # fenced-window stage split, same link-rate model as encode
+        h2d_s = d2h_s = 0.0
+        if self._h2d_bps > 0:
+            h2d_s = min(dev_time, in_bytes / self._h2d_bps)
+            d2h_s = min(dev_time - h2d_s, out_bytes / self._h2d_bps)
+        self.stage_seconds["h2d"] += h2d_s
+        self.stage_seconds["d2h"] += d2h_s
+        self.stage_seconds["device"] += max(
+            0.0, dev_time - h2d_s - d2h_s)
+        if self.bperf is not None:
+            self.bperf.hinc("dispatch_ms", dev_time * 1e3)
+            self.bperf.inc("d2h_bytes", out_bytes)
+            self.bperf.inc("device_reqs", len(reqs))
+            if len(reqs) > 1:
+                self.bperf.inc("coalesced_reqs", len(reqs))
+        for h in handles:
+            led = getattr(h, "ledger", None)
+            if led is not None:
+                led["group"] = "decode"
+            self._observe_device_ledger(led)
+        self._publish_device_telemetry(reqs[0].ec_impl)
+        off = 0
+        for r in reqs:
+            out = {}
+            for s in r.want:
+                if s in missing:
+                    out[s] = memoryview(np.ascontiguousarray(
+                        rec[s][off:off + r.nstripes])).cast("B")
+                else:
+                    hv = r.have[s]
+                    out[s] = hv if isinstance(hv, bytes) else \
+                        memoryview(hv).cast("B")
+            off += r.nstripes
+            try:
+                r.done = True
+                r.cb(out)
+            except Exception:
+                self._cb_error()
+
+    def _cpu_rate_dec(self, key: Tuple,
+                      reqs: List[_DecReq]) -> float:
+        """CPU twin DECODE throughput for this geometry (bytes of
+        survivor input per second), measured once on real data;
+        shared process-wide like _cpu_rate."""
+        rk = ("dec", key[1])
+        rate = EncodeBatcher._cpu_bps.get(rk)
+        if rate is None:
+            r = reqs[0]
+            cs = r.sinfo.chunk_size
+            try:
+                twin = self.cpu_twin(r.ec_impl, r.sinfo)
+                present = {
+                    s: ecutil.as_stripe_array(r.have[s], r.nstripes,
+                                              1, cs)
+                    .reshape(r.nstripes, cs) for s in r.have}
+                t0 = time.monotonic()
+                twin.decode_batch(present, cs)
+                dt = max(time.monotonic() - t0, 1e-6)
+                rate = sum(v.nbytes for v in present.values()) / dt
+            except Exception:
+                # no twin: fall back to the encode-side measurement
+                # (same matmul cost model) rather than guessing
+                rate = EncodeBatcher._cpu_bps.get(key[1], 0.0)
+            EncodeBatcher._cpu_bps[rk] = rate
+        return rate
+
+    def _learn_crossover_dec(self, key: Tuple, reqs: List[_DecReq],
+                             dev_time: float, in_bytes: int,
+                             out_bytes: int,
+                             trust_win: bool = True) -> None:
+        """_learn_crossover for decode groups: the same pipelined
+        cost model (max of the h2d/compute/d2h legs vs the CPU twin's
+        prediction) and compile/outlier rejection, but moving the
+        DECODE-side threshold and its own per-geometry device-rate
+        EWMA bucket."""
+        try:
+            cls = EncodeBatcher
+            rk = ("dec", key[1])
+            cpu_rate = max(self._cpu_rate_dec(key, reqs), 1.0)
+            cpu_pred = in_bytes / cpu_rate
+            h2d_s = d2h_s = 0.0
+            if cls._h2d_bps > 0:
+                h2d_s = min(dev_time, in_bytes / cls._h2d_bps)
+                d2h_s = min(max(0.0, dev_time - h2d_s),
+                            out_bytes / cls._h2d_bps)
+            compute_s = max(0.0, dev_time - h2d_s - d2h_s)
+            rate = cls._dev_bps.get(rk, 0.0)
+            if rate > 0 and compute_s > 5.0 * (in_bytes / rate) \
+                    and compute_s > 1e-3:
+                return               # compile/stall outlier
+            if compute_s > 0:
+                bps = in_bytes / compute_s
+                cls._dev_bps[rk] = bps if rate <= 0 else (
+                    0.7 * rate + 0.3 * bps)
+            dev_pipe = max(h2d_s, compute_s, d2h_s) \
+                if (h2d_s or d2h_s) else dev_time
+            cur = self._dec_min_bytes()
+            if dev_pipe > cpu_pred:
+                cls._dec_min_device_bytes = max(
+                    cur, dev_pipe * cpu_rate / 2, self.crossover_min)
+            elif trust_win and dev_pipe < cpu_pred / 2 and cur > 0:
+                cls._dec_min_device_bytes = min(cur, in_bytes / 2)
+        except Exception:
+            pass                     # learning is best-effort
+
     def _learn_crossover(self, reqs: List[_Req],
                          dev_time: float,
                          trust_win: bool = True) -> None:
@@ -1361,8 +1728,8 @@ class EncodeBatcher:
         means the caller should take the CPU twin."""
         if EncodeBatcher._breaker_open:
             reason, to_cpu = "breaker_open", True
-        elif (self.adaptive_cpu and self._min_device_bytes > 0
-                and nbytes < self._min_device_bytes):
+        elif (self.adaptive_cpu and self._dec_min_bytes() > 0
+                and nbytes < self._dec_min_bytes()):
             reason, to_cpu = "learned", True
         else:
             reason, to_cpu = "device", False

@@ -59,6 +59,12 @@ from .backend import OI_ATTR, Mutation, ObjectInfo, PGBackend, PGHost
 from .pglog import Eversion, LogEntry
 
 
+class _HostCrcWindow(Exception):
+    """Scrub-window routing verdict: the batched bitmatrix apply
+    would lose to the native per-chunk host CRC kernel here (no
+    accelerator, no syndrome bands to fold) — take the host loop."""
+
+
 class _WriteOp:
     """One in-flight client write (reference ECBackend::Op).
 
@@ -269,11 +275,26 @@ class ECBackend(PGBackend):
         batches = tuple(sorted({ms, max(1, ms // 2)}))
         chunk = self.sinfo.chunk_size
 
+        warm_dec = getattr(self.ec_impl, "prewarm_decode", None)
+
         def work():
             try:
                 warm(chunk, batches=batches)
             except Exception:
                 pass             # warms are best-effort
+            if warm_dec is not None:
+                # decode-side activation warm (ISSUE 11): the common
+                # single-erasure recovery signatures (combined
+                # recovery rows + staging ring + one compiled decode
+                # executable), so the first rebuild window after an
+                # OSD loss pays no compile/alloc tax.  The decode
+                # crossover itself needs no warm — it seeds from the
+                # encode EWMA the batcher.prewarm above measures
+                # (EncodeBatcher._dec_min_bytes).
+                try:
+                    warm_dec(chunk, batches=batches)
+                except Exception:
+                    pass
 
         threading.Thread(target=work, name="ec-activate-prewarm",
                          daemon=True).start()
@@ -1142,6 +1163,55 @@ class ECBackend(PGBackend):
             if errors or len(received) < min_needed:
                 cb(-5, b"")
                 return
+            degraded = any(i not in received for i in range(self.k))
+            batcher = getattr(self.host, "encode_batcher", None)
+            if degraded and batcher is not None and \
+                    hasattr(self.ec_impl, "decode_batch"):
+                # client-facing reconstruction rides the OSD's
+                # cross-op decode batcher (ISSUE 11): concurrent
+                # degraded reads of one erasure signature share one
+                # batched device dispatch (full seven-phase ledger),
+                # and the batcher owns routing, breaker, and the
+                # CPU-twin fallback.  The continuation arrives on the
+                # batcher's worker thread, so it re-enters under the
+                # PG lock — same contract as recovery's
+                # decode_done_async.
+                if hop_msg is not None:
+                    hop_msg.stamp_hop("decode_dispatch")
+
+                def decode_done(dec) -> None:
+                    lock = getattr(self.host, "lock", None)
+                    if lock is None:
+                        import contextlib
+                        lock = contextlib.nullcontext()
+                    with lock:
+                        if dec is None:
+                            cb(-5, b"")
+                            return
+                        try:
+                            if hop_msg is not None:
+                                hop_msg.stamp_hop("decode_complete")
+                            import numpy as np
+                            cs = self.sinfo.chunk_size
+                            total = len(dec[0])
+                            nst = total // cs if cs else 0
+                            shards = np.stack(
+                                [np.frombuffer(dec[i], dtype=np.uint8)
+                                 .reshape(nst, cs)
+                                 for i in range(self.k)], axis=1)
+                            data = shards.reshape(
+                                nst * self.sinfo.stripe_width
+                            ).tobytes()  # copycheck: ok - shard interleave -> client payload
+                        except Exception:
+                            cb(-5, b"")
+                            return
+                        lo = offset - astart
+                        cb(0, data[lo:lo + length])
+
+                batcher.submit_decode(self.ec_impl, self.sinfo,
+                                      received, set(range(self.k)),
+                                      decode_done)
+                return
             try:
                 # client-facing decode window rides the op's ledger:
                 # degraded reads reconstruct here, healthy reads
@@ -1851,11 +1921,22 @@ class ECBackend(PGBackend):
         ECBackend.cc:2475-2579): under deep, recompute this shard's CRC
         from stored bytes and compare against the HashInfo xattr — no
         decode on scrub.  ``hinfo_ok`` is None when the CRC is
-        unknowable (overwritten object cleared its cumulative CRCs)."""
+        unknowable (overwritten object cleared its cumulative CRCs).
+
+        Deep CRCs batch per scrub window (ISSUE 11): CRC32C is a
+        GF(2)-affine map, so a whole window of objects checksums as
+        ONE bitmatrix matmul through the codec backend
+        (ops/crclinear) instead of a per-chunk CPU loop.  With
+        ``osd_deep_scrub_syndrome`` the same apply also emits GF
+        syndrome CRC partials — XORed across shards by the primary,
+        zero iff the whole code word is consistent — a distributed
+        whole-stripe check the reference's per-shard CRC compare
+        cannot see."""
         out: Dict[str, dict] = {}
         store = self.host.store
         shard = self.host.own_shard
         coll = self.host.coll
+        pending = []                 # (entry, data, hinfo) for deep
         for obj in store.collection_list(coll):
             if obj.oid.startswith("_pgmeta"):
                 continue
@@ -1876,20 +1957,125 @@ class ECBackend(PGBackend):
                     pass
                 if deep:
                     data = store.read(coll, obj)
-                    entry["data_crc"] = ecutil.chunk_crc(data)
-                    if hinfo is not None and \
-                            hinfo.total_chunk_size == len(data):
-                        entry["stored_crc"] = hinfo.crcs[shard]
-                        entry["hinfo_ok"] = \
-                            hinfo.crcs[shard] == entry["data_crc"]
-                    else:
-                        entry["hinfo_ok"] = None    # CRC unknowable
+                    pending.append((entry, data, hinfo))
             except OSError:
                 # missing OR store-csum EIO: both scrub as read_error
                 # and repair via recovery
                 entry = {"error": "read_error", "shard": shard}
             out[obj.oid] = entry
+        if pending:
+            self._scrub_fill_crcs(pending)
+            for entry, data, hinfo in pending:
+                if hinfo is not None and \
+                        hinfo.total_chunk_size == len(data):
+                    entry["stored_crc"] = hinfo.crcs[shard]
+                    entry["hinfo_ok"] = \
+                        hinfo.crcs[shard] == entry["data_crc"]
+                else:
+                    entry["hinfo_ok"] = None        # CRC unknowable
         return out
+
+    def _scrub_fill_crcs(self, pending) -> None:
+        """Fill ``data_crc`` (and, when osd_deep_scrub_syndrome is
+        on, ``syndrome_partials``) for every pending deep-scrub
+        entry, one batched linear-CRC apply per
+        ``ec_tpu_scrub_window_bytes`` window.  Any window trouble
+        falls that window back to the per-chunk CPU loop — scrub
+        must never fail an object on device grounds."""
+        def conf(key, dflt):
+            try:
+                return self.host.conf[key]
+            except (AttributeError, KeyError, TypeError):
+                return dflt
+        wbytes = max(1 << 20, int(conf("ec_tpu_scrub_window_bytes",
+                                       16 << 20)))
+        shard = self.host.own_shard
+        from ..ops import crclinear
+        lin = crclinear.shared()
+        backend = getattr(getattr(self.ec_impl, "core", None),
+                          "backend", None)
+        if backend is not None and \
+                not hasattr(backend, "apply_bitmatrix_bytes"):
+            backend = None
+        scales = None
+        if conf("osd_deep_scrub_syndrome", False):
+            cm = getattr(getattr(self.ec_impl, "core", None),
+                         "coding_matrix", None)
+            if cm is not None and getattr(self.ec_impl, "w", 0) == 8:
+                if shard < self.k:
+                    scales = [int(cm[e][shard])
+                              for e in range(self.m)]
+                else:
+                    scales = [1 if e == shard - self.k else 0
+                              for e in range(self.m)]
+        # the batched bitmatrix CRC only beats the native per-chunk
+        # host kernel when an accelerator executes the apply OR the
+        # GF syndrome bands must fold into the same matmul; on a
+        # plain-CPU box with syndrome off, the pre-existing host
+        # loop is strictly faster, so route there
+        accel = False
+        try:
+            import jax
+            accel = jax.default_backend() != "cpu"
+        except Exception:
+            pass
+        _obs = getattr(self.host, "observe_hops", None)
+        import numpy as np
+        i = 0
+        while i < len(pending):
+            t0 = time.time()
+            j, acc = i, 0
+            while j < len(pending) and \
+                    (j == i or acc + len(pending[j][1]) <= wbytes):
+                acc += len(pending[j][1])
+                j += 1
+            window = pending[i:j]
+            chunks = [p[1] for p in window]
+            lens = [len(c) for c in chunks]
+            try:
+                if scales is None and not (accel and
+                                           backend is not None):
+                    raise _HostCrcWindow
+                if scales is not None:
+                    # distinct nonzero syndrome scales share the data
+                    # band's apply: bands = (1, *scales) in one matmul
+                    nz = sorted({s for s in scales if s})
+                    Lmax = max(lens) if lens else 0
+                    stack = np.zeros((len(chunks), Lmax),
+                                     dtype=np.uint8)
+                    for idx, c in enumerate(chunks):
+                        if lens[idx]:
+                            stack[idx, Lmax - lens[idx]:] = \
+                                np.frombuffer(c, dtype=np.uint8)
+                    parts = lin._apply_window(
+                        stack, (1,) + tuple(nz), backend=backend)
+                    zero = np.array([lin.zero_crc(n) for n in lens],
+                                    dtype=np.uint32)
+                    crcs = parts[0] ^ zero
+                    for idx, (entry, _d, _h) in enumerate(window):
+                        entry["data_crc"] = int(crcs[idx])
+                        entry["syndrome_partials"] = [
+                            int(parts[1 + nz.index(s)][idx])
+                            if s else 0 for s in scales]
+                else:
+                    crcs = lin.crc_batch(chunks, backend=backend)
+                    for idx, (entry, _d, _h) in enumerate(window):
+                        entry["data_crc"] = int(crcs[idx])
+                self.scrub_device_windows = getattr(
+                    self, "scrub_device_windows", 0) + 1
+            except Exception:
+                for entry, data, _h in window:
+                    entry["data_crc"] = ecutil.chunk_crc(data)
+            self.scrub_windows = getattr(self, "scrub_windows", 0) + 1
+            self.scrub_crc_bytes = getattr(
+                self, "scrub_crc_bytes", 0) + sum(lens)
+            if _obs is not None:
+                # one scrub_window hop per batched window: the scrub
+                # waterfall attributes checksum time per window, not
+                # per object
+                _obs({"pg_locked": t0, "scrub_window": time.time()},
+                     kind="recovery")
+            i = j
 
     def on_change(self) -> None:
         """New interval: drop every in-flight op (reference on_change);

@@ -169,12 +169,14 @@ class Scrubber:
     def _finish(self) -> None:
         pg = self.pg
         inconsistent: Dict[str, List[int]] = {}
+        self.syndrome_errors = 0     # per-round (see _compare_ec)
         if pg.pool.is_erasure():
             self._compare_ec(inconsistent)
         else:
             self._compare_replicated(inconsistent)
         self.inconsistent = inconsistent
-        self.errors = sum(len(v) for v in inconsistent.values())
+        self.errors = sum(len(v) for v in inconsistent.values()) \
+            + self.syndrome_errors
         now = time.time()
         self.last_scrub = now
         if self.deep:
@@ -244,9 +246,21 @@ class Scrubber:
 
     def _compare_ec(self, out: Dict[str, List[int]]) -> None:
         """EC shards self-check vs HashInfo; sizes must match the
-        object size's shard footprint (reference ECBackend.cc:2475)."""
+        object size's shard footprint (reference ECBackend.cc:2475).
+
+        With ``osd_deep_scrub_syndrome`` each deep map also carries
+        per-object GF-syndrome CRC partials (ecbackend
+        _scrub_fill_crcs): XORing them across the full shard set is
+        the linear CRC of the whole code word's syndrome vector —
+        nonzero means the stripe is inconsistent even when every
+        shard's own CRC matches its HashInfo (e.g. a stale-but-
+        self-consistent shard).  The check cannot LOCALIZE the bad
+        shard, so a syndrome hit on an object with no per-shard
+        culprits counts as an error without scheduling repair."""
         for oid in self._all_oids():
             bad: List[int] = []
+            syn: Optional[List[int]] = None
+            nsyn = 0
             for shard, smap in self.maps.items():
                 e = smap.get(oid)
                 if e is None or "error" in e:
@@ -258,8 +272,24 @@ class Scrubber:
                 expect = e.get("expect_size")
                 if expect is not None and e.get("size") != expect:
                     bad.append(shard)
+                    continue
+                parts = e.get("syndrome_partials")
+                if parts:
+                    nsyn += 1
+                    if syn is None:
+                        syn = list(parts)
+                    else:
+                        syn = [a ^ b for a, b in zip(syn, parts)]
             if bad:
                 out[oid] = sorted(bad)
+            elif syn is not None and nsyn == len(self.maps) and \
+                    any(syn):
+                # full shard set, every per-shard check clean, but
+                # the whole-code-word syndrome is nonzero: count the
+                # inconsistency (unlocalizable -> no shard listed,
+                # no auto-repair)
+                self.syndrome_errors = getattr(
+                    self, "syndrome_errors", 0) + 1
 
     def _repair(self, inconsistent: Dict[str, List[int]]) -> None:
         """Mark bad copies missing so recovery rebuilds them from the
@@ -302,6 +332,7 @@ class Scrubber:
         return {
             "active": self.active,
             "errors": self.errors,
+            "syndrome_errors": getattr(self, "syndrome_errors", 0),
             "inconsistent": dict(self.inconsistent),
             "last_scrub": self.last_scrub,
             "last_deep_scrub": self.last_deep_scrub,

@@ -614,6 +614,20 @@ def _opts() -> List[Option]:
                description="skip scheduling scrubs while 1-min load "
                            "average exceeds this; 0 disables the "
                            "check (reference osd_scrub_load_threshold)"),
+        Option("ec_tpu_scrub_window_bytes", int, 16 << 20, min=1 << 20,
+               description="deep-scrub checksum window: object bytes "
+                           "batched into ONE linear-CRC device apply "
+                           "(ops/crclinear); bounds per-window host "
+                           "memory and device batch size"),
+        Option("osd_deep_scrub_syndrome", bool, False,
+               description="deep scrub also emits per-object GF "
+                           "syndrome CRC partials per shard; the "
+                           "primary XORs them across the acting set "
+                           "— nonzero means the code word is "
+                           "inconsistent even when every shard's own "
+                           "CRC matches (whole-stripe check beyond "
+                           "reference ECBackend.cc:2475 per-shard "
+                           "compare)"),
     ]
 
 

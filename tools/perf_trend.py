@@ -40,6 +40,16 @@ history and fails loudly on:
   Gated on the fresh run actually expecting / using the device: a
   CPU-only box reports ``expect_device`` false and zero
   ``device_reqs`` and must NOT trip on its overlap of 0.
+- **rebuild throughput floor** — the ``OSD rebuild MB/s`` ratio from
+  the rebuild config must hold >= ``ratio_tol`` x the best comparable
+  (k=8 m=4) history round; OSD-loss recovery is a first-class path
+  now that decode rides the batched device pipeline.
+- **decode routing collapse** — the encode collapse check applied to
+  the collect-time decode router: ``device_decode_fraction`` below
+  the floor while the run's calibration expected the device to win
+  means every recovery decode rode the CPU twin (the ``dec_route_*``
+  verdict counters name the reason).  Runs whose calibration did not
+  pin for the device (CPU-only box) self-skip.
 - **SLO regression** — the attribution's ``slo`` block (per-class
   error-budget burn merged across every OSD) must show ZERO
   client-class burn on a bench run (bench runs are fault-free), and
@@ -70,6 +80,8 @@ _ATTRIB_PREFIX = "cluster k8m4 write per-stage time attribution"
 _CLUSTER_PREFIX = "cluster write MB/s"
 _HEADLINE_PREFIX = "EC encode GiB/s at the codec boundary"
 _SCALING_PREFIX = "cluster write scaling"
+_REBUILD_PREFIX = "OSD rebuild MB/s"
+_REBUILD_ATTRIB_PREFIX = "rebuild decode attribution"
 _K8M4_MARK = "k=8 m=4"
 
 # defaults, overridable from the CLI
@@ -168,6 +180,7 @@ def check(attribution: Optional[Dict], history: List[Dict],
           fresh_ratio: Optional[float] = None,
           fresh_headline_ratio: Optional[float] = None,
           fresh_scaling: Optional[Dict] = None,
+          fresh_rebuild: Optional[Dict] = None,
           stage_tol: float = STAGE_TOL,
           ratio_tol: float = RATIO_TOL,
           min_device_fraction: float = MIN_DEVICE_FRACTION,
@@ -184,7 +197,9 @@ def check(attribution: Optional[Dict], history: List[Dict],
     the crimson client-ladder dict ({"1": MB/s, ...}) from the
     cluster_scaling config — compared at the 16-client rung against
     the best history round that recorded one (rounds predating the
-    ladder silently skip the check)."""
+    ladder silently skip the check); ``fresh_rebuild`` the rebuild
+    config's decode-side attribution object, feeding the rebuild
+    throughput floor and the decode routing-collapse check."""
     findings: List[Dict] = []
 
     # -- routing collapse (the r05 signature) -------------------------
@@ -434,6 +449,54 @@ def check(attribution: Optional[Dict], history: List[Dict],
                     f"< {scaling_tol:.2f} x best history "
                     f"{best16:.1f} MB/s (shard-per-core concurrency "
                     f"ladder)"})
+
+    # -- rebuild throughput floor + decode routing collapse -----------
+    # (ISSUE 11) ``fresh_rebuild`` is the rebuild config's
+    # decode-side attribution object.  The floor mirrors the
+    # write-ratio gate over the "OSD rebuild MB/s" history records
+    # (k=8 m=4 marked runs only — the line predates the device
+    # decode pipeline, so history exists to hold it to); the routing
+    # check is the r05 collapse signature applied to the
+    # collect-time decode router, gated on this run's own
+    # calibration expecting the device to win.
+    if fresh_rebuild is not None:
+        rb_ratio = fresh_rebuild.get("vs_baseline")
+        best = None
+        for rnd in history:
+            rec = _pick(rnd["records"], _REBUILD_PREFIX, _K8M4_MARK)
+            if rec and isinstance(rec.get("vs_baseline"),
+                                  (int, float)):
+                v = float(rec["vs_baseline"])
+                best = v if best is None else max(best, v)
+        if isinstance(rb_ratio, (int, float)) and best is not None \
+                and rb_ratio < ratio_tol * best:
+            findings.append({
+                "check": "rebuild-throughput-regression",
+                "severity": "fail",
+                "message":
+                    f"OSD rebuild at {rb_ratio:.3f}x baseline < "
+                    f"{ratio_tol:.2f} x best history {best:.3f}x "
+                    f"(k8m4 OSD-loss recovery floor)"})
+        frac = fresh_rebuild.get("device_decode_fraction")
+        if frac is None:
+            routing = fresh_rebuild.get("routing") or {}
+            dev = routing.get("device_reqs")
+            cpu = routing.get("cpu_twin_reqs")
+            if dev is not None and cpu is not None and dev + cpu > 0:
+                frac = dev / (dev + cpu)
+        if fresh_rebuild.get("expect_device") is True \
+                and frac is not None and frac < min_device_fraction:
+            findings.append({
+                "check": "dec-routing-collapse", "severity": "fail",
+                "message":
+                    f"device_decode_fraction {frac:.3f} < "
+                    f"{min_device_fraction:.2f} while calibration "
+                    f"pinned the crossover for the device — recovery "
+                    f"decode traffic is misrouted to the CPU twin "
+                    f"(dec_route_* verdicts: "
+                    f"{fresh_rebuild.get('dec_routes')}; check the "
+                    f"decode crossover seed and "
+                    f"ec_tpu_min_device_bytes pinning)"})
     return findings
 
 
@@ -446,6 +509,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
     cluster = _pick(fresh_records, _CLUSTER_PREFIX, _K8M4_MARK)
     headline = _pick(fresh_records, _HEADLINE_PREFIX)
     scaling = _pick(fresh_records, _SCALING_PREFIX)
+    rebuild = _pick(fresh_records, _REBUILD_ATTRIB_PREFIX)
     if att is None and cluster is None:
         print("perf_trend: fresh input carries neither an "
               "attribution object nor a k8m4 cluster metric",
@@ -461,6 +525,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
                                    (int, float)) else None,
         fresh_scaling=((scaling.get("crimson") or {}).get("clients")
                        if scaling else None),
+        fresh_rebuild=rebuild,
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
         hop_p99_factor=hop_p99_factor, overlap_tol=overlap_tol)
