@@ -68,6 +68,12 @@ class _DecodeHandle:
         return getattr(self._ab, "ledger", None)
 
     @property
+    def ledgers(self):
+        """Per-chip ledger clones on a mesh-sharded dispatch (one lane
+        per device), None on single-chip — AsyncBatch.ledgers."""
+        return getattr(self._ab, "ledgers", None)
+
+    @property
     def h2d_bytes(self):
         return getattr(self._ab, "h2d_bytes", 0)
 
@@ -108,21 +114,15 @@ class TpuCodecMixin:
         yields parity [B, m, L].  Submitting the next batch before
         waiting overlaps transfers with MXU compute — the OSD write
         pipeline's double-buffering entry point.  On a multi-device
-        host the batch is sharded (dp x sp) over the local mesh
-        (parallel/mesh.py ShardedEncoder) so the OSD batcher's
-        production dispatch rides every chip."""
+        host the backend lays the batch out with the sharded
+        (dp, None, sp) NamedSharding and dispatches ONE sharded GF
+        matmul over the mesh (jax_engine _staged_put + gf8_fn /
+        _mesh_apply_fn routing), riding the same staging rings,
+        h2d EWMA sampling, and per-device phase ledgers as the
+        single-chip path."""
         data = np.asarray(data, dtype=np.uint8)
         if data.ndim != 3 or data.shape[1] != self.k:
             raise ValueError(f"expected [batch, k={self.k}, L] input")
-        try:
-            from ...parallel.mesh import shared_encoder
-            enc = shared_encoder(self)
-            if enc is not None:
-                handle = enc.encode_async(data)
-                if handle is not None:
-                    return handle
-        except Exception:
-            pass                     # mesh trouble -> single-device path
         if self.core.gf8_encode_fast():
             return self.core.backend.apply_gf8_matrix_async(
                 self.core.coding_matrix, data)

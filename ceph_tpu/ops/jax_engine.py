@@ -620,12 +620,15 @@ class _StageSlot:
     bytes, so the array may be overwritten (correct even when the
     device input buffer was donated to the kernel)."""
 
-    __slots__ = ("host", "fence", "max_l")
+    __slots__ = ("host", "fence", "max_l", "max_b")
 
     def __init__(self, host: np.ndarray):
         self.host = host
         self.fence = None
         self.max_l = 0          # column high-water mark (pad hygiene)
+        self.max_b = 0          # row (stripe) high-water mark — mesh
+                                # dispatch needs dp-padding rows to be
+                                # zero-stripes, not stale stripes
 
 
 class StagingPool:
@@ -803,11 +806,25 @@ class AsyncBatch:
         # finalized by wait(); keyed by JAX device id so lanes are
         # mesh-ready for the multichip promotion
         self.ledger = ledger
+        # mesh dispatch: one ledger clone per chip the output is
+        # sharded over (same stamps — every chip shares the dispatch
+        # window — bytes split per chip), built by wait(); None until
+        # then, and None forever on single-device dispatch
+        self.ledgers = None
+        self._mesh_device_ids = None
         if ledger is not None and "device" not in ledger:
             try:
-                ledger["device"] = next(iter(dev_out.devices())).id
+                ids = sorted(d.id for d in dev_out.sharding.device_set)
             except Exception:
-                ledger["device"] = 0
+                ids = []
+            if len(ids) > 1:
+                self._mesh_device_ids = ids
+                ledger["device"] = ids[0]
+            else:
+                try:
+                    ledger["device"] = next(iter(dev_out.devices())).id
+                except Exception:
+                    ledger["device"] = 0
 
     def wait(self) -> np.ndarray:
         led = self.ledger
@@ -825,6 +842,12 @@ class AsyncBatch:
             out = out.reshape(self._lead + out.shape[-2:])
             led["deliver"] = time.time()
             led["bytes"] = out.nbytes
+            ids = self._mesh_device_ids
+            if ids:
+                n = len(ids)
+                self.ledgers = [dict(led, device=d,
+                                     bytes=led["bytes"] // n)
+                                for d in ids]
             return out
         out = np.asarray(self._dev)[:self._batch, :, :self._L]
         return out.reshape(self._lead + out.shape[-2:])
@@ -842,6 +865,63 @@ class JaxBackend:
         self._dev_matrices: dict = {}
         self._chain_lru = ChainLRU(256)
         self.staging = StagingPool()
+        # multichip mesh (ISSUE 12): lazily resolved from the conf
+        # knobs on first dispatch.  None on single-device hosts — the
+        # single-chip path stays byte-identical with zero overhead.
+        self._mesh_conf = (0, 0)      # (n_devices, sp); 0 = auto
+        self._mesh = None
+        self._mesh_checked = False
+        self._mesh_err: Optional[Exception] = None
+        self._mesh_sharding = None    # cached NamedSharding(dp, None, sp)
+        self.mesh_events: list = []   # mesh_build records for the
+                                      # flight recorder (batcher drains)
+
+    # -- multichip mesh ----------------------------------------------
+    def configure_mesh(self, n_devices: int = 0, sp: int = 0) -> None:
+        """Set the mesh conf knobs (``ec_tpu_mesh_devices`` /
+        ``ec_tpu_mesh_sp``; 0 = auto).  Resets the lazy resolution so
+        the next dispatch/prewarm re-probes."""
+        conf = (int(n_devices), int(sp))
+        if conf != self._mesh_conf:
+            self._mesh_conf = conf
+            self._mesh = None
+            self._mesh_checked = False
+            self._mesh_err = None
+            self._mesh_sharding = None
+
+    def _resolve_mesh(self, strict: bool = False):
+        """The production mesh, or None (single device / probe failed).
+        ``strict=True`` (prewarm) re-raises a bad explicit conf as a
+        clear ValueError instead of silently falling back — a
+        misconfigured mesh must fail at prewarm, not mid-dispatch."""
+        if not self._mesh_checked:
+            self._mesh_checked = True
+            from ..parallel import mesh as pmesh
+            try:
+                self._mesh = pmesh.resolve_mesh(*self._mesh_conf)
+            except Exception as e:
+                self._mesh = None
+                self._mesh_err = e
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                self._mesh_sharding = NamedSharding(
+                    self._mesh, PartitionSpec("dp", None, "sp"))
+                info = pmesh.mesh_info(self._mesh) or {}
+                self.mesh_events.append(
+                    dict(info, event="mesh_build", ts=time.time()))
+        if strict and self._mesh_err is not None:
+            raise ValueError(
+                f"mesh configuration invalid "
+                f"(ec_tpu_mesh_devices={self._mesh_conf[0]}, "
+                f"ec_tpu_mesh_sp={self._mesh_conf[1]}): "
+                f"{self._mesh_err}")
+        return self._mesh
+
+    def mesh_info(self) -> Optional[dict]:
+        """JSON-able dp/sp/device-id summary of the live mesh (admin
+        socket ``dump_device`` + bench mesh block), or None."""
+        from ..parallel import mesh as pmesh
+        return pmesh.mesh_info(self._resolve_mesh())
 
     def _device_matrix(self, B: np.ndarray) -> jnp.ndarray:
         key = (B.shape, B.tobytes())  # copycheck: ok - cache key over a tiny coding matrix (k*m bytes), not payload
@@ -850,6 +930,31 @@ class JaxBackend:
             hit = jnp.asarray(B, dtype=jnp.int8)
             self._dev_matrices[key] = hit
         return hit
+
+    def _device_matrix_mesh(self, B: np.ndarray, mesh) -> jnp.ndarray:
+        """Mesh-replicated bitmatrix (P(None, None)) so a sharded jit
+        never sees mixed device placements."""
+        key = ("mesh", tuple(int(v) for v in np.asarray(mesh.devices).shape),
+               B.shape, B.tobytes())  # copycheck: ok - cache key over a tiny coding matrix (k*m bytes), not payload
+        hit = self._dev_matrices.get(key)
+        if hit is None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            hit = jax.device_put(
+                np.asarray(B, dtype=np.int8),
+                NamedSharding(mesh, PartitionSpec(None, None)))
+            self._dev_matrices[key] = hit
+        return hit
+
+    def _mesh_apply_fn(self, mesh, w: int):
+        """Sharded generic-w bitmatrix apply, LRU-cached per (mesh
+        shape, w) — the mesh twin of the module-level
+        ``_apply_byte_domain`` jit."""
+        dp = int(mesh.shape["dp"])
+        sp = int(mesh.shape["sp"])
+        from ..parallel import mesh as pmesh
+        return self._chain_lru.get_or_build(
+            ("bmmesh", dp, sp, w),
+            lambda: pmesh.sharded_apply_fn(mesh, w))
 
     def memory_stats(self) -> dict:
         """Footprint snapshot for the memory-accounting gauges: host
@@ -888,21 +993,43 @@ class JaxBackend:
 
     def _staged_put(self, data: np.ndarray, quantum: int):
         """Pad [batch, k, L] into a persistent staging slot and start
-        its h2d.  Returns ``(dev, batch, L, done, sampled, ledger)``;
-        the caller MUST invoke ``done(fence)`` with the device value
-        computed from ``dev`` right after dispatch — the fence is what
-        lets the slot's host bytes be overwritten by a later batch.
-        Every Nth staging is fenced and timed to keep the pool's warm
-        h2d EWMA honest.  ``ledger`` carries the device-phase stamps
-        accrued so far (stage_acquire/h2d_*); AsyncBatch finalizes it."""
+        its h2d.  Returns ``(dev, batch, L, done, sampled, ledger,
+        mesh)``; the caller MUST invoke ``done(fence)`` with the device
+        value computed from ``dev`` right after dispatch — the fence is
+        what lets the slot's host bytes be overwritten by a later
+        batch.  Every Nth staging is fenced and timed to keep the
+        pool's warm h2d EWMA honest.  ``ledger`` carries the
+        device-phase stamps accrued so far (stage_acquire/h2d_*);
+        AsyncBatch finalizes it.  ``mesh`` is the live Mesh when the
+        batch was placed with the sharded (dp, None, sp) layout — the
+        caller must then dispatch the matching sharded kernel — or
+        None for the single-chip layout (single-device host, or a
+        padded length the sp axis cannot shard cleanly)."""
         batch, k, L = data.shape
         if not self.bucket_shapes:
             ledger = {"stage_acquire": time.time()}
             ledger["h2d_start"] = ledger["stage_acquire"]
             dev = jax.device_put(data)
             ledger["h2d_done"] = time.time()
-            return dev, batch, L, None, None, ledger
-        shape = (_bucket_batch(batch), k, _round_up(L, quantum))
+            return dev, batch, L, None, None, ledger, None
+        mesh = self._resolve_mesh()
+        Lp = _round_up(L, quantum)
+        bb = _bucket_batch(batch)
+        if mesh is not None:
+            # the sp axis shards the chunk-width dim: every shard must
+            # be a whole number of w-bit words or the word repack
+            # breaks.  Non-dividing geometry (auto sp) falls back to
+            # the single-chip layout; an EXPLICIT bad sp was already
+            # rejected at prewarm (strict resolve).
+            wbytes = max(1, quantum // LENGTH_QUANTUM)
+            if Lp % (int(mesh.shape["sp"]) * wbytes):
+                mesh = None
+            else:
+                # dp shards the stripe-batch axis: round the bucket up
+                # so every group shards cleanly (padding rows are
+                # zero-stripes, stripped on deliver)
+                bb = _round_up(bb, int(mesh.shape["dp"]))
+        shape = (bb, k, Lp)
         slot = self.staging.acquire(shape)
         # ledger origin: the slot is ours (ring fence retired).  The
         # interval ending at h2d_start is the host fill; h2d_done is
@@ -917,11 +1044,20 @@ class JaxBackend:
                 # pad region must stay zero (GF-linear => zeros are inert)
                 host[:, :, L:slot.max_l] = 0
             slot.max_l = max(slot.max_l, L)
+            if mesh is not None and slot.max_b > batch:
+                # mesh dp-padding contract: rows past the live batch
+                # are zero-stripes (stale stripes from a fuller
+                # previous batch would still be trimmed on deliver,
+                # but the sharded layout promises zero padding rows)
+                host[batch:slot.max_b, :, :] = 0  # copycheck: ok - zeroing dp-padding rows of the REUSED staging buffer, not a payload copy
+            slot.max_b = max(slot.max_b, batch)
             sample = None
             ledger["h2d_start"] = time.time()
+            sharding = self._mesh_sharding if mesh is not None else None
             if self.staging.should_sample():
                 t0 = time.monotonic()
-                dev = jax.device_put(host)
+                dev = jax.device_put(host, sharding) \
+                    if sharding is not None else jax.device_put(host)
                 try:
                     dev.block_until_ready()
                     dt = time.monotonic() - t0
@@ -930,7 +1066,8 @@ class JaxBackend:
                 except Exception:
                     pass
             else:
-                dev = jax.device_put(host)
+                dev = jax.device_put(host, sharding) \
+                    if sharding is not None else jax.device_put(host)
             ledger["h2d_done"] = time.time()
         except BaseException:
             # staging/h2d failed before a fence existed: return the
@@ -941,7 +1078,7 @@ class JaxBackend:
 
         def done(fence, _shape=shape, _slot=slot):
             self.staging.release(_shape, _slot, fence)
-        return dev, batch, L, done, sample, ledger
+        return dev, batch, L, done, sample, ledger, mesh
 
     def prewarm_geometry(self, k: int, chunk_size: int,
                          batches=(1,), w: int = 8) -> None:
@@ -949,13 +1086,36 @@ class JaxBackend:
         will dispatch, so the first client write after PG activation
         reuses warm buffers instead of paying fresh allocation.
         Idempotent and cheap (host-side only); executable compilation
-        is driven by the codec layer, which calls this first."""
+        is driven by the codec layer, which calls this first.
+
+        This is also where mesh misconfiguration surfaces: a bad
+        explicit ``ec_tpu_mesh_sp`` (doesn't divide the device count,
+        or can't shard this geometry's padded chunk length) raises a
+        clear ValueError HERE, not mid-dispatch."""
         if not self.bucket_shapes:
             return
-        quantum = LENGTH_QUANTUM * max(1, w // 8)
+        wbytes = max(1, w // 8)
+        quantum = LENGTH_QUANTUM * wbytes
+        Lp = _round_up(chunk_size, quantum)
+        mesh = self._resolve_mesh(strict=True)
+        dp = 1
+        if mesh is not None:
+            sp = int(mesh.shape["sp"])
+            if Lp % (sp * wbytes):
+                if self._mesh_conf[1]:
+                    raise ValueError(
+                        f"ec_tpu_mesh_sp={sp} cannot shard the padded "
+                        f"chunk length {Lp} (w={w}: every sp shard "
+                        f"must hold a whole number of {wbytes}-byte "
+                        f"words) — pick an sp dividing "
+                        f"{Lp // wbytes}")
+                mesh = None      # auto sp that can't shard this
+                                 # geometry: single-chip rings serve
+            else:
+                dp = int(mesh.shape["dp"])
         for nb in batches:
-            self.staging.ensure((_bucket_batch(max(1, int(nb))), k,
-                                 _round_up(chunk_size, quantum)))
+            self.staging.ensure(
+                (_round_up(_bucket_batch(max(1, int(nb))), dp), k, Lp))
 
     def gf8_fast_path(self) -> bool:
         """The XOR-chain compiles once per coding matrix (static
@@ -993,7 +1153,8 @@ class JaxBackend:
         """Device-resident byte-domain apply (codec-kernel boundary)."""
         return self.gf8_fn(M)(dev_data)
 
-    def gf8_fn(self, rows: np.ndarray, donate: bool = False):
+    def gf8_fn(self, rows: np.ndarray, donate: bool = False,
+               mesh=None):
         """Best compiled kernel for an arbitrary GF(2^8) row set over
         [.., C, L] byte chunks, LRU-cached per row set — per-pool
         coding matrices AND per-erasure-signature decode rows (the
@@ -1001,10 +1162,21 @@ class JaxBackend:
         in gf8_inner (shared with the mesh path).  ``donate=True``
         hands the staged device input to XLA for output aliasing —
         legal only when output bytes == input bytes (square row set,
-        m == k), so it is silently ignored otherwise."""
+        m == k), so it is silently ignored otherwise.  ``mesh`` (from
+        _staged_put) selects the sharded shard_map wrapper around the
+        SAME gf8_inner kernel — one dispatch = one sharded GF matmul,
+        bit-exact vs single-chip."""
         rows = np.asarray(rows, dtype=np.int64)
         donate = donate and rows.shape[0] == rows.shape[1]
         coeffs = tuple(tuple(int(v) for v in row) for row in rows)
+        if mesh is not None:
+            from ..parallel import mesh as pmesh
+            dp = int(mesh.shape["dp"])
+            sp = int(mesh.shape["sp"])
+            return self._chain_lru.get_or_build(
+                ("gf8mesh", dp, sp, donate, coeffs),
+                lambda: pmesh.sharded_rows_fn(mesh, rows,
+                                              donate=donate))
         if donate:
             return self._chain_lru.get_or_build(
                 ("gf8don", coeffs),
@@ -1079,10 +1251,10 @@ class JaxBackend:
             data = data[None]
         lead = data.shape[:-2] if not squeeze else ()
         data = data.reshape((-1,) + data.shape[-2:])
-        dev, batch, L, done, sample, ledger = self._staged_put(
+        dev, batch, L, done, sample, ledger, mesh = self._staged_put(
             data, LENGTH_QUANTUM)
         try:
-            out = self.gf8_fn(M, donate=done is not None)(dev)
+            out = self.gf8_fn(M, donate=done is not None, mesh=mesh)(dev)
             ledger["compute_start"] = time.time()
             out.copy_to_host_async()
         except BaseException:
@@ -1117,10 +1289,11 @@ class JaxBackend:
             data = data[None]
         lead = data.shape[:-2] if not squeeze else ()
         data = data.reshape((-1,) + data.shape[-2:])
-        dev, batch, L, done, sample, ledger = self._staged_put(
+        dev, batch, L, done, sample, ledger, mesh = self._staged_put(
             data, LENGTH_QUANTUM)
         try:
-            out = self.gf8_fn(rows, donate=done is not None)(dev)
+            out = self.gf8_fn(rows, donate=done is not None,
+                              mesh=mesh)(dev)
             ledger["compute_start"] = time.time()
             out.copy_to_host_async()
         except BaseException:
@@ -1169,10 +1342,14 @@ class JaxBackend:
         if data.shape[-1] % wbytes:
             raise ValueError(
                 f"chunk length must be a multiple of {wbytes} for w={w}")
-        dev, batch, L, done, sample, ledger = self._staged_put(
+        dev, batch, L, done, sample, ledger, mesh = self._staged_put(
             data, LENGTH_QUANTUM * wbytes)
         try:
-            out = _apply_byte_domain(self._device_matrix(B), dev, w)
+            if mesh is not None:
+                out = self._mesh_apply_fn(mesh, w)(
+                    self._device_matrix_mesh(B, mesh), dev)
+            else:
+                out = _apply_byte_domain(self._device_matrix(B), dev, w)
             ledger["compute_start"] = time.time()
             out.copy_to_host_async()
         except BaseException:

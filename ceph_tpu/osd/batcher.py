@@ -165,6 +165,14 @@ class EncodeBatcher:
                                              # throughput EWMA per
                                              # geometry (compile/outlier
                                              # rejection in the learner)
+    # per-mesh-shape learner state (ISSUE 12): the crossover and link
+    # EWMA model the AGGREGATE device+ICI bandwidth, so a dp x sp mesh
+    # and a single chip must not share one estimate.  _mesh_key is the
+    # (dp, sp) shape the CURRENT class-level scalars belong to (None =
+    # single chip); _mesh_state stashes the scalars of every other
+    # shape seen, swapped by _rekey_mesh when the live mesh changes.
+    _mesh_key: Optional[Tuple] = None
+    _mesh_state: Dict[Optional[Tuple], dict] = {}
     # shared idle clocks, seeded by the FIRST batcher construction
     # (None until then): seeding at import would treat process
     # lifetime as device idleness, while re-seeding on every
@@ -248,6 +256,14 @@ class EncodeBatcher:
         # through a bounded FIFO (depth = groups genuinely in flight
         # on the device; the blocking put is the throttle)
         self.inflight_groups = max(1, get("ec_tpu_inflight_groups", 2))
+        # multichip mesh shape (ISSUE 12): 0 = auto (use every visible
+        # JAX device, dp x sp factored by the backend); >1 forces the
+        # device count, ec_tpu_mesh_sp forces the chunk-width axis.
+        # The batcher only FORWARDS the shape — the backend owns mesh
+        # construction and the sharded dispatch path.
+        self.mesh_devices = get("ec_tpu_mesh_devices", 0)
+        self.mesh_sp = get("ec_tpu_mesh_sp", 0)
+        self._mesh_noted = False     # mesh_build drained to recorder
         # seed the shared idle clocks ONCE (first batcher built, not
         # at import and not per construction — see the class attrs)
         if EncodeBatcher._last_device_ts is None:
@@ -448,6 +464,20 @@ class EncodeBatcher:
                        description="device phases (h2d / compute "
                                    "fence) that exceeded "
                                    "ec_tpu_device_phase_stall_ms")
+            if "mesh_dp" not in dp._types:
+                # multichip mesh shape gauges (ISSUE 12), own guard:
+                # dperf instances created by older sessions predate
+                # these
+                from ..utils.perf import TYPE_U64
+                for g, desc in (
+                        ("mesh_dp", "stripe-batch (dp) axis of the "
+                                    "active device mesh (0 = single "
+                                    "chip)"),
+                        ("mesh_sp", "chunk-width (sp) axis of the "
+                                    "active device mesh"),
+                        ("mesh_devices", "devices in the active "
+                                         "encode/decode mesh")):
+                    dp.add(g, TYPE_U64, desc)
             self.dperf = dp
         # device-phase ledger accumulator (utils/device_ledger):
         # per-group stage_acquire..deliver stamps harvested from each
@@ -609,6 +639,17 @@ class EncodeBatcher:
         if not self.prewarm_enabled or \
                 not hasattr(ec_impl, "encode_batch_async"):
             return
+        # configure the backend's device mesh BEFORE any learner
+        # seeding: the h2d EWMA and crossover thresholds are keyed per
+        # mesh shape (_rekey_mesh), so the seed measurements below
+        # must accrue to the shape real dispatches will ride.  An
+        # explicit ec_tpu_mesh_sp that cannot shard raises HERE (via
+        # the backend's strict prewarm_geometry), not mid-dispatch.
+        backend = getattr(getattr(ec_impl, "core", None),
+                          "backend", None)
+        if backend is not None and hasattr(backend, "configure_mesh"):
+            backend.configure_mesh(self.mesh_devices, self.mesh_sp)
+            self._note_mesh(backend)
         key = _geometry_key(ec_impl, sinfo)
         with self._cond:
             if key in EncodeBatcher._warmed:
@@ -1072,6 +1113,8 @@ class EncodeBatcher:
         cls._dev_bps = {}
         cls._warmed = set()
         cls._h2d_bps = 0.0
+        cls._mesh_state = {}
+        cls._mesh_key = None
         cls._last_device_ts = time.monotonic()
         cls._last_idle_probe_ts = time.monotonic()
         cls.reset_breaker()
@@ -1086,6 +1129,62 @@ class EncodeBatcher:
             cls._breaker_open = False
             cls._breaker_opens = 0
             cls._breaker_closes = 0
+
+    @classmethod
+    def _rekey_mesh(cls, key: Optional[Tuple]) -> None:
+        """Swap the shared routing/link learner scalars to the state
+        belonging to mesh shape ``key`` ((dp, sp), or None for single
+        chip).  The h2d EWMA and the crossover thresholds model the
+        AGGREGATE device+ICI bandwidth of the active mesh — carrying a
+        single-chip estimate into a 4x2 mesh (or back) misroutes every
+        batch until the learner recovers.  The outgoing shape's state
+        is stashed, so flipping back restores what was learned."""
+        if key == cls._mesh_key:
+            return
+        cls._mesh_state[cls._mesh_key] = {
+            "h2d_bps": cls._h2d_bps,
+            "min_device_bytes": cls._min_device_bytes,
+            "pinned_min_device_bytes": cls._pinned_min_device_bytes,
+            "dec_min_device_bytes": cls._dec_min_device_bytes,
+            "dev_bps": dict(cls._dev_bps),
+        }
+        st = cls._mesh_state.get(key)
+        if st is not None:
+            cls._h2d_bps = st["h2d_bps"]
+            cls._min_device_bytes = st["min_device_bytes"]
+            cls._pinned_min_device_bytes = st["pinned_min_device_bytes"]
+            cls._dec_min_device_bytes = st["dec_min_device_bytes"]
+            cls._dev_bps = dict(st["dev_bps"])
+        # first time on this shape: keep the current scalars as the
+        # seed (a mesh is at worst as fast as one of its chips)
+        cls._mesh_key = key
+
+    def _note_mesh(self, backend) -> None:
+        """Fold the backend's active mesh into the batcher's
+        telemetry: rekey the learner state to the mesh shape, set the
+        mesh_* gauges, and (once) drain the backend's mesh_build
+        events into the flight recorder so a misconfigured mesh is
+        diagnosable from the admin socket."""
+        info = None
+        try:
+            info = backend.mesh_info()
+        except Exception:
+            pass
+        key = (info["dp"], info["sp"]) if info else None
+        EncodeBatcher._rekey_mesh(key)
+        dp = self.dperf
+        if dp is not None and "mesh_dp" in dp._types:
+            dp.set("mesh_dp", info["dp"] if info else 0)
+            dp.set("mesh_sp", info["sp"] if info else 0)
+            dp.set("mesh_devices", info["n_devices"] if info else 0)
+        rec = self.recorder
+        if rec is not None and not self._mesh_noted:
+            self._mesh_noted = True
+            for ev in list(getattr(backend, "mesh_events", ()) or ()):
+                rec.note("mesh_build",
+                         dp=ev.get("dp"), sp=ev.get("sp"),
+                         n_devices=ev.get("n_devices"),
+                         device_ids=ev.get("device_ids"))
 
     def _cpu_rate(self, key: Tuple, req: _Req) -> float:
         """CPU twin throughput for this geometry, measured once on
@@ -1561,10 +1660,14 @@ class EncodeBatcher:
             if len(reqs) > 1:
                 self.bperf.inc("coalesced_reqs", len(reqs))
         for h in handles:
-            led = getattr(h, "ledger", None)
-            if led is not None:
-                led["group"] = "decode"
-            self._observe_device_ledger(led)
+            # a mesh dispatch carries one ledger clone per chip
+            # (AsyncBatch.ledgers); single-chip keeps the scalar
+            leds = getattr(h, "ledgers", None) or \
+                [getattr(h, "ledger", None)]
+            for led in leds:
+                if led is not None:
+                    led["group"] = "decode"
+                self._observe_device_ledger(led)
         self._publish_device_telemetry(reqs[0].ec_impl)
         off = 0
         for r in reqs:
@@ -1781,10 +1884,12 @@ class EncodeBatcher:
         """Issue one async device call for every request of one
         geometry; returns (arrs, async_handle) or None on dispatch
         failure (completion falls back to per-request CPU encode).
-        On a multi-device host the codec's encode_batch_async itself
-        shards (dp x sp) over the mesh (parallel/mesh.py
-        ShardedEncoder via the tpu plugin) so this production path
-        rides every local chip, not just chip 0."""
+        On a multi-device host the backend's staged dispatch itself
+        lays each group out with a NamedSharding(dp, None, sp) over
+        the device mesh (jax_engine._staged_put + parallel/mesh.py
+        kernels), so this production path rides every local chip —
+        one dispatch is still ONE sharded GF matmul, and the ledger
+        fans out per chip (AsyncBatch.ledgers)."""
         t_form = time.monotonic()
         self._account_queue_wait(reqs, t_form)
         try:
@@ -1854,6 +1959,10 @@ class EncodeBatcher:
             # remembered so dump_device can report memory accounting
             # even on a daemon with no perf plumbing (unit stubs)
             self._last_backend = backend
+        if backend is not None and hasattr(backend, "mesh_info"):
+            # keep the mesh gauges / learner keying current even when
+            # prewarm was skipped (ec_tpu_prewarm=false paths)
+            self._note_mesh(backend)
         if dp is None and rec is None:
             return
         pool = getattr(backend, "staging", None)
@@ -1948,10 +2057,17 @@ class EncodeBatcher:
                 mem = backend.memory_stats()
             except Exception:
                 mem = None
+        mesh = None
+        if backend is not None and hasattr(backend, "mesh_info"):
+            try:
+                mesh = backend.mesh_info()
+            except Exception:
+                mesh = None
         return {
             "ledger": dump,
             "overlap": dump.get("overlap"),
             "memory": mem,
+            "mesh": mesh,
             "stage_seconds": dict(self.stage_seconds),
             "breaker_open": bool(EncodeBatcher._breaker_open),
         }
@@ -2065,9 +2181,13 @@ class EncodeBatcher:
                     self.bperf.inc("coalesced_reqs", len(reqs))
             # harvest each tile's device-phase ledger (finalized by
             # AsyncBatch.wait above): feeds the phase accumulator,
-            # the overlap engine, and the stall flight recorder
+            # the overlap engine, and the stall flight recorder.  A
+            # mesh dispatch finalizes one clone per chip (.ledgers),
+            # so every device gets its own waterfall/trace lane.
             for t in async_tiles:
-                self._observe_device_ledger(getattr(t, "ledger", None))
+                for led in (getattr(t, "ledgers", None) or
+                            [getattr(t, "ledger", None)]):
+                    self._observe_device_ledger(led)
             self._publish_device_telemetry(reqs[0].ec_impl)
         off = 0
         for r, arr in zip(reqs, arrs):

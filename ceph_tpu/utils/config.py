@@ -362,6 +362,21 @@ def _opts() -> List[Option]:
                            "completion worker joins window N, so h2d "
                            "staging overlaps fanout (bounded FIFO; "
                            "continuations stay in submission order)"),
+        Option("ec_tpu_mesh_devices", int, 0, min=0,
+               description="devices in the encode/decode dispatch "
+                           "mesh: 0 = auto (every visible JAX device "
+                           "when >1, single-chip otherwise), 1 forces "
+                           "single-chip, >1 forces that many chips "
+                           "(clamped to what is visible).  Groups are "
+                           "laid out dp x sp (stripe-batch x "
+                           "chunk-width) with one sharded GF matmul "
+                           "per dispatch"),
+        Option("ec_tpu_mesh_sp", int, 0, min=0,
+               description="chunk-width (sp) axis of the dispatch "
+                           "mesh: 0 = auto-factor; an explicit value "
+                           "that cannot shard a geometry's padded "
+                           "chunk raises at prewarm time rather than "
+                           "mid-dispatch"),
         Option("osd_ec_subwrite_timeout_ms", float, 0.0, min=0.0,
                description="primary re-requests an EC sub-write from "
                            "a laggard shard after this deadline "

@@ -352,12 +352,20 @@ def merge_dumps(dumps: List[dict]) -> dict:
     return out
 
 
-def device_waterfall_block(dump: dict, wall_s: float) -> dict:
+def device_waterfall_block(dump: dict, wall_s: float,
+                           mesh: Optional[dict] = None,
+                           recent: Optional[List[dict]] = None) -> dict:
     """Shape a device-ledger dump into bench.py's attribution
     ``device_waterfall`` block: phase shares of batcher device time
     (sum to 1.0), those shares scaled onto the measured device wall,
     per-phase p50/p99, the named top phase, and the overlap engine's
-    verdict — mirroring hops.waterfall_block."""
+    verdict — mirroring hops.waterfall_block.
+
+    ``mesh`` (a backend ``mesh_info()`` dict — dp, sp, n_devices,
+    device_ids) folds a ``mesh`` sub-block in, with per-device group
+    counts censused from ``recent`` raw ledgers when supplied, so one
+    block answers both "what shape ran" and "did every chip pull its
+    weight"."""
     phase_seconds = dump.get("phase_seconds", {})
     total = sum(phase_seconds.values())
     shares = {k: (v / total if total > 0 else 0.0)
@@ -365,6 +373,20 @@ def device_waterfall_block(dump: dict, wall_s: float) -> dict:
     scaled = {k: wall_s * s for k, s in shares.items()}
     top = max(shares.items(), key=lambda kv: kv[1])[0] if shares else None
     overlap = dump.get("overlap") or {}
+    mesh_block = None
+    if mesh:
+        counts: Dict[int, int] = {}
+        for led in (recent or ()):
+            dev = int(led.get("device", -1))
+            if dev >= 0:
+                counts[dev] = counts.get(dev, 0) + 1
+        mesh_block = {
+            "dp": mesh.get("dp"),
+            "sp": mesh.get("sp"),
+            "n_devices": mesh.get("n_devices"),
+            "device_groups": {str(d): counts[d]
+                              for d in sorted(counts)},
+        }
     return {
         "groups": dump.get("groups", 0),
         "wall_s": wall_s,
@@ -383,4 +405,5 @@ def device_waterfall_block(dump: dict, wall_s: float) -> dict:
         "bounding_phase": overlap.get("bounding_phase"),
         "bubble_s": overlap.get("bubble_s", {}),
         "devices": overlap.get("devices", []),
+        "mesh": mesh_block,
     }

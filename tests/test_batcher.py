@@ -198,21 +198,31 @@ def test_adaptive_crossover_routes_small_batches_to_cpu(codec):
 
 
 def test_dispatch_rides_mesh_on_multidevice_host(codec):
-    """VERDICT r2 Missing #5: on a multi-device host (the conftest's
+    """ISSUE 12 tentpole: on a multi-device host (the conftest's
     8-device virtual CPU mesh) the batcher's production dispatch must
-    shard over the mesh (parallel/mesh.py ShardedEncoder), bit-exact
-    with the synchronous path — including batches that need dp
-    padding."""
+    shard over the mesh INSIDE the backend (jax_engine _staged_put
+    lays the staging slot out with the (dp, None, sp) NamedSharding),
+    bit-exact with the synchronous path — including batches that need
+    dp padding."""
     import jax
 
-    from ceph_tpu.parallel.mesh import _ShardedAsync, shared_encoder
     assert len(jax.devices()) > 1
-    enc = shared_encoder(codec)
-    assert enc is not None, "w=8 byte-domain codec must get a mesh encoder"
+    backend = codec.core.backend
+    info = backend.mesh_info()
+    assert info is not None, "multi-device host must resolve a mesh"
+    assert info["dp"] * info["sp"] == info["n_devices"] == 8
     # the codec's async entry (the batcher's dispatch seam) returns a
-    # mesh-sharded handle, proving the production path rides the mesh
+    # handle whose device output spans every mesh chip — the
+    # production path rides the sharded layout, one dispatch = one
+    # sharded GF matmul — and wait() fans the phase ledger out into
+    # one lane per chip
     probe = np.zeros((5, 2, 256), dtype=np.uint8)
-    assert isinstance(codec.encode_batch_async(probe), _ShardedAsync)
+    ab = codec.encode_batch_async(probe)
+    devs = sorted(d.id for d in ab._dev.sharding.device_set)
+    assert devs == info["device_ids"]
+    ab.wait()
+    assert ab.ledgers is not None and len(ab.ledgers) == 8
+    assert sorted(led["device"] for led in ab.ledgers) == devs
     bat = make_batcher()
     sinfo = ecutil.StripeInfo(2, 2 * 256)
     rng = np.random.default_rng(3)

@@ -373,20 +373,29 @@ def test_staging_slot_released_on_failed_dispatch():
         reed_sol_vandermonde_coding_matrix(3, 2, 8), 8)
     data = np.zeros((2, 3, 1024), dtype=np.uint8)
     ref = np.asarray(be.apply_bitmatrix_bytes_async(B, data, 8).wait())
-    shape = (jax_engine._bucket_batch(2), 3,
+    # the staged batch bucket is rounded up to a dp multiple when the
+    # dispatch rides the device mesh
+    info = be.mesh_info()
+    dp = info["dp"] if info else 1
+    shape = (jax_engine._round_up(jax_engine._bucket_batch(2), dp), 3,
              jax_engine._round_up(1024, jax_engine.LENGTH_QUANTUM))
 
     def boom(*a, **k):
         raise RuntimeError("injected kernel fault")
 
+    # inject into both kernel seams so the fault fires whichever path
+    # (sharded mesh or single-chip) the dispatch takes
     real = jax_engine._apply_byte_domain
+    real_mesh = jax_engine.JaxBackend._mesh_apply_fn
     jax_engine._apply_byte_domain = boom
+    jax_engine.JaxBackend._mesh_apply_fn = lambda self, mesh, w: boom
     try:
         for _ in range(2 * pool.depth):   # more failures than slots
             with pytest.raises(RuntimeError):
                 be.apply_bitmatrix_bytes_async(B, data.copy(), 8)
     finally:
         jax_engine._apply_byte_domain = real
+        jax_engine.JaxBackend._mesh_apply_fn = real_mesh
     # every slot came back unfenced: the ring is fully free and no
     # stall-recovery alloc was needed
     assert len(pool._free[shape]) == pool._made[shape]

@@ -59,6 +59,11 @@ HOT_MODULES = [
     # crosses the MPSC batcher front — both must stay copy-free
     "ceph_tpu/crimson/reactor.py",
     "ceph_tpu/crimson/osd.py",
+    # the multichip dispatch layer (ISSUE 12): the sharded device_put
+    # layout must add ZERO host-side payload copies beyond the staging
+    # fill — shard_map/NamedSharding slice views, they must never
+    # materialise per-chip copies on the host
+    "ceph_tpu/parallel/mesh.py",
 ]
 
 # constructs that materialise a full payload copy

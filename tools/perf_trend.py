@@ -56,6 +56,13 @@ history and fails loudly on:
   no recovery/scrub-class *errors* where the most recent
   SLO-carrying history round had none.  Rounds predating the SLO
   engine silently skip.
+- **multichip mesh floor** — the ``multichip mesh attribution``
+  record from the multichip config: the batcher-routed sharded
+  encode must beat its device-count floor vs single-chip (>=0.9x on
+  1 device, >=1.5x on >=4), hold ``ratio_tol`` x the best history
+  round's sharded GiB/s, and show one per-device ledger lane per
+  mesh chip.  History rounds without a mesh block (pre-mesh rounds)
+  are silently skipped.
 
 History files are ``{"n", "cmd", "rc", "tail", "parsed"}`` wrappers
 around a captured bench stdout; metric records are re-extracted from
@@ -82,6 +89,7 @@ _HEADLINE_PREFIX = "EC encode GiB/s at the codec boundary"
 _SCALING_PREFIX = "cluster write scaling"
 _REBUILD_PREFIX = "OSD rebuild MB/s"
 _REBUILD_ATTRIB_PREFIX = "rebuild decode attribution"
+_MESH_ATTRIB_PREFIX = "multichip mesh attribution"
 _K8M4_MARK = "k=8 m=4"
 
 # defaults, overridable from the CLI
@@ -181,6 +189,7 @@ def check(attribution: Optional[Dict], history: List[Dict],
           fresh_headline_ratio: Optional[float] = None,
           fresh_scaling: Optional[Dict] = None,
           fresh_rebuild: Optional[Dict] = None,
+          fresh_mesh: Optional[Dict] = None,
           stage_tol: float = STAGE_TOL,
           ratio_tol: float = RATIO_TOL,
           min_device_fraction: float = MIN_DEVICE_FRACTION,
@@ -497,6 +506,54 @@ def check(attribution: Optional[Dict], history: List[Dict],
                     f"{fresh_rebuild.get('dec_routes')}; check the "
                     f"decode crossover seed and "
                     f"ec_tpu_min_device_bytes pinning)"})
+
+    # -- multichip mesh throughput floor ------------------------------
+    # (ISSUE 12) ``fresh_mesh`` is the multichip config's attribution
+    # record: the batcher-routed sharded-vs-single-chip speedup and
+    # its device-count-dependent floor (>=0.9x on 1 device where the
+    # mesh must be pure fallback, >=1.5x on >=4 where ICI must pay).
+    # History rounds are compared on the sharded throughput itself;
+    # rounds without a mesh block (pre-PR-12) are silently skipped.
+    if fresh_mesh is not None:
+        sp = fresh_mesh.get("speedup")
+        fl = fresh_mesh.get("floor")
+        if isinstance(sp, (int, float)) and \
+                isinstance(fl, (int, float)) and sp < fl:
+            nd = fresh_mesh.get("n_devices")
+            findings.append({
+                "check": "mesh-floor", "severity": "fail",
+                "message":
+                    f"sharded/single-chip speedup {sp:.3f}x < floor "
+                    f"{fl:.2f}x on {nd} device(s) — the mesh "
+                    f"dispatch path costs more than it pays"})
+        gbps = fresh_mesh.get("sharded_gbps")
+        best = None
+        for rnd in history:
+            rec = _pick(rnd["records"], _MESH_ATTRIB_PREFIX)
+            if rec and rec.get("mesh") and \
+                    isinstance(rec.get("sharded_gbps"), (int, float)):
+                v = float(rec["sharded_gbps"])
+                best = v if best is None else max(best, v)
+        if isinstance(gbps, (int, float)) and best is not None \
+                and gbps < ratio_tol * best:
+            findings.append({
+                "check": "mesh-throughput-regression",
+                "severity": "fail",
+                "message":
+                    f"batcher-routed mesh encode at {gbps:.3f} GiB/s "
+                    f"< {ratio_tol:.2f} x best history {best:.3f} "
+                    f"GiB/s"})
+        nd = fresh_mesh.get("n_devices")
+        lanes = fresh_mesh.get("device_lanes")
+        if isinstance(nd, int) and nd > 1 and \
+                isinstance(lanes, int) and lanes < nd:
+            findings.append({
+                "check": "mesh-lane-collapse", "severity": "fail",
+                "message":
+                    f"only {lanes} per-device ledger lane(s) for a "
+                    f"{nd}-device mesh — some chips produced no "
+                    f"waterfall evidence (sharding or ledger fanout "
+                    f"broke)"})
     return findings
 
 
@@ -510,6 +567,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
     headline = _pick(fresh_records, _HEADLINE_PREFIX)
     scaling = _pick(fresh_records, _SCALING_PREFIX)
     rebuild = _pick(fresh_records, _REBUILD_ATTRIB_PREFIX)
+    mesh = _pick(fresh_records, _MESH_ATTRIB_PREFIX)
     if att is None and cluster is None:
         print("perf_trend: fresh input carries neither an "
               "attribution object nor a k8m4 cluster metric",
@@ -525,7 +583,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
                                    (int, float)) else None,
         fresh_scaling=((scaling.get("crimson") or {}).get("clients")
                        if scaling else None),
-        fresh_rebuild=rebuild,
+        fresh_rebuild=rebuild, fresh_mesh=mesh,
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
         hop_p99_factor=hop_p99_factor, overlap_tol=overlap_tol)
