@@ -1091,6 +1091,8 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
 _FLOOR_STATS = {"cluster_k8m4_vs_baseline": None,
                 "cluster_k8m4_attribution": None,
                 "cluster_scaling_clients": None,
+                "cluster_scaling_ladder": None,
+                "load_attribution": None,
                 "rebuild_attribution": None,
                 "multichip_mesh": None}
 
@@ -1439,7 +1441,11 @@ def bench_cluster_scaling(obj_bytes=512 << 10, per_client=2):
         "crimson": sides["crimson"],
     }), flush=True)
     # --assert-floor hands this ladder to the perf_trend scaling gate
+    # (crimson 16-client floor) and to the every-rung crimson>=classic
+    # ladder assert (ISSUE 13)
     _FLOOR_STATS["cluster_scaling_clients"] = cr
+    _FLOOR_STATS["cluster_scaling_ladder"] = {"classic": cl,
+                                              "crimson": cr}
 
 
 def bench_cluster(n_objs=8, obj_bytes=4 << 20):
@@ -1541,6 +1547,309 @@ def bench_chaos_soak(n_objs=26, obj_bytes=8 << 20):
         "fault_free_breaker": st_ff.get("breaker", {}),
         "slo": {"fault_free": slo_ff, "chaos": slo_ch},
     }), flush=True)
+
+
+def _pctl(sorted_vals, q):
+    """Percentile over a pre-sorted sample list (nearest-rank)."""
+    if not sorted_vals:
+        return 0.0
+    idx = min(len(sorted_vals) - 1,
+              int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def bench_load(n_clients=200, n_gateways=2, ops_per_client=6,
+               hot_keys=48, obj_bytes=16 << 10):
+    """Open-loop many-client load harness (ISSUE 13): hundreds of
+    concurrent S3 clients through MULTIPLE RGW gateways over one
+    crimson cluster — mixed GET/PUT/DELETE plus multipart, Zipf
+    hot-key skew on the read set, and Poisson arrivals scheduled
+    against ABSOLUTE deadlines (``t0 + cumulative exponential gaps``,
+    never ``sleep(gap)`` from "now") so a slow response cannot thin
+    the offered load behind it and queueing delay stays honest.
+
+    Mid-run one OSD is killed with data loss and revived, so recovery
+    churns through the mClock scheduler UNDER client contention; the
+    acceptance asserts, from exported counters alone: zero
+    client-visible errors across every HTTP op, per-class client p99
+    within its SLO target, recovery-class burn NONZERO (the QoS
+    demotion made recovery late against its tightened target — that
+    is the demotion working) while client-class burn stays ZERO, and
+    both classes actually rode the per-shard op scheduler."""
+    import bisect
+    import http.client
+    import random
+    import threading
+
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.mgr.slo import SLOEngine
+    from ceph_tpu.rgw.server import RGWServer
+
+    assert n_clients >= 200 and n_gateways >= 2, \
+        "acceptance floor: >=200 clients through >=2 RGW gateways"
+    f = machine_factor()
+    # recovery SLO tightened so the QoS demotion is VISIBLE as burn:
+    # per-object recovery under client contention (weight 10 vs the
+    # client class's 100 + reservation) runs well past 50 ms.  Client
+    # targets stay at their defaults — any client burn is real.
+    conf = test_config(osd_backend="crimson",
+                       slo_recovery_p99_ms=50.0,
+                       osd_heartbeat_interval=2.0,
+                       osd_heartbeat_grace=max(20.0, 12.0 * f),
+                       mon_osd_down_out_interval=120.0)
+    # per-client Poisson mean inter-arrival.  Open-loop honesty cuts
+    # both ways: an offered rate past the box's service rate grows
+    # the queue without bound and the p99 measures the backlog, not
+    # the system.  200 clients / (16 s x factor) keeps the offered
+    # ~12 ops/s on a dev box — under capacity, so the p99s reflect
+    # scheduling, and the QoS demotion still gets a contended window.
+    mean_gap = 16.0 * f
+    total_ops = n_clients * ops_per_client
+    # Zipf(1.1) CDF over the hot-key set: a handful of keys soak most
+    # GETs (the skew real object stores see)
+    w = [1.0 / (i + 1) ** 1.1 for i in range(hot_keys)]
+    tot_w = sum(w)
+    cdf, acc = [], 0.0
+    for wi in w:
+        acc += wi / tot_w
+        cdf.append(acc)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        c.create_pool("loadp", "replicated", size=2)
+        gws = []
+        for g in range(n_gateways):
+            rad = c.rados(timeout=120 * f)
+            gws.append(RGWServer(rad.open_ioctx("loadp")).start())
+        # bucket + hot-key pre-population (untimed): the GET mix must
+        # never 404, and the seed objects give the mid-run OSD loss a
+        # real recovery workload.  Gateways share cluster-backed omap
+        # state, so one writer primes all of them.
+        host, port = gws[0].addr
+        seed = http.client.HTTPConnection(host, port,
+                                          timeout=120 * f)
+        blob = os.urandom(obj_bytes)
+
+        def _seed_req(method, path, body=None):
+            seed.request(method, path, body=body)
+            resp = seed.getresponse()
+            resp.read()
+            assert resp.status < 400, (method, path, resp.status)
+
+        _seed_req("PUT", "/loadb")
+        for kk in range(hot_keys):
+            _seed_req("PUT", f"/loadb/hot-{kk}", blob)
+        seed.close()
+
+        errors: list = []
+        lats: dict = {ci: {"client_read": [], "client_write": []}
+                      for ci in range(n_clients)}
+        verb_counts = {"GET": 0, "PUT": 0, "DELETE": 0,
+                       "multipart": 0}
+        vc_lock = threading.Lock()
+        progress = [0]
+        late = [0]
+        t0 = time.monotonic() + 0.5   # shared epoch: fleet starts hot
+
+        def worker(ci):
+            rng = random.Random(0xC0FFEE ^ ci)
+            gw = gws[ci % n_gateways]
+            hconn = http.client.HTTPConnection(
+                gw.addr[0], gw.addr[1], timeout=120 * f)
+            my_keys = []
+
+            def req(method, path, body=None):
+                t_s = time.monotonic()
+                hconn.request(method, path, body=body)
+                resp = hconn.getresponse()
+                data = resp.read()
+                if resp.status >= 400:
+                    raise RuntimeError(
+                        f"{method} {path} -> {resp.status}")
+                return time.monotonic() - t_s, resp, data
+
+            # open-loop schedule: absolute deadlines from the shared
+            # epoch — a late op fires immediately but the NEXT
+            # deadline is unmoved (no cumulative sleep drift)
+            next_t = t0 + rng.expovariate(1.0 / mean_gap)
+            for j in range(ops_per_client):
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                elif delay < -0.25:
+                    late[0] += 1
+                r = rng.random()
+                try:
+                    if r < 0.45:
+                        kk = bisect.bisect_left(cdf, rng.random())
+                        dt, _, _ = req("GET", f"/loadb/hot-{kk}")
+                        lats[ci]["client_read"].append(dt)
+                        verb = "GET"
+                    elif r < 0.80 or (r < 0.90 and not my_keys):
+                        key = f"c{ci}-{j}"
+                        dt, _, _ = req("PUT", f"/loadb/{key}", blob)
+                        lats[ci]["client_write"].append(dt)
+                        my_keys.append(key)
+                        verb = "PUT"
+                    elif r < 0.90:
+                        # only keys this client wrote: DELETE can
+                        # never race another client into a 404
+                        dt, _, _ = req("DELETE",
+                                       f"/loadb/{my_keys.pop()}")
+                        lats[ci]["client_write"].append(dt)
+                        verb = "DELETE"
+                    else:
+                        key = f"mp{ci}-{j}"
+                        t_s = time.monotonic()
+                        _, _, xml = req("POST",
+                                        f"/loadb/{key}?uploads",
+                                        b"")
+                        uid = xml.decode().split("<UploadId>")[1] \
+                            .split("<")[0]
+                        etags = []
+                        for pn in (1, 2):
+                            _, resp, _ = req(
+                                "PUT",
+                                f"/loadb/{key}?uploadId={uid}"
+                                f"&partNumber={pn}",
+                                blob[:4 << 10])
+                            etags.append(
+                                resp.headers["ETag"].strip('"'))
+                        parts = "".join(
+                            f"<Part><PartNumber>{pn}</PartNumber>"
+                            f"<ETag>\"{et}\"</ETag></Part>"
+                            for pn, et in enumerate(etags, 1))
+                        req("POST", f"/loadb/{key}?uploadId={uid}",
+                            parts.encode())
+                        lats[ci]["client_write"].append(
+                            time.monotonic() - t_s)
+                        verb = "multipart"
+                    with vc_lock:
+                        verb_counts[verb] += 1
+                        progress[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append((ci, j, repr(e)))
+                next_t += rng.expovariate(1.0 / mean_gap)
+            hconn.close()
+
+        ts = [threading.Thread(target=worker, args=(ci,),
+                               name=f"load-c{ci}")
+              for ci in range(n_clients)]
+        for t in ts:
+            t.start()
+        # injected recovery contention: once the fleet is
+        # demonstrably flowing (progress-driven, not wall-clock),
+        # lose one OSD's data and revive it — recovery now competes
+        # with the remaining ~85% of the client schedule through the
+        # per-shard mClock scheduler
+        victim = 2
+        deadline = time.monotonic() + 120 * f
+        while progress[0] < max(1, total_ops // 8) and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.kill_osd(victim, lose_data=True)
+        c.wait_for_osd_down(victim, 30)
+        c.revive_osd(victim)
+        c.wait_for_osd_up(victim, 30)
+        for t in ts:
+            t.join()
+        wall = time.monotonic() - t0
+        assert not errors, \
+            f"load harness leaked client errors: {errors[:5]}"
+        c.wait_for_clean(max(120.0, 90.0 * f))
+
+        # per-class client-side latency vs the declarative SLO targets
+        latency = {}
+        for cls in ("client_read", "client_write"):
+            vals = sorted(v for ci in lats
+                          for v in lats[ci][cls])
+            latency[cls] = {
+                "ops": len(vals),
+                "p50_ms": round(_pctl(vals, 0.50) * 1e3, 2),
+                "p95_ms": round(_pctl(vals, 0.95) * 1e3, 2),
+                "p99_ms": round(_pctl(vals, 0.99) * 1e3, 2),
+                "target_ms": float(conf[f"slo_{cls}_p99_ms"]),
+            }
+            assert latency[cls]["p99_ms"] <= \
+                latency[cls]["target_ms"], \
+                (f"{cls} p99 {latency[cls]['p99_ms']} ms blew its "
+                 f"SLO target {latency[cls]['target_ms']} ms")
+        # QoS demotion evidence, from exported counters alone: the
+        # scheduler carried both classes, recovery burned its
+        # (tightened) budget under contention, clients burned NOTHING
+        opq: dict = {}
+        for osd in c.osds.values():
+            _, _, dump = osd._exec_command(
+                {"prefix": "dump_op_queue"})
+            for cls, row in (dump.get("classes") or {}).items():
+                a = opq.setdefault(cls, {"queued": 0, "served": 0,
+                                         "depth_hwm": 0})
+                a["queued"] += int(row.get("queued", 0))
+                a["served"] += int(row.get("served", 0))
+                a["depth_hwm"] = max(a["depth_hwm"],
+                                     int(row.get("depth_hwm", 0)))
+        assert opq.get("client", {}).get("served", 0) > 0, \
+            f"no client ops rode the op scheduler: {opq}"
+        assert opq.get("recovery", {}).get("served", 0) > 0, \
+            f"no recovery items rode the op scheduler: {opq}"
+        slo = SLOEngine.merge_dumps(
+            [osd.slo.dump() for osd in c.osds.values()
+             if getattr(osd, "slo", None) is not None])
+        rec_burn = (slo.get("recovery") or {}).get("burn", 0.0)
+        assert rec_burn > 0.0, \
+            (f"recovery class shows no burn under contention — "
+             f"demotion invisible: {slo}")
+        client_burn = {}
+        for cls in ("client_read", "client_write"):
+            row = slo.get(cls) or {}
+            client_burn[cls] = row.get("burn", 0.0)
+            assert client_burn[cls] == 0.0, \
+                f"client class {cls} burned budget under QoS: {row}"
+            assert row.get("errors", 0) == 0, \
+                f"client class {cls} leaked errors: {row}"
+        p99r = latency["client_read"]["p99_ms"]
+        emit(f"open-loop load client_read p99 ms ({n_clients} S3 "
+             f"clients x {n_gateways} RGW gateways over a 3-OSD "
+             f"crimson cluster, mixed GET/PUT/DELETE + multipart, "
+             f"zipf hot keys, poisson arrivals vs absolute "
+             f"deadlines, one OSD lost+revived mid-run; 0 client "
+             f"errors, recovery burn {rec_burn:.1f} with zero "
+             f"client-class burn; baseline=the slo_client_read "
+             f"target {latency['client_read']['target_ms']:.0f} ms)",
+             p99r, "ms",
+             p99r / latency["client_read"]["target_ms"]
+             if latency["client_read"]["target_ms"] else 0.0)
+        rec = {
+            "metric": "open-loop load attribution "
+                      f"({n_clients} clients x {n_gateways} RGW "
+                      "gateways, mixed GET/PUT/DELETE + multipart, "
+                      "zipf hot keys, poisson open-loop arrivals "
+                      "against absolute deadlines; value = "
+                      "client_read p99 ms)",
+            "value": p99r, "unit": "ms",
+            "vs_baseline": round(
+                p99r / latency["client_read"]["target_ms"], 4)
+            if latency["client_read"]["target_ms"] else 0.0,
+            "clients": n_clients, "gateways": n_gateways,
+            "ops": dict(verb_counts, total=progress[0]),
+            "errors": len(errors),
+            "latency_ms": latency,
+            "arrival": {
+                "mean_gap_s": round(mean_gap, 3),
+                "offered_hz": round(n_clients / mean_gap, 2),
+                "achieved_hz": round(progress[0] / wall, 2)
+                if wall > 0 else 0.0,
+                "late_frac": round(late[0] / max(1, total_ops), 4)},
+            "slo": slo,
+            "op_queue": opq,
+            "contention": {"victim_osd": victim,
+                           "recovery_burn": round(rec_burn, 4),
+                           "client_burn": client_burn},
+        }
+        print(json.dumps(rec), flush=True)
+        _FLOOR_STATS["load_attribution"] = rec
+        for gw in gws:
+            gw.shutdown()
 
 
 def bench_rebuild(n_objs=26, obj_bytes=8 << 20):
@@ -1907,6 +2216,10 @@ EXTRA_CONFIGS = {
     # opt-in (--only multichip): the batcher-routed mesh floor
     # (ISSUE 12) — replaces the __graft_entry__ dry-run
     "multichip": bench_multichip,
+    # opt-in (--only load): the open-loop many-client S3 harness
+    # (ISSUE 13) — 200+ clients through multiple RGW gateways with
+    # injected recovery contention and QoS-demotion acceptance
+    "load": bench_load,
 }
 CONFIGS_ALL = dict(CONFIGS, **EXTRA_CONFIGS)
 
@@ -1996,6 +2309,9 @@ def main():
                 fresh_ratio=ratio,
                 fresh_scaling=_FLOOR_STATS.get(
                     "cluster_scaling_clients"),
+                fresh_ladder=_FLOOR_STATS.get(
+                    "cluster_scaling_ladder"),
+                fresh_load=_FLOOR_STATS.get("load_attribution"),
                 fresh_rebuild=_FLOOR_STATS.get(
                     "rebuild_attribution"),
                 fresh_mesh=_FLOOR_STATS.get("multichip_mesh"))

@@ -22,6 +22,7 @@ but drives it from the reactor.
 from __future__ import annotations
 
 import socket
+import threading
 from typing import List, Optional
 
 from collections import deque
@@ -39,6 +40,10 @@ _RECV_CHUNK = 1 << 18
 # at most this many recv() calls per readiness event, so one firehose
 # peer cannot monopolize a tick
 _RECV_ROUNDS = 64
+# connection-to-shard affinity (ISSUE 13): every client op votes for
+# its PG's owning shard; after this many votes a strict majority for a
+# foreign shard re-pins the connection's pumps there
+_VOTE_WINDOW = 32
 
 
 class CrimsonConnection(Connection):
@@ -64,10 +69,21 @@ class CrimsonConnection(Connection):
         self._rbuf = bytearray()
         self._wq: deque = deque()       # pending iovecs (memoryviews)
         self._wants_write = False
-        # shard-per-core (ISSUE 8): each connection pins to ONE
-        # reactor for its whole life — its pumps and inline dispatch
-        # run there; ops for PGs owned by another shard hop over via
-        # submit_to at the dispatch layer, never by sharing the pump
+        # write coalescing (ISSUE 13): replies generated within one
+        # tick share a single scatter-gather flush scheduled at most
+        # once per batch
+        self._flush_scheduled = False
+        # admission backpressure: reads paused while the owning
+        # shard's op queue is past its high-water mark
+        self._read_paused = False
+        # shard-affinity vote window (reactor-thread only)
+        self._shard_votes: dict = {}
+        self._vote_n = 0
+        self._migrating = False
+        # shard-per-core (ISSUE 8): each connection starts on a
+        # round-robin reactor; with crimson_conn_affinity its pumps
+        # later re-pin to the shard owning most of its ops, so inline
+        # dispatch lands on the PG's home shard with no mailbox hop
         self._reactor = msgr.pick_reactor()
 
     @property
@@ -97,6 +113,7 @@ class CrimsonConnection(Connection):
         self._rbuf.clear()
         self._wq.clear()
         self._wants_write = False
+        self._read_paused = False
         self.reactor.register(sock, self._on_readable, self._on_writable)
         self._pump_writes()             # flush traffic queued meanwhile
 
@@ -106,6 +123,8 @@ class CrimsonConnection(Connection):
             self._rbuf.clear()
             self._wq.clear()
             self._wants_write = False
+            self._read_paused = False
+            self.msgr.forget_paused(self)
         self.reactor.unregister(sock)
 
     def _io_error(self, sock, gen) -> None:
@@ -129,19 +148,101 @@ class CrimsonConnection(Connection):
         if sock is not None:
             self._detach(sock)
 
+    # -- shard affinity (ISSUE 13) -----------------------------------------
+    def note_shard_vote(self, shard: int) -> None:
+        """One client op's vote for its PG's owning shard.  Called
+        from inline dispatch, i.e. on this connection's reactor.  A
+        strict majority over the vote window re-pins the connection
+        to the winning shard's reactor — subsequent ops then skip the
+        cross-shard mailbox handoff entirely."""
+        votes = self._shard_votes
+        votes[shard] = votes.get(shard, 0) + 1
+        self._vote_n += 1
+        if self._vote_n < _VOTE_WINDOW:
+            return
+        best = max(votes, key=votes.get)
+        n_best = votes[best]
+        self._shard_votes = {}
+        self._vote_n = 0
+        reactors = self.msgr.reactors
+        if best >= len(reactors) or self._migrating or \
+                n_best * 2 <= _VOTE_WINDOW:
+            return
+        target = reactors[best]
+        if target is self._reactor:
+            return
+        self._migrating = True
+        # defer past the current read pump: migrating mid-parse would
+        # hand _rbuf to the new reactor while this one still walks it
+        self._reactor.call_soon(self._migrate, target)
+
+    def _migrate(self, target: Reactor) -> None:
+        # old reactor thread, outside any pump
+        sock = self._reg_sock
+        if self._reactor is target:
+            self._migrating = False
+            return
+        if sock is None:
+            self._reactor = target
+            self._migrating = False
+            return
+        old = self._reactor
+        gen = self._reg_gen
+        old.unregister(sock)
+        self._reactor = target
+        # nothing fires this connection's callbacks between the old
+        # shard's unregister and the adopt below, so _rbuf/_wq hand
+        # over untouched; stale callbacks left on the old reactor
+        # re-route via the in_reactor() guard in _pump_writes
+        target.call_soon(self._adopt, sock, gen)
+
+    def _adopt(self, sock, gen) -> None:
+        # new reactor thread: re-register the live socket
+        self._migrating = False
+        with self.lock:
+            if self.sock is not sock or self.gen != gen \
+                    or self.state != "open":
+                return              # died/reconnected mid-migration
+        self._reg_sock = sock
+        self._reg_gen = gen
+        self._reactor.register(sock, self._on_readable,
+                               self._on_writable)
+        if self._wants_write:
+            self._reactor.want_write(sock, True)
+        if self._read_paused:
+            self._reactor.want_read(sock, False)
+        self._pump_writes()
+
     # -- write pump --------------------------------------------------------
     def send_message(self, msg) -> None:
         super().send_message(msg)       # enqueue under the lock
-        r = self.reactor
-        if r.in_reactor():
-            self._pump_writes()
-        else:
-            r.call_soon(self._pump_writes)
+        self._schedule_pump()
+
+    def _schedule_pump(self) -> None:
+        """Coalesced flush: the first sender in a tick schedules one
+        pump; everyone else just appends to ``out_q``.  Under 64-way
+        fan-in the per-reply ``sendmsg`` calls collapse into one
+        scatter-gather burst per tick."""
+        if self._flush_scheduled:
+            return
+        self._flush_scheduled = True
+        self.reactor.call_soon(self._flush_coalesced)
+
+    def _flush_coalesced(self) -> None:
+        self._flush_scheduled = False
+        self._pump_writes()
 
     def _on_writable(self) -> None:
         self._pump_writes()
 
     def _pump_writes(self) -> None:
+        r = self._reactor
+        if not r.in_reactor():
+            # connection migrated while this callback sat queued on
+            # the previous reactor: re-run on the new home so only
+            # one thread ever touches _wq and the socket
+            r.call_soon(self._pump_writes)
+            return
         sock = self._reg_sock
         gen = self._reg_gen
         if sock is None:
@@ -203,9 +304,40 @@ class CrimsonConnection(Connection):
         gen = self._reg_gen
         if sock is None:
             return
+        # admission backpressure (ISSUE 13): past the shard's op-queue
+        # HWM, stop reading — bytes queue in the kernel buffer and
+        # then the client's send window, so overload waits at the
+        # edge instead of inflating reactor loop-lag.  The OSD's
+        # resume tick re-arms read interest once the queue drains.
+        gate = getattr(self.msgr, "admission_gate", None)
+        if gate is not None and not self._read_paused:
+            try:
+                overloaded = gate(self)
+            except Exception:  # noqa: BLE001 — gating must not kill IO
+                overloaded = False
+            if overloaded:
+                self._read_paused = True
+                self._reactor.want_read(sock, False)
+                self.msgr.note_paused(self)
+                return
         if self._inject_recv_fault():
             self._io_error(sock, gen)
             return
+        self._recv_rounds(sock, gen)
+
+    def resume_reads(self) -> None:
+        """Re-arm read interest after an admission pause (runs on
+        this connection's reactor, marshalled by the messenger)."""
+        if not self._read_paused:
+            return
+        self._read_paused = False
+        sock = self._reg_sock
+        if sock is not None:
+            # level-triggered: bytes that piled up while paused
+            # re-fire the selector on the next tick
+            self._reactor.want_read(sock, True)
+
+    def _recv_rounds(self, sock, gen) -> None:
         try:
             for _ in range(_RECV_ROUNDS):
                 chunk = sock.recv(_RECV_CHUNK)
@@ -281,7 +413,7 @@ class CrimsonConnection(Connection):
                 if ack is not None:
                     self.out_q.append(ack)
             if ack is not None:
-                self._pump_writes()
+                self._schedule_pump()
             msg.connection = self
             # inline dispatch: THE crimson fast path — the op runs on
             # the reactor right out of the frame parser
@@ -315,6 +447,33 @@ class CrimsonMessenger(Messenger):
             list(reactors) if reactors else [reactor])
         self.reactor = self.reactors[0]
         self._rr = 0
+        # admission backpressure (ISSUE 13): the OSD installs a gate
+        # callable; connections it judges overloaded pause their read
+        # pump and park here until the owning shard drains
+        self.admission_gate = None
+        self._paused_lock = threading.Lock()
+        self._paused: set = set()
+
+    def note_paused(self, conn) -> None:
+        with self._paused_lock:
+            self._paused.add(conn)
+
+    def forget_paused(self, conn) -> None:
+        with self._paused_lock:
+            self._paused.discard(conn)
+
+    def resume_paused(self, reactor: Optional[Reactor] = None) -> None:
+        """Re-admit paused connections (all, or only those pinned to
+        ``reactor``); callable from any thread."""
+        with self._paused_lock:
+            if not self._paused:
+                return
+            conns = [c for c in self._paused
+                     if reactor is None or c._reactor is reactor]
+            for c in conns:
+                self._paused.discard(c)
+        for c in conns:
+            c._reactor.call_soon(c.resume_reads)
 
     def pick_reactor(self) -> Reactor:
         """Round-robin shard assignment for a new connection.  The

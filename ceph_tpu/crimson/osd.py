@@ -186,6 +186,26 @@ class CrimsonOSD(OSD):
         self.reactor = self.reactors[0]      # shard 0: maintenance +
         self.n_reactors = n                  # single-reactor compat
         super().__init__(whoami, store, mon_addr, conf=conf, addr=addr)
+        # mClock QoS on the reactor data path (ISSUE 13): one
+        # OpScheduler per reactor shard replaces the classic
+        # osd_op_num_shards queues the base built — PG-addressed work
+        # (client ops, recovery items, scrub rounds) enqueues
+        # class-tagged on the owning shard and the reactor drains it
+        # through the same reservation/weight/limit arbitration the
+        # classic workers use
+        from ..osd.scheduler import OpScheduler, qos_from_conf
+        fifo = self.conf["osd_op_queue"] == "fifo"
+        qos = {} if fifo else qos_from_conf(self.conf)
+        hard = any(lim > 0 for _, _, lim in qos.values())
+        for q in self._shard_queues:
+            q.close()
+        self._n_shards = n
+        self._shard_queues = [
+            OpScheduler(qos, hard_limits=hard, fifo=fifo)
+            for _ in range(n)]
+        # admission backpressure: the messenger consults the owning
+        # shard's queue depth before reading more client bytes
+        self.msgr.admission_gate = self._admission_overloaded
         self.encode_batcher = ReactorBatcher(self.encode_batcher,
                                              self.reactors)
         # mailbox depth + cross-shard handoff latency ride the PR 7
@@ -239,12 +259,19 @@ class CrimsonOSD(OSD):
                                 self._tick_once)
         self.reactor.call_every(self._RECOVERY_TICK,
                                 self._drain_recovery_kick)
-        # the coalescing barrier: ops processed this tick have already
-        # submitted their stripes, so flush each shard's MPSC buffer
-        # and cut the batch window once ALL shards have drained
+        # per-shard QoS drain first (queued ops run inside this tick,
+        # their stripes reach the MPSC buffer), then the coalescing
+        # barrier: flush each shard's buffer and cut the batch window
+        # once ALL shards have drained
         for r in self.reactors:
             r.add_tick_hook(
+                lambda i=r.shard: self._qos_tick(i))
+            r.add_tick_hook(
                 lambda i=r.shard: self.encode_batcher.shard_tick(i))
+            # admission backpressure: re-admit paused client sockets
+            # once this shard's queue has drained below half the HWM
+            r.add_tick_hook(
+                lambda i=r.shard: self._admission_resume_tick(i))
         self.monc.subscribe_osdmap()
         self.monc.send_boot(self.whoami, self.my_addr)
         if self.admin_socket is not None:
@@ -261,7 +288,7 @@ class CrimsonOSD(OSD):
         self.encode_batcher.stop(
             drain=self.conf["osd_batcher_drain_timeout"])
         for q in self._shard_queues:
-            q.close()                    # empty; closed for symmetry
+            q.close()                    # stop admitting scheduler work
         if self._int_client is not None:
             try:
                 self._int_client.shutdown()
@@ -315,37 +342,83 @@ class CrimsonOSD(OSD):
         msg.stamp_hop("pg_queued")
         shard = self._shard_of(pgid)
         cur = self._current_reactor()
+        # connection-to-shard affinity: vote for the op's owning
+        # shard; a sustained majority re-pins the connection's pumps
+        # there so subsequent ops skip the cross-shard handoff
+        if self.conf["crimson_conn_affinity"] and \
+                hasattr(conn, "note_shard_vote"):
+            conn.note_shard_vote(shard)
         if cur is not None and cur.shard != shard:
-            # wrong shard: lock-free mailbox handoff to the owner
-            cur.submit_to(shard, self._run_handoff_op, conn, msg)
-            return
-        # owner shard (or a foreign thread): continuation, not queue
-        # hop — the op runs later in this very tick (the ready queue
-        # drains to empty), after the reader finishes parsing whatever
-        # else the socket delivered
-        (cur or self.reactors[shard]).submit_to(
-            shard, self._run_client_op, conn, msg)
+            msg._crossed_shard = True    # stamped at owner dequeue
+        # class-tagged into the owning shard's mClock scheduler; the
+        # kick rides the mailbox so the owner drains it this tick (the
+        # scheduler may serve a HIGHER-priority class first — that is
+        # the point)
+        self._shard_queues[shard].enqueue("client", (conn, msg))
+        self._kick_shard(shard, cur)
 
-    def _run_handoff_op(self, conn, msg) -> None:
-        msg.stamp_hop("xshard_handoff")
-        self._run_client_op(conn, msg)
+    def _kick_shard(self, shard: int,
+                    cur: Optional[Reactor] = None) -> None:
+        """Schedule one scheduler drain on ``shard``'s reactor."""
+        (cur or self.reactors[shard]).submit_to(
+            shard, self._qos_drain, shard)
+
+    def _qos_drain(self, shard: int) -> None:
+        out = self._shard_queues[shard].dequeue_nowait()
+        if out is not None:
+            self._run_sched_item(*out)
+
+    def _qos_tick(self, shard: int) -> None:
+        """Tick hook: serve whatever the per-kick drains left behind
+        (token-gated classes waiting out a refill, kicks lost to
+        shutdown races).  Bounded so one tick cannot run unbounded
+        backlog."""
+        q = self._shard_queues[shard]
+        for _ in range(128):
+            out = q.dequeue_nowait()
+            if out is None:
+                return
+            self._run_sched_item(*out)
+
+    def _admission_overloaded(self, conn) -> bool:
+        """Messenger admission gate: pause reading a client socket
+        while its reactor's shard queue is past the high-water mark.
+        Daemon peers (osd./mon.) are never gated — stalling sub-op
+        replies under client load would deadlock the very commits
+        that drain the queue."""
+        hwm = self.conf["crimson_admission_hwm"]
+        if not hwm:
+            return False
+        peer = getattr(conn, "peer_name", "") or ""
+        if peer.startswith(("osd.", "mon.", "mgr.")):
+            return False
+        shard = getattr(conn, "reactor", self.reactor).shard
+        if shard >= len(self._shard_queues):
+            return False
+        return self._shard_queues[shard].queued() >= hwm
+
+    def _admission_resume_tick(self, shard: int) -> None:
+        hwm = self.conf["crimson_admission_hwm"]
+        if not hwm:
+            return
+        if shard < len(self._shard_queues) and \
+                self._shard_queues[shard].queued() <= hwm // 2:
+            self.msgr.resume_paused(self.reactors[shard])
 
     def queue_recovery_item(self, pg: PG) -> None:
         with pg.lock:
             if getattr(pg, "_recovery_queued", False):
                 return
             pg._recovery_queued = True
-        self._submit_to_pg(pg, self._run_recovery_item, pg)
+        shard = self._shard_of(pg.pgid)
+        self._shard_queues[shard].enqueue("recovery", pg)
+        self._kick_shard(shard, self._current_reactor())
 
     def _queue_scrub(self, pg: PG, deep: bool) -> None:
-        self._submit_to_pg(pg, self._start_scrub, pg, deep)
-
-    def _submit_to_pg(self, pg: PG, fn, *args) -> None:
-        """Run ``fn(*args)`` on ``pg``'s owning shard, from any
-        thread."""
         shard = self._shard_of(pg.pgid)
-        cur = self._current_reactor()
-        (cur or self.reactors[shard]).submit_to(shard, fn, *args)
+        self._shard_queues[shard].enqueue(
+            "scrub", lambda p=pg, d=deep: self._start_scrub(p, d))
+        self._kick_shard(shard, self._current_reactor())
 
     def kick_recovery(self) -> None:
         # peering events may kick from foreign threads (mon dispatch

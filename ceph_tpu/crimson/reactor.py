@@ -165,6 +165,7 @@ class Reactor:
         self._tick_hooks: List[Callable[[], None]] = []
         self._handlers: Dict[int, Tuple[Any, Optional[Callable],
                                         Optional[Callable]]] = {}
+        self._interest: Dict[int, int] = {}   # fd -> selector events
         self._stop_flag = False
         self._thread: Optional[threading.Thread] = None
         # self-wake pipe: writing one byte pops the selector out of its
@@ -223,10 +224,19 @@ class Reactor:
 
     # ---------------------------------------------------------- scheduling
     def call_soon(self, fn: Callable, *args) -> None:
-        """Run ``fn(*args)`` on the reactor thread; threadsafe."""
+        """Run ``fn(*args)`` on the reactor thread; threadsafe.
+
+        Batched wake (ISSUE 13): the wake byte is sent only on the
+        empty→non-empty transition — same contract as the SPSC
+        mailboxes — so a fan-in burst of N callbacks costs one
+        ``send()`` instead of N.  A non-empty queue means an earlier
+        producer's wake is still pending (or the loop has already
+        seen the work via ``_next_timeout``), so the byte is
+        redundant."""
         with self._ready_lock:
+            was_empty = not self._ready
             self._ready.append((fn, args))
-        if not self.in_reactor():
+        if was_empty and not self.in_reactor():
             self._wake()
 
     def call_later(self, delay: float, fn: Callable, *args) -> _Timer:
@@ -372,35 +382,66 @@ class Reactor:
     # ------------------------------------------------------------------ IO
     def register(self, sock, on_readable: Optional[Callable[[], None]],
                  on_writable: Optional[Callable[[], None]] = None) -> None:
-        """Watch ``sock`` for readability (and, via :meth:`want_write`,
-        writability).  Must be invoked on the reactor thread."""
+        """Watch ``sock`` for readability (and, via :meth:`want_write`
+        / :meth:`want_read`, toggled interest).  Must be invoked on
+        the reactor thread."""
         fd = sock.fileno()
         if fd < 0:
             return
         self._handlers[fd] = (sock, on_readable, on_writable)
+        self._interest[fd] = selectors.EVENT_READ
         try:
             self._sel.register(sock, selectors.EVENT_READ, fd)
         except KeyError:
             self._sel.modify(sock, selectors.EVENT_READ, fd)
+
+    def _set_interest(self, sock, fd: int, events: int) -> None:
+        # selectors refuses events=0, so "no interest" means
+        # unregistering from the selector while the handler entry
+        # (and _interest bookkeeping) stays — re-adding an event
+        # re-registers
+        try:
+            if events:
+                try:
+                    self._sel.modify(sock, events, fd)
+                except KeyError:
+                    self._sel.register(sock, events, fd)
+            else:
+                self._sel.unregister(sock)
+        except (KeyError, ValueError, OSError):
+            pass
 
     def want_write(self, sock, flag: bool) -> None:
         """Toggle EVENT_WRITE interest for a registered socket."""
         fd = sock.fileno()
         if fd < 0 or fd not in self._handlers:
             return
-        events = selectors.EVENT_READ
-        if flag:
-            events |= selectors.EVENT_WRITE
-        try:
-            self._sel.modify(sock, events, fd)
-        except (KeyError, ValueError, OSError):
-            pass
+        ev = self._interest.get(fd, selectors.EVENT_READ)
+        ev = (ev | selectors.EVENT_WRITE) if flag \
+            else (ev & ~selectors.EVENT_WRITE)
+        self._interest[fd] = ev
+        self._set_interest(sock, fd, ev)
+
+    def want_read(self, sock, flag: bool) -> None:
+        """Toggle EVENT_READ interest (admission backpressure: a
+        paused client socket queues bytes in the kernel — and
+        eventually the peer's send window — instead of the shard's
+        op queue)."""
+        fd = sock.fileno()
+        if fd < 0 or fd not in self._handlers:
+            return
+        ev = self._interest.get(fd, selectors.EVENT_READ)
+        ev = (ev | selectors.EVENT_READ) if flag \
+            else (ev & ~selectors.EVENT_READ)
+        self._interest[fd] = ev
+        self._set_interest(sock, fd, ev)
 
     def unregister(self, sock) -> None:
         """Forget a socket; tolerant of sockets already closed."""
         try:
             key = self._sel.get_key(sock)
             self._handlers.pop(key.data, None)
+            self._interest.pop(key.data, None)
             self._sel.unregister(sock)
             return
         except (KeyError, ValueError, OSError):
@@ -409,6 +450,7 @@ class Reactor:
         for fd, (s, _r, _w) in list(self._handlers.items()):
             if s is sock:
                 self._handlers.pop(fd, None)
+                self._interest.pop(fd, None)
                 for key in list(self._sel.get_map().values()):
                     if key.fileobj is sock:
                         try:
@@ -574,6 +616,7 @@ class Reactor:
                 dead = True
             if dead:
                 self._handlers.pop(key.data, None)
+                self._interest.pop(key.data, None)
                 try:
                     self._sel.unregister(sock)
                 except (KeyError, ValueError, OSError):

@@ -35,7 +35,9 @@ def checks_from_signals(*, breaker_open: bool = False,
                         slow_ops: int = 0, blocked_ops: int = 0,
                         down_osds: Optional[List[int]] = None,
                         degraded_pgs: int = 0,
-                        total_pgs: int = 0) -> Dict[str, dict]:
+                        total_pgs: int = 0,
+                        op_queue: Optional[dict] = None
+                        ) -> Dict[str, dict]:
     """Evaluate one daemon's (or the merged cluster's) raw signals
     into the named-check dict.  Every check is always present —
     ``ok`` entries included — so dashboards key on a stable set."""
@@ -91,6 +93,21 @@ def checks_from_signals(*, breaker_open: bool = False,
         if degraded_pgs else
         f"all {total_pgs} pgs active+clean",
         degraded=int(degraded_pgs), total=int(total_pgs))
+
+    # sustained client-class op-queue growth: the mClock scheduler is
+    # admitting client work faster than the shards retire it (ISSUE
+    # 13) — a transient spike is normal, 3+ consecutive growth ticks
+    # while depth is nonzero is saturation
+    oq = op_queue or {}
+    growth = int(oq.get("client_growth_ticks", 0))
+    depth = int(oq.get("client_queued", 0))
+    sev = "warn" if growth >= 3 and depth > 0 else "ok"
+    checks["OP_QUEUE_BACKLOG"] = _check(
+        sev,
+        f"client op queue growing {growth} consecutive ticks "
+        f"({depth} ops queued)" if sev != "ok"
+        else "op queues draining",
+        queued=depth, growth_ticks=growth)
 
     return checks
 

@@ -193,6 +193,10 @@ class OSD(Dispatcher):
         self._shard_queues: List[OpScheduler] = [
             OpScheduler(qos, hard_limits=hard, fifo=fifo)
             for _ in range(self._n_shards)]
+        # sustained-growth detector for the OP_QUEUE_BACKLOG health
+        # check: consecutive ticks the client class got deeper
+        self._opq_last_depth = 0
+        self._opq_growth_ticks = 0
         self._workers: List[threading.Thread] = []
         self._stop = threading.Event()
         self._recovery_kick = threading.Event()
@@ -236,6 +240,27 @@ class OSD(Dispatcher):
                       "laggard shards")
         self.perf.add("ec_subwrite_peer_reports",
                       description="laggard peers reported to the mon")
+        # mClock scheduler telemetry (ISSUE 13): per-class queue
+        # depth/served/deficit aggregated over this daemon's op-queue
+        # shards.  Registered at boot on BOTH backends so the mgr
+        # prometheus scrape carries the ceph_op_queue_* families
+        # before any traffic; refreshed on every tick and perf dump.
+        from ..utils.perf import TYPE_U64
+        self.op_queue_perf = self.perf_coll.create("op_queue")
+        from .scheduler import DEFAULT_QOS
+        for cls_name in DEFAULT_QOS:
+            self.op_queue_perf.add(
+                f"{cls_name}_queued_now", TYPE_U64,
+                f"{cls_name}-class ops queued across shards")
+            self.op_queue_perf.add(
+                f"{cls_name}_served",
+                description=f"{cls_name}-class ops dequeued")
+            self.op_queue_perf.add(
+                f"{cls_name}_depth_hwm", TYPE_U64,
+                f"max {cls_name}-class depth on any one shard")
+            self.op_queue_perf.add(
+                f"{cls_name}_deficit_now", TYPE_U64,
+                f"{cls_name}-class weighted-fair deficit (sum)")
         # process-wide fault injection (utils/faults.py): arm the
         # registry from fault_injection/_seed; idempotent, so an OSD
         # restart mid-run keeps the sites' RNG streams
@@ -351,6 +376,7 @@ class OSD(Dispatcher):
                            "dump_critical_path", "dump_hops",
                            "dump_slo", "dump_trace",
                            "dump_profile", "dump_device",
+                           "dump_op_queue",
                            "dump_health", "status",
                            "config get", "config set"):
                 self.admin_socket.register(
@@ -813,19 +839,65 @@ class OSD(Dispatcher):
             out = q.dequeue()
             if out is None:
                 return
-            cls, item = out
-            if cls == "recovery":
-                self._run_recovery_item(item)
-                continue
-            if cls == "scrub":
-                try:
-                    item()
-                except Exception:
-                    import traceback
-                    traceback.print_exc()
-                continue
-            conn, msg = item
-            self._run_client_op(conn, msg)
+            self._run_sched_item(*out)
+
+    def _run_sched_item(self, cls: str, item) -> None:
+        """Run one scheduled op-queue item.  Shared by the classic
+        shard workers and the crimson per-shard reactor drain."""
+        if cls == "recovery":
+            self._run_recovery_item(item)
+            return
+        if cls == "scrub":
+            try:
+                item()
+            except Exception:
+                import traceback
+                traceback.print_exc()
+            return
+        conn, msg = item
+        if getattr(msg, "_crossed_shard", False):
+            # crimson: the op was enqueued from a foreign reactor —
+            # charge the hop now that the owner shard picked it up
+            msg._crossed_shard = False
+            msg.stamp_hop("xshard_handoff")
+        self._run_client_op(conn, msg)
+
+    # -- op-queue telemetry (ISSUE 13) ---------------------------------
+    def _op_queue_stats(self) -> Dict[str, Dict[str, float]]:
+        """Aggregate per-class scheduler stats over every shard."""
+        agg: Dict[str, Dict[str, float]] = {}
+        for q in self._shard_queues:
+            for cls, row in q.stats().items():
+                a = agg.setdefault(cls, {"queued": 0, "served": 0,
+                                         "deficit": 0.0,
+                                         "depth_hwm": 0})
+                a["queued"] += row["queued"]
+                a["served"] += row["served"]
+                a["deficit"] += row["deficit"]
+                a["depth_hwm"] = max(a["depth_hwm"], row["depth_hwm"])
+        return agg
+
+    def _refresh_op_queue_perf(self) -> Dict[str, Dict[str, float]]:
+        agg = self._op_queue_stats()
+        perf = self.op_queue_perf
+        for cls, row in agg.items():
+            try:
+                perf.set(f"{cls}_queued_now", row["queued"])
+                perf.set(f"{cls}_served", row["served"])
+                perf.set(f"{cls}_depth_hwm", row["depth_hwm"])
+                perf.set(f"{cls}_deficit_now",
+                         round(row["deficit"], 4))
+            except KeyError:
+                pass            # ad-hoc class outside DEFAULT_QOS
+        # growth streak for OP_QUEUE_BACKLOG: consecutive refreshes
+        # where the client class got strictly deeper
+        depth = int((agg.get("client") or {}).get("queued", 0))
+        if depth > self._opq_last_depth:
+            self._opq_growth_ticks += 1
+        else:
+            self._opq_growth_ticks = 0
+        self._opq_last_depth = depth
+        return agg
 
     def _run_client_op(self, conn: Connection, msg: MOSDOp) -> None:
         """Dequeued client op: span + perf + PG dispatch.  Shared by
@@ -899,6 +971,7 @@ class OSD(Dispatcher):
         retcode, rs, out = 0, "", {}
         try:
             if prefix == "perf dump":
+                self._refresh_op_queue_perf()
                 out = self.perf_coll.perf_dump()
                 # fault-injection trip counters ride the same dump so
                 # admin socket / tell / mgr prometheus all see them
@@ -945,6 +1018,11 @@ class OSD(Dispatcher):
                            prefix=f"osd{self.whoami}-", n=10)}
             elif prefix == "dump_device":
                 out = self.encode_batcher.device_dump()
+            elif prefix == "dump_op_queue":
+                out = {"classes": self._refresh_op_queue_perf(),
+                       "shards": [q.stats()
+                                  for q in self._shard_queues],
+                       "growth_ticks": self._opq_growth_ticks}
             elif prefix == "dump_health":
                 out = self._health_dump()
             elif prefix == "status":
@@ -980,13 +1058,16 @@ class OSD(Dispatcher):
             total_pgs = len(self.pgs)
             degraded = sum(1 for pg in self.pgs.values()
                            if pg.state != STATE_ACTIVE)
+        oq = self._op_queue_stats().get("client") or {}
         checks = healthlib.checks_from_signals(
             breaker_open=getattr(self.encode_batcher,
                                  "_breaker_open", False),
             slo=self.slo.dump(),
             slow_ops=slow, blocked_ops=blocked,
             down_osds=down,
-            degraded_pgs=degraded, total_pgs=total_pgs)
+            degraded_pgs=degraded, total_pgs=total_pgs,
+            op_queue={"client_queued": int(oq.get("queued", 0)),
+                      "client_growth_ticks": self._opq_growth_ticks})
         out = healthlib.summarize(checks)
         out["daemon"] = f"osd.{self.whoami}"
         return out
@@ -1247,6 +1328,7 @@ class OSD(Dispatcher):
             self._send_pg_stats()
         self._retry_stuck_peering()
         self._renotify_strays()
+        self._refresh_op_queue_perf()
         self._maybe_schedule_scrub()
         self._maybe_trim_snaps()
         self._maybe_trim_pg_logs()

@@ -2461,8 +2461,11 @@ class PG:
             # SLO error classification: infrastructure failures burn
             # budget; client-semantic errnos (ENOENT, EEXIST, ENODATA,
             # EOPNOTSUPP, ECANCELED, ETIMEDOUT-on-notify) do not — a
-            # read of a nonexistent object is a correct answer
-            if result < 0 and result not in (-2, -17, -61, -95, -125):
+            # read of a nonexistent object is a correct answer.  -108
+            # (ESHUTDOWN) is the misdirected-op bounce: a routing
+            # redirect during map churn that the objecter transparently
+            # retries against the new primary, not a service failure
+            if result < 0 and result not in (-2, -17, -61, -95, -108, -125):
                 tracked.slo_ok = False
             tracked.finish()
         if conn is None:

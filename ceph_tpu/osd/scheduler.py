@@ -49,6 +49,7 @@ class _ClassQueue:
         self.lim_tokens = 0.0        # limit bucket (when lim > 0)
         self.vdeficit = 0.0          # weighted-fair deficit counter
         self.served = 0
+        self.depth_hwm = 0           # max queued depth ever observed
 
 
 class OpScheduler:
@@ -86,7 +87,10 @@ class OpScheduler:
             if self.fifo:
                 self._fifo_q.append((cls, item))
             else:
-                self._class(cls).q.append(item)
+                cq = self._class(cls)
+                cq.q.append(item)
+                if len(cq.q) > cq.depth_hwm:
+                    cq.depth_hwm = len(cq.q)
             self._lock.notify()
 
     def enqueue_front(self, cls: str, item) -> None:
@@ -145,6 +149,19 @@ class OpScheduler:
                             key=lambda nc: nc[1].vdeficit)
         return best
 
+    def _serve(self, name: str):
+        """Pop + token/deficit bookkeeping for a picked class.
+        Caller holds the lock."""
+        cq = self._classes[name]
+        item = cq.q.popleft()
+        cq.served += 1
+        if cq.res > 0:
+            cq.res_tokens = max(0.0, cq.res_tokens - 1.0)
+        if cq.lim > 0:
+            cq.lim_tokens = max(0.0, cq.lim_tokens - 1.0)
+        cq.vdeficit = max(0.0, cq.vdeficit - 1.0)
+        return name, item
+
     def dequeue(self, timeout: Optional[float] = None):
         """-> (cls, item), or None on close/timeout."""
         deadline = None if timeout is None \
@@ -164,15 +181,7 @@ class OpScheduler:
                 self._refill()
                 name = self._pick()
                 if name is not None:
-                    cq = self._classes[name]
-                    item = cq.q.popleft()
-                    cq.served += 1
-                    if cq.res > 0:
-                        cq.res_tokens = max(0.0, cq.res_tokens - 1.0)
-                    if cq.lim > 0:
-                        cq.lim_tokens = max(0.0, cq.lim_tokens - 1.0)
-                    cq.vdeficit = max(0.0, cq.vdeficit - 1.0)
-                    return name, item
+                    return self._serve(name)
                 if any(cq.q for cq in self._classes.values()):
                     wait = 0.05      # token-gated work: refill tick
                 else:
@@ -185,6 +194,29 @@ class OpScheduler:
                         else min(wait, remaining)
                 self._lock.wait(wait)
 
+    def dequeue_nowait(self):
+        """Single-poll dequeue for reactor-tick draining (crimson):
+        -> (cls, item) or None, never blocks.  Token-gated work stays
+        queued; the caller's next tick retries after refill."""
+        with self._lock:
+            if self._closed:
+                return None
+            if self.fifo:
+                return self._fifo_q.popleft() if self._fifo_q else None
+            self._refill()
+            name = self._pick()
+            if name is None:
+                return None
+            return self._serve(name)
+
+    def queued(self) -> int:
+        """Total items queued across all classes (admission
+        backpressure reads this without touching per-class detail)."""
+        with self._lock:
+            if self.fifo:
+                return len(self._fifo_q)
+            return sum(len(cq.q) for cq in self._classes.values())
+
     def close(self) -> None:
         with self._lock:
             self._closed = True
@@ -192,7 +224,9 @@ class OpScheduler:
 
     def stats(self) -> Dict[str, Dict[str, float]]:
         with self._lock:
-            return {n: {"queued": len(cq.q), "served": cq.served}
+            return {n: {"queued": len(cq.q), "served": cq.served,
+                        "deficit": round(cq.vdeficit, 4),
+                        "depth_hwm": cq.depth_hwm}
                     for n, cq in self._classes.items()}
 
 
@@ -200,7 +234,7 @@ def qos_from_conf(conf) -> Dict[str, Tuple[float, float, float]]:
     """Read the reference-style mclock knobs
     (osd_mclock_scheduler_<class>_{res,wgt,lim})."""
     out = {}
-    for cls in ("client", "recovery", "scrub"):
+    for cls in ("client", "recovery", "scrub", "peering"):
         try:
             out[cls] = (
                 float(conf[f"osd_mclock_scheduler_{cls}_res"]),

@@ -134,6 +134,20 @@ def _opts() -> List[Option]:
         Option("osd_mclock_scheduler_scrub_res", float, 0.0),
         Option("osd_mclock_scheduler_scrub_wgt", float, 5.0),
         Option("osd_mclock_scheduler_scrub_lim", float, 0.0),
+        Option("osd_mclock_scheduler_peering_res", float, 50.0),
+        Option("osd_mclock_scheduler_peering_wgt", float, 50.0),
+        Option("osd_mclock_scheduler_peering_lim", float, 0.0),
+        Option("crimson_conn_affinity", bool, True,
+               description="re-pin a client connection's reactor to "
+                           "the shard owning the majority of its PG "
+                           "ops, eliminating the cross-shard mailbox "
+                           "hop under fan-in"),
+        Option("crimson_admission_hwm", int, 192, min=0,
+               description="per-shard queued-op high-water mark; past "
+                           "it the messenger stops reading client "
+                           "sockets so overload queues at the edge "
+                           "(TCP backpressure) instead of inflating "
+                           "reactor loop-lag.  0 = unlimited"),
         Option("osd_op_num_threads_per_shard", int, 1, min=1),
         Option("osd_recovery_max_active", int, 0, min=0,
                description="recovery ops in flight per OSD; 0 = pick "

@@ -85,6 +85,15 @@ def test_prometheus_endpoint(cl):
     assert "# TYPE ceph_contention_msgr_sendq_depth_now gauge" in body
     assert "ceph_contention_pg_lock_wait_us_bucket" in body
     assert "ceph_contention_batcher_cond_wait_us_bucket" in body
+    # op-queue QoS telemetry (ISSUE 13): per-class depth/served
+    # gauges registered at OSD boot ride the same scrape
+    assert 'ceph_op_queue_client_queued_now{daemon="osd.0"}' in body
+    assert "# TYPE ceph_op_queue_client_queued_now gauge" in body
+    assert "# TYPE ceph_op_queue_client_depth_hwm gauge" in body
+    assert "# TYPE ceph_op_queue_client_deficit_now gauge" in body
+    assert "# TYPE ceph_op_queue_client_served counter" in body
+    assert 'ceph_op_queue_recovery_served{daemon="osd.0"}' in body
+    assert "ceph_op_queue_scrub_queued_now" in body
 
     st = json.loads(urllib.request.urlopen(
         f"http://{host}:{port}/status", timeout=5).read().decode())
@@ -460,6 +469,21 @@ def test_health_checks_and_cluster_merge():
     assert merged["checks"]["SLOW_OPS"]["slow"] == 5
     assert merged["checks"]["OSD_DOWN"]["down"] == [2, 5]
     assert merged["checks"]["EC_BREAKER_OPEN"]["daemons_firing"] == 1
+    # OP_QUEUE_BACKLOG (ISSUE 13): sustained client-class queue
+    # growth warns; a transient spike (short streak) or an empty
+    # queue after a long streak does not
+    grow = health.checks_from_signals(
+        op_queue={"client_growth_ticks": 3, "client_queued": 40})
+    assert grow["OP_QUEUE_BACKLOG"]["severity"] == "warn"
+    assert grow["OP_QUEUE_BACKLOG"]["queued"] == 40
+    assert grow["OP_QUEUE_BACKLOG"]["growth_ticks"] == 3
+    spike = health.checks_from_signals(
+        op_queue={"client_growth_ticks": 2, "client_queued": 40})
+    assert spike["OP_QUEUE_BACKLOG"]["severity"] == "ok"
+    drained = health.checks_from_signals(
+        op_queue={"client_growth_ticks": 5, "client_queued": 0})
+    assert drained["OP_QUEUE_BACKLOG"]["severity"] == "ok"
+    assert ok["OP_QUEUE_BACKLOG"]["severity"] == "ok"
 
 
 def test_dump_health_admin_round_trip(cl):
@@ -470,6 +494,29 @@ def test_dump_health_admin_round_trip(cl):
         assert out["daemon"] == f"osd.{osd_id}"
         assert out["status"] in ("HEALTH_OK", "HEALTH_WARN",
                                  "HEALTH_ERR")
-        # a healthy fixture cluster: breaker closed, no OSDs down
+        # a healthy fixture cluster: breaker closed, no OSDs down,
+        # op queues draining
         assert out["checks"]["EC_BREAKER_OPEN"]["severity"] == "ok"
         assert out["checks"]["OSD_DOWN"]["severity"] == "ok"
+        assert out["checks"]["OP_QUEUE_BACKLOG"]["severity"] == "ok"
+
+
+def test_dump_op_queue_admin_round_trip(cl):
+    """The per-class scheduler telemetry behind the ceph_op_queue_*
+    scrape: every OSD answers dump_op_queue with aggregated classes
+    plus the raw per-shard stats, and the fixture's client traffic
+    shows up as served client-class ops somewhere in the cluster."""
+    client_served = 0
+    for osd_id in range(3):
+        ret, _, out = cl.osds[osd_id]._exec_command(
+            {"prefix": "dump_op_queue"})
+        assert ret == 0
+        classes = out["classes"]
+        for cls in ("client", "recovery", "scrub", "peering"):
+            assert cls in classes, classes
+            for field in ("queued", "served", "deficit", "depth_hwm"):
+                assert field in classes[cls]
+        assert len(out["shards"]) >= 1
+        assert out["growth_ticks"] >= 0
+        client_served += classes["client"]["served"]
+    assert client_served > 0, "fixture ops never rode the scheduler"

@@ -185,6 +185,97 @@ def test_scaling_gate_runs_from_cli_fresh_records(tmp_path, history):
     assert "scaling-regression" in r.stdout
 
 
+# ------------------------------- ISSUE 13: ladder + open-loop load gates
+def _load_rec(read_p99=12.0, write_p99=20.0, errors=0, burn=None):
+    return {"metric": "open-loop load attribution (200 clients x 2 "
+                      "RGW gateways, mixed GET/PUT/DELETE + "
+                      "multipart, zipf hot keys, poisson open-loop "
+                      "arrivals against absolute deadlines; value = "
+                      "client_read p99 ms)",
+            "value": read_p99, "unit": "ms", "vs_baseline": 0.01,
+            "clients": 200, "gateways": 2, "errors": errors,
+            "latency_ms": {
+                "client_read": {"ops": 90, "p50_ms": 4.0,
+                                "p95_ms": 9.0, "p99_ms": read_p99,
+                                "target_ms": 30000.0},
+                "client_write": {"ops": 110, "p50_ms": 7.0,
+                                 "p95_ms": 14.0, "p99_ms": write_p99,
+                                 "target_ms": 30000.0}},
+            "contention": {"victim_osd": 2, "recovery_burn": 1.4,
+                           "client_burn": burn or
+                           {"client_read": 0.0, "client_write": 0.0}}}
+
+
+def test_load_gate_skips_without_history(history):
+    """Rounds predating the load harness carry no load attribution:
+    the p99 half must self-skip (ISSUE 13 satellite)."""
+    findings = perf_trend.check(
+        None, perf_trend.load_history(history),
+        fresh_load=_load_rec(read_p99=5000.0))
+    assert not [f for f in findings
+                if f["check"] == "load-p99-regression"], findings
+
+
+def test_load_gate_fails_on_p99_regression(tmp_path, history):
+    hist = history + [_hist_round(tmp_path, 3, [_load_rec()])]
+    rounds = perf_trend.load_history(hist)
+    # client_read p99 blows 1.5x + 1 ms past the last load round
+    findings = perf_trend.check(
+        None, rounds, fresh_load=_load_rec(read_p99=40.0))
+    hits = [f for f in findings if f["check"] == "load-p99-regression"]
+    assert len(hits) == 1 and "client_read" in hits[0]["message"]
+    # within tolerance (<= 1.5 x 12 ms) it passes
+    assert not perf_trend.check(
+        None, rounds, fresh_load=_load_rec(read_p99=17.0))
+
+
+def test_load_gate_errors_and_burn_need_no_history(history):
+    """The zero-error / zero-client-burn promises are absolute — they
+    re-assert even when no history round carries a load record."""
+    findings = perf_trend.check(
+        None, perf_trend.load_history(history),
+        fresh_load=_load_rec(errors=3,
+                             burn={"client_read": 0.5,
+                                   "client_write": 0.0}))
+    checks = [f["check"] for f in findings]
+    assert "load-client-errors" in checks
+    assert "load-qos-regression" in checks
+    qos = [f for f in findings if f["check"] == "load-qos-regression"]
+    assert len(qos) == 1 and "client_read" in qos[0]["message"]
+
+
+def test_ladder_gate_crimson_must_win_every_rung(history):
+    """The tentpole's acceptance: crimson >= classic at EVERY rung of
+    the concurrency ladder, asserted within one fresh run."""
+    rounds = perf_trend.load_history(history)
+    losing = {"classic": {"1": 40.0, "4": 45.0, "16": 50.0,
+                          "64": 38.2},
+              "crimson": {"1": 60.0, "4": 55.0, "16": 52.0,
+                          "64": 29.7}}
+    findings = perf_trend.check(None, rounds, fresh_ladder=losing)
+    hits = [f for f in findings
+            if f["check"] == "crimson-ladder-regression"]
+    assert len(hits) == 1 and "64-client" in hits[0]["message"]
+    winning = {"classic": {"1": 40.0, "4": 45.0, "16": 50.0,
+                           "64": 38.2},
+               "crimson": {"1": 60.0, "4": 55.0, "16": 52.0,
+                           "64": 41.0}}
+    assert not perf_trend.check(None, rounds, fresh_ladder=winning)
+
+
+def test_load_and_ladder_gates_run_from_cli(tmp_path, history):
+    hist = history + [_hist_round(tmp_path, 3, [_load_rec()])]
+    good = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                         "commit": 3.0}, 0.95)
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.0), _cluster(1.0), good,
+        _load_rec(read_p99=40.0))))
+    r = _run_cli(fresh, hist)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "load-p99-regression" in r.stdout
+
+
 # ---------------------------------------- ISSUE 10: device-path gates
 def _dwf(frac, p99=None, groups=120):
     return {"groups": groups, "wall_s": 1.0,

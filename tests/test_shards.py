@@ -305,6 +305,116 @@ def test_cluster_pg_to_reactor_affinity(monkeypatch):
             assert io.read(f"o{i}") == bytes([i]) * 16384
 
 
+def test_cluster_forced_four_shards(monkeypatch):
+    """ISSUE 13 satellite: force crimson_num_reactors=4 regardless of
+    the box's core count.  PG affinity must hold across all four
+    shards, wrong-shard arrivals must ride the mailboxes (hwm +
+    handoff counters move), and the concurrency ladder stays
+    monotone: four concurrent clients may not collapse below a lone
+    client's throughput."""
+    import os as _os
+    seen = []
+    orig = PG.do_request
+
+    def spy(self, msg, conn):
+        seen.append((threading.current_thread().name, self.home_shard))
+        return orig(self, msg, conn)
+
+    monkeypatch.setattr(PG, "do_request", spy)
+    conf = make_conf(osd_backend="crimson", crimson_num_reactors=4)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        assert all(type(o) is CrimsonOSD and o.n_reactors == 4
+                   for o in c.osds.values())
+        assert all(len(o._shard_queues) == 4 and len(o.reactors) == 4
+                   for o in c.osds.values())
+        c.create_pool("forcep", "replicated", size=2)
+        blob = _os.urandom(32 << 10)
+        n_each = 6
+        rad = c.rados(timeout=30)
+        rad.op_timeout = 60.0
+        io = rad.open_ioctx("forcep")
+        # rung 1: a lone serial client
+        t0 = time.monotonic()
+        for i in range(n_each):
+            io.write_full(f"s{i}", blob)
+        serial_bps = n_each * len(blob) / (time.monotonic() - t0)
+
+        # rung 4: four concurrent clients over their own connections
+        errs = []
+
+        def writer(cj):
+            try:
+                rj = c.rados(timeout=30)
+                rj.op_timeout = 60.0
+                ioj = rj.open_ioctx("forcep")
+                for i in range(n_each):
+                    ioj.write_full(f"c{cj}-{i}", blob)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=writer, args=(cj,))
+              for cj in range(4)]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        conc_bps = 4 * n_each * len(blob) / (time.monotonic() - t0)
+        assert not errs, errs
+        # monotonicity with generous noise slack: fan-in must not
+        # collapse aggregate throughput below the lone client
+        assert conc_bps > 0.4 * serial_bps, \
+            (f"4-client rung collapsed: {conc_bps / 1e6:.1f} MB/s vs "
+             f"lone client {serial_bps / 1e6:.1f} MB/s")
+        # affinity held on every one of the 4 shards
+        assert len(seen) >= 4 * n_each + n_each
+        homes = set()
+        for name, home in seen:
+            assert home is not None and 0 <= home < 4
+            homes.add(home)
+            assert name.endswith(f"-r{home}"), \
+                f"op ran on {name}, PG owned by shard {home}"
+        assert len(homes) >= 2, f"all PGs hashed to one shard: {homes}"
+        # wrong-shard arrivals crossed mailboxes and registered depth
+        hops = sum(o.perf_coll.create("contention")
+                   .get("xshard_handoff_acquires")
+                   for o in c.osds.values())
+        hwm = max(r.mailbox_hwm for o in c.osds.values()
+                  for r in o.reactors)
+        assert hops > 0 and hwm >= 1, (hops, hwm)
+        for i in range(n_each):
+            assert io.read(f"s{i}") == blob
+
+
+def test_connection_affinity_migration_ends_tail_handoffs():
+    """ISSUE 13: sustained one-PG traffic re-pins the client's
+    connection to the PG's owning shard (majority over the 32-op vote
+    window), so tail ops stop crossing a mailbox — the client's own
+    write-hop ledger gains ZERO xshard_handoff stamps over the tail."""
+    conf = make_conf(osd_backend="crimson", crimson_num_reactors=2)
+    with Cluster(n_osds=3, conf=conf) as c:
+        for i in range(3):
+            c.wait_for_osd_up(i, 30)
+        c.create_pool("affp", "replicated", size=2)
+        rad = c.rados(timeout=30)
+        rad.op_timeout = 60.0
+        io = rad.open_ioctx("affp")
+        blob = b"a" * 4096
+        for _ in range(40):          # > the 32-op vote window
+            io.write_full("pinned", blob)
+        before = rad.objecter.hops.dump()["hop_counts"].get(
+            "xshard_handoff", 0)
+        for _ in range(8):
+            io.write_full("pinned", blob)
+        after = rad.objecter.hops.dump()["hop_counts"].get(
+            "xshard_handoff", 0)
+        assert after == before, \
+            (f"tail writes still crossed shards "
+             f"({after - before} handoffs after migration)")
+
+
 def test_concurrent_cluster_writes_coalesce_multi_stripe_groups():
     """The shared-batcher regression bar: concurrent cluster writes
     from many PGs (and both reactor shards) must dispatch as

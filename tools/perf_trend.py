@@ -56,6 +56,18 @@ history and fails loudly on:
   no recovery/scrub-class *errors* where the most recent
   SLO-carrying history round had none.  Rounds predating the SLO
   engine silently skip.
+- **load p99 regression** — the ``open-loop load attribution``
+  record from the load config: each client class's p99 must stay
+  within the hop-p99 budget (1.5x + 1 ms) of the most recent
+  load-carrying history round.  Rounds predating the load harness
+  carry no load record and the check silently skips.  Independent of
+  history, a fresh load record showing client errors or client-class
+  SLO burn fails outright — the harness's own acceptance re-asserted
+  at the gate.
+- **crimson ladder regression** — the cluster_scaling record's full
+  classic/crimson sides: crimson must be >= classic at EVERY rung of
+  the 1/4/16/64 client ladder (ISSUE 13's tentpole — the 64-client
+  fan-in was the one rung classic still won).
 - **multichip mesh floor** — the ``multichip mesh attribution``
   record from the multichip config: the batcher-routed sharded
   encode must beat its device-count floor vs single-chip (>=0.9x on
@@ -90,6 +102,7 @@ _SCALING_PREFIX = "cluster write scaling"
 _REBUILD_PREFIX = "OSD rebuild MB/s"
 _REBUILD_ATTRIB_PREFIX = "rebuild decode attribution"
 _MESH_ATTRIB_PREFIX = "multichip mesh attribution"
+_LOAD_PREFIX = "open-loop load attribution"
 _K8M4_MARK = "k=8 m=4"
 
 # defaults, overridable from the CLI
@@ -188,6 +201,8 @@ def check(attribution: Optional[Dict], history: List[Dict],
           fresh_ratio: Optional[float] = None,
           fresh_headline_ratio: Optional[float] = None,
           fresh_scaling: Optional[Dict] = None,
+          fresh_ladder: Optional[Dict] = None,
+          fresh_load: Optional[Dict] = None,
           fresh_rebuild: Optional[Dict] = None,
           fresh_mesh: Optional[Dict] = None,
           stage_tol: float = STAGE_TOL,
@@ -206,9 +221,15 @@ def check(attribution: Optional[Dict], history: List[Dict],
     the crimson client-ladder dict ({"1": MB/s, ...}) from the
     cluster_scaling config — compared at the 16-client rung against
     the best history round that recorded one (rounds predating the
-    ladder silently skip the check); ``fresh_rebuild`` the rebuild
-    config's decode-side attribution object, feeding the rebuild
-    throughput floor and the decode routing-collapse check."""
+    ladder silently skip the check); ``fresh_ladder`` both sides of
+    that ladder ({"classic": {...}, "crimson": {...}}), feeding the
+    every-rung crimson>=classic assert; ``fresh_load`` the load
+    config's ``open-loop load attribution`` record, feeding the
+    per-class p99 budget vs the latest load-carrying history round
+    and the zero-client-error / zero-client-burn re-assert;
+    ``fresh_rebuild`` the rebuild config's decode-side attribution
+    object, feeding the rebuild throughput floor and the decode
+    routing-collapse check."""
     findings: List[Dict] = []
 
     # -- routing collapse (the r05 signature) -------------------------
@@ -459,6 +480,84 @@ def check(attribution: Optional[Dict], history: List[Dict],
                     f"{best16:.1f} MB/s (shard-per-core concurrency "
                     f"ladder)"})
 
+    # -- crimson>=classic ladder (every rung) -------------------------
+    # (ISSUE 13) The tentpole's acceptance: with the 64-client fan-in
+    # fix and QoS on the reactor path, the default backend may not
+    # lose ANY rung of the concurrency ladder to classic.  Compared
+    # within one fresh run (same box, same minute), so no machine-
+    # speed tolerance is owed.
+    if fresh_ladder:
+        cl_side = fresh_ladder.get("classic") or {}
+        cr_side = fresh_ladder.get("crimson") or {}
+        for rung in sorted(set(cl_side) & set(cr_side),
+                           key=lambda r: int(r)):
+            old = cl_side.get(rung)
+            new = cr_side.get(rung)
+            if not isinstance(old, (int, float)) \
+                    or not isinstance(new, (int, float)):
+                continue
+            if new < old:
+                findings.append({
+                    "check": "crimson-ladder-regression",
+                    "severity": "fail",
+                    "message":
+                        f"crimson {new:.1f} MB/s < classic "
+                        f"{old:.1f} MB/s at the {rung}-client rung "
+                        f"— the reactor path lost a rung of the "
+                        f"concurrency ladder (check the fan-in "
+                        f"batching, connection-shard affinity and "
+                        f"admission backpressure)"})
+
+    # -- open-loop load: per-class p99 budget + QoS re-assert ---------
+    # History rounds predating the load harness record no load
+    # attribution and the p99 half self-skips; the error/burn half is
+    # absolute (the harness promised zero) and needs no history.
+    if fresh_load:
+        errs = fresh_load.get("errors")
+        if isinstance(errs, (int, float)) and errs > 0:
+            findings.append({
+                "check": "load-client-errors", "severity": "fail",
+                "message":
+                    f"open-loop load run leaked {int(errs)} client "
+                    f"errors (the harness promises zero across "
+                    f"every gateway)"})
+        burn = (fresh_load.get("contention") or {}) \
+            .get("client_burn") or {}
+        for cls, b in sorted(burn.items()):
+            if isinstance(b, (int, float)) and b > 0:
+                findings.append({
+                    "check": "load-qos-regression", "severity": "fail",
+                    "message":
+                        f"{cls} burned error budget ({b:.3f}) under "
+                        f"injected recovery contention — QoS "
+                        f"demotion failed to protect the client "
+                        f"class"})
+        hist_load = None
+        for rnd in reversed(history):
+            rec = _pick(rnd["records"], _LOAD_PREFIX)
+            if rec is not None and \
+                    isinstance(rec.get("latency_ms"), dict):
+                hist_load = rec["latency_ms"]
+                break
+        new_lat = fresh_load.get("latency_ms") or {}
+        if hist_load is not None:
+            for cls in sorted(new_lat):
+                old = (hist_load.get(cls) or {}).get("p99_ms")
+                new = (new_lat.get(cls) or {}).get("p99_ms")
+                if not isinstance(old, (int, float)) \
+                        or not isinstance(new, (int, float)):
+                    continue
+                if new > old * hop_p99_factor \
+                        and new - old > HOP_P99_SLACK_S * 1e3:
+                    findings.append({
+                        "check": "load-p99-regression",
+                        "severity": "fail",
+                        "message":
+                            f"open-loop load {cls} p99 {new:.2f} ms "
+                            f"> {hop_p99_factor:.1f} x history "
+                            f"{old:.2f} ms (+1 ms slack) under the "
+                            f"same offered load"})
+
     # -- rebuild throughput floor + decode routing collapse -----------
     # (ISSUE 11) ``fresh_rebuild`` is the rebuild config's
     # decode-side attribution object.  The floor mirrors the
@@ -568,6 +667,13 @@ def run(fresh_records: List[Dict], history: List[Dict],
     scaling = _pick(fresh_records, _SCALING_PREFIX)
     rebuild = _pick(fresh_records, _REBUILD_ATTRIB_PREFIX)
     mesh = _pick(fresh_records, _MESH_ATTRIB_PREFIX)
+    load = _pick(fresh_records, _LOAD_PREFIX)
+    ladder = None
+    if scaling:
+        cl_side = (scaling.get("classic") or {}).get("clients")
+        cr_side = (scaling.get("crimson") or {}).get("clients")
+        if cl_side and cr_side:
+            ladder = {"classic": cl_side, "crimson": cr_side}
     if att is None and cluster is None:
         print("perf_trend: fresh input carries neither an "
               "attribution object nor a k8m4 cluster metric",
@@ -583,6 +689,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
                                    (int, float)) else None,
         fresh_scaling=((scaling.get("crimson") or {}).get("clients")
                        if scaling else None),
+        fresh_ladder=ladder, fresh_load=load,
         fresh_rebuild=rebuild, fresh_mesh=mesh,
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
