@@ -1094,7 +1094,8 @@ _FLOOR_STATS = {"cluster_k8m4_vs_baseline": None,
                 "cluster_scaling_ladder": None,
                 "load_attribution": None,
                 "rebuild_attribution": None,
-                "multichip_mesh": None}
+                "multichip_mesh": None,
+                "selftune_attribution": None}
 
 
 def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
@@ -2187,6 +2188,126 @@ def bench_multichip(k=8, m=4, chunk=4 << 10, stripes=128, n_ops=6):
     return speedup
 
 
+def bench_selftune(obj_bytes=512 << 10, per_client=2):
+    """Closed-loop selftune ladder (ISSUE 15): the SAME 3-OSD k=2 m=1
+    tpu pool driven by a 1/4/16 concurrent-client ladder twice — once
+    on the static conf defaults and once with the per-OSD autotuner
+    walking the batcher knobs live (osd_tuner_enable, 10 Hz tick,
+    verdict every tick).  Guarded rollback means the controller's
+    worst case is "changed nothing", so the acceptance is strict:
+    tuned >= static at EVERY rung and zero guard trips.  The tuned
+    side's dump_tuner audit (decisions, final knob values, guard
+    reasons) rides the attribution record into the perf_trend gate."""
+    import threading
+
+    from ceph_tpu.cluster import Cluster, test_config
+
+    levels = (1, 4, 16)
+    f = machine_factor()
+    sides = {}
+    tuner_block = None
+    for mode in ("static", "tuned"):
+        over = {"ec_tpu_queue_window_us": 1000,
+                # identical tick cadence on both sides so the only
+                # delta is the controller acting on it
+                "osd_tick_interval": 0.1}
+        if mode == "tuned":
+            over.update(osd_tuner_enable=True,
+                        osd_tuner_interval_ticks=1,
+                        osd_tuner_cooldown_ticks=1)
+        conf = test_config(**over)
+        rungs = {}
+        with Cluster(n_osds=3, conf=conf) as c:
+            for i in range(3):
+                c.wait_for_osd_up(i, 30)
+            c.create_ec_profile("selft", plugin="tpu", k="2", m="1")
+            c.create_pool("selftp", "erasure",
+                          erasure_code_profile="selft")
+            blob = os.urandom(obj_bytes)
+            rads = [c.rados(timeout=60 * f) for _ in range(max(levels))]
+            ios = [r.open_ioctx("selftp") for r in rads]
+            ios[0].write_full("warm", blob)      # compile / prewarm
+            for n in levels:
+                errs = []
+
+                def worker(ci):
+                    try:
+                        comps = [ios[ci].aio_write_full(
+                            f"t{n}-{ci}-{j}", blob)
+                            for j in range(per_client)]
+                        for comp in comps:
+                            rc = comp.wait(120 * f)
+                            if rc != 0:
+                                errs.append(rc)
+                    except Exception as e:  # noqa: BLE001
+                        errs.append(e)
+
+                ts = [threading.Thread(target=worker, args=(ci,))
+                      for ci in range(n)]
+                t0 = time.perf_counter()
+                for t in ts:
+                    t.start()
+                for t in ts:
+                    t.join()
+                wall = time.perf_counter() - t0
+                assert not errs, \
+                    f"selftune {mode} rung {n} failed: {errs[:3]}"
+                rungs[str(n)] = round(
+                    n * per_client * obj_bytes / 2**20 / wall, 2)
+            if mode == "tuned":
+                # harvest the audit trail while the OSDs are alive:
+                # merged decision counts, final knob values, and any
+                # guard reasons the controller saw
+                counts = {"probe": 0, "kept": 0, "rolled_back": 0,
+                          "neutral": 0, "guard_trips": 0}
+                knobs_final = {}
+                guards = []
+                moved = set()
+                for o in c.osds.values():
+                    ret, _, d = o._exec_command(
+                        {"prefix": "dump_tuner"})
+                    if ret != 0:
+                        continue
+                    for k2, v in d["counts"].items():
+                        counts[k2] = counts.get(k2, 0) + v
+                    for kn in d["knobs"]:
+                        knobs_final.setdefault(kn["name"], {})[
+                            f"osd.{o.whoami}"] = kn["value"]
+                    for s in d["steps"]:
+                        if s.get("guard"):
+                            guards.append(s["guard"])
+                        if s["verdict"] == "kept":
+                            moved.add(s["knob"])
+                tuner_block = {
+                    "counts": counts,
+                    "guard_trips": counts.get("guard_trips", 0),
+                    "guards": guards,
+                    "knobs_kept": sorted(moved),
+                    "knobs_final": knobs_final}
+        sides[mode] = rungs
+    st, tn = sides["static"], sides["tuned"]
+    emit(f"cluster write MB/s at 16 concurrent clients, self-tuned "
+         f"(3-OSD k=2 m=1 tpu pool, per-OSD autotuner walking the "
+         f"batcher knobs live; full 1/4/16 ladder in the JSON "
+         f"record; baseline=the same ladder on static conf defaults "
+         f"{st['16']:.1f} MB/s)",
+         tn["16"], "MB/s", tn["16"] / st["16"] if st["16"] else 0.0)
+    rec = {
+        "metric": "closed-loop selftune attribution (static vs "
+                  "self-tuned 1/4/16-client ladder, 3-OSD k=2 m=1; "
+                  "value = tuned 16-client MB/s)",
+        "value": tn["16"], "unit": "MB/s",
+        "vs_baseline": round(tn["16"] / st["16"], 3)
+        if st["16"] else 0.0,
+        "ladder": {"static": st, "tuned": tn},
+        "tuner": tuner_block,
+    }
+    print(json.dumps(rec), flush=True)
+    # --assert-floor hands this to the perf_trend selftune gate
+    # (tuned >= static at every rung, zero guard trips)
+    _FLOOR_STATS["selftune_attribution"] = rec
+
+
 CONFIGS = {
     "roofline": bench_roofline,
     "rs_k2m1": lambda: bench_encode_rs(2, 1, 4 << 10, 1024),
@@ -2220,6 +2341,10 @@ EXTRA_CONFIGS = {
     # (ISSUE 13) — 200+ clients through multiple RGW gateways with
     # injected recovery contention and QoS-demotion acceptance
     "load": bench_load,
+    # opt-in (--only selftune): the closed-loop autotuner ladder
+    # (ISSUE 15) — static conf defaults vs the per-OSD controller
+    # walking the batcher knobs live, tuned >= static at every rung
+    "selftune": bench_selftune,
 }
 CONFIGS_ALL = dict(CONFIGS, **EXTRA_CONFIGS)
 
@@ -2314,7 +2439,9 @@ def main():
                 fresh_load=_FLOOR_STATS.get("load_attribution"),
                 fresh_rebuild=_FLOOR_STATS.get(
                     "rebuild_attribution"),
-                fresh_mesh=_FLOOR_STATS.get("multichip_mesh"))
+                fresh_mesh=_FLOOR_STATS.get("multichip_mesh"),
+                fresh_selftune=_FLOOR_STATS.get(
+                    "selftune_attribution"))
             for fnd in findings:
                 print(f"# --assert-floor perf-trend "
                       f"{fnd['severity'].upper()} [{fnd['check']}]: "

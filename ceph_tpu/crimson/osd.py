@@ -255,6 +255,10 @@ class CrimsonOSD(OSD):
         # identical; per-PG work they queue is routed to owner shards
         self.reactor.call_every(self.conf["osd_heartbeat_interval"],
                                 self._heartbeat_once)
+        # _tick_once carries the closed-loop tuner tick too
+        # (_maybe_tuner_tick): on crimson the controller runs as this
+        # shard-0 reactor timer, on classic as the tick thread — the
+        # same guarded hill-climb either way
         self.reactor.call_every(self.conf["osd_tick_interval"],
                                 self._tick_once)
         self.reactor.call_every(self._RECOVERY_TICK,

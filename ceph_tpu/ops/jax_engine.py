@@ -771,6 +771,21 @@ class StagingPool:
                     "host_bytes": self.host_bytes,
                     "host_bytes_peak": self.host_bytes_peak}
 
+    def set_depth(self, depth: int) -> None:
+        """Retarget the per-shape ring depth live (the
+        ``ec_tpu_staging_depth`` autotuner seam).  Raising it only
+        admits more allocations on future acquires; lowering it only
+        stops further growth — slots already made keep cycling
+        through the free lists untouched, so in-flight stagings (and
+        the encoded bytes) are unaffected.  Waiters are woken since a
+        deeper ring may unblock a stalled acquire."""
+        depth = max(1, int(depth))
+        with self._cv:
+            if depth == self.depth:
+                return
+            self.depth = depth
+            self._cv.notify_all()
+
     def ensure(self, shape: tuple) -> None:
         """Preallocate a full ring for ``shape`` (prewarm path)."""
         with self._cv:
@@ -875,6 +890,14 @@ class JaxBackend:
         self._mesh_sharding = None    # cached NamedSharding(dp, None, sp)
         self.mesh_events: list = []   # mesh_build records for the
                                       # flight recorder (batcher drains)
+
+    # -- staging ring ------------------------------------------------
+    def configure_staging(self, depth: int = 0) -> None:
+        """Apply the ``ec_tpu_staging_depth`` knob to the live
+        StagingPool (mirrors :meth:`configure_mesh`); 0 or negative
+        leaves the pool as built."""
+        if depth and depth > 0:
+            self.staging.set_depth(depth)
 
     # -- multichip mesh ----------------------------------------------
     def configure_mesh(self, n_devices: int = 0, sp: int = 0) -> None:

@@ -199,6 +199,10 @@ class EncodeBatcher:
                 return conf[k]
             except KeyError:
                 return d
+        # kept for the live-tuning seam: apply_tuning() re-reads the
+        # runtime-tunable knobs from here at safe points (collector
+        # loop top + OSD tuner tick) instead of latching them forever
+        self.conf = conf
         self.max_stripes = get("ec_tpu_batch_stripes", 1024)
         self.window_s = get("ec_tpu_queue_window_us", 200) / 1e6
         # admission-aware coalescing window: the effective window
@@ -779,9 +783,63 @@ class EncodeBatcher:
         return out
 
     # -- collector -------------------------------------------------------
+    def apply_tuning(self) -> None:
+        """Re-read the runtime-tunable knobs from conf and apply them
+        to the LIVE pipeline — no restart, bit-exact output (the
+        knobs only shape batching/overlap, never data).  Called at
+        the top of every collector cycle and from the OSD tuner tick,
+        so a ``conf.set(..., source="runtime")`` (operator or
+        autotuner) lands within one window:
+
+        * ``ec_tpu_queue_window_max_us`` — coalescing-window ceiling;
+          the dynamic window is re-clamped under ``_cond``.
+        * ``ec_tpu_inflight_groups`` — the bounded completion FIFO's
+          depth; ``queue.Queue`` checks ``maxsize`` under its own
+          mutex on every put, so resizing it there (+ waking blocked
+          putters) is the safe seam.
+        * ``ec_tpu_staging_depth`` — forwarded to the codec backend's
+          StagingPool (jax_engine) when one has been seen.
+        """
+        conf = self.conf
+        if conf is None:
+            return
+        def get(k, d):
+            try:
+                return conf[k]
+            except Exception:
+                return d
+        wmax = get("ec_tpu_queue_window_max_us", None)
+        if wmax is not None:
+            new_max = (wmax / 1e6) if wmax > 0 \
+                else max(self.window_base_s * 16, 0.02)
+            if new_max != self.window_max_s:
+                with self._cond:
+                    self.window_max_s = new_max
+                    self.dyn_window_s = max(
+                        min(self.dyn_window_s, new_max),
+                        min(self.window_base_s, new_max))
+        infl = get("ec_tpu_inflight_groups", None)
+        if infl is not None:
+            infl = max(1, int(infl))
+            if infl != self.inflight_groups:
+                self.inflight_groups = infl
+                q = self._completions
+                with q.mutex:
+                    q.maxsize = infl
+                    q.not_full.notify_all()
+        depth = get("ec_tpu_staging_depth", None)
+        backend = self._last_backend
+        if depth is not None and backend is not None and \
+                hasattr(backend, "configure_staging"):
+            try:
+                backend.configure_staging(int(depth))
+            except Exception:
+                pass
+
     def _run(self) -> None:
         while True:
             grew = False
+            self.apply_tuning()
             with self._cond:
                 while not self._queues and not self._stop:
                     self._cond.wait()

@@ -354,6 +354,47 @@ class OSD(Dispatcher):
         # the CPU-twin fallback keeps the op itself successful
         self.encode_batcher.on_decode_fault = \
             lambda: self.slo.note_error("recovery")
+        # closed-loop per-OSD tuner (utils/tuner.py, ROADMAP item 5):
+        # a guarded hill-climb over the Option-marked tunable batcher
+        # knobs, fed by the telemetry ladder (overlap engine, staging
+        # stalls, contention stalls, SLO burn) from _maybe_tuner_tick.
+        # Built even while osd_tuner_enable is off so the "tuner" perf
+        # subsystem and dump_tuner exist on every daemon.
+        from ..utils.tuner import Tuner, knobs_from_config
+        tuner_knobs = []
+        if hasattr(self.conf, "tunables"):
+            tuner_knobs = knobs_from_config(
+                self.conf,
+                # seeds give the 0-means-auto knobs a real first step
+                {"ec_tpu_queue_window_max_us": {"seed": 20000},
+                 "ec_tpu_inflight_groups": {},
+                 "ec_tpu_staging_depth": {},
+                 "osd_ec_pipeline_segment_bytes": {"seed": 1 << 20}},
+                pinned=self.conf["osd_tuner_pin"])
+        self.tuner = Tuner(
+            f"osd.{whoami}", tuner_knobs,
+            hysteresis=self.conf["osd_tuner_hysteresis"],
+            cooldown_ticks=self.conf["osd_tuner_cooldown_ticks"],
+            blacklist_ticks=self.conf["osd_tuner_blacklist_ticks"],
+            recorder=self.flight_recorder,
+            perf_coll=self.perf_coll)
+        self._tuner_ticks = 0
+        self._tuner_last = (None, 0)     # (monotonic, reqs) objective
+        self._tuner_last_overlap = None  # collapse-guard memory
+        # live mClock retune seam: the mgr tuner module (or an
+        # operator `config set`) changes an osd_mclock_scheduler_*
+        # option; the central config rides the next map epoch into
+        # this daemon's conf, whose observer pushes the new triples
+        # into every RUNNING shard queue (OpScheduler.set_qos) — no
+        # restart, no queue drain
+        if hasattr(self.conf, "add_observer"):
+            def _remclock(_name, _val):
+                self._reapply_mclock()
+            for _cls in ("client", "recovery", "scrub", "peering"):
+                for _part in ("res", "wgt", "lim"):
+                    self.conf.add_observer(
+                        f"osd_mclock_scheduler_{_cls}_{_part}",
+                        _remclock)
         from ..utils.tracer import Tracer
         self.tracer = Tracer(f"osd.{whoami}",
                              enabled=self.conf["osd_tracing"],
@@ -376,7 +417,7 @@ class OSD(Dispatcher):
                            "dump_critical_path", "dump_hops",
                            "dump_slo", "dump_trace",
                            "dump_profile", "dump_device",
-                           "dump_op_queue",
+                           "dump_op_queue", "dump_tuner",
                            "dump_health", "status",
                            "config get", "config set"):
                 self.admin_socket.register(
@@ -1023,6 +1064,10 @@ class OSD(Dispatcher):
                        "shards": [q.stats()
                                   for q in self._shard_queues],
                        "growth_ticks": self._opq_growth_ticks}
+            elif prefix == "dump_tuner":
+                out = self.tuner.dump()
+                out["enabled"] = bool(
+                    self.conf["osd_tuner_enable"])
             elif prefix == "dump_health":
                 out = self._health_dump()
             elif prefix == "status":
@@ -1334,6 +1379,103 @@ class OSD(Dispatcher):
         self._maybe_trim_pg_logs()
         self._maybe_cache_agent()
         self._maybe_reboot()
+        self._maybe_tuner_tick()
+
+    def _maybe_tuner_tick(self) -> None:
+        """Per-OSD closed-loop tuner tick (ROADMAP item 5).  Runs on
+        BOTH backends for free: the classic tick thread and the
+        crimson reactor timer share _tick_once.  Every
+        osd_tuner_interval_ticks ticks it feeds the controller one
+        (objective, signals, guard) sample — objective is EC requests
+        retired per second, signals are the overlap/waterfall/stall
+        ladder, the guard trips on SLO burn, an open device breaker,
+        or an overlap collapse — then re-applies the batcher's live
+        knobs so an accepted step lands within this tick."""
+        try:
+            if not self.conf["osd_tuner_enable"]:
+                return
+            interval = max(1, self.conf["osd_tuner_interval_ticks"])
+        except (KeyError, TypeError):
+            return
+        self._tuner_ticks += 1
+        if self._tuner_ticks % interval:
+            return
+        b = self.encode_batcher
+        now = time.monotonic()
+        reqs = b.reqs_total + b.dec_reqs
+        last_t, last_reqs = self._tuner_last
+        self._tuner_last = (now, reqs)
+        if last_t is None or now <= last_t:
+            return                   # first sample: baseline only
+        objective = (reqs - last_reqs) / (now - last_t)
+        signals, guard = self._tuner_signals()
+        self.tuner.step(objective, signals=signals, guard=guard)
+        b.apply_tuning()
+
+    def _tuner_signals(self):
+        """(signals, guard) for the controller: the observability
+        ladder collapsed to one cheap snapshot.  Must not raise —
+        a telemetry hiccup must never take down the tick."""
+        b = self.encode_batcher
+        signals = {}
+        guard = None
+        try:
+            from ..utils.device_ledger import overlap_stats
+            ov = overlap_stats(b.ledger_accum.recent())
+            frac = ov.get("pipeline_overlap_frac", 0.0)
+            signals["overlap_frac"] = frac
+            if ov.get("bounding_phase"):
+                signals["bounding_phase"] = ov["bounding_phase"]
+            ps = dict(b.ledger_accum.phase_seconds)
+            if ps:
+                signals["top_hop"] = max(ps, key=ps.get)
+            signals["staging_stalls"] = b._staging_stalls_seen
+            cperf = getattr(self.contention, "cperf", None)
+            if cperf is not None:
+                signals["contention_stalls"] = int(
+                    cperf.get("stalls"))
+            # guard 1: overlap collapse — a step that halves a
+            # previously healthy overlap is wrong no matter what the
+            # throughput sample says this tick
+            last = self._tuner_last_overlap
+            self._tuner_last_overlap = frac
+            if last is not None and last >= 0.25 and frac < 0.5 * last:
+                guard = "overlap_collapse"
+            # guard 2: SLO burn — any class consuming its error
+            # budget faster than allowed vetoes the current probe
+            for cls in self.slo.CLASSES:
+                burn = self.slo.burn(cls)
+                if burn > 1.0:
+                    signals[f"{cls}_burn"] = round(burn, 3)
+                    guard = f"slo_burn:{cls}"
+            # guard 3: an open device circuit breaker means the
+            # device is sick — never walk knobs on top of that
+            if b.device_dump().get("breaker_open"):
+                guard = "breaker_open"
+        except Exception:
+            pass
+        return signals, guard
+
+    def _reapply_mclock(self) -> None:
+        """Config-observer target for the osd_mclock_scheduler_*
+        options: push the current triples into every live shard
+        queue.  The mgr tuner module's `config set` lands here via
+        the central config riding the next map epoch."""
+        try:
+            if self.conf["osd_op_queue"] == "fifo":
+                return
+            from .scheduler import qos_from_conf
+            qos = qos_from_conf(self.conf)
+            changed = False
+            for sq in self._shard_queues:
+                changed = sq.set_qos(qos) or changed
+            if changed:
+                self.flight_recorder.note(
+                    "mclock_retune",
+                    **{cls: str(tuple(qos[cls]))
+                       for cls in sorted(qos)})
+        except Exception:
+            pass
 
     def _renotify_strays(self) -> None:
         """Stray copies (split children on the parent's holders,

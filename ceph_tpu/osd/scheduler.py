@@ -209,6 +209,42 @@ class OpScheduler:
                 return None
             return self._serve(name)
 
+    def set_qos(self, qos: Dict[str, Tuple[float, float, float]]
+                ) -> bool:
+        """Live mClock retune (the mgr tuner module's actuation seam):
+        update class (res, wgt, lim) triples on the RUNNING shard
+        queues without a restart or queue drain.  Queued items, token
+        buckets and deficit counters are preserved — only the rates
+        change, so the next ``_refill``/``_pick`` already schedules
+        under the new triples.  Returns True when anything changed.
+        No-op in fifo mode (QoS is ignored there anyway)."""
+        changed = False
+        with self._lock:
+            if self.fifo:
+                return False
+            for name, (res, wgt, lim) in qos.items():
+                self._qos[name] = (res, wgt, lim)
+                cq = self._classes.get(name)
+                if cq is None:
+                    cq = self._classes[name] = _ClassQueue(
+                        res, wgt, lim)
+                    changed = True
+                    continue
+                if (cq.res, cq.wgt, cq.lim) != (res, wgt, lim):
+                    cq.res = res
+                    cq.wgt = wgt
+                    cq.lim = lim
+                    # clamp stale burst credit to the new rates so a
+                    # demoted class cannot coast on old tokens
+                    cq.res_tokens = min(cq.res_tokens, res) \
+                        if res > 0 else 0.0
+                    if lim > 0:
+                        cq.lim_tokens = min(cq.lim_tokens, lim)
+                    changed = True
+            if changed:
+                self._lock.notify_all()
+        return changed
+
     def queued(self) -> int:
         """Total items queued across all classes (admission
         backpressure reads this without touching per-class detail)."""

@@ -37,6 +37,12 @@ class Option:
     max: Optional[float] = None
     enum_allowed: Tuple[str, ...] = ()
     see_also: Tuple[str, ...] = ()
+    # machine-readable autotuner marker (utils/tuner.py enumerates
+    # these instead of a hand-kept knob list; reference has no analog
+    # — the closest is options tagged ``runtime``).  A tunable option
+    # MUST carry finite min/max bounds so no controller step can walk
+    # it out of its safe range.
+    tunable: bool = False
 
     def validate(self, value: Any) -> Any:
         if self.type is bool and isinstance(value, str):
@@ -75,17 +81,19 @@ def _opts() -> List[Option]:
                "plugin=jerasure technique=reed_sol_van k=2 m=1",
                description="default profile for new EC pools"),
         # -- tpu codec batching (framework-specific) ----------------------
-        Option("ec_tpu_batch_stripes", int, 1024, min=1,
+        Option("ec_tpu_batch_stripes", int, 1024, min=1, max=1 << 20,
                description="stripes gathered per device call"),
-        Option("ec_tpu_queue_window_us", int, 200, min=0,
+        Option("ec_tpu_queue_window_us", int, 200, min=0, max=1_000_000,
                description="max microseconds a stripe waits for a batch"),
         Option("ec_tpu_queue_window_max_us", int, 0, min=0,
+               max=5_000_000, tunable=True,
                description="ceiling for the admission-aware coalescing "
                            "window (0 = auto: max(16x base, 20ms)); the "
                            "effective window doubles under sustained "
                            "queue pressure and shrinks back when the "
                            "queue drains"),
         Option("osd_ec_pipeline_segment_bytes", int, 2 << 20, min=0,
+               max=256 << 20, tunable=True,
                description="segment size for pipelined EC writes: an "
                            "aligned write larger than this is encoded "
                            "and fanned out segment-by-segment so the "
@@ -124,19 +132,33 @@ def _opts() -> List[Option]:
                            "(reference osd_op_queue)"),
         # dmClock triples (reference osd_mclock_scheduler_*): res =
         # guaranteed tokens/s, wgt = spare-capacity share, lim = cap
-        # (0 = none)
-        Option("osd_mclock_scheduler_client_res", float, 100.0),
-        Option("osd_mclock_scheduler_client_wgt", float, 100.0),
-        Option("osd_mclock_scheduler_client_lim", float, 0.0),
-        Option("osd_mclock_scheduler_recovery_res", float, 0.0),
-        Option("osd_mclock_scheduler_recovery_wgt", float, 10.0),
-        Option("osd_mclock_scheduler_recovery_lim", float, 0.0),
-        Option("osd_mclock_scheduler_scrub_res", float, 0.0),
-        Option("osd_mclock_scheduler_scrub_wgt", float, 5.0),
-        Option("osd_mclock_scheduler_scrub_lim", float, 0.0),
-        Option("osd_mclock_scheduler_peering_res", float, 50.0),
-        Option("osd_mclock_scheduler_peering_wgt", float, 50.0),
-        Option("osd_mclock_scheduler_peering_lim", float, 0.0),
+        # (0 = none).  Bounded [0, 1e6] so neither the operator nor
+        # the mgr tuner module can walk one negative or unbounded;
+        # wgt floors at 1 so no class can be starved to a zero share.
+        Option("osd_mclock_scheduler_client_res", float, 100.0,
+               min=0.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_client_wgt", float, 100.0,
+               min=1.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_client_lim", float, 0.0,
+               min=0.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_recovery_res", float, 0.0,
+               min=0.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_recovery_wgt", float, 10.0,
+               min=1.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_recovery_lim", float, 0.0,
+               min=0.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_scrub_res", float, 0.0,
+               min=0.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_scrub_wgt", float, 5.0,
+               min=1.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_scrub_lim", float, 0.0,
+               min=0.0, max=1e6, tunable=True),
+        Option("osd_mclock_scheduler_peering_res", float, 50.0,
+               min=0.0, max=1e6),
+        Option("osd_mclock_scheduler_peering_wgt", float, 50.0,
+               min=1.0, max=1e6),
+        Option("osd_mclock_scheduler_peering_lim", float, 0.0,
+               min=0.0, max=1e6),
         Option("crimson_conn_affinity", bool, True,
                description="re-pin a client connection's reactor to "
                            "the shard owning the majority of its PG "
@@ -245,11 +267,59 @@ def _opts() -> List[Option]:
                            "this (reference mds_beacon_grace)"),
         Option("mgr_enabled_modules", str,
                "prometheus restful dashboard balancer pg_autoscaler "
-               "alerts",
+               "alerts tuner",
                description="mgr modules to run (reference MgrMap "
                            "module list; edited by `ceph mgr module "
                            "enable/disable` through the central "
                            "config)"),
+        # -- closed-loop tuner (utils/tuner.py + mgr/modules/tuner.py) ----
+        Option("osd_tuner_enable", bool, False,
+               description="per-OSD closed-loop tuner: each OSD tick "
+                           "hill-climbs the tunable batcher/staging "
+                           "knobs from the device telemetry "
+                           "(pipeline_overlap_frac, bounding_phase, "
+                           "staging stalls, contention stalls).  Off "
+                           "by default so benches compare static vs "
+                           "tuned explicitly"),
+        Option("osd_tuner_interval_ticks", int, 2, min=1, max=1000,
+               description="run the per-OSD tuner controller every N "
+                           "housekeeping ticks (one tick = "
+                           "osd_tick_interval seconds)"),
+        Option("osd_tuner_cooldown_ticks", int, 1, min=0, max=1000,
+               description="controller ticks to sit still after a "
+                           "knob move so its effect lands in the "
+                           "signals before the next decision"),
+        Option("osd_tuner_blacklist_ticks", int, 8, min=1, max=10000,
+               description="after a guarded rollback, the reverted "
+                           "(knob, direction) pair is blacklisted for "
+                           "this many controller ticks"),
+        Option("osd_tuner_hysteresis", float, 0.05, min=0.0, max=1.0,
+               description="relative objective deadband: a step is "
+                           "kept only if the objective improves by "
+                           "more than this fraction, reverted only if "
+                           "it regresses by more (prevents "
+                           "oscillation on a noisy plateau)"),
+        Option("osd_tuner_pin", str, "",
+               description="space/comma-joined tunable option names "
+                           "the tuner must never move (operator "
+                           "opt-out; a pinned knob keeps its "
+                           "configured value)"),
+        Option("mgr_tuner_mode", str, "act",
+               enum_allowed=("off", "advisory", "act"),
+               description="cluster tuner mgr module: 'act' applies "
+                           "mClock res/wgt retunes through the "
+                           "central config (the balancer/"
+                           "pg_autoscaler pattern, but defaulting to "
+                           "act), 'advisory' only records what it "
+                           "would do, 'off' disables the loop"),
+        Option("mgr_tuner_burn_high", float, 1.0, min=0.0,
+               description="SLO burn (1.0 = consuming the whole error "
+                           "budget) above which the client class is "
+                           "considered under pressure and recovery "
+                           "is demoted"),
+        Option("mgr_tuner_burn_low", float, 0.25, min=0.0,
+               description="client burn below which a lagging rebuild "
+                           "may be promoted (recovery weight raised)"),
         Option("mgr_pg_autoscale_mode", str, "off",
                enum_allowed=("off", "on"),
                description="apply pg_autoscaler recommendations (grow "
@@ -370,12 +440,20 @@ def _opts() -> List[Option]:
                            "waiting out the 1-in-N probe tick — a "
                            "learned CPU bias must not outlive the "
                            "condition that taught it (0 disables)"),
-        Option("ec_tpu_inflight_groups", int, 2, min=1,
+        Option("ec_tpu_inflight_groups", int, 2, min=1, max=64,
+               tunable=True,
                description="encode groups in flight per batcher: the "
                            "collector dispatches window N+1 while the "
                            "completion worker joins window N, so h2d "
                            "staging overlaps fanout (bounded FIFO; "
                            "continuations stay in submission order)"),
+        Option("ec_tpu_staging_depth", int, 2, min=1, max=32,
+               tunable=True,
+               description="pinned host staging buffers per shape in "
+                           "the jax_engine StagingPool ring; deeper "
+                           "rings absorb h2d bursts at the cost of "
+                           "pinned host memory (the pool still grows "
+                           "one emergency slot on a sustained stall)"),
         Option("ec_tpu_mesh_devices", int, 0, min=0,
                description="devices in the encode/decode dispatch "
                            "mesh: 0 = auto (every visible JAX device "
@@ -756,6 +834,13 @@ class Config:
         with self._lock:
             return {name: self.get(name) for name in sorted(self.schema)
                     if self.get(name) != self.schema[name].default}
+
+    def tunables(self) -> List[Option]:
+        """Options carrying the machine-readable ``tunable`` marker —
+        the autotuner's knob universe (utils/tuner.py enumerates this
+        instead of keeping its own list)."""
+        with self._lock:
+            return [o for o in self.schema.values() if o.tunable]
 
 
 def apply_cluster_config_overrides(conf: "Config",

@@ -520,3 +520,152 @@ def test_dump_op_queue_admin_round_trip(cl):
         assert out["growth_ticks"] >= 0
         client_served += classes["client"]["served"]
     assert client_served > 0, "fixture ops never rode the scheduler"
+
+
+# ------------------------------------------- ISSUE 15: closed-loop tuner
+def test_dump_tuner_admin_round_trip(cl):
+    """Every OSD answers dump_tuner (the controller is built even when
+    disabled, so the audit surface always exists): knob universe from
+    the Option schema with bounds attached, counters, decision ring."""
+    for osd_id in range(3):
+        ret, _, out = cl.osds[osd_id]._exec_command(
+            {"prefix": "dump_tuner"})
+        assert ret == 0
+        assert out["name"] == f"osd.{osd_id}"
+        assert out["enabled"] is False           # default off
+        names = {k["name"] for k in out["knobs"]}
+        assert names == {"ec_tpu_queue_window_max_us",
+                         "ec_tpu_inflight_groups",
+                         "ec_tpu_staging_depth",
+                         "osd_ec_pipeline_segment_bytes"}
+        for k in out["knobs"]:
+            assert k["min"] is not None and k["max"] is not None
+            assert k["min"] <= k["value"] <= k["max"], k
+        assert out["counts"]["probe"] == 0       # disabled: no walks
+        assert out["steps"] == []
+        assert out["blacklist"] == []
+
+
+def test_prometheus_tuner_family(cl):
+    """The tuner perf subsystem rides the standard scrape: counter +
+    gauge families typed correctly, knob count visible per daemon."""
+    host, port = cl.mgr.http_addr
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5).read().decode()
+        if 'ceph_tuner_steps{daemon="osd.0"}' in body:
+            break
+        time.sleep(0.3)
+    else:
+        raise TimeoutError("metrics never included tuner counters")
+    assert "# TYPE ceph_tuner_steps counter" in body
+    assert "# TYPE ceph_tuner_rolled_back counter" in body
+    assert "# TYPE ceph_tuner_guard_trips counter" in body
+    assert "# TYPE ceph_tuner_objective_now gauge" in body
+    assert "# TYPE ceph_tuner_knobs_now gauge" in body
+    assert "# TYPE ceph_tuner_probing_now gauge" in body
+    assert 'ceph_tuner_knobs_now{daemon="osd.0"} 4' in body
+
+
+def _tuner_module_host(wgt=10.0, mode="act"):
+    """Stub Manager for pure-logic mgr tuner module tests: conf dict,
+    a monc whose `config set` lands back in conf (the map ride), and
+    synthetic SLO burn gauges (permille, as in perf dumps)."""
+    class _Monc:
+        def __init__(self, host):
+            self.host = host
+            self.cmds = []
+
+        def command(self, cmd, timeout):
+            self.cmds.append(cmd)
+            if cmd.get("prefix") == "config set":
+                self.host.conf[cmd["name"]] = float(cmd["value"])
+            return 0, "", {}
+
+    class _Host:
+        def __init__(self):
+            self.conf = {
+                "mgr_tuner_mode": mode,
+                "mgr_tuner_burn_high": 1.0,
+                "mgr_tuner_burn_low": 0.25,
+                "osd_mclock_scheduler_recovery_wgt": wgt,
+            }
+            self.burns = {"client": 0.0, "recovery": 0.0}
+            self.monc = _Monc(self)
+
+        def _module_get(self, what):
+            assert what == "perf_counters"
+            return {"osd.0": {"slo": {
+                "client_write_burn_now": self.burns["client"] * 1000,
+                "client_read_burn_now": 0,
+                "recovery_burn_now": self.burns["recovery"] * 1000,
+            }}}
+
+    return _Host()
+
+
+def test_mgr_tuner_module_demote_promote_restore():
+    from ceph_tpu.mgr.modules.tuner import Module as TunerModule
+    host = _tuner_module_host(wgt=10.0)
+    mod = TunerModule(host)
+
+    # clients burning error budget -> recovery weight halves
+    host.burns["client"] = 2.0
+    mod._tick()
+    assert host.conf["osd_mclock_scheduler_recovery_wgt"] == 5.0
+    assert host.monc.cmds[-1]["prefix"] == "config set"
+    # cooldown: nothing moves even though burn persists
+    for _ in range(3):
+        mod._tick()
+    assert host.conf["osd_mclock_scheduler_recovery_wgt"] == 5.0
+    mod._tick()                                  # cooldown expired
+    assert host.conf["osd_mclock_scheduler_recovery_wgt"] == 2.5
+
+    # both calm -> drift back toward the 10.0 baseline, additively
+    host.burns["client"] = 0.0
+    for _ in range(40):
+        mod._tick()
+    assert host.conf["osd_mclock_scheduler_recovery_wgt"] == 10.0
+
+    # rebuild lagging, clients idle -> promote past the baseline
+    host.burns["recovery"] = 1.5
+    for _ in range(4):
+        mod._tick()
+    assert host.conf["osd_mclock_scheduler_recovery_wgt"] == 15.0
+
+    steps = mod.handle_command({})[2]["steps"]
+    actions = [s["action"] for s in steps]
+    assert actions[0] == "demote_recovery"
+    assert "restore_recovery" in actions
+    assert actions[-1] == "promote_recovery"
+    assert all(s["applied"] for s in steps)
+
+
+def test_mgr_tuner_module_advisory_and_operator_override():
+    from ceph_tpu.mgr.modules.tuner import Module as TunerModule
+
+    # advisory mode records the decision but never issues config set
+    host = _tuner_module_host(wgt=10.0, mode="advisory")
+    mod = TunerModule(host)
+    host.burns["client"] = 2.0
+    mod._tick()
+    assert host.monc.cmds == []
+    assert host.conf["osd_mclock_scheduler_recovery_wgt"] == 10.0
+    steps = mod.handle_command({})[2]["steps"]
+    assert steps and steps[0]["applied"] is False
+
+    # act mode: an operator override re-baselines instead of being
+    # "restored" away
+    host2 = _tuner_module_host(wgt=10.0)
+    mod2 = TunerModule(host2)
+    mod2._tick()                                 # calm: baseline=10
+    host2.burns["client"] = 2.0
+    mod2._tick()                                 # demote 10 -> 5
+    assert host2.conf["osd_mclock_scheduler_recovery_wgt"] == 5.0
+    host2.burns["client"] = 0.0
+    host2.conf["osd_mclock_scheduler_recovery_wgt"] = 3.0  # operator
+    for _ in range(10):
+        mod2._tick()
+    # 3.0 is the new baseline: calm ticks must NOT walk it back up
+    assert host2.conf["osd_mclock_scheduler_recovery_wgt"] == 3.0

@@ -345,3 +345,33 @@ def test_device_dump_13_daemons_stays_interactive():
         f"(ceiling {DEVICE_DUMP_CEILING * 1e3:.0f}ms)"
     assert merged["groups"] == 13 * depth
     assert merged["overlap"]["pipeline_overlap_frac"] >= 0.0
+
+
+# ISSUE 15 puts the autotuner's step() on every OSD tick: the common
+# case (cooldown / idle / plateau-neutral verdicts) must stay in the
+# same class as the other always-on instrumentation, or the control
+# plane taxes the data plane it is tuning.
+TUNE_STEP_CEILING = 20e-6
+
+
+def test_tuner_step_is_cheap():
+    from ceph_tpu.utils.flight_recorder import FlightRecorder
+    from ceph_tpu.utils.perf import PerfCountersCollection
+    from ceph_tpu.utils.tuner import KnobSpec, Tuner
+
+    cell = {"v": 8}
+    knob = KnobSpec("k", 1, 64, True,
+                    get=lambda: cell["v"],
+                    set=lambda v: cell.__setitem__("v", v))
+    t = Tuner("guard", [knob], hysteresis=0.05, cooldown_ticks=0,
+              recorder=FlightRecorder(capacity=256, name="guard"),
+              perf_coll=PerfCountersCollection())
+    # flat objective -> probe/neutral alternation: every tick does
+    # full bookkeeping (flight note + perf + ring append)
+    cost = _per_op(lambda: t.step(1000.0,
+                                  signals={"overlap_frac": 0.5}))
+    assert cost < TUNE_STEP_CEILING, \
+        f"tuner step costs {cost * 1e6:.2f}us/op " \
+        f"(ceiling {TUNE_STEP_CEILING * 1e6:.0f}us)"
+    t.step(1000.0)                        # settle any half-open probe
+    assert cell["v"] == 8                 # plateau never walked

@@ -375,3 +375,77 @@ def test_overlap_gate_runs_from_cli(tmp_path, history):
          "--overlap-tol", "0"],
         capture_output=True, text=True)
     assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+# ------------------------------------------ ISSUE 15: selftune gate
+def _selftune_rec(static=None, tuned=None, trips=0, guards=()):
+    return {"metric": "closed-loop selftune attribution (static vs "
+                      "self-tuned 1/4/16-client ladder, 3-OSD k=2 "
+                      "m=1; value = tuned 16-client MB/s)",
+            "value": (tuned or {}).get("16", 0.0), "unit": "MB/s",
+            "vs_baseline": 1.0,
+            "ladder": {"static": static or
+                       {"1": 20.0, "4": 30.0, "16": 25.0},
+                       "tuned": tuned or
+                       {"1": 21.0, "4": 32.0, "16": 27.0}},
+            "tuner": {"counts": {"probe": 6, "kept": 2,
+                                 "rolled_back": 1, "neutral": 3,
+                                 "guard_trips": trips},
+                      "guard_trips": trips,
+                      "guards": list(guards),
+                      "knobs_kept": ["ec_tpu_inflight_groups"],
+                      "knobs_final": {}}}
+
+
+def test_selftune_gate_passes_when_tuned_holds_every_rung(history):
+    rounds = perf_trend.load_history(history)
+    assert perf_trend.check(None, rounds,
+                            fresh_selftune=_selftune_rec()) == []
+
+
+def test_selftune_gate_fails_on_lost_rung(history):
+    rounds = perf_trend.load_history(history)
+    findings = perf_trend.check(
+        None, rounds,
+        fresh_selftune=_selftune_rec(
+            tuned={"1": 21.0, "4": 32.0, "16": 20.0}))
+    assert [f["check"] for f in findings] == ["selftune-regression"]
+    assert "16-client rung" in findings[0]["message"]
+    # equality is NOT a regression: worst case is "changed nothing"
+    assert perf_trend.check(
+        None, rounds,
+        fresh_selftune=_selftune_rec(
+            tuned={"1": 20.0, "4": 30.0, "16": 25.0})) == []
+
+
+def test_selftune_gate_fails_on_guard_trips(history):
+    rounds = perf_trend.load_history(history)
+    findings = perf_trend.check(
+        None, rounds,
+        fresh_selftune=_selftune_rec(trips=2,
+                                     guards=["slo_burn:client_write",
+                                             "overlap_collapse"]))
+    assert [f["check"] for f in findings] == ["selftune-guard-trip"]
+    assert "slo_burn:client_write" in findings[0]["message"]
+
+
+def test_selftune_gate_runs_from_cli(tmp_path, history):
+    # the record rides a raw bench log next to the k8m4 metrics and
+    # run() picks it up by prefix
+    bad = tmp_path / "fresh.json"
+    bad.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(1.05),
+        _attribution({"queue_wait": 1.1, "encode": 2.1,
+                      "commit": 2.9}, 0.97),
+        _selftune_rec(tuned={"1": 5.0, "4": 32.0, "16": 27.0}))))
+    r = _run_cli(bad, history)
+    assert r.returncode == 1
+    assert "selftune-regression" in r.stdout
+    good = tmp_path / "fresh_ok.json"
+    good.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(1.05),
+        _attribution({"queue_wait": 1.1, "encode": 2.1,
+                      "commit": 2.9}, 0.97),
+        _selftune_rec())))
+    r = _run_cli(good, history)
+    assert r.returncode == 0, (r.stdout, r.stderr)

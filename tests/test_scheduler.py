@@ -165,6 +165,33 @@ def test_client_latency_under_recovery_load():
         c.wait_for_clean(60)     # and recovery still finishes
 
 
+def test_set_qos_live_retune_preserves_queue():
+    """ISSUE 15: the mgr tuner module's actuation seam — ``set_qos``
+    on a RUNNING queue changes the weighted split without dropping a
+    single queued item, and the clamped burst credit means a demoted
+    class cannot coast on stale tokens."""
+    s = OpScheduler({"recovery": (0, 10, 0), "scrub": (0, 5, 0)})
+    for i in range(600):
+        s.enqueue("recovery", i)
+        s.enqueue("scrub", i)
+    first = drain(s, 150)
+    # 10:5 -> recovery dominates the first window
+    assert first.count("recovery") > first.count("scrub")
+    # live demote recovery 10 -> 1 (the module's halving walk, twice
+    # over) while 800+ items are still queued
+    assert s.set_qos({"recovery": (0.0, 1.0, 0.0)}) is True
+    assert s.set_qos({"recovery": (0.0, 1.0, 0.0)}) is False  # no-op
+    second = drain(s, 300)
+    # 1:5 -> scrub now dominates; deficit rounding gets slack
+    ratio = second.count("scrub") / max(second.count("recovery"), 1)
+    assert ratio > 2.0, (second.count("scrub"),
+                         second.count("recovery"))
+    # nothing was lost across the retune
+    rest = drain(s, 2000)
+    assert len(first) + len(second) + len(rest) == 1200
+    s.close()
+
+
 @pytest.mark.parametrize("backend", ["classic", "crimson"])
 def test_qos_demotes_recovery_without_client_burn(backend):
     """Live contention on BOTH backends (ISSUE 13 satellite): with the

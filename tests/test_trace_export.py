@@ -207,3 +207,30 @@ def test_trace_export_live_ec_write_degraded_read():
             evs = export_bundles(bundles)["traceEvents"]
         assert cs, "no reactor utilization counters in the export"
         assert any(e["name"].endswith("_loop_lag_ms") for e in cs)
+
+
+def test_tune_step_events_get_their_own_lane():
+    """ISSUE 15: autotuner decisions export as instants on a dedicated
+    'tuner decisions' track (tid 800), named verdict:knob, instead of
+    drowning in the generic flight-recorder lane."""
+    b = _synthetic_bundle("osd.0")
+    b["flight"]["events"].append(
+        {"time": 1000.02, "mono": 2.0, "kind": "tune_step",
+         "tuner": "osd.0", "knob": "ec_tpu_inflight_groups",
+         "dir": 1, "old": 2, "new": 3, "verdict": "kept",
+         "objective": 123.4})
+    trace = export_bundles([b])
+    evs = trace["traceEvents"]
+    tune = [e for e in evs if e["ph"] == "i" and e["cat"] == "tuner"]
+    assert len(tune) == 1
+    assert tune[0]["name"] == "kept:ec_tpu_inflight_groups"
+    assert tune[0]["tid"] == 800
+    assert tune[0]["args"]["verdict"] == "kept"
+    assert tune[0]["args"]["old"] == 2 and tune[0]["args"]["new"] == 3
+    # the generic flight lane still carries the non-tuner instants
+    flight = [e for e in evs
+              if e["ph"] == "i" and e["cat"] == "flight"]
+    assert {e["name"] for e in flight} == {"lock_stall"}
+    tn = {e["args"]["name"] for e in evs
+          if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "tuner decisions" in tn

@@ -1,0 +1,364 @@
+"""Closed-loop autotuner tests (ISSUE 15).
+
+Controller core (utils/tuner.py) under a deterministic synthetic-
+signal harness: hill-climb convergence, hysteresis (no oscillation on
+a noisy plateau), guarded rollback + direction blacklist on injected
+regression, guard-signal veto, pinning.  Plus the live-mutation seams:
+every runtime-tunable batcher knob is flipped on a LIVE EncodeBatcher
+mid-stream and the output must stay bit-exact with the synchronous
+ecutil.encode path, and StagingPool depth retargets without touching
+in-flight slots.
+"""
+import os
+import threading
+
+import pytest
+
+from ceph_tpu.utils.config import Config
+from ceph_tpu.utils.tuner import (VERDICT_KEPT, VERDICT_NEUTRAL,
+                                  VERDICT_PROBE, VERDICT_ROLLED_BACK,
+                                  KnobSpec, Tuner, knobs_from_config)
+
+
+def make_knob(name="k", lo=1, hi=64, init=2, is_int=True, **kw):
+    """A KnobSpec over a plain cell, returned with the cell so tests
+    can read/poke the 'live' value."""
+    cell = {"v": init}
+    spec = KnobSpec(name, lo, hi, is_int,
+                    get=lambda: cell["v"],
+                    set=lambda v: cell.__setitem__("v", v), **kw)
+    return spec, cell
+
+
+def drive(tuner, objective_of, n):
+    """n controller ticks; objective is a pure function of the live
+    knob values at tick time (the deterministic synthetic plant)."""
+    records = []
+    for _ in range(n):
+        rec = tuner.step(objective_of())
+        if rec is not None:
+            records.append(rec)
+    return records
+
+
+# -- control law ------------------------------------------------------
+
+def test_hill_climb_converges_to_optimum():
+    """Throughput rises with the knob up to 8 then falls: the
+    controller must climb 2 -> 8 and hold there (kept on the way up,
+    rollbacks past the peak, neutral/quiet at the plateau)."""
+    spec, cell = make_knob(init=2)
+    t = Tuner("t", [spec], hysteresis=0.02, cooldown_ticks=0,
+              blacklist_ticks=4)
+
+    def objective():
+        v = cell["v"]
+        return 100.0 * min(v, 8) - 60.0 * max(0, v - 8)
+
+    recs = drive(t, objective, 60)
+    assert cell["v"] == 8, f"expected convergence to 8, at {cell['v']}"
+    assert t.counts[VERDICT_KEPT] >= 3          # climbed, not jumped
+    assert t.counts[VERDICT_ROLLED_BACK] >= 1   # found the cliff
+    verdicts = {r["verdict"] for r in recs}
+    assert VERDICT_PROBE in verdicts
+    # bounds were never violated at any point of the walk
+    assert all(spec.lo <= r["new"] <= spec.hi for r in recs)
+
+
+def test_noisy_plateau_does_not_oscillate():
+    """Objective noise inside the hysteresis deadband must read as
+    neutral: no kept, no rollback/blacklist, knob restored after every
+    probe -- i.e. the controller doesn't random-walk a flat system."""
+    spec, cell = make_knob(init=8)
+    t = Tuner("t", [spec], hysteresis=0.05, cooldown_ticks=0,
+              blacklist_ticks=4)
+    noise = [0.0, +0.02, -0.02, +0.01, -0.015, +0.005]
+    i = [0]
+
+    def objective():
+        i[0] += 1
+        return 1000.0 * (1.0 + noise[i[0] % len(noise)])
+
+    drive(t, objective, 40)
+    assert cell["v"] == 8, "plateau walk moved the knob"
+    assert t.counts[VERDICT_KEPT] == 0
+    assert t.counts[VERDICT_ROLLED_BACK] == 0
+    assert t.counts[VERDICT_NEUTRAL] == t.counts[VERDICT_PROBE] > 0
+    assert t.dump()["blacklist"] == []
+
+
+def test_injected_regression_rolls_back_and_blacklists():
+    """Any move off 8 tanks the objective: both directions must be
+    probed at most once, rolled back (value restored), blacklisted,
+    and the controller then holds still until the blacklist expires."""
+    spec, cell = make_knob(init=8)
+    t = Tuner("t", [spec], hysteresis=0.02, cooldown_ticks=0,
+              blacklist_ticks=100)
+
+    def objective():
+        return 800.0 if cell["v"] == 8 else 100.0
+
+    drive(t, objective, 30)
+    assert cell["v"] == 8, "regressing probe was not rolled back"
+    assert t.counts[VERDICT_ROLLED_BACK] == 2   # once per direction
+    assert t.counts[VERDICT_KEPT] == 0
+    d = t.dump()
+    assert {(b["knob"], b["dir"]) for b in d["blacklist"]} == \
+        {("k", +1), ("k", -1)}
+    # fully blacklisted: no further probes happen
+    assert t.counts[VERDICT_PROBE] == 2
+
+
+def test_blacklist_expires_and_reprobes():
+    spec, cell = make_knob(init=8)
+    t = Tuner("t", [spec], hysteresis=0.02, cooldown_ticks=0,
+              blacklist_ticks=3)
+
+    def objective():
+        return 800.0 if cell["v"] == 8 else 100.0
+
+    drive(t, objective, 12)
+    assert t.counts[VERDICT_PROBE] > 2, \
+        "blacklist never expired -> knob never re-probed"
+    assert cell["v"] == 8                        # still guarded
+
+
+def test_guard_trip_forces_rollback():
+    """A probe that improves the objective but trips a guard signal
+    (SLO burn, overlap collapse) must still be reverted + counted."""
+    spec, cell = make_knob(init=4)
+    t = Tuner("t", [spec], hysteresis=0.02, cooldown_ticks=0)
+    rec = t.step(100.0)                          # probe applied
+    assert rec["verdict"] == VERDICT_PROBE
+    assert cell["v"] != 4
+    rec = t.step(500.0, guard="slo_burn:client") # better, but tripped
+    assert rec["verdict"] == VERDICT_ROLLED_BACK
+    assert rec["guard"] == "slo_burn:client"
+    assert cell["v"] == 4
+    assert t.counts["guard_trips"] == 1
+    # and a standing guard stops NEW probes from starting at all
+    assert t.step(500.0, guard="overlap_collapse") is None
+
+
+def test_idle_system_is_left_alone():
+    """objective <= 0 (no traffic) must never start a probe."""
+    spec, cell = make_knob(init=4)
+    t = Tuner("t", [spec], cooldown_ticks=0)
+    for _ in range(10):
+        assert t.step(0.0) is None
+    assert cell["v"] == 4
+    assert t.counts[VERDICT_PROBE] == 0
+
+
+def test_pinned_knob_is_never_touched():
+    pinned, pcell = make_knob(name="p", init=4, pinned=True)
+    free, fcell = make_knob(name="f", init=4)
+    t = Tuner("t", [pinned, free], hysteresis=0.02, cooldown_ticks=0)
+    drive(t, lambda: 100.0 + fcell["v"], 20)
+    assert pcell["v"] == 4, "pinned knob moved"
+    assert t.counts[VERDICT_PROBE] > 0           # free knob still walked
+
+
+def test_cooldown_spaces_decisions():
+    spec, cell = make_knob(init=4)
+    t = Tuner("t", [spec], hysteresis=0.02, cooldown_ticks=2)
+    assert t.step(100.0)["verdict"] == VERDICT_PROBE
+    assert t.step(100.0) is None                 # settling
+    assert t.step(100.0) is None
+    assert t.step(200.0)["verdict"] == VERDICT_KEPT
+
+
+def test_zero_auto_knob_seeds_up_and_never_goes_negative():
+    spec, cell = make_knob(init=0, lo=0, hi=100, seed=20)
+    t = Tuner("t", [spec], hysteresis=0.02, cooldown_ticks=0)
+    rec = t.step(100.0)
+    assert rec["verdict"] == VERDICT_PROBE and rec["new"] == 20
+    t.step(10.0)                                 # regress: roll back
+    assert cell["v"] == 0
+    # down from 0 is unproposable; up is blacklisted -> hold
+    assert t.step(100.0) is None
+
+
+def test_dump_shape_and_audit_ring():
+    spec, cell = make_knob(init=4)
+    t = Tuner("osd.0", [spec], cooldown_ticks=0)
+    t.step(100.0)
+    t.step(200.0)
+    d = t.dump()
+    assert d["name"] == "osd.0"
+    assert d["knobs"][0]["name"] == "k"
+    assert d["knobs"][0]["min"] == 1 and d["knobs"][0]["max"] == 64
+    assert d["counts"][VERDICT_PROBE] == 1
+    assert len(d["steps"]) == 2
+    assert d["steps"][0]["verdict"] == VERDICT_PROBE
+    assert d["steps"][1]["verdict"] == VERDICT_KEPT
+
+
+# -- knob universe from the Option schema -----------------------------
+
+def test_every_tunable_option_has_finite_bounds():
+    """Satellite 1's audit, as a standing invariant: an Option marked
+    tunable without finite min/max is a schema bug the controller
+    would otherwise walk off a cliff."""
+    conf = Config()
+    tunables = conf.tunables()
+    assert len(tunables) >= 4
+    for opt in tunables:
+        assert opt.min is not None and opt.max is not None, \
+            f"tunable option {opt.name} lacks finite min/max bounds"
+        assert opt.min < opt.max, opt.name
+    names = {o.name for o in tunables}
+    assert {"ec_tpu_queue_window_max_us", "ec_tpu_inflight_groups",
+            "ec_tpu_staging_depth",
+            "osd_ec_pipeline_segment_bytes"} <= names
+    # QoS triples for the mgr half; peering deliberately NOT tunable
+    assert "osd_mclock_scheduler_recovery_wgt" in names
+    assert "osd_mclock_scheduler_peering_wgt" not in names
+
+
+def test_knobs_from_config_live_set_and_pinning():
+    conf = Config()
+    knobs = knobs_from_config(
+        conf,
+        {"ec_tpu_inflight_groups": {},
+         "ec_tpu_staging_depth": {},
+         "ec_tpu_queue_window_max_us": {"seed": 20000}},
+        pinned="ec_tpu_staging_depth, ec_tpu_queue_window_max_us")
+    by = {k.name: k for k in knobs}
+    assert len(by) == 3
+    assert by["ec_tpu_staging_depth"].pinned
+    assert by["ec_tpu_queue_window_max_us"].pinned
+    assert by["ec_tpu_queue_window_max_us"].seed == 20000
+    infl = by["ec_tpu_inflight_groups"]
+    assert not infl.pinned and infl.is_int
+    old = infl.get()
+    infl.set(old + 1)                    # through Config.set(runtime)
+    assert conf["ec_tpu_inflight_groups"] == old + 1
+    # Option bounds arrived in the spec: the controller's clamp range
+    assert infl.lo >= 1 and infl.hi <= 64
+
+
+def test_knobs_from_config_skips_unbounded_tunable():
+    """Defense in depth: even if a schema slips an unbounded tunable
+    in, knobs_from_config refuses to walk it."""
+    conf = Config()
+    with conf._lock:
+        opt = conf.schema["ec_tpu_inflight_groups"]
+    import dataclasses
+    bad = dataclasses.replace(opt, max=None)
+    try:
+        with conf._lock:
+            conf.schema["ec_tpu_inflight_groups"] = bad
+        knobs = knobs_from_config(conf,
+                                  {"ec_tpu_inflight_groups": {}})
+        assert knobs == []
+    finally:
+        with conf._lock:
+            conf.schema["ec_tpu_inflight_groups"] = opt
+
+
+# -- live-mutation seams (satellite 2): bit-exact mid-stream ----------
+
+def test_live_knob_mutation_keeps_output_bit_exact():
+    """Flip every runtime-tunable batcher knob on a LIVE batcher in
+    the middle of an encode stream; every op's chunk map must stay
+    bit-identical to the synchronous ecutil.encode path."""
+    from ceph_tpu.ec import registry as ecreg
+    from ceph_tpu.osd import ecutil
+    from ceph_tpu.osd.batcher import EncodeBatcher
+
+    conf = {"ec_tpu_batch_stripes": 1024,
+            "ec_tpu_queue_window_us": 2_000,
+            "ec_tpu_queue_window_max_us": 30_000,
+            "ec_tpu_inflight_groups": 4,
+            "ec_tpu_staging_depth": 2}
+    EncodeBatcher.reset_learning()
+    codec = ecreg.instance().factory(
+        "tpu", {"k": "2", "m": "1", "technique": "reed_sol_van"})
+    b = EncodeBatcher(conf)
+    try:
+        sinfo = ecutil.StripeInfo(2, 8192)
+        n_ops = 24
+        datas = [os.urandom((1 + i % 3) * 8192) for i in range(n_ops)]
+        got = {}
+        done = threading.Event()
+        lock = threading.Lock()
+
+        def cb(i):
+            def _cb(chunks):
+                with lock:
+                    got[i] = chunks
+                    if len(got) == n_ops:
+                        done.set()
+            return _cb
+
+        # mutation schedule: hit each knob mid-stream, twice (up and
+        # down) so both resize directions run against live traffic
+        mutations = {
+            6: ("ec_tpu_inflight_groups", 1),
+            10: ("ec_tpu_queue_window_max_us", 500),
+            14: ("ec_tpu_staging_depth", 8),
+            18: ("ec_tpu_inflight_groups", 16),
+            20: ("ec_tpu_queue_window_max_us", 100_000),
+            22: ("ec_tpu_staging_depth", 1),
+        }
+        for i, data in enumerate(datas):
+            if i in mutations:
+                key, val = mutations[i]
+                conf[key] = val          # a runtime conf.set
+            b.submit(codec, sinfo, data, cb(i))
+        assert done.wait(60), f"stream stalled: {len(got)}/{n_ops}"
+        for i, data in enumerate(datas):
+            assert got[i] == ecutil.encode(sinfo, codec, data), \
+                f"op {i} chunks diverged after live knob mutation"
+        # the seams actually latched the final values
+        b.apply_tuning()
+        assert b.inflight_groups == 16
+        assert b._completions.maxsize == 16
+        assert b.window_max_s == pytest.approx(0.1)
+    finally:
+        b.stop()
+
+
+def test_staging_pool_set_depth_live():
+    """Raising depth admits new slots; lowering stops growth without
+    touching slots already in flight (bit-exactness by construction:
+    buffers are never resized or freed under a writer)."""
+    from ceph_tpu.ops.jax_engine import StagingPool
+    pool = StagingPool(depth=2)
+    shape = (1, 2, 512)
+    a = pool.acquire(shape)
+    bslot = pool.acquire(shape)
+    assert pool.allocs == 2
+    pool.set_depth(4)
+    c = pool.acquire(shape)              # third slot now admitted
+    assert pool.allocs == 3
+    pool.set_depth(1)                    # shrink target below live
+    host_a = a.host
+    pool.release(shape, a, None)
+    got = pool.acquire(shape)            # in-flight slot keeps cycling
+    assert got.host is host_a
+    assert pool.allocs == 3              # no growth past the target
+    pool.release(shape, bslot, None)
+    pool.release(shape, c, None)
+    pool.release(shape, got, None)
+
+
+def test_queue_window_zero_means_auto_restores_adaptive_ceiling():
+    from ceph_tpu.osd.batcher import EncodeBatcher
+    conf = {"ec_tpu_batch_stripes": 64,
+            "ec_tpu_queue_window_us": 1_000,
+            "ec_tpu_queue_window_max_us": 50_000}
+    EncodeBatcher.reset_learning()
+    b = EncodeBatcher(conf)
+    try:
+        b.apply_tuning()
+        assert b.window_max_s == pytest.approx(0.05)
+        conf["ec_tpu_queue_window_max_us"] = 0
+        b.apply_tuning()
+        # 0 = auto: back to the adaptive default ceiling
+        assert b.window_max_s == pytest.approx(
+            max(b.window_base_s * 16, 0.02))
+        assert b.dyn_window_s <= b.window_max_s
+    finally:
+        b.stop()

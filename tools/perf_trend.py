@@ -75,6 +75,14 @@ history and fails loudly on:
   round's sharded GiB/s, and show one per-device ledger lane per
   mesh chip.  History rounds without a mesh block (pre-mesh rounds)
   are silently skipped.
+- **selftune floor** — the ``closed-loop selftune attribution``
+  record from the selftune config (ISSUE 15): with the autotuner
+  enabled the client ladder may not lose ANY rung to the static
+  defaults run in the same process (guarded rollback means the
+  controller's worst case is "changed nothing"), and zero guard
+  trips (SLO burn / overlap collapse / breaker) may fire while it
+  tunes.  Compared within one fresh run, so no machine-speed
+  tolerance is owed; runs without a selftune record self-skip.
 
 History files are ``{"n", "cmd", "rc", "tail", "parsed"}`` wrappers
 around a captured bench stdout; metric records are re-extracted from
@@ -103,6 +111,7 @@ _REBUILD_PREFIX = "OSD rebuild MB/s"
 _REBUILD_ATTRIB_PREFIX = "rebuild decode attribution"
 _MESH_ATTRIB_PREFIX = "multichip mesh attribution"
 _LOAD_PREFIX = "open-loop load attribution"
+_SELFTUNE_PREFIX = "closed-loop selftune attribution"
 _K8M4_MARK = "k=8 m=4"
 
 # defaults, overridable from the CLI
@@ -114,6 +123,7 @@ HOP_P99_FACTOR = 1.5       # fresh hop p99 may grow to this x history
 HOP_P99_SLACK_S = 1e-3     # ...and must also grow by this much abs.
 SCALING_TOL = 0.8          # 16-client MB/s >= tol * best history
 OVERLAP_TOL = 0.5          # fresh overlap frac >= tol * best history
+SELFTUNE_FLOOR = 1.0       # tuned MB/s >= floor * static, every rung
 
 
 def _records_from_text(text: str) -> List[Dict]:
@@ -205,6 +215,7 @@ def check(attribution: Optional[Dict], history: List[Dict],
           fresh_load: Optional[Dict] = None,
           fresh_rebuild: Optional[Dict] = None,
           fresh_mesh: Optional[Dict] = None,
+          fresh_selftune: Optional[Dict] = None,
           stage_tol: float = STAGE_TOL,
           ratio_tol: float = RATIO_TOL,
           min_device_fraction: float = MIN_DEVICE_FRACTION,
@@ -229,7 +240,10 @@ def check(attribution: Optional[Dict], history: List[Dict],
     and the zero-client-error / zero-client-burn re-assert;
     ``fresh_rebuild`` the rebuild config's decode-side attribution
     object, feeding the rebuild throughput floor and the decode
-    routing-collapse check."""
+    routing-collapse check; ``fresh_selftune`` the selftune config's
+    static-vs-tuned ladder + tuner audit block, feeding the
+    tuned>=static every-rung floor and the zero-guard-trip
+    re-assert."""
     findings: List[Dict] = []
 
     # -- routing collapse (the r05 signature) -------------------------
@@ -653,6 +667,52 @@ def check(attribution: Optional[Dict], history: List[Dict],
                     f"{nd}-device mesh — some chips produced no "
                     f"waterfall evidence (sharding or ledger fanout "
                     f"broke)"})
+
+    # -- closed-loop selftune floor + guard-trip re-assert ------------
+    # (ISSUE 15) ``fresh_selftune`` carries the static-vs-tuned
+    # client ladder measured in ONE process (same box, same minute —
+    # no machine-speed tolerance owed) plus the merged dump_tuner
+    # audit block.  Guarded rollback means the controller's worst
+    # case is "changed nothing": a tuned rung below its static twin,
+    # or ANY guard trip (SLO burn / overlap collapse / breaker)
+    # while tuning, is a controller regression outright.
+    if fresh_selftune is not None:
+        ladder = fresh_selftune.get("ladder") or {}
+        st_side = ladder.get("static") or {}
+        tn_side = ladder.get("tuned") or {}
+        for rung in sorted(set(st_side) & set(tn_side),
+                           key=lambda r: int(r)):
+            old = st_side.get(rung)
+            new = tn_side.get(rung)
+            if not isinstance(old, (int, float)) \
+                    or not isinstance(new, (int, float)):
+                continue
+            if new < SELFTUNE_FLOOR * old:
+                findings.append({
+                    "check": "selftune-regression",
+                    "severity": "fail",
+                    "message":
+                        f"self-tuned {new:.1f} MB/s < static "
+                        f"{old:.1f} MB/s at the {rung}-client rung — "
+                        f"the autotuner made the cluster slower than "
+                        f"leaving the knobs alone (check the tuner "
+                        f"block's kept/rolled_back decisions and the "
+                        f"hysteresis band)"})
+        tuner = fresh_selftune.get("tuner") or {}
+        trips = tuner.get("guard_trips")
+        guards = tuner.get("guards") or []
+        if (isinstance(trips, (int, float)) and trips > 0) or guards:
+            why = sorted(set(guards)) if guards \
+                else "reasons not recorded"
+            findings.append({
+                "check": "selftune-guard-trip", "severity": "fail",
+                "message":
+                    f"{int(trips or len(guards))} guard trip(s) "
+                    f"fired while self-tuning ({why}) — a probe "
+                    f"pushed the cluster into SLO burn / overlap "
+                    f"collapse before the rollback caught it; the "
+                    f"controller must stay inside the guard envelope "
+                    f"on a fault-free bench run"})
     return findings
 
 
@@ -668,6 +728,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
     rebuild = _pick(fresh_records, _REBUILD_ATTRIB_PREFIX)
     mesh = _pick(fresh_records, _MESH_ATTRIB_PREFIX)
     load = _pick(fresh_records, _LOAD_PREFIX)
+    selftune = _pick(fresh_records, _SELFTUNE_PREFIX)
     ladder = None
     if scaling:
         cl_side = (scaling.get("classic") or {}).get("clients")
@@ -691,6 +752,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
                        if scaling else None),
         fresh_ladder=ladder, fresh_load=load,
         fresh_rebuild=rebuild, fresh_mesh=mesh,
+        fresh_selftune=selftune,
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
         hop_p99_factor=hop_p99_factor, overlap_tol=overlap_tol)

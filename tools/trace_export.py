@@ -55,7 +55,7 @@ except ImportError:                     # invoked as a script from tools/
 #: concurrent ops fan out upward from the base
 _TID_BASE = {"write": 100, "read": 200, "recovery": 300,
              "optracker": 400, "flight": 500, "reactor": 600,
-             "device": 700}
+             "device": 700, "tuner": 800}
 _MAX_LANES = 64          # overlap-packing cap per track family
 _DEVICE_LANE_STRIDE = 32  # tid span per JAX device id (mesh-ready)
 
@@ -230,7 +230,11 @@ def export_bundles(bundles: List[Dict]) -> Dict:
                 prev_t = t
 
         # -- flight-recorder instants ------------------------------
+        # tune_step events (ISSUE 15: every autotuner decision is
+        # flight-recorded) get their own named lane so knob walks
+        # read as a timeline instead of drowning in route verdicts
         tid = _TID_BASE["flight"]
+        tuner_tid = _TID_BASE["tuner"]
         fl = [e for e in
               _as_list(_as_dict(b.get("flight")).get("events"))
               if isinstance(e, dict)]
@@ -242,7 +246,19 @@ def export_bundles(bundles: List[Dict]) -> Dict:
                 continue
             args = {k: v for k, v in ev.items()
                     if k not in ("time", "mono")}
-            events.append({"ph": "i", "name": str(ev.get("kind", "ev")),
+            kind = str(ev.get("kind", "ev"))
+            if kind == "tune_step":
+                named_tids.setdefault(tuner_tid, "tuner decisions")
+                name = kind
+                knob, verdict = ev.get("knob"), ev.get("verdict")
+                if knob and verdict:
+                    name = f"{verdict}:{knob}"
+                events.append({"ph": "i", "name": name,
+                               "cat": "tuner", "pid": pid,
+                               "tid": tuner_tid, "ts": us(ts),
+                               "s": "p", "args": args})
+                continue
+            events.append({"ph": "i", "name": kind,
                            "cat": "flight", "pid": pid, "tid": tid,
                            "ts": us(ts), "s": "p", "args": args})
 
