@@ -1070,6 +1070,13 @@ def _cluster_run(plugin, n_objs, obj_bytes, k="2", m="1",
                     pass
         stats["device_memory"] = mem_total
         stats.setdefault("device_mesh", None)
+        # store waterfall (ISSUE 16): every daemon's transaction-phase
+        # ledger below the store_apply hop, merged across the cluster
+        from ceph_tpu.utils.store_ledger import (
+            merge_dumps as _store_merge)
+        stats["store_ledger"] = _store_merge(
+            [osd.store.dump_store() for osd in c.osds.values()
+             if hasattr(osd.store, "dump_store")])
         stats["device_recent_ledgers"] = [
             led for osd in c.osds.values()
             if getattr(osd, "encode_batcher", None) is not None
@@ -1203,6 +1210,23 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
             if st.get("device_memory"):
                 dwf["memory"] = st["device_memory"]
             att_obj["device_waterfall"] = dwf
+        # store waterfall (ISSUE 16): intra-transaction phase shares
+        # over the slice of wall the hop waterfall charges to the
+        # store_apply hop — journal append/fsync, alloc, data write,
+        # compress, kv commit — same shares-sum-to-1.0 contract
+        sl = st.get("store_ledger")
+        if sl and sl.get("txns"):
+            from ceph_tpu.utils.store_ledger import (
+                store_waterfall_block)
+            store_wall = 0.0
+            if "waterfall" in att_obj:
+                store_wall = att_obj["waterfall"].get(
+                    "scaled_s", {}).get("store_apply", 0.0)
+            if not store_wall:
+                store_wall = sum(
+                    (sl.get("phase_seconds") or {}).values())
+            att_obj["store_waterfall"] = store_waterfall_block(
+                sl, round(store_wall, 6))
         if st.get("health"):
             att_obj["health"] = st["health"]
         if st.get("slo"):

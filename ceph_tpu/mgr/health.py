@@ -36,7 +36,8 @@ def checks_from_signals(*, breaker_open: bool = False,
                         down_osds: Optional[List[int]] = None,
                         degraded_pgs: int = 0,
                         total_pgs: int = 0,
-                        op_queue: Optional[dict] = None
+                        op_queue: Optional[dict] = None,
+                        store: Optional[dict] = None
                         ) -> Dict[str, dict]:
     """Evaluate one daemon's (or the merged cluster's) raw signals
     into the named-check dict.  Every check is always present —
@@ -108,6 +109,19 @@ def checks_from_signals(*, breaker_open: bool = False,
         f"({depth} ops queued)" if sev != "ok"
         else "op queues draining",
         queued=depth, growth_ticks=growth)
+
+    # store-phase stalls (ISSUE 16): one journal-fsync/kv-commit/
+    # data-write interval at or over store_phase_stall_ms already
+    # flight-recorded a store_stall event; here the count becomes a
+    # standing named check so `ceph -s` names a wedged local store
+    st = store or {}
+    stalls = int(st.get("stalls", 0))
+    checks["STORE_SLOW"] = _check(
+        "warn" if stalls else "ok",
+        f"{stalls} store transaction phase(s) exceeded the stall "
+        f"threshold" if stalls
+        else "store transactions within the stall threshold",
+        stalls=stalls, txns=int(st.get("txns", 0)))
 
     return checks
 

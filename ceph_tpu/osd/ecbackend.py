@@ -993,7 +993,7 @@ class ECBackend(PGBackend):
         self.host.prepare_log_txn(txn, wire_entries)
         txn.register_on_commit(
             lambda: self.host.on_local_commit(on_commit))
-        self.host.store.queue_transactions([txn])
+        self.host.store.queue_transactions([txn], op="client_write")
 
     def _sub_write_committed(self, tid: int, shard: int,
                              seg: int = 0) -> None:
@@ -1733,7 +1733,7 @@ class ECBackend(PGBackend):
             on_commit()
         txn.register_on_commit(
             lambda: self.host.on_local_commit(committed))
-        self.host.store.queue_transactions([txn])
+        self.host.store.queue_transactions([txn], op="recovery_push")
 
     def _push_acked(self, oid: str, shard: int) -> None:
         rec = self.recovery_ops.get(oid)

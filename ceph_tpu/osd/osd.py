@@ -296,6 +296,16 @@ class OSD(Dispatcher):
         elif st_lock is not None:
             store._lock = TimedLock("store_lock", stats=self.contention,
                                     inner=st_lock)
+        # store-transaction ledger (utils/store_ledger.py): every
+        # queue_transactions charges its wall to the phase waterfall
+        # ("store" perf subsystem, dump_store command); a phase at or
+        # over store_phase_stall_ms flight-records a store_stall and
+        # rate-limit auto-dumps.  Idempotent across OSD restart on a
+        # surviving store — accumulated history stays, the counters
+        # rebind into this daemon's collection.
+        self.store.attach_observability(
+            perf_coll=self.perf_coll, recorder=self.flight_recorder,
+            stall_threshold_s=self.conf["store_phase_stall_ms"] / 1e3)
         # cross-daemon hop-ledger accumulators: this OSD's view of
         # sub-op round trips, split by op class so the read/recovery
         # waterfall doesn't smear into the write one ("hops" = write
@@ -418,6 +428,7 @@ class OSD(Dispatcher):
                            "dump_slo", "dump_trace",
                            "dump_profile", "dump_device",
                            "dump_op_queue", "dump_tuner",
+                           "dump_store",
                            "dump_health", "status",
                            "config get", "config set"):
                 self.admin_socket.register(
@@ -612,7 +623,8 @@ class OSD(Dispatcher):
                             groups[(pool_id, seed)]):
                         txn.remove_collection(coll)
                     try:
-                        self.store.queue_transactions([txn])
+                        self.store.queue_transactions([txn],
+                                                      op="pg_merge")
                     except Exception:
                         pass
                 self.log.dout(1, f"dropped non-acting child copy "
@@ -662,7 +674,8 @@ class OSD(Dispatcher):
                                                    obj)
                     txn.remove_collection(coll)
                 try:
-                    self.store.queue_transactions([txn])
+                    self.store.queue_transactions([txn],
+                                                  op="pg_merge")
                 except Exception as e:
                     self.log.dout(1, f"merge of {pool_id}.{seed:x} -> "
                                   f"{base} failed: {e!r}; retrying on "
@@ -1068,6 +1081,8 @@ class OSD(Dispatcher):
                 out = self.tuner.dump()
                 out["enabled"] = bool(
                     self.conf["osd_tuner_enable"])
+            elif prefix == "dump_store":
+                out = self.store.dump_store()
             elif prefix == "dump_health":
                 out = self._health_dump()
             elif prefix == "status":
@@ -1112,7 +1127,8 @@ class OSD(Dispatcher):
             down_osds=down,
             degraded_pgs=degraded, total_pgs=total_pgs,
             op_queue={"client_queued": int(oq.get("queued", 0)),
-                      "client_growth_ticks": self._opq_growth_ticks})
+                      "client_growth_ticks": self._opq_growth_ticks},
+            store=self.store.store_stall_signals())
         out = healthlib.summarize(checks)
         out["daemon"] = f"osd.{self.whoami}"
         return out
@@ -1148,6 +1164,8 @@ class OSD(Dispatcher):
             "flight": self.flight_recorder.dump_state(),
             "reactors": reactors,
             "device": self.encode_batcher.device_trace_block(),
+            "store": {"ledgers":
+                      self.store._store_accum().recent()},
             "folded": folded,
         }
 

@@ -353,7 +353,7 @@ class PG:
                 txn.create_collection(self.coll_of(-1))
                 made = True
         if made:
-            self.store.queue_transactions([txn])
+            self.store.queue_transactions([txn], op="pg_create")
 
     def _append_pgmeta_ops(self, txn: Transaction) -> None:
         import json as _json
@@ -375,7 +375,7 @@ class PG:
                       # interval change): no home shard to persist to
         txn = Transaction()
         self._append_pgmeta_ops(txn)
-        self.store.queue_transactions([txn])
+        self.store.queue_transactions([txn], op="pgmeta")
 
     def _load_pgmeta(self) -> None:
         """Restart is resume (reference OSD::init loads PGs from disk):
@@ -527,7 +527,7 @@ class PG:
             # between the in-memory split and its durable txn, so the
             # rollback above can never clobber a concurrent append
             try:
-                self.store.queue_transactions([txn])
+                self.store.queue_transactions([txn], op="pg_split")
             except Exception as e:
                 self.log = log_snapshot
                 self.missing = missing_snapshot
@@ -760,7 +760,7 @@ class PG:
             else:
                 if self.store.collection_exists(self.coll_of(-1)):
                     txn.remove_collection(self.coll_of(-1))
-            self.store.queue_transactions([txn])
+            self.store.queue_transactions([txn], op="pg_delete")
             self.state = STATE_INACTIVE
             self.log = PGLog()
             self.missing = MissingSet()
@@ -1050,7 +1050,8 @@ class PG:
                 obj = GHObject(oid, self.own_shard)
                 txn = Transaction()
                 txn.remove(self.coll, obj)
-                self.store.queue_transactions([txn])
+                self.store.queue_transactions([txn],
+                                              op="recovery_trim")
         for oid, ver in objs.items():
             oi = self.backend.get_object_info(oid)
             local = oi.version if oi is not None else None
@@ -1126,7 +1127,8 @@ class PG:
         if self.store.exists(self.coll, obj):
             txn = Transaction()
             txn.remove(self.coll, obj)
-            self.store.queue_transactions([txn])
+            self.store.queue_transactions([txn],
+                                          op="recovery_trim")
         if prior > (0, 0):
             self.missing.add(oid, prior, None)
 
@@ -1223,7 +1225,8 @@ class PG:
                         obj = GHObject(oid, self.own_shard)
                         txn = Transaction()
                         txn.remove(self.coll, obj)
-                        self.store.queue_transactions([txn])
+                        self.store.queue_transactions(
+                            [txn], op="recovery_trim")
                     else:
                         oi = self.backend.get_object_info(oid)
                         if oi is not None:
@@ -1253,7 +1256,8 @@ class PG:
                         if self.store.exists(self.coll, obj):
                             txn = Transaction()
                             txn.remove(self.coll, obj)
-                            self.store.queue_transactions([txn])
+                            self.store.queue_transactions(
+                                [txn], op="recovery_trim")
                         self.missing.rm(e.oid)
             self._persist_pgmeta()
             self.state = STATE_ACTIVE
@@ -2663,7 +2667,8 @@ class PG:
                 if self.store.exists(self.coll, obj):
                     txn = Transaction()
                     txn.remove(self.coll, obj)
-                    self.store.queue_transactions([txn])
+                    self.store.queue_transactions(
+                        [txn], op="recovery_trim")
         self._on_recovered(oid, 0)
 
     def requeue_scrub_waiters(self) -> None:

@@ -152,7 +152,7 @@ class ReplicatedBackend(PGBackend):
         self.host.prepare_log_txn(txn, wire_entries)
         txn.register_on_commit(
             lambda: self.host.on_local_commit(on_commit))
-        self.host.store.queue_transactions([txn])
+        self.host.store.queue_transactions([txn], op="client_write")
 
     def _committed(self, tid: int, osd: int) -> None:
         op = self.in_flight.get(tid)
@@ -310,7 +310,7 @@ class ReplicatedBackend(PGBackend):
             on_commit()
         txn.register_on_commit(
             lambda: self.host.on_local_commit(committed))
-        self.host.store.queue_transactions([txn])
+        self.host.store.queue_transactions([txn], op="recovery_push")
 
     def _push_acked(self, oid: str, osd: int) -> None:
         rec = self.recovery_ops.get(oid)

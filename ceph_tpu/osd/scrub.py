@@ -325,7 +325,8 @@ class Scrubber:
                     if pg.store.exists(pg.coll, obj):
                         txn = Transaction()
                         txn.remove(pg.coll, obj)
-                        pg.store.queue_transactions([txn])
+                        pg.store.queue_transactions([txn],
+                                                    op="scrub_repair")
                 pg.mark_shard_missing(oid, version, shard, osd)
 
     def dump(self) -> Dict:

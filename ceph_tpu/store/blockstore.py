@@ -48,6 +48,7 @@ import errno
 import json
 import os
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Set
 
 from ..utils.crc import crc32c
@@ -83,7 +84,7 @@ class BitmapAllocator:
             self.bits[idx] = 0
 
     def state(self) -> bytes:
-        return bytes(self.bits)
+        return bytes(self.bits)  # copycheck: ok - allocator bitmap snapshot for the KV record, not payload
 
     def used(self) -> int:
         return sum(self.bits)
@@ -260,8 +261,15 @@ class BlockStore(ObjectStore):
                           self._exists_key(c, o)) is not None)
             self._journal_seq += 1
             jkey = f"J/{self._journal_seq:016d}"
-            self._db.submit(WriteBatch().set(jkey, merged.encode()),
-                            sync=True)
+            record = merged.encode()
+            self._txn_meta("journal_bytes", len(record))
+            # WAL append and WAL durability are separate ledger
+            # phases: a wedged disk shows up as journal_fsync, a
+            # bloated txn encode as journal_append
+            self._db.submit(WriteBatch().set(jkey, record))
+            self._stamp_txn("journal_append")
+            self._db.sync()
+            self._stamp_txn("journal_fsync")
             batch = WriteBatch()
             try:
                 dirty = self._apply_ops(merged.ops, batch)
@@ -277,13 +285,16 @@ class BlockStore(ObjectStore):
                 self._db.submit(WriteBatch().rm(jkey), sync=True)
                 raise
             self._flush_dev(dirty)       # data durable first
+            self._stamp_txn("data_write")
             batch.rm(jkey)
             batch.set("alloc", self._alloc.state())
             self._db.submit(batch, sync=True)   # ONE atomic flip
+            self._stamp_txn("kv_commit")
             fin = self._finisher
         for txn in txns:
             for fn in txn.on_applied:
                 fn()
+        self._stamp_txn("flush")
         callbacks = [fn for txn in txns for fn in txn.on_commit]
         if on_commit is not None:
             callbacks.append(on_commit)
@@ -304,14 +315,23 @@ class BlockStore(ObjectStore):
         freed: Set[int] = set()
         allocated: List[int] = []
         dirty = False
+        # alloc/compress interleave per block inside this loop, so
+        # their time cannot carry monotone ledger stamps: it
+        # accumulates here and rides the ledger as carved meta
+        # seconds (store_ledger.charge carves them out of data_write)
+        alloc_s = 0.0
+        compress_s = 0.0
 
         def alloc() -> int:
             # every in-txn allocation is tracked so a failed apply
             # (csum EIO mid-transaction) rolls the in-memory bitmap
             # back — otherwise the next successful commit would
             # persist the leak with no reclaim path
+            nonlocal alloc_s
+            t0 = time.time()
             phys = self._alloc.allocate()
             allocated.append(phys)
+            alloc_s += time.time() - t0
             return phys
 
         def get_ext(coll, obj) -> _Extents:
@@ -403,16 +423,19 @@ class BlockStore(ObjectStore):
             compressed segment when it saves at least one block;
             -> True when it did (reference BlueStore blob compression:
             compress, keep only if the result helps)."""
-            nonlocal dirty
+            nonlocal dirty, compress_s
             nfull = last_full - first_full
             if not self._comp_alg or nfull < COMPRESS_MIN_BLOCKS:
                 return False
             lo = first_full * BLOCK - offset
             span = data[lo:lo + nfull * BLOCK]
+            t0 = time.time()
             try:
                 comp = self._compressor(self._comp_alg).compress(span)
             except Exception:
                 return False
+            finally:
+                compress_s += time.time() - t0
             nphys = (len(comp) + BLOCK - 1) // BLOCK
             if nphys >= nfull:           # no win: store raw
                 return False
@@ -439,6 +462,8 @@ class BlockStore(ObjectStore):
                 ext.crcs[lb] = crc32c(span[i * BLOCK:(i + 1) * BLOCK])
             self.compress_logical_bytes += len(span)
             self.compress_stored_bytes += nphys * BLOCK
+            self._txn_meta("compress_logical", len(span))
+            self._txn_meta("compress_stored", nphys * BLOCK)
             dirty = True
             return True
 
@@ -713,6 +738,16 @@ class BlockStore(ObjectStore):
             batch.set(key, ext.dump())
         for phys in freed:
             self._alloc.free(phys)
+        # IO accounting + carved phase seconds onto the ledger
+        # (no-ops during mount-time replay — no active ledger)
+        if allocated:
+            self._txn_meta("blocks_allocated", len(allocated))
+        if freed:
+            self._txn_meta("blocks_freed", len(freed))
+        if alloc_s > 0:
+            self._txn_meta("alloc_s", alloc_s)
+        if compress_s > 0:
+            self._txn_meta("compress_s", compress_s)
         return dirty
 
     # -- reads ---------------------------------------------------------
@@ -731,7 +766,7 @@ class BlockStore(ObjectStore):
             comp.extend(self._read_block(phys))
         try:
             raw = self._compressor(seg["alg"]).decompress(
-                bytes(comp[:seg["clen"]]))
+                bytes(comp[:seg["clen"]]))  # copycheck: ok - zlib/lz4 need a contiguous buffer; read path, not apply
         except Exception as e:
             self.csum_failures += 1
             raise OSError(errno.EIO,
@@ -768,7 +803,7 @@ class BlockStore(ObjectStore):
                 raise OSError(errno.EIO,
                               f"csum mismatch at logical block {lb}")
             out.extend(blk)
-        return bytes(out[:ext.size])
+        return bytes(out[:ext.size])  # copycheck: ok - returns an immutable object image; read path, not apply
 
     def _read_object(self, coll: str, obj: GHObject) -> bytes:
         return self._materialize(self._load_extents(coll, obj))

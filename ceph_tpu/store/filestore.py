@@ -188,23 +188,32 @@ class FileStore(ObjectStore):
                           self._exists_key(c, o)) is not None)
             # 2. journal (WAL): the whole txn durable before any apply;
             #    on an I/O failure past this point the entry stays and
-            #    replays on the next mount
+            #    replays on the next mount.  Append and fsync are
+            #    stamped separately so the ledger splits WAL write
+            #    cost from WAL durability cost.
             self._journal_seq += 1
             jkey = f"J/{self._journal_seq:016d}"
-            self._db.submit(
-                WriteBatch().set(jkey, merged.encode()), sync=True)
+            record = merged.encode()
+            self._txn_meta("journal_bytes", len(record))
+            self._db.submit(WriteBatch().set(jkey, record))
+            self._stamp_txn("journal_append")
+            self._db.sync()
+            self._stamp_txn("journal_fsync")
             # 3. apply data-file writes + metadata batch
             ctx = _ApplyCtx(self._db)
             for op in merged.ops:
                 self._apply_op(op, ctx)
             # 4. data durable before the journal entry is retired
             self._sync_dirty(ctx)
+            self._stamp_txn("data_write")
             ctx.batch.rm(jkey)
             self._db.submit(ctx.batch, sync=True)
+            self._stamp_txn("kv_commit")
             fin = self._finisher
         for txn in txns:
             for fn in txn.on_applied:
                 fn()
+        self._stamp_txn("flush")
         callbacks = [fn for txn in txns for fn in txn.on_commit]
         if on_commit is not None:
             callbacks.append(on_commit)

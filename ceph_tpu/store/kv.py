@@ -63,6 +63,13 @@ class KeyValueDB(abc.ABC):
         """Sorted iteration over keys with the given prefix, starting at
         ``start`` (inclusive) if given."""
 
+    def sync(self) -> None:
+        """Make previously submitted records durable (reference
+        KeyValueDB::submit_transaction_sync's fsync half).  Splitting
+        append from fsync lets the store ledger charge WAL write and
+        WAL durability as separate phases.  Default: no-op (MemDB has
+        no durability to wait for)."""
+
     def get_prefix(self, prefix: str) -> Dict[str, bytes]:
         return dict(self.iterate(prefix))
 
@@ -237,6 +244,12 @@ class LogDB(KeyValueDB):
             self._log_bytes += len(record)
             _apply_batch(self._data, batch)
             self._maybe_compact()
+
+    def sync(self) -> None:
+        with self._lock:
+            if self._fh is None:
+                raise RuntimeError("LogDB not open")
+            os.fsync(self._fh.fileno())
 
     def _live_bytes(self) -> int:
         return sum(len(k) + len(v) + 13 for k, v in self._data.items())

@@ -97,10 +97,14 @@ class MemStore(ObjectStore):
             for txn in txns:
                 for op in txn.ops:
                     self._apply_op(op)
+            # no journal, no KV: those ledger phases never stamp and
+            # fold to zero-width — the whole apply charges here
+            self._stamp_txn("data_write")
             fin = self._finisher
         for txn in txns:
             for fn in txn.on_applied:
                 fn()
+        self._stamp_txn("flush")
         callbacks = [fn for txn in txns for fn in txn.on_commit]
         if on_commit is not None:
             callbacks.append(on_commit)

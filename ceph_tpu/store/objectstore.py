@@ -20,11 +20,20 @@ test double os/memstore/MemStore.cc) and FileStore
 from __future__ import annotations
 
 import abc
+import threading
+import time
 from dataclasses import dataclass, field
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..utils import faults as faultlib
+from ..utils import store_ledger
 from ..utils.encoding import Decoder, Encoder
+
+#: thread-local current store-transaction ledger: backends stamp
+#: phases through _stamp_txn without any signature change to
+#: _do_queue_transactions (apply runs synchronously on the queueing
+#: thread in every backend, so thread-local is exact)
+_TXN_TLS = threading.local()
 
 # Collection ids are strings: str(SPGid) for PG collections, "meta" for
 # the OSD's bookkeeping collection (reference coll_t, osd/osd_types.h).
@@ -373,10 +382,95 @@ class ObjectStore(abc.ABC):
     def mkfs(self) -> None:
         """Initialize an empty store (reference ObjectStore::mkfs)."""
 
+    # -- observability seams (utils/store_ledger.py) -----------------------
+    # ObjectStore subclasses never call super().__init__, so all
+    # ledger state is created lazily: any store — including a future
+    # BlueStore-class rewrite — inherits the full instrumentation by
+    # merely routing mutations through queue_transactions and
+    # (optionally) stamping its internal phases via _stamp_txn.
+
+    def _store_accum(self) -> store_ledger.StoreLedgerAccum:
+        accum = getattr(self, "_sl_accum", None)
+        if accum is None:
+            accum = store_ledger.StoreLedgerAccum()
+            self._sl_accum = accum
+        return accum
+
+    def attach_observability(self, perf_coll=None, recorder=None,
+                             stall_threshold_s: float = 0.0
+                             ) -> store_ledger.StoreLedgerAccum:
+        """Wire the store's ledger into a daemon: register the
+        ``store`` perf subsystem in ``perf_coll`` (-> ``ceph_store_*``
+        prometheus), flight-record ``store_stall`` events into
+        ``recorder`` for phases at/over ``stall_threshold_s``.
+        Idempotent, and safe for stores surviving an OSD restart:
+        accumulated state is kept, counters rebind into the new
+        daemon's collection."""
+        accum = self._store_accum()
+        if perf_coll is not None:
+            accum.bind_perf(perf_coll)
+        self._sl_recorder = recorder
+        self._sl_stall_s = float(stall_threshold_s)
+        return accum
+
+    def _stamp_txn(self, phase: str) -> None:
+        """Backend seam: stamp the current transaction's ledger.
+        No-op outside queue_transactions (mount-time replay)."""
+        led = getattr(_TXN_TLS, "led", None)
+        if led is not None:
+            led[phase] = time.time()
+
+    def _txn_meta(self, field_name: str, value) -> None:
+        """Backend seam: accumulate a meta field (carved phase
+        seconds, IO accounting counts) on the current ledger."""
+        led = getattr(_TXN_TLS, "led", None)
+        if led is not None:
+            led[field_name] = led.get(field_name, 0) + value
+
+    def dump_store(self) -> dict:
+        """``dump_store`` admin payload: the accumulator dump plus
+        backend identity (merge-compatible across backends)."""
+        out = self._store_accum().dump()
+        out["backend"] = type(self).__name__
+        return out
+
+    def store_stall_signals(self) -> dict:
+        """Health-check feed: stall count + txn volume."""
+        accum = self._store_accum()
+        return {"stalls": accum.stalls, "txns": accum.txns}
+
+    def _observe_txn(self, led: Dict[str, float],
+                     txns: List["Transaction"]) -> None:
+        bytes_written = 0
+        op_counts: Dict[str, int] = {}
+        fam_of = store_ledger.op_family
+        for txn in txns:
+            for o in txn.ops:
+                fam = fam_of(o[0])
+                op_counts[fam] = op_counts.get(fam, 0) + 1
+                if o[0] == "write":
+                    bytes_written += len(o[4])
+        led["txns"] = len(txns)
+        led["bytes_written"] = bytes_written
+        accum = self._store_accum()
+        charged = accum.observe(led, op_counts=op_counts)
+        stall_s = getattr(self, "_sl_stall_s", 0.0)
+        if stall_s > 0:
+            for phase, dt in charged:
+                if dt >= stall_s:
+                    accum.note_stall()
+                    rec = getattr(self, "_sl_recorder", None)
+                    if rec is not None:
+                        rec.note("store_stall", phase=phase,
+                                 ms=round(dt * 1e3, 3),
+                                 backend=type(self).__name__,
+                                 op=led.get("op"))
+                        rec.auto_dump("store-phase-stall")
+
     # -- mutation ----------------------------------------------------------
     def queue_transactions(self, txns: List[Transaction],
-                           on_commit: Optional[Callable[[], None]] = None
-                           ) -> None:
+                           on_commit: Optional[Callable[[], None]] = None,
+                           op: Optional[str] = None) -> None:
         """Apply atomically; deliver per-transaction on_applied inline
         and on_commit (plus the aggregate callback) via the finisher
         (reference os/ObjectStore.h:222).
@@ -386,9 +480,25 @@ class ObjectStore(abc.ABC):
         any mutation, stall sleeps in place like a wedged disk,
         corrupt mode bit-flips one queued write payload (planted bit
         rot for the scrub/repair machinery) — then the backend's
-        ``_do_queue_transactions`` applies."""
-        faultlib.registry().store_apply(txns)
-        self._do_queue_transactions(txns, on_commit)
+        ``_do_queue_transactions`` applies.  ``op`` tags the txn's
+        store ledger with the enclosing client op's identity.
+
+        The ledger's ``txn_queued`` t0 lands BEFORE the fault gate so
+        an injected store.apply stall is charged into the following
+        phase interval — exactly where a real wedged journal/device
+        would surface."""
+        led: Dict[str, float] = {"txn_queued": time.time()}
+        if op is not None:
+            led["op"] = op
+        prev = getattr(_TXN_TLS, "led", None)
+        _TXN_TLS.led = led
+        try:
+            faultlib.registry().store_apply(txns)
+            self._do_queue_transactions(txns, on_commit)
+        finally:
+            _TXN_TLS.led = prev
+        led["apply_done"] = time.time()
+        self._observe_txn(led, txns)
 
     @abc.abstractmethod
     def _do_queue_transactions(self, txns: List[Transaction],

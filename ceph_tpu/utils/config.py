@@ -433,6 +433,13 @@ def _opts() -> List[Option]:
                            "device_stall event and rate-limit "
                            "auto-dumps (mirrors lock_stall; 0 "
                            "disables)"),
+        Option("store_phase_stall_ms", float, 250.0, min=0.0,
+               description="store-phase stall threshold: any phase "
+                           "of one store transaction (journal fsync, "
+                           "kv commit, data write, ...) at or over "
+                           "this flight-records a store_stall event "
+                           "and rate-limit auto-dumps (mirrors "
+                           "device_stall/lock_stall; 0 disables)"),
         Option("ec_tpu_device_idle_reprobe_s", float, 2.0, min=0.0,
                description="a device with zero traffic for this long "
                            "gets the next small batch as an immediate "

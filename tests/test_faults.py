@@ -198,6 +198,55 @@ def test_store_queue_transactions_consults_the_gate():
         s.umount()
 
 
+def test_injected_store_stall_fires_store_stall_forensics():
+    """ISSUE 16 wiring: an injected store.apply stall lands in the
+    transaction's phase ledger (t0 is stamped before the fault gate),
+    crosses the stall threshold, emits a ``store_stall`` flight-
+    recorder event with forensics fields, and surfaces as a
+    STORE_SLOW warn through the health-check feed.  A clean store
+    records zero stall events."""
+    from ceph_tpu.mgr import health
+    from ceph_tpu.utils.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=64, name="store-test")
+    s = MemStore()
+    s.mkfs()
+    s.mount()
+    s.attach_observability(recorder=rec, stall_threshold_s=0.05)
+    try:
+        s.queue_transactions([Transaction().create_collection("1.0s0")])
+        # clean traffic first: no stall events, STORE_SLOW ok
+        s.queue_transactions(
+            [Transaction().write("1.0s0", GHObject("a", 0), 0, b"x")],
+            op="client_write")
+        assert not [e for e in rec.dump() if e["kind"] == "store_stall"]
+        sig = s.store_stall_signals()
+        assert sig["stalls"] == 0 and sig["txns"] >= 2
+        ok = health.checks_from_signals(store=sig)
+        assert ok["STORE_SLOW"]["severity"] == "ok"
+
+        reg().arm(STORE_APPLY, mode="stall", every=1, stall_s=0.08,
+                  max_trips=1)
+        s.queue_transactions(
+            [Transaction().write("1.0s0", GHObject("b", 0), 0, b"y")],
+            op="client_write")
+        events = [e for e in rec.dump() if e["kind"] == "store_stall"]
+        assert len(events) == 1
+        ev = events[0]
+        # a stall at the gate charges into the first following phase
+        assert ev["phase"] in ("journal_append", "data_write")
+        assert ev["ms"] >= 75
+        assert ev["backend"] == "MemStore"
+        assert ev["op"] == "client_write"
+        sig = s.store_stall_signals()
+        assert sig["stalls"] == 1
+        warn = health.checks_from_signals(store=sig)
+        assert warn["STORE_SLOW"]["severity"] == "warn"
+        assert warn["STORE_SLOW"]["stalls"] == 1
+    finally:
+        s.umount()
+
+
 # ------------------------------------------------- batcher hardening
 def codec():
     return ecreg.instance().factory(

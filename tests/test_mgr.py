@@ -94,6 +94,14 @@ def test_prometheus_endpoint(cl):
     assert "# TYPE ceph_op_queue_client_served counter" in body
     assert 'ceph_op_queue_recovery_served{daemon="osd.0"}' in body
     assert "ceph_op_queue_scrub_queued_now" in body
+    # store-transaction ledger (ISSUE 16): per-phase waterfall, op
+    # census and IO accounting register at OSD boot too
+    assert 'ceph_store_txns{daemon="osd.0"}' in body
+    assert "# TYPE ceph_store_data_write_hist_s histogram" in body
+    assert "# TYPE ceph_store_kv_commit_hist_s histogram" in body
+    assert "ceph_store_op_write" in body
+    assert "ceph_store_bytes_written" in body
+    assert "ceph_store_phase_stalls" in body
 
     st = json.loads(urllib.request.urlopen(
         f"http://{host}:{port}/status", timeout=5).read().decode())
@@ -484,6 +492,20 @@ def test_health_checks_and_cluster_merge():
         op_queue={"client_growth_ticks": 5, "client_queued": 0})
     assert drained["OP_QUEUE_BACKLOG"]["severity"] == "ok"
     assert ok["OP_QUEUE_BACKLOG"]["severity"] == "ok"
+    # STORE_SLOW (ISSUE 16): store-phase stalls warn; the check is
+    # always present and defaults to ok, and merged stall counts sum
+    assert ok["STORE_SLOW"]["severity"] == "ok"
+    stall = health.checks_from_signals(
+        store={"stalls": 2, "txns": 100})
+    assert stall["STORE_SLOW"]["severity"] == "warn"
+    assert stall["STORE_SLOW"]["stalls"] == 2
+    assert stall["STORE_SLOW"]["txns"] == 100
+    more = health.checks_from_signals(
+        store={"stalls": 3, "txns": 40})
+    smerged = health.merge([{"checks": ok}, {"checks": stall},
+                            {"checks": more}])
+    assert smerged["checks"]["STORE_SLOW"]["severity"] == "warn"
+    assert smerged["checks"]["STORE_SLOW"]["stalls"] == 5
 
 
 def test_dump_health_admin_round_trip(cl):
@@ -499,6 +521,7 @@ def test_dump_health_admin_round_trip(cl):
         assert out["checks"]["EC_BREAKER_OPEN"]["severity"] == "ok"
         assert out["checks"]["OSD_DOWN"]["severity"] == "ok"
         assert out["checks"]["OP_QUEUE_BACKLOG"]["severity"] == "ok"
+        assert out["checks"]["STORE_SLOW"]["severity"] == "ok"
 
 
 def test_dump_op_queue_admin_round_trip(cl):

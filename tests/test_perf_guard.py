@@ -347,6 +347,78 @@ def test_device_dump_13_daemons_stays_interactive():
     assert merged["overlap"]["pipeline_overlap_frac"] >= 0.0
 
 
+# ISSUE 16 extends the ledger discipline below the store_apply wall:
+# every queue_transactions folds a phase ledger into the store
+# accumulator inline on the apply thread (same 20us bar), and
+# dump_store merges a bench cluster's worth of accumulators — 13
+# daemons x a full recent ring — inside the interactive bar.
+STORE_LEDGER_OBSERVE_CEILING = 20e-6
+STORE_DUMP_CEILING = 0.050
+
+
+def _store_led(t0):
+    return {"txn_queued": t0, "journal_append": t0 + 4e-5,
+            "journal_fsync": t0 + 2.4e-4, "data_write": t0 + 5e-4,
+            "kv_commit": t0 + 6.5e-4, "flush": t0 + 6.8e-4,
+            "apply_done": t0 + 7e-4, "alloc_s": 3e-5,
+            "compress_s": 5e-5, "op": "client_write", "txns": 1,
+            "bytes_written": 1 << 16, "journal_bytes": 1 << 16,
+            "blocks_allocated": 16}
+
+
+def test_store_ledger_observe_is_cheap():
+    from ceph_tpu.utils.store_ledger import StoreLedgerAccum
+    accum = StoreLedgerAccum()
+    led = _store_led(1000.0)
+    ops = {"write": 4, "setattr": 2}
+    cost = _per_op(lambda: accum.observe(dict(led), op_counts=ops))
+    assert cost < STORE_LEDGER_OBSERVE_CEILING, \
+        f"store-ledger observe costs {cost * 1e6:.2f}us/op " \
+        f"(ceiling {STORE_LEDGER_OBSERVE_CEILING * 1e6:.0f}us)"
+    assert accum.txns > N             # and the ring stayed bounded
+    assert len(accum.recent()) == StoreLedgerAccum.RECENT_LEDGERS
+
+
+def test_store_stamp_seam_is_cheap():
+    """The per-phase backend seam itself: a TLS load + one
+    time.time() + dict store when a txn is active, and a bare TLS
+    load no-op during mount-time replay."""
+    from ceph_tpu.store import MemStore
+    from ceph_tpu.store.objectstore import _TXN_TLS
+    s = MemStore()
+    _TXN_TLS.led = {}
+    try:
+        cost = _per_op(lambda: s._stamp_txn("data_write"))
+    finally:
+        _TXN_TLS.led = None
+    assert cost < STORE_LEDGER_OBSERVE_CEILING, \
+        f"store phase stamp costs {cost * 1e6:.2f}us/op"
+    cost = _per_op(lambda: s._stamp_txn("data_write"))  # replay no-op
+    assert cost < STORE_LEDGER_OBSERVE_CEILING
+
+
+def test_store_dump_13_daemons_stays_interactive():
+    from ceph_tpu.utils.store_ledger import (StoreLedgerAccum,
+                                             merge_dumps)
+    depth = StoreLedgerAccum.RECENT_LEDGERS
+    accums = []
+    for d in range(13):
+        a = StoreLedgerAccum()
+        for j in range(depth):
+            a.observe(_store_led(1000.0 + d + j * 1e-3),
+                      op_counts={"write": 4})
+        accums.append(a)
+    merge_dumps([a.dump() for a in accums])      # warm
+    t0 = time.perf_counter()
+    merged = merge_dumps([a.dump() for a in accums])
+    elapsed = time.perf_counter() - t0
+    assert elapsed < STORE_DUMP_CEILING, \
+        f"13-daemon store dump+merge took {elapsed * 1e3:.1f}ms " \
+        f"(ceiling {STORE_DUMP_CEILING * 1e3:.0f}ms)"
+    assert merged["txns"] == 13 * depth
+    assert merged["io"]["op_counts"]["write"] == 13 * depth * 4
+
+
 # ISSUE 15 puts the autotuner's step() on every OSD tick: the common
 # case (cooldown / idle / plateau-neutral verdicts) must stay in the
 # same class as the other always-on instrumentation, or the control

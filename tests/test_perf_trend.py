@@ -377,6 +377,79 @@ def test_overlap_gate_runs_from_cli(tmp_path, history):
     assert r.returncode == 0, (r.stdout, r.stderr)
 
 
+# ------------------------------------- ISSUE 16: store-phase p99 gate
+def _swf(p99=None, txns=400):
+    return {"txns": txns, "wall_s": 2.0,
+            "phase_seconds": {"journal_fsync": 0.8,
+                              "data_write": 0.9, "kv_commit": 0.3},
+            "shares": {"journal_fsync": 0.4, "data_write": 0.45,
+                       "kv_commit": 0.15},
+            "p99_s": p99 or {"journal_fsync": 0.004,
+                             "data_write": 0.005,
+                             "kv_commit": 0.001},
+            "sum_of_shares": 1.0, "top_phase": "data_write",
+            "stalls": 0, "io": {"bytes_written": 1 << 26}}
+
+
+def _att_with_swf(swf):
+    att = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                        "commit": 3.0}, 0.95)
+    att["store_waterfall"] = swf
+    return att
+
+
+def test_store_phase_gate_skips_without_store_history(history):
+    """History rounds predating the store ledger carry no
+    store_waterfall block; the store-phase gate self-skips — a fresh
+    run with arbitrarily slow phases must not fail against rounds
+    that never measured them."""
+    bad = _swf(p99={"journal_fsync": 5.0, "data_write": 9.0})
+    findings = perf_trend.check(_att_with_swf(bad),
+                                perf_trend.load_history(history))
+    assert not [f for f in findings
+                if f["check"] == "store-phase-p99-regression"]
+
+
+def test_store_phase_p99_gate(tmp_path, history):
+    hist = history + [_hist_round(
+        tmp_path, 3, [_att_with_swf(_swf())])]
+    rounds = perf_trend.load_history(hist)
+    # journal_fsync p99 blows 10x past history (and > 1 ms absolute)
+    bad = _swf(p99={"journal_fsync": 0.040, "data_write": 0.005})
+    findings = perf_trend.check(_att_with_swf(bad), rounds)
+    hits = [f for f in findings
+            if f["check"] == "store-phase-p99-regression"]
+    assert len(hits) == 1 and "journal_fsync" in hits[0]["message"]
+    # within the 1.5x + 1 ms budget: passes
+    ok = _swf(p99={"journal_fsync": 0.0045, "data_write": 0.0055})
+    assert not [f for f in
+                perf_trend.check(_att_with_swf(ok), rounds)
+                if f["check"] == "store-phase-p99-regression"]
+    # growth under the absolute 1 ms slack never trips even past 1.5x
+    tiny = _swf(p99={"kv_commit": 0.0018})
+    assert not [f for f in
+                perf_trend.check(_att_with_swf(tiny), rounds)
+                if f["check"] == "store-phase-p99-regression"]
+    # a fresh run that applied no store transactions self-skips
+    idle = _swf(p99={"journal_fsync": 9.0}, txns=0)
+    assert not [f for f in
+                perf_trend.check(_att_with_swf(idle), rounds)
+                if f["check"] == "store-phase-p99-regression"]
+
+
+def test_store_phase_gate_runs_from_cli(tmp_path, history):
+    hist = history + [_hist_round(
+        tmp_path, 3, [_att_with_swf(_swf())])]
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.0), _cluster(1.0),
+        _att_with_swf(_swf(p99={"journal_fsync": 0.040})))))
+    r = _run_cli(fresh, hist)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "store-phase-p99-regression" in r.stdout
+    assert "journal_fsync" in r.stdout
+
+
 # ------------------------------------------ ISSUE 15: selftune gate
 def _selftune_rec(static=None, tuned=None, trips=0, guards=()):
     return {"metric": "closed-loop selftune attribution (static vs "

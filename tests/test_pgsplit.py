@@ -353,13 +353,13 @@ def test_split_retries_after_failed_move_txn():
             orig = store.queue_transactions
             state = {"failed": False}
 
-            def wrapper(txns, _orig=orig, _state=state):
+            def wrapper(txns, *args, _orig=orig, _state=state, **kw):
                 if not _state["failed"] and any(
                         op[0] == "coll_move_rename"
                         for t in txns for op in t.ops):
                     _state["failed"] = True
                     raise RuntimeError("injected: move txn lost a race")
-                return _orig(txns)
+                return _orig(txns, *args, **kw)
             store.queue_transactions = wrapper
 
         rc, msg, _ = c.mon_command(

@@ -1,0 +1,332 @@
+"""Store waterfall (ISSUE 16): the intra-transaction phase ledger
+below the store_apply wall, IO accounting, the ``dump_store``
+surface, and the trace exporter's store lanes.
+
+The invariant is the hop/device ledger's, pushed into the ObjectStore:
+charging each inter-stamp interval to the phase that ENDS it makes the
+per-transaction phase sum equal the transaction wall exactly — on
+synthetic ledgers, on carved (alloc/compress meta) ledgers, and on
+real ledgers harvested from writes through all three backends.  The
+cluster-merged ``store_waterfall`` block must name a real top phase
+so the ROADMAP item-2 store work has a measured target.
+"""
+import json
+import os
+import time
+
+import pytest
+
+from ceph_tpu.cluster import Cluster
+from ceph_tpu.cluster import test_config as make_conf
+from ceph_tpu.store import (BlockStore, FileStore, GHObject, MemStore,
+                            Transaction)
+from ceph_tpu.utils.store_ledger import (PHASE_ORDER, StoreLedgerAccum,
+                                         charge, merge_dumps,
+                                         op_family,
+                                         store_waterfall_block)
+from tools.trace_export import export_bundles
+
+C = "1.0s0"
+
+
+def _led(t0, **over):
+    led = {"txn_queued": t0,
+           "journal_append": t0 + 0.002,
+           "journal_fsync": t0 + 0.005,
+           "data_write": t0 + 0.011,
+           "kv_commit": t0 + 0.013,
+           "flush": t0 + 0.014,
+           "apply_done": t0 + 0.015,
+           "op": "client_write", "txns": 1, "bytes_written": 4096}
+    led.update(over)
+    return led
+
+
+@pytest.fixture(params=["mem", "file", "block"])
+def store(request, tmp_path):
+    if request.param == "mem":
+        s = MemStore()
+    elif request.param == "block":
+        s = BlockStore(str(tmp_path / "store"))
+    else:
+        s = FileStore(str(tmp_path / "store"))
+    s.mkfs()
+    s.mount()
+    s.queue_transactions([Transaction().create_collection(C)])
+    yield s
+    s.umount()
+
+
+# ------------------------------------------------------------- units
+def test_charge_sum_equals_txn_wall():
+    led = _led(1000.0)
+    charged = charge(led)
+    # every interval charged to the phase ending it; meta fields
+    # (op, txns, bytes) never appear as phases
+    names = [n for n, _ in charged]
+    assert names == [n for n in PHASE_ORDER[1:]
+                     if n not in ("alloc", "compress")]
+    assert sum(dt for _, dt in charged) == \
+        pytest.approx(led["apply_done"] - led["txn_queued"], abs=1e-12)
+
+
+def test_charge_carves_alloc_and_compress_out_of_data_write():
+    led = _led(2000.0, alloc_s=0.002, compress_s=0.001)
+    charged = dict(charge(led))
+    # the 6 ms journal_fsync -> data_write interval splits three ways
+    assert charged["alloc"] == pytest.approx(0.002, abs=1e-9)
+    assert charged["compress"] == pytest.approx(0.001, abs=1e-9)
+    assert charged["data_write"] == pytest.approx(0.003, abs=1e-9)
+    # ...and the per-txn sum stays exact
+    assert sum(charge(led)[i][1] for i in range(len(charge(led)))) == \
+        pytest.approx(led["apply_done"] - led["txn_queued"], abs=1e-9)
+    # carve order follows PHASE_ORDER (alloc before data_write)
+    names = [n for n, _ in charge(led)]
+    assert names.index("alloc") < names.index("data_write") < \
+        names.index("compress")
+
+
+def test_charge_clamps_oversized_carve_meta():
+    # a meta accumulator gone wild can never push the sum past the
+    # wall: the carve is clamped to the enclosing data_write interval
+    led = _led(3000.0, alloc_s=10.0, compress_s=5.0)
+    charged = dict(charge(led))
+    assert charged["data_write"] == pytest.approx(0.0, abs=1e-9)
+    assert charged["alloc"] == pytest.approx(0.006, abs=1e-9)
+    assert "compress" not in charged      # nothing left to carve
+    assert sum(dt for _, dt in charge(led)) == \
+        pytest.approx(led["apply_done"] - led["txn_queued"], abs=1e-9)
+
+
+def test_charge_partial_ledger_stays_exact():
+    # the MemStore shape: no journal, no KV — the whole wall folds
+    # into data_write / flush / apply_done (absent phases zero-width)
+    led = {"txn_queued": 5.0, "data_write": 5.02, "flush": 5.021,
+           "apply_done": 5.021}
+    charged = dict(charge(led))
+    assert charged["data_write"] == pytest.approx(0.02, abs=1e-12)
+    assert sum(charge(led)[i][1] for i in range(3)) == \
+        pytest.approx(0.021, abs=1e-12)
+    assert charge({"apply_done": 1.0}) == []
+    assert charge({}) == []
+    assert charge({"bytes_written": 4096}) == []
+
+
+def test_op_family_mapping():
+    assert op_family("write") == "write"
+    assert op_family("zero") == "write"
+    assert op_family("omap_rmkeys") == "omap"
+    assert op_family("setattrs") == "setattr"
+    assert op_family("coll_move_rename") == "clone"
+    assert op_family("create_collection") == "other"
+    assert op_family("never_heard_of_it") == "other"
+
+
+def test_accum_census_and_io_accounting():
+    accum = StoreLedgerAccum()
+    for j in range(8):
+        accum.observe(_led(100.0 + j * 0.02, journal_bytes=512,
+                           blocks_allocated=2, alloc_s=0.001),
+                      op_counts={"write": 2, "omap": 1})
+    accum.observe(None)                      # tolerated, not counted
+    accum.observe({"bytes_written": 4096})   # stamp-free: not counted
+    dump = accum.dump()
+    assert dump["txns"] == 8
+    # accumulated phase seconds == accumulated txn walls (the
+    # invariant, summed), with the alloc carve folded in
+    assert sum(dump["phase_seconds"].values()) == \
+        pytest.approx(dump["txn_seconds"], abs=1e-9)
+    assert dump["phase_seconds"]["alloc"] == \
+        pytest.approx(8 * 0.001, abs=1e-9)
+    io = dump["io"]
+    assert io["op_counts"] == {"write": 16, "omap": 8}
+    assert io["bytes_written"] == 8 * 4096
+    assert io["journal_bytes"] == 8 * 512
+    assert io["blocks_allocated"] == 16
+    assert io["txn_batch_occupancy"] == pytest.approx(1.0)
+    assert set(dump["p99_s"]) >= {"journal_fsync", "data_write",
+                                  "kv_commit"}
+
+
+def test_merge_dumps_and_waterfall_block():
+    a, b = StoreLedgerAccum(), StoreLedgerAccum()
+    for j in range(4):
+        a.observe(_led(50.0 + j * 0.02), op_counts={"write": 1})
+        b.observe(_led(80.0 + j * 0.02), op_counts={"write": 1})
+    b.note_stall()
+    merged = merge_dumps([a.dump(), b.dump(), None, {}])
+    assert merged["txns"] == 8
+    assert merged["stalls"] == 1
+    assert merged["io"]["op_counts"]["write"] == 8
+    assert sum(merged["phase_seconds"].values()) == \
+        pytest.approx(merged["txn_seconds"], abs=1e-9)
+    blk = store_waterfall_block(merged, wall_s=2.0)
+    assert blk["sum_of_shares"] == pytest.approx(1.0, abs=1e-3)
+    assert blk["vs_wall"] == pytest.approx(1.0, abs=1e-3)
+    # data_write dominates the synthetic ledger (6 ms of 15 ms)
+    assert blk["top_phase"] == "data_write"
+    assert sum(blk["scaled_s"].values()) == pytest.approx(2.0, abs=1e-2)
+    assert blk["stalls"] == 1
+    assert blk["io"]["bytes_written"] == 8 * 4096
+    # degenerate: an idle store produces an empty, non-crashing block
+    empty = store_waterfall_block(merge_dumps([]), wall_s=0.0)
+    assert empty["txns"] == 0 and empty["top_phase"] is None
+
+
+# --------------------------------------- live stores, all 3 backends
+def test_backend_ledgers_charge_sum_equals_wall(store):
+    """Writes through a real backend must leave ledgers whose charged
+    phases sum to the transaction wall exactly — BlockStore with its
+    journal/alloc/kv stamps, FileStore, and the stamp-sparse MemStore
+    all under the same rule."""
+    payload = os.urandom(8192)
+    for i in range(6):
+        store.queue_transactions(
+            [Transaction().write(C, GHObject(f"o{i}", 0), 0, payload)],
+            op="client_write")
+    store.queue_transactions(
+        [Transaction().setattr(C, GHObject("o0", 0), "k", b"v")])
+    accum = store._store_accum()
+    recent = accum.recent()
+    assert len(recent) >= 7              # + the fixture's collection
+    for led in recent:
+        stamps = [led[p] for p in PHASE_ORDER if p in led]
+        assert len(stamps) >= 2
+        assert sum(dt for _, dt in charge(led)) == \
+            pytest.approx(stamps[-1] - stamps[0], abs=1e-9)
+    dump = store.dump_store()
+    assert dump["backend"] == type(store).__name__
+    assert dump["txns"] == len(recent)
+    assert sum(dump["phase_seconds"].values()) == \
+        pytest.approx(dump["txn_seconds"], abs=1e-6)
+    io = dump["io"]
+    assert io["op_counts"]["write"] == 6
+    assert io["op_counts"]["setattr"] == 1
+    assert io["bytes_written"] == 6 * len(payload)
+    # the op tag rides the ledger for the forensics/trace lanes
+    assert any(led.get("op") == "client_write" for led in recent)
+    if isinstance(store, BlockStore):
+        # the journal/alloc/kv path actually stamped its phases
+        assert dump["phase_seconds"].get("journal_append", 0) > 0
+        assert dump["phase_seconds"].get("kv_commit", 0) > 0
+        assert io["journal_bytes"] > 0
+        assert io["blocks_allocated"] > 0
+
+
+# ------------------------------------------------- live vstart cluster
+def _cluster_store_dumps(c):
+    dumps = []
+    for osd in c.osds.values():
+        if osd is None:
+            continue
+        ret, _, out = osd._exec_command({"prefix": "dump_store"})
+        assert ret == 0
+        assert out["backend"]
+        assert "phase_seconds" in out and "io" in out
+        dumps.append(out)
+    return dumps
+
+
+def test_cluster_store_waterfall_names_a_real_top_phase():
+    """vstart EC write: dump_store round-trips through the admin
+    socket on every OSD and the cluster-merged store_waterfall block
+    names a real dominant phase (the ISSUE 16 acceptance invariant,
+    small-cluster tier-1 variant)."""
+    with Cluster(n_osds=4, conf=make_conf()) as c:
+        for i in range(4):
+            c.wait_for_osd_up(i, 20)
+        c.create_ec_profile("swf", plugin="tpu", k="2", m="1")
+        c.create_pool("swfp", "erasure", erasure_code_profile="swf")
+        rad = c.rados(timeout=60)
+        io = rad.open_ioctx("swfp")
+        for i in range(8):
+            io.write_full(f"sw{i}", os.urandom(8192))
+        merged = merge_dumps(_cluster_store_dumps(c))
+        assert merged["txns"] > 0
+        assert merged["io"]["op_counts"].get("write", 0) > 0
+        assert merged["io"]["bytes_written"] > 0
+        blk = store_waterfall_block(
+            merged, wall_s=sum(merged["phase_seconds"].values()))
+        assert blk["sum_of_shares"] == pytest.approx(1.0, abs=1e-3)
+        assert blk["top_phase"] in PHASE_ORDER
+        # the store perf subsystem is live on every daemon
+        osd = next(o for o in c.osds.values() if o is not None)
+        pd = osd.perf_coll.perf_dump()
+        assert pd["store"]["txns"] > 0
+        assert pd["store"]["op_write"] > 0
+        # ...and the trace bundle carries the store lanes
+        bundle = osd._trace_bundle()
+        assert bundle["store"]["ledgers"]
+        trace = export_bundles([bundle])
+        assert any(e.get("name") == "store_txn"
+                   for e in trace["traceEvents"])
+
+
+@pytest.mark.slow
+def test_cluster_store_waterfall_k8m4():
+    """The full bench shape: k=8 m=4 over 13 OSDs — the cluster-
+    merged waterfall still sums to 1.0 and names a top phase."""
+    with Cluster(n_osds=13, conf=make_conf()) as c:
+        for i in range(13):
+            c.wait_for_osd_up(i, 60)
+        c.create_ec_profile("swf84", plugin="tpu", k="8", m="4")
+        c.create_pool("swfp84", "erasure", erasure_code_profile="swf84")
+        rad = c.rados(timeout=120)
+        io = rad.open_ioctx("swfp84")
+        for i in range(12):
+            io.write_full(f"sw{i}", os.urandom(1 << 20))
+        merged = merge_dumps(_cluster_store_dumps(c))
+        assert merged["txns"] > 0
+        blk = store_waterfall_block(
+            merged, wall_s=sum(merged["phase_seconds"].values()))
+        assert blk["sum_of_shares"] == pytest.approx(1.0, abs=1e-3)
+        assert blk["top_phase"] in PHASE_ORDER
+        assert merged["io"]["bytes_written"] >= 12 * (1 << 20)
+
+
+# --------------------------------------------- trace export store lanes
+def _store_bundle(name, t0=1000.0):
+    return {"daemon": name,
+            "ledgers": {"write": [{"client_send": t0,
+                                   "recv": t0 + 0.01,
+                                   "store_apply": t0 + 0.04,
+                                   "client_complete": t0 + 0.05}]},
+            "ops": [], "flight": {"events": []}, "reactors": [],
+            "store": {"ledgers": [
+                _led(t0 + 0.011),
+                _led(t0 + 0.027, op="pgmeta", bytes_written=0),
+                {"txn_queued": t0 + 0.06, "data_write": t0 + 0.065,
+                 "flush": t0 + 0.0655, "apply_done": t0 + 0.066},
+                {"bytes_written": 4096},        # stamp-free: skipped
+                None, "garbage"]},              # armor: never raises
+            "folded": []}
+
+
+def test_export_store_lanes_round_trip():
+    trace = export_bundles([_store_bundle("osd.0")])
+    evs = json.loads(json.dumps(trace, allow_nan=False))["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    txns = [e for e in xs if e["name"] == "store_txn"]
+    # three stamped ledgers -> three enclosing slices; the meta-only
+    # and garbage entries are dropped, not fatal
+    assert len(txns) == 3 and all(e["cat"] == "store" for e in txns)
+    assert all(e["tid"] >= 850 for e in txns)
+    assert any(e["args"].get("op") == "client_write" and
+               e["args"].get("bytes") == 4096 for e in txns)
+    assert any(e["args"].get("op") == "pgmeta" for e in txns)
+    for phase in ("journal_append", "journal_fsync", "data_write",
+                  "kv_commit", "flush", "apply_done"):
+        assert any(e["name"] == phase and e.get("cat") == "store"
+                   for e in xs), phase
+    tn = {e["args"]["name"] for e in evs
+          if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "store txns" in tn
+    assert all(e["ts"] >= 0 and e["dur"] >= 0 for e in xs)
+    # the store slices share the hop clock: the client_write txn
+    # lands NESTED inside its enclosing store_apply hop slice
+    hop = next(e for e in xs if e["name"] == "store_apply"
+               and e.get("cat") != "store")
+    inner = next(e for e in txns
+                 if e["args"].get("op") == "client_write")
+    assert inner["ts"] >= hop["ts"] - 1
+    assert inner["ts"] + inner["dur"] <= hop["ts"] + hop["dur"] + 1

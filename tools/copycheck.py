@@ -64,6 +64,12 @@ HOT_MODULES = [
     # fill — shard_map/NamedSharding slice views, they must never
     # materialise per-chip copies on the host
     "ceph_tpu/parallel/mesh.py",
+    # the store apply hot path (ISSUE 16): every transaction's data
+    # blocks flow through _apply_ops to the block device, and the
+    # store ledger stamps time.time() floats / meta ints along this
+    # same path — stamps and census counts are scalars, never payload
+    # slices, so the intra-transaction waterfall must add ZERO copies
+    "ceph_tpu/store/blockstore.py",
 ]
 
 # constructs that materialise a full payload copy

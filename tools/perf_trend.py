@@ -33,6 +33,12 @@ history and fails loudly on:
   stage_acquire / h2d / compute fence / d2h / deliver).  Rounds
   predating the device ledger silently skip, as does a fresh run
   that routed no groups to the device.
+- **store-phase p99 regression** — the same budget applied another
+  layer down, to the ``store_waterfall`` block (the intra-transaction
+  ledger below the ``store_apply`` hop: journal append/fsync, alloc,
+  data write, compress, kv commit).  History rounds predating the
+  store ledger carry no store_waterfall block and self-skip, as does
+  a fresh run that applied no store transactions.
 - **pipeline-overlap collapse** — the overlap engine's verdict
   (``pipeline_overlap_frac``: fraction of the device window where
   group N+1's h2d hides under group N's compute) falls below
@@ -370,6 +376,39 @@ def check(attribution: Optional[Dict], history: List[Dict],
                         f"{new * 1e3:.2f} ms > "
                         f"{hop_p99_factor:.1f} x history "
                         f"{old * 1e3:.2f} ms (device_waterfall "
+                        f"budget)"})
+
+    # -- store-phase p99 budgets (store_waterfall block) --------------
+    # (ISSUE 16) The hop budget applied below the store_apply wall:
+    # the intra-transaction phase ledger stamped inside the
+    # ObjectStore seams (journal append / journal fsync / alloc /
+    # data write / compress / kv commit / flush).  Rounds predating
+    # the store ledger carry no store_waterfall block and self-skip;
+    # a fresh run that applied no store transactions has no phase
+    # p99s worth budgeting and also self-skips.
+    fresh_swf = (attribution or {}).get("store_waterfall") \
+        if attribution is not None else None
+    hist_swf = _hist_block("store_waterfall")
+    if isinstance(fresh_swf, dict) and fresh_swf.get("txns") \
+            and hist_swf is not None:
+        old_p99 = hist_swf.get("p99_s") or {}
+        new_p99 = fresh_swf.get("p99_s") or {}
+        for phase in sorted(new_p99):
+            old = old_p99.get(phase)
+            new = new_p99.get(phase)
+            if not isinstance(old, (int, float)) \
+                    or not isinstance(new, (int, float)):
+                continue
+            if new > old * hop_p99_factor \
+                    and new - old > HOP_P99_SLACK_S:
+                findings.append({
+                    "check": "store-phase-p99-regression",
+                    "severity": "fail",
+                    "message":
+                        f"store phase {phase!r} p99 "
+                        f"{new * 1e3:.2f} ms > "
+                        f"{hop_p99_factor:.1f} x history "
+                        f"{old * 1e3:.2f} ms (store_waterfall "
                         f"budget)"})
 
     # -- pipeline-overlap collapse ------------------------------------

@@ -45,17 +45,21 @@ from typing import Dict, List, Optional
 try:
     from ceph_tpu.utils.hops import CHARGE_ORDER
     from ceph_tpu.utils.device_ledger import PHASE_ORDER
+    from ceph_tpu.utils.store_ledger import (
+        PHASE_ORDER as STORE_PHASE_ORDER)
 except ImportError:                     # invoked as a script from tools/
     sys.path.insert(0, os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     from ceph_tpu.utils.hops import CHARGE_ORDER
     from ceph_tpu.utils.device_ledger import PHASE_ORDER
+    from ceph_tpu.utils.store_ledger import (
+        PHASE_ORDER as STORE_PHASE_ORDER)
 
 #: thread-id bases per track family (per daemon process); lanes for
 #: concurrent ops fan out upward from the base
 _TID_BASE = {"write": 100, "read": 200, "recovery": 300,
              "optracker": 400, "flight": 500, "reactor": 600,
-             "device": 700, "tuner": 800}
+             "device": 700, "tuner": 800, "store": 850}
 _MAX_LANES = 64          # overlap-packing cap per track family
 _DEVICE_LANE_STRIDE = 32  # tid span per JAX device id (mesh-ready)
 
@@ -111,10 +115,12 @@ def _ledger_slices(ledger: Dict[str, float]):
     return stamps[0][1], prev_t, spans
 
 
-def _device_phase_slices(ledger: Dict[str, float]):
-    """-> (start, end, [(phase, t_start, t_end)]) in device phase
-    order (charge-to-ending-phase), or None for degenerate ledgers."""
-    stamps = [(name, ledger[name]) for name in PHASE_ORDER
+def _phase_slices(ledger: Dict[str, float], order):
+    """-> (start, end, [(phase, t_start, t_end)]) in the given phase
+    order (charge-to-ending-phase), or None for degenerate ledgers.
+    Only the phase stamps are read — meta fields (op tags, byte
+    counts, carved seconds) never look like timestamps here."""
+    stamps = [(name, ledger[name]) for name in order
               if isinstance(ledger.get(name), (int, float))]
     if len(stamps) < 2:
         return None
@@ -159,6 +165,10 @@ def export_bundles(bundles: List[Dict]) -> Dict:
             # phase stamps only: device ledgers carry meta fields
             # (device id, payload bytes) that are NOT timestamps
             for name in PHASE_ORDER:
+                _see(led.get(name))
+        for led in _as_list(_as_dict(b.get("store")).get("ledgers")):
+            led = _as_dict(led)
+            for name in STORE_PHASE_ORDER:
                 _see(led.get(name))
     if t0 is None:
         t0 = 0.0
@@ -296,7 +306,7 @@ def export_bundles(bundles: List[Dict]) -> Dict:
         for led in _as_list(dev_block.get("ledgers")):
             if not isinstance(led, dict):
                 continue
-            sl = _device_phase_slices(led)
+            sl = _phase_slices(led, PHASE_ORDER)
             if sl is None:
                 continue
             try:
@@ -362,6 +372,44 @@ def export_bundles(bundles: List[Dict]) -> Dict:
                     except (KeyError, TypeError):
                         pass
                 prev = led
+        # -- store transaction phase lanes (ISSUE 16) --------------
+        # every recent store-transaction ledger becomes an enclosing
+        # store_txn slice plus nested per-phase slices (journal
+        # append/fsync, alloc, data write, compress, kv commit —
+        # charge-to-ending-phase, same rule as the hop and device
+        # tracks).  Store ledgers use the same absolute clock as the
+        # hop ledgers, so these slices land NESTED under the
+        # store_apply hop slice of the enclosing op on the timeline.
+        base = _TID_BASE["store"]
+        lanes = _Lanes()
+        store_leds = [led for led in
+                      _as_list(_as_dict(b.get("store")).get("ledgers"))
+                      if isinstance(led, dict)]
+        store_items = []
+        for led in store_leds:
+            sl = _phase_slices(led, STORE_PHASE_ORDER)
+            if sl is not None:
+                store_items.append((led, sl))
+        store_items.sort(key=lambda it: it[1][0])
+        for led, (start, end, spans) in store_items:
+            tid = base + lanes.place(start, end)
+            named_tids.setdefault(tid, "store txns")
+            args = {"txns": led.get("txns", 1)}
+            if led.get("op"):
+                args["op"] = led["op"]
+            if led.get("bytes_written"):
+                args["bytes"] = led["bytes_written"]
+            events.append({
+                "ph": "X", "name": "store_txn", "cat": "store",
+                "pid": pid, "tid": tid, "ts": us(start),
+                "dur": round((end - start) * 1e6, 1),
+                "args": args})
+            for phase, hs, he in spans:
+                events.append({
+                    "ph": "X", "name": phase, "cat": "store",
+                    "pid": pid, "tid": tid, "ts": us(hs),
+                    "dur": round((he - hs) * 1e6, 1)})
+
         mem = _as_dict(dev_block.get("memory"))
         if mem and by_dev:
             last_ts = max(end for items in by_dev.values()
