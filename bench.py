@@ -1102,7 +1102,8 @@ _FLOOR_STATS = {"cluster_k8m4_vs_baseline": None,
                 "load_attribution": None,
                 "rebuild_attribution": None,
                 "multichip_mesh": None,
-                "selftune_attribution": None}
+                "selftune_attribution": None,
+                "store_ladder_attribution": None}
 
 
 def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
@@ -1113,10 +1114,18 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
     config (below) is deliberately CPU-routed.  26 objects over 13
     primaries: the r4 shape (12 objects) gave every primary ONE op,
     making coalesced=0 structural."""
+    # both sides run the BlueStore-class async store (ISSUE 17): the
+    # synchronous store discipline was the top_hop on BOTH configs,
+    # converging the ratio toward 1x — with commit acks riding WAL
+    # group commit and apply deferred off the PG-lock path, the codec
+    # difference is what's left to measure
+    store_conf = {"osd_objectstore": "bluestore"}
     w_tpu, r_tpu, st = _cluster_run("tpu", n_objs, obj_bytes,
-                                    k="8", m="4", n_osds=13)
+                                    k="8", m="4", n_osds=13,
+                                    extra_conf=store_conf)
     w_cpu, r_cpu, _ = _cluster_run("jerasure", n_objs, obj_bytes,
-                                   k="8", m="4", n_osds=13)
+                                   k="8", m="4", n_osds=13,
+                                   extra_conf=store_conf)
     emit(f"cluster write MB/s (13-OSD vstart, pool plugin=tpu k=8 "
          f"m=4, {n_objs}x{obj_bytes >> 20} MiB concurrent writes; "
          f"batcher: {st['reqs']} encode reqs -> {st['calls']} device "
@@ -1162,6 +1171,7 @@ def bench_cluster_k8m4(n_objs=26, obj_bytes=8 << 20):
             "faults": st.get("faults", {}),
             "breaker": st.get("breaker", {}),
             "subwrite_deadlines": st.get("subwrite", {}),
+            "osd_objectstore": "bluestore",
         }
         # hop-by-hop waterfall over the same wall: the client's
         # end-to-end ledger view scaled onto measured wall (shares
@@ -2332,6 +2342,138 @@ def bench_selftune(obj_bytes=512 << 10, per_client=2):
     _FLOOR_STATS["selftune_attribution"] = rec
 
 
+def bench_store_ladder():
+    """Single-OSD store microbench (ISSUE 17): the three local-store
+    disciplines head to head — memstore (no durability), blockstore
+    (synchronous WAL+apply under one lock) and bluestore (WAL group
+    commit + deferred apply) — at queue depths 1/8/32 with 64 KiB and
+    1 MiB transactions, all file-backed in one tmpdir so the fsync
+    cost is real and comparable.  Emits a store_waterfall-carrying
+    attribution record; perf_trend gates bluestore >= blockstore at
+    every rung."""
+    import shutil
+    import tempfile
+    import threading
+    from ceph_tpu.store import BlockStore, BlueStore, MemStore
+    from ceph_tpu.store.objectstore import GHObject, Transaction
+
+    root = tempfile.mkdtemp(prefix="store_ladder_")
+    rng = np.random.default_rng(17)
+    payloads = {"64k": rng.integers(0, 256, 64 << 10,
+                                    dtype=np.uint8).tobytes(),
+                "1m": rng.integers(0, 256, 1 << 20,
+                                   dtype=np.uint8).tobytes()}
+    # per-rung byte budget ~24 MiB: enough txns that group commit
+    # has concurrency to amortize, small enough the 18-rung sweep
+    # stays in bench time
+    n_txns = {"64k": 384, "1m": 24}
+
+    def make(kind, tag):
+        if kind == "memstore":
+            s = MemStore()
+        elif kind == "blockstore":
+            s = BlockStore(os.path.join(root, tag))
+        else:
+            s = BlueStore(os.path.join(root, tag))
+        s.mkfs()
+        s.mount()
+        return s
+
+    def rung(store, qd, label):
+        data = payloads[label]
+        per = max(1, n_txns[label] // qd)
+        coll = f"1.{qd}{label}s0"
+        store.queue_transactions(
+            [Transaction().create_collection(coll)])
+        errs = []
+
+        def worker(wid):
+            try:
+                for i in range(per):
+                    t = Transaction()
+                    t.write(coll, GHObject(f"o{wid}_{i}"), 0, data)
+                    store.queue_transactions([t])
+            except Exception as e:     # surfaced, not swallowed
+                errs.append(e)
+
+        t0 = time.perf_counter()
+        ws = [threading.Thread(target=worker, args=(w,))
+              for w in range(qd)]
+        for w in ws:
+            w.start()
+        for w in ws:
+            w.join()
+        store.flush()                  # applied + callbacks drained
+        wall = time.perf_counter() - t0
+        if errs:
+            raise errs[0]
+        return qd * per * len(data) / 2**20 / wall, wall
+
+    ladder = {}
+    walls = {}
+    dumps = {}
+    for kind in ("memstore", "blockstore", "bluestore"):
+        side = {}
+        wall_sum = 0.0
+        for label in ("64k", "1m"):
+            for qd in (1, 8, 32):
+                s = make(kind, f"{kind}_{label}_qd{qd}")
+                try:
+                    mbs, wall = rung(s, qd, label)
+                finally:
+                    s.umount()
+                side[f"qd{qd}_{label}"] = round(mbs, 2)
+                wall_sum += wall
+        ladder[kind] = side
+        walls[kind] = wall_sum
+        # the waterfall rides the LAST store of a kind; the merged
+        # cross-rung view needs the accumulators of all six, so
+        # re-dump from a fresh mount would lose them — instead merge
+        # nothing and keep the per-kind phase profile of the sweep
+        # via dump_store on the final instance (phase history is
+        # per-instance; the bluestore block below is the gated one)
+    # one more bluestore pass with dump_store retained: the
+    # store_waterfall must carry the deferred pipeline's phase split
+    s = make("bluestore", "bluestore_waterfall")
+    try:
+        mbs32, wall32 = rung(s, 32, "1m")
+        dumps["bluestore"] = s.dump_store()
+        blue_usage = s.usage()
+    finally:
+        s.umount()
+    shutil.rmtree(root, ignore_errors=True)
+    blue = ladder["bluestore"]
+    block = ladder["blockstore"]
+    agg_blue = sum(blue.values()) / len(blue)
+    agg_block = sum(block.values()) / len(block)
+    rec = {
+        "metric": "store ladder write MB/s (single-OSD microbench: "
+                  "memstore vs blockstore vs bluestore, qd 1/8/32, "
+                  "64 KiB and 1 MiB txns, file-backed; value = "
+                  "bluestore qd32 1 MiB rung, vs_baseline = mean "
+                  "bluestore over mean blockstore across rungs)",
+        "value": round(blue["qd32_1m"], 2), "unit": "MB/s",
+        "vs_baseline": round(agg_blue / agg_block, 3),
+        "ladder": ladder,
+        "wal": blue_usage.get("wal", {}),
+        "apply": blue_usage.get("apply", {}),
+        "csum": blue_usage.get("csum", {}),
+    }
+    from ceph_tpu.utils.store_ledger import store_waterfall_block
+    sl = dumps.get("bluestore")
+    if sl and sl.get("txns"):
+        rec["store_waterfall"] = store_waterfall_block(
+            sl, round(wall32, 6))
+    print(json.dumps(rec), flush=True)
+    emit(f"store ladder summary (bluestore qd32 1 MiB "
+         f"{blue['qd32_1m']:.1f} MB/s; blockstore "
+         f"{block['qd32_1m']:.1f} MB/s; wal group_syncs "
+         f"{rec['wal'].get('group_syncs', 0)} over "
+         f"{rec['wal'].get('records', 0)} txns)",
+         blue["qd32_1m"], "MB/s", agg_blue / agg_block)
+    _FLOOR_STATS["store_ladder_attribution"] = rec
+
+
 CONFIGS = {
     "roofline": bench_roofline,
     "rs_k2m1": lambda: bench_encode_rs(2, 1, 4 << 10, 1024),
@@ -2369,6 +2511,10 @@ EXTRA_CONFIGS = {
     # (ISSUE 15) — static conf defaults vs the per-OSD controller
     # walking the batcher knobs live, tuned >= static at every rung
     "selftune": bench_selftune,
+    # opt-in (--only store_ladder): the single-OSD local-store
+    # microbench (ISSUE 17) — memstore vs blockstore vs bluestore at
+    # qd 1/8/32, 64 KiB and 1 MiB txns, bluestore >= blockstore gated
+    "store_ladder": bench_store_ladder,
 }
 CONFIGS_ALL = dict(CONFIGS, **EXTRA_CONFIGS)
 
@@ -2465,7 +2611,9 @@ def main():
                     "rebuild_attribution"),
                 fresh_mesh=_FLOOR_STATS.get("multichip_mesh"),
                 fresh_selftune=_FLOOR_STATS.get(
-                    "selftune_attribution"))
+                    "selftune_attribution"),
+                fresh_store_ladder=_FLOOR_STATS.get(
+                    "store_ladder_attribution"))
             for fnd in findings:
                 print(f"# --assert-floor perf-trend "
                       f"{fnd['severity'].upper()} [{fnd['check']}]: "

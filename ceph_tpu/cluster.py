@@ -91,6 +91,20 @@ class Cluster:
     def mon_addr(self) -> Tuple[str, int]:
         return self.mon.my_addr
 
+    def _bluestore(self, path: str) -> ObjectStore:
+        from .store.bluestore import BlueStore
+        return BlueStore(
+            path,
+            compression=self.conf[
+                "blockstore_compression_algorithm"],
+            wal_segment_bytes=self.conf[
+                "bluestore_wal_segment_bytes"],
+            group_commit_window_s=self.conf[
+                "bluestore_group_commit_window_us"] / 1e6,
+            apply_batch_txns=self.conf["bluestore_apply_batch_txns"],
+            deferred_queue_depth=self.conf[
+                "bluestore_deferred_queue_depth"])
+
     def _make_store(self, osd_id: int) -> ObjectStore:
         if self.data_dir is None:
             if self.store_kind == "block":
@@ -98,9 +112,17 @@ class Cluster:
                     "store_kind='block' needs a data_dir (a durable "
                     "backend silently downgraded to MemStore would "
                     "lose data)")
-            store = MemStore(
-                max_bytes=self.conf["memstore_max_bytes"])
-            store.mkfs()
+            if self.store_kind == "bluestore":
+                # RAM mode: the full async pipeline (WAL group
+                # commit, deferred apply, overlay reads) minus the
+                # backing files — memory clusters exercise the real
+                # transaction discipline
+                store = self._bluestore("")
+                store.mkfs()
+            else:
+                store = MemStore(
+                    max_bytes=self.conf["memstore_max_bytes"])
+                store.mkfs()
         else:
             path = os.path.join(self.data_dir, f"osd.{osd_id}")
             if self.store_kind == "block":
@@ -108,6 +130,8 @@ class Cluster:
                 store = BlockStore(
                     path, compression=self.conf[
                         "blockstore_compression_algorithm"])
+            elif self.store_kind == "bluestore":
+                store = self._bluestore(path)
             else:
                 store = FileStore(path,
                                   fsync=self.conf["filestore_fsync"])

@@ -216,6 +216,20 @@ class CrimsonOSD(OSD):
             site = f"mailbox_r{r.shard}"
             self.contention.register_queue(site)
             r.bind_contention(self.contention, site)
+        # reactor-native deferred apply: a BlueStore-class backend
+        # schedules its apply batches as tasks on the LAST shard
+        # (shard 0 carries maintenance timers) instead of spinning a
+        # thread the reactor model doesn't own; blocked readers still
+        # work-steal, so a shard reading its own pending write makes
+        # progress without waiting on the apply shard.  Only worth it
+        # with a spare shard: on a single-reactor OSD the apply
+        # batches would block the one event loop that carries the
+        # whole data path (measured: 0.67x jerasure on the 1-core
+        # k8m4 run vs 2x+ with the applier thread), so N=1 keeps the
+        # store's own daemon thread.
+        if hasattr(self.store, "bind_apply_reactor") \
+                and len(self.reactors) > 1:
+            self.store.bind_apply_reactor(self.reactors[-1])
 
     def _make_messenger(self) -> Messenger:
         return CrimsonMessenger(f"osd.{self.whoami}", conf=self.conf,
@@ -300,6 +314,10 @@ class CrimsonOSD(OSD):
                 pass
         self.msgr.shutdown()
         self.timer_wheel.stop()
+        # unbind the apply shard BEFORE the reactors die so umount's
+        # inline drain doesn't schedule onto a stopped reactor
+        if hasattr(self.store, "bind_apply_reactor"):
+            self.store.bind_apply_reactor(None)
         for r in self.reactors:
             r.stop()
         self._sampler_release()

@@ -54,6 +54,10 @@ class Mutation:
     trace_id: int = 0               # blkin-style trace context (0=off)
     parent_span_id: int = 0         # primary's osd_op span (0=none)
     tracked_op: Optional[object] = None   # OpTracker TrackedOp handle
+    client_msg: Optional[object] = None   # MOSDOp for hop stamping: the
+    # backend stamps store_apply on it at the PRIMARY'S LOCAL store
+    # commit, so the client waterfall splits local-store time from the
+    # peer_ack_wait that follows (first-stamp-wins keeps it safe)
     # -- snapshot machinery (reference make_writeable, osd/snaps.py) --
     clone_to: Optional[str] = None  # COW the head to this oid FIRST
     clone_attrs: Dict[str, bytes] = field(default_factory=dict)

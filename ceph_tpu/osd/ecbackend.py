@@ -722,10 +722,17 @@ class ECBackend(PGBackend):
                 span.tag("shard", self.host.own_shard).tag(
                     "pgid", self.host.pgid_str).finish()
             tid = op.tid
+            cmsg = op.mutation.client_msg
+
+            def _local_committed(t=tid, s=seg, m=cmsg):
+                if m is not None:
+                    # first segment's commit wins: from here the op is
+                    # waiting on the ack set, not the local store
+                    m.stamp_hop("store_apply")
+                self._sub_write_committed(t, self.host.own_shard, s)
             self._apply_sub_write(
                 self.host.own_shard, local_txn, wire_entries,
-                lambda: self._sub_write_committed(
-                    tid, self.host.own_shard, seg))
+                _local_committed)
 
     # -- pipelined segmented fanout ------------------------------------
     def _start_segmented(self, op: _WriteOp, astart: int, hi: int,

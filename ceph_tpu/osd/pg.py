@@ -1953,6 +1953,7 @@ class PG:
         # batcher can stamp stage events on the client op's timeline
         mut.parent_span_id = getattr(msg, "osd_span_id", 0)
         mut.tracked_op = getattr(msg, "tracked", None)
+        mut.client_msg = msg
         err = 0
         ec = self.pool.is_erasure()
         full_replace = any(op.op == "writefull" for op in msg.ops)
@@ -2188,7 +2189,13 @@ class PG:
         tracked = getattr(msg, "tracked", None)
         if tracked is not None:
             tracked.mark_event("op_commit")
+        # the backend stamped store_apply at the primary's LOCAL store
+        # commit (first-stamp-wins makes this a no-op then); the time
+        # from there to the full acting-set ack is peer_ack_wait — an
+        # async store that acks fast must not have the distributed
+        # round trip charged against it
         msg.stamp_hop("store_apply")
+        msg.stamp_hop("peer_ack_wait")
         self._inflight_remove(msg.oid)
         if msg.oid not in self.inflight_writes:
             self._pending_versions.pop(msg.oid, None)

@@ -81,8 +81,16 @@ class ReplicatedBackend(PGBackend):
             rep.stamp_hop("client_send")
             self.host.send_shard(osd, rep)
         tid = op.tid
-        self._apply_local(txn, wire_entries,
-                          lambda: self._committed(tid, self.host.whoami))
+        cmsg = mutation.client_msg
+
+        def _local_committed(t=tid, m=cmsg):
+            if m is not None:
+                # local store commit: the client waterfall's
+                # store_apply ends here; the replica ack wait that
+                # follows charges to peer_ack_wait
+                m.stamp_hop("store_apply")
+            self._committed(t, self.host.whoami)
+        self._apply_local(txn, wire_entries, _local_committed)
 
     def _lower(self, oid: str, mut: Mutation, at_version: Eversion,
                info: Optional[ObjectInfo]) -> Transaction:

@@ -218,6 +218,19 @@ class BlockStore(ObjectStore):
             self._journal_seq = max(self._journal_seq,
                                     int(key.split("/")[1]))
 
+    # -- checksum seam -------------------------------------------------
+    def _crc_block(self, ext: _Extents, lb: int, blk: bytes) -> None:
+        """Stamp the per-logical-block CRC of freshly written content.
+        Synchronous base: compute inline, one host call per block.
+        BlueStore overrides to queue the block and fold all CRCs of an
+        apply batch through one GF-bitmatrix pass (_crc_fold)."""
+        ext.crcs[lb] = crc32c(blk)
+
+    def _crc_fold(self) -> None:
+        """Hook before extent maps fold into the KV batch: deferred
+        checksum backends materialize queued CRCs here (base: CRCs
+        were computed inline, nothing to do)."""
+
     # -- block IO ------------------------------------------------------
     def _read_block(self, phys: int) -> bytes:
         self._dev.seek(phys * BLOCK)
@@ -406,7 +419,7 @@ class BlockStore(ObjectStore):
                 phys = alloc()
                 self._write_block(phys, blk)
                 ext.blocks[lb] = phys
-                ext.crcs[lb] = crc32c(blk)
+                self._crc_block(ext, lb, blk)
                 dirty = True
             freed.update(seg["phys"])
 
@@ -459,7 +472,8 @@ class BlockStore(ObjectStore):
                 if ext.blocks[lb] >= 0:
                     freed.add(ext.blocks[lb])
                 ext.blocks[lb] = ref
-                ext.crcs[lb] = crc32c(span[i * BLOCK:(i + 1) * BLOCK])
+                self._crc_block(ext, lb,
+                                span[i * BLOCK:(i + 1) * BLOCK])
             self.compress_logical_bytes += len(span)
             self.compress_stored_bytes += nphys * BLOCK
             self._txn_meta("compress_logical", len(span))
@@ -515,7 +529,7 @@ class BlockStore(ObjectStore):
                     if old_phys >= 0:
                         freed.add(old_phys)
                     ext.blocks[lb] = new_phys
-                    ext.crcs[lb] = crc32c(merged_blk)
+                    self._crc_block(ext, lb, merged_blk)
                     dirty = True
                     pos += run
             ext.size = max(ext.size, end)
@@ -594,7 +608,7 @@ class BlockStore(ObjectStore):
                             self._write_block(new_phys, blk)
                             freed.add(ext.blocks[lb])
                             ext.blocks[lb] = new_phys
-                            ext.crcs[lb] = crc32c(blk)
+                            self._crc_block(ext, lb, blk)
                             dirty = True
                     ext.size = size
                     put_ext(coll, obj, ext)
@@ -734,6 +748,9 @@ class BlockStore(ObjectStore):
                     self._alloc.free(phys)
                 raise
         # the COW flip: all extent maps updated in the same batch
+        # (deferred-checksum backends land their batched CRCs first so
+        # the dumped maps carry real values, not placeholders)
+        self._crc_fold()
         for key, ext in ext_cache.items():
             batch.set(key, ext.dump())
         for phys in freed:

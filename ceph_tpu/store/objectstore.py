@@ -495,10 +495,39 @@ class ObjectStore(abc.ABC):
         try:
             faultlib.registry().store_apply(txns)
             self._do_queue_transactions(txns, on_commit)
+        except BaseException:
+            # abort-path ledger hygiene: a txn that raises (check_ops
+            # reject, fault-site error, mid-apply I/O error) leaves
+            # dangling phase stamps — discard the ledger WHOLE rather
+            # than charge a partial waterfall, and count the abort.
+            # BaseException: a simulated crash in the torture test
+            # must not leak ledger state into the next txn either.
+            led.pop("_deferred", None)
+            self._store_accum().note_abort()
+            raise
         finally:
             _TXN_TLS.led = prev
+        if led.pop("_deferred", False):
+            # a deferred-apply backend (BlueStore) took ownership: the
+            # txn is WAL-durable but not yet applied; the apply driver
+            # stamps the remaining phases and calls _finalize_txn when
+            # the batch lands, keeping charge-sum == txn wall.
+            return
+        self._finalize_txn(led, txns)
+
+    def _finalize_txn(self, led: Dict[str, float],
+                      txns: List["Transaction"]) -> None:
+        """Close a transaction's ledger: final stamp + accumulate.
+        Synchronous backends reach here from queue_transactions;
+        deferred-apply backends call it from the apply driver."""
         led["apply_done"] = time.time()
         self._observe_txn(led, txns)
+
+    def flush(self) -> None:
+        """Block until previously queued transactions are applied and
+        their callbacks delivered (reference ObjectStore::flush).
+        Synchronous backends have nothing pending; deferred-apply
+        backends override."""
 
     @abc.abstractmethod
     def _do_queue_transactions(self, txns: List[Transaction],

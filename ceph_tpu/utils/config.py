@@ -516,7 +516,7 @@ def _opts() -> List[Option]:
                            "reports every tick (reference "
                            "osd_mon_report_interval)"),
         Option("osd_objectstore", str, "memstore",
-               enum_allowed=("memstore", "file", "block"),
+               enum_allowed=("memstore", "file", "block", "bluestore"),
                description="backing store kind for new OSDs "
                            "(reference osd_objectstore; consumed by "
                            "vstart/cephadm provisioning)"),
@@ -602,6 +602,31 @@ def _opts() -> List[Option]:
                            "(reference bluestore_compression_"
                            "algorithm; none disables; reads honor "
                            "whatever a segment was written with)"),
+        Option("bluestore_wal_segment_bytes", int, 16 << 20,
+               min=1 << 20, max=256 << 20, tunable=True,
+               description="BlueStore WAL rolls to a new segment "
+                           "past this size; retired whole once fully "
+                           "applied (reference bluefs/WAL sizing)"),
+        Option("bluestore_group_commit_window_us", int, 0,
+               min=0, max=10000, tunable=True,
+               description="group-commit leader dwells this long "
+                           "before the shared WAL fsync so "
+                           "concurrent committers pile in; 0 syncs "
+                           "immediately (reference "
+                           "bluefs_alloc_size-era batching analog)"),
+        Option("bluestore_apply_batch_txns", int, 16,
+               min=1, max=512, tunable=True,
+               description="max WAL-durable transactions folded into "
+                           "one deferred apply batch: one vectored "
+                           "device pass + one KV commit (reference "
+                           "bluestore_deferred_batch_ops)"),
+        Option("bluestore_deferred_queue_depth", int, 128,
+               min=1, max=4096, tunable=True,
+               description="pending (committed, unapplied) txns "
+                           "before queue_transactions blocks — "
+                           "bounds the commit→apply window "
+                           "(reference bluestore_throttle_deferred_"
+                           "bytes analog)"),
         # -- client -------------------------------------------------------
         Option("rados_mon_op_timeout", float, 30.0, min=0.1,
                description="default mon_command timeout (reference "

@@ -42,6 +42,12 @@ HOP_ORDER = (
     "decode_dispatch",  # reconstruction decode handed to the batcher
     "decode_complete",  # decoded payload back on the op path
     "scrub_window",     # one deep-scrub window walked + hashed
+    # -- ISSUE 17: the async store made the old primary-side
+    # store_apply stamp a lie — it fired only when the LAST peer ack
+    # arrived, so distributed ack-collection time was charged to the
+    # store.  store_apply now stamps at the primary's local store
+    # commit; this hop closes when the full acting-set ack arrives.
+    "peer_ack_wait",    # replica/shard commit acks all collected
 )
 HOP_ID: Dict[str, int] = {name: i for i, name in enumerate(HOP_ORDER)}
 
@@ -68,7 +74,8 @@ CHARGE_ORDER = (
     "client_send", "msgr_enqueue", "wire_sent", "recv",
     "dispatch_queued", "pg_queued", "xshard_handoff", "pg_locked",
     "read_queued", "shard_read", "decode_dispatch", "decode_complete",
-    "store_apply", "commit_sent", "client_complete", "scrub_window",
+    "store_apply", "peer_ack_wait", "commit_sent", "client_complete",
+    "scrub_window",
 )
 
 #: log-spaced histogram bounds (seconds) for per-hop intervals: the

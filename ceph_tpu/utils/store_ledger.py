@@ -51,6 +51,7 @@ PHASE_ORDER = (
     "txn_queued",       # txn admitted to queue_transactions (t0)
     "journal_append",   # WAL record written (page cache, not durable)
     "journal_fsync",    # WAL durable on media
+    "deferred_queue",   # durable txn waiting for the deferred applier
     "alloc",            # block allocation (carved from data_write)
     "data_write",       # object data written + device flush/fsync
     "compress",         # inline compression (carved from data_write)
@@ -176,6 +177,7 @@ class StoreLedgerAccum:
         self.batch_calls = 0          # queue_transactions invocations
         self.batch_txns = 0           # txns across those calls
         self.stalls = 0
+        self.aborts = 0               # queue_transactions exits by raise
         self.phase_seconds: Dict[str, float] = {}
         self.phase_counts: Dict[str, int] = {}
         self.op_counts: Dict[str, int] = {}
@@ -202,6 +204,9 @@ class StoreLedgerAccum:
             dp.add("phase_stalls",
                    description="store phases at/over "
                                "store_phase_stall_ms")
+            dp.add("txn_aborts",
+                   description="queue_transactions calls that raised "
+                               "(ledger discarded, nothing charged)")
             for name in PHASE_ORDER:
                 dp.add_time_avg(
                     f"{name}_s",
@@ -257,7 +262,11 @@ class StoreLedgerAccum:
             self.blocks_freed += bf
             self.compress_logical += cl
             self.compress_stored += cs
-            self._recent.append(dict(ledger))
+            # underscore keys are backend-private handshake state
+            # (e.g. BlueStore's _deferred ownership flag), not txn data
+            self._recent.append(
+                {k: v for k, v in ledger.items()
+                 if not (isinstance(k, str) and k.startswith("_"))})
             phase_seconds, phase_counts = \
                 self.phase_seconds, self.phase_counts
             buckets = self._buckets
@@ -307,6 +316,16 @@ class StoreLedgerAccum:
         if dp is not None:
             dp.inc("phase_stalls")
 
+    def note_abort(self) -> None:
+        """A queue_transactions call raised: its ledger is discarded
+        whole (dangling stamps must not bleed into the next txn), and
+        the abort itself is the only thing recorded."""
+        with self._lock:
+            self.aborts += 1
+        dp = self.slperf
+        if dp is not None:
+            dp.inc("txn_aborts")
+
     def dump(self) -> dict:
         with self._lock:
             buckets = {k: list(v) for k, v in self._buckets.items()}
@@ -318,6 +337,7 @@ class StoreLedgerAccum:
                 "bounds": list(PHASE_BOUNDS),
                 "buckets": buckets,
                 "stalls": self.stalls,
+                "aborts": self.aborts,
                 "io": {
                     "op_counts": dict(self.op_counts),
                     "bytes_written": self.bytes_written,
@@ -355,7 +375,7 @@ def merge_dumps(dumps: List[dict]) -> dict:
     cluster-wide view; ratios are recomputed over the pooled sums."""
     out = {"txns": 0, "txn_seconds": 0.0, "phase_seconds": {},
            "phase_counts": {}, "bounds": list(PHASE_BOUNDS),
-           "buckets": {}, "stalls": 0}
+           "buckets": {}, "stalls": 0, "aborts": 0}
     io = {"op_counts": {}, "bytes_written": 0, "journal_bytes": 0,
           "blocks_allocated": 0, "blocks_freed": 0,
           "compress_logical": 0, "compress_stored": 0,
@@ -366,6 +386,7 @@ def merge_dumps(dumps: List[dict]) -> dict:
         out["txns"] += dump.get("txns", 0)
         out["txn_seconds"] += dump.get("txn_seconds", 0.0)
         out["stalls"] += dump.get("stalls", 0)
+        out["aborts"] += dump.get("aborts", 0)
         for k, v in dump.get("phase_seconds", {}).items():
             out["phase_seconds"][k] = \
                 out["phase_seconds"].get(k, 0.0) + v
@@ -427,5 +448,6 @@ def store_waterfall_block(dump: dict, wall_s: float) -> dict:
         if wall_s > 0 else 0.0,
         "top_phase": top,
         "stalls": dump.get("stalls", 0),
+        "aborts": dump.get("aborts", 0),
         "io": dump.get("io", {}),
     }

@@ -522,3 +522,102 @@ def test_selftune_gate_runs_from_cli(tmp_path, history):
         _selftune_rec())))
     r = _run_cli(good, history)
     assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+# ------------------------- ISSUE 17: bluestore top-hop + ladder gates
+def _att_bluestore(top_hop):
+    att = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                        "commit": 3.0}, 0.95)
+    att["osd_objectstore"] = "bluestore"
+    att["waterfall"] = {"top_hop": top_hop,
+                        "hops": {"store_apply": 0.1}}
+    return att
+
+
+def _store_ladder_rec(blue=None, block=None):
+    return {"metric": "store ladder write MB/s (single-OSD "
+                      "microbench: memstore vs blockstore vs "
+                      "bluestore, qd 1/8/32 x 64 KiB / 1 MiB txns; "
+                      "vs_baseline = mean bluestore over mean "
+                      "blockstore across rungs)",
+            "value": 99.3, "unit": "MB/s", "vs_baseline": 1.56,
+            "ladder": {
+                "memstore": {"qd1_64k": 670.0, "qd8_64k": 900.0},
+                "blockstore": block or {"qd1_64k": 37.8,
+                                        "qd8_64k": 35.6,
+                                        "qd1_1m": 89.9},
+                "bluestore": blue or {"qd1_64k": 50.2,
+                                      "qd8_64k": 99.3,
+                                      "qd1_1m": 137.1}}}
+
+
+def test_store_top_hop_gate_fires_on_bluestore(history):
+    """With osd_objectstore=bluestore the deferred pipeline must take
+    store_apply off the k8m4 top hop — a fresh waterfall still naming
+    it means the async rewrite is not deferring (ISSUE 17
+    acceptance)."""
+    rounds = perf_trend.load_history(history)
+    findings = perf_trend.check(_att_bluestore("store_apply"), rounds)
+    hits = [f for f in findings if f["check"] == "store-top-hop"]
+    assert len(hits) == 1
+    assert "store_apply" in hits[0]["message"]
+    # any other top hop passes
+    assert not [f for f in
+                perf_trend.check(_att_bluestore("net_rtt"), rounds)
+                if f["check"] == "store-top-hop"]
+
+
+def test_store_top_hop_gate_skips_on_sync_backends(history):
+    """Rounds (and fresh runs) on memstore/blockstore never tagged
+    osd_objectstore=bluestore: store_apply on top is the expected
+    synchronous shape there, not a finding."""
+    att = _attribution({"queue_wait": 1.0, "encode": 2.0,
+                        "commit": 3.0}, 0.95)
+    att["waterfall"] = {"top_hop": "store_apply"}
+    findings = perf_trend.check(att, perf_trend.load_history(history))
+    assert not [f for f in findings
+                if f["check"] == "store-top-hop"], findings
+
+
+def test_store_ladder_floor_per_rung(history):
+    """bluestore must hold >= STORE_LADDER_FLOOR x blockstore at
+    EVERY (queue depth, txn size) rung of the fresh microbench."""
+    rounds = perf_trend.load_history(history)
+    # healthy ladder (the measured shape) passes
+    assert not [f for f in
+                perf_trend.check(None, rounds,
+                                 fresh_store_ladder=_store_ladder_rec())
+                if f["check"] == "store-ladder-regression"]
+    # one lost rung fails, and the message names it
+    losing = _store_ladder_rec(
+        blue={"qd1_64k": 50.2, "qd8_64k": 20.0, "qd1_1m": 137.1})
+    findings = perf_trend.check(None, rounds,
+                                fresh_store_ladder=losing)
+    hits = [f for f in findings
+            if f["check"] == "store-ladder-regression"]
+    assert len(hits) == 1 and "qd8_64k" in hits[0]["message"]
+    # noise slack: a rung within the floor does not trip
+    noisy = _store_ladder_rec(
+        blue={"qd1_64k": 50.2, "qd8_64k": 35.6 * 0.9,
+              "qd1_1m": 137.1})
+    assert not perf_trend.check(None, rounds,
+                                fresh_store_ladder=noisy)
+    # no store_ladder record at all: gate self-skips
+    assert not perf_trend.check(None, rounds)
+
+
+def test_store_gates_run_from_cli(tmp_path, history):
+    fresh = tmp_path / "fresh.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(1.05), _att_bluestore("store_apply"),
+        _store_ladder_rec(blue={"qd1_64k": 10.0}))))
+    r = _run_cli(fresh, history)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "store-top-hop" in r.stdout
+    assert "store-ladder-regression" in r.stdout
+    ok = tmp_path / "fresh_ok.json"
+    ok.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(1.05), _att_bluestore("net_rtt"),
+        _store_ladder_rec())))
+    r = _run_cli(ok, history)
+    assert r.returncode == 0, (r.stdout, r.stderr)

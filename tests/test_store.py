@@ -10,18 +10,21 @@ import threading
 
 import pytest
 
-from ceph_tpu.store import (BlockStore, FileStore, GHObject, LogDB,
-                            MemStore, Transaction, WriteBatch)
+from ceph_tpu.store import (BlockStore, BlueStore, FileStore,
+                            GHObject, LogDB, MemStore, Transaction,
+                            WriteBatch)
 
 C = "1.0s0"
 
 
-@pytest.fixture(params=["mem", "file", "block"])
+@pytest.fixture(params=["mem", "file", "block", "bluestore"])
 def store(request, tmp_path):
     if request.param == "mem":
         s = MemStore()
     elif request.param == "block":
         s = BlockStore(str(tmp_path / "store"))
+    elif request.param == "bluestore":
+        s = BlueStore(str(tmp_path / "store"))
     else:
         s = FileStore(str(tmp_path / "store"))
     s.mkfs()
@@ -177,9 +180,13 @@ def test_commit_callbacks(store):
     t.register_on_applied(applied.set)
     t.register_on_commit(committed.set)
     store.queue_transactions([t], on_commit=aggregate.set)
-    assert applied.is_set()       # applied delivered inline
     assert committed.wait(5)      # commit via finisher thread
     assert aggregate.wait(5)
+    # synchronous backends deliver on_applied inline; deferred-apply
+    # backends (BlueStore) deliver it from the applier — flush()
+    # bounds both
+    store.flush()
+    assert applied.wait(5)
 
 
 def test_transaction_atomic_ordering(store):

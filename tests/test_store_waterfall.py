@@ -18,8 +18,8 @@ import pytest
 
 from ceph_tpu.cluster import Cluster
 from ceph_tpu.cluster import test_config as make_conf
-from ceph_tpu.store import (BlockStore, FileStore, GHObject, MemStore,
-                            Transaction)
+from ceph_tpu.store import (BlockStore, BlueStore, FileStore,
+                            GHObject, MemStore, Transaction)
 from ceph_tpu.utils.store_ledger import (PHASE_ORDER, StoreLedgerAccum,
                                          charge, merge_dumps,
                                          op_family,
@@ -42,12 +42,14 @@ def _led(t0, **over):
     return led
 
 
-@pytest.fixture(params=["mem", "file", "block"])
+@pytest.fixture(params=["mem", "file", "block", "bluestore"])
 def store(request, tmp_path):
     if request.param == "mem":
         s = MemStore()
     elif request.param == "block":
         s = BlockStore(str(tmp_path / "store"))
+    elif request.param == "bluestore":
+        s = BlueStore(str(tmp_path / "store"))
     else:
         s = FileStore(str(tmp_path / "store"))
     s.mkfs()
@@ -62,12 +64,24 @@ def test_charge_sum_equals_txn_wall():
     led = _led(1000.0)
     charged = charge(led)
     # every interval charged to the phase ending it; meta fields
-    # (op, txns, bytes) never appear as phases
+    # (op, txns, bytes) never appear as phases; deferred_queue is the
+    # async-store stamp, absent from this synchronous-shape ledger
     names = [n for n, _ in charged]
     assert names == [n for n in PHASE_ORDER[1:]
-                     if n not in ("alloc", "compress")]
+                     if n not in ("alloc", "compress",
+                                  "deferred_queue")]
     assert sum(dt for _, dt in charged) == \
         pytest.approx(led["apply_done"] - led["txn_queued"], abs=1e-12)
+    # the deferred-apply shape (BlueStore): a deferred_queue stamp
+    # between WAL durability and the apply batch slots into order and
+    # keeps the sum exact
+    led2 = _led(1000.0, deferred_queue=1000.0 + 0.007)
+    charged2 = charge(led2)
+    assert [n for n, _ in charged2] == \
+        [n for n in PHASE_ORDER[1:] if n not in ("alloc", "compress")]
+    assert sum(dt for _, dt in charged2) == \
+        pytest.approx(led2["apply_done"] - led2["txn_queued"],
+                      abs=1e-12)
 
 
 def test_charge_carves_alloc_and_compress_out_of_data_write():
@@ -186,6 +200,9 @@ def test_backend_ledgers_charge_sum_equals_wall(store):
             op="client_write")
     store.queue_transactions(
         [Transaction().setattr(C, GHObject("o0", 0), "k", b"v")])
+    # deferred-apply backends (BlueStore) finalize ledgers from the
+    # applier — flush() guarantees every observation has landed
+    store.flush()
     accum = store._store_accum()
     recent = accum.recent()
     assert len(recent) >= 7              # + the fixture's collection

@@ -560,6 +560,47 @@ def test_read_hop_wire_ids_stable():
             "decode_complete", "scrub_window"} <= CONDITIONAL_HOPS
 
 
+# --------------------------------------------------------------- ISSUE 17
+
+
+def test_peer_ack_wait_hop_wire_id_stable():
+    """peer_ack_wait was appended for the async store: the primary's
+    store_apply stamp moved to its LOCAL store commit, and the
+    remaining acting-set ack collection charges here.  Wire id 16,
+    forever; CHARGE_ORDER slots it between the local commit and the
+    reply leaving."""
+    assert HOP_ORDER.index("peer_ack_wait") == 16
+    assert set(CHARGE_ORDER) == set(HOP_ORDER)
+    i = CHARGE_ORDER.index
+    assert i("store_apply") < i("peer_ack_wait") < i("commit_sent")
+
+
+def test_charge_splits_local_commit_from_peer_ack_wait():
+    """With an async store the local commit acks in milliseconds while
+    the 12-shard ack set takes the round trip: the ledger must charge
+    those separately, or the store is blamed for the network."""
+    hops = {"client_send": 0.0, "msgr_enqueue": 0.001,
+            "wire_sent": 0.002, "recv": 0.010,
+            "dispatch_queued": 0.011, "pg_queued": 0.012,
+            "pg_locked": 0.013, "store_apply": 0.020,
+            "peer_ack_wait": 0.090, "commit_sent": 0.091,
+            "client_complete": 0.100}
+    charged = dict(charge(hops))
+    assert charged["store_apply"] == pytest.approx(0.007)
+    assert charged["peer_ack_wait"] == pytest.approx(0.070)
+    assert sum(charged.values()) == pytest.approx(0.100)
+    # a pre-split ledger (no local stamp: both hops at ack-complete)
+    # degrades to peer_ack_wait == 0, never a negative interval
+    hops2 = dict(hops, store_apply=0.090, peer_ack_wait=0.090)
+    charged2 = dict(charge(hops2))
+    assert charged2["store_apply"] == pytest.approx(0.077)
+    assert charged2["peer_ack_wait"] == pytest.approx(0.0)
+    # and it round-trips the wire like any other hop
+    e = Encoder()
+    encode_ledger(e, hops)
+    assert decode_ledger(Decoder(e.build())) == hops
+
+
 def test_charge_read_path_ledger():
     """A client-facing EC read ledger charges the shard fan-out wait
     to decode_dispatch and the reconstruction to decode_complete,

@@ -70,6 +70,12 @@ HOT_MODULES = [
     # same path — stamps and census counts are scalars, never payload
     # slices, so the intra-transaction waterfall must add ZERO copies
     "ceph_tpu/store/blockstore.py",
+    # the async rewrite of that path (ISSUE 17): WAL record framing,
+    # the vectored apply-batch flush and the deferred checksum queue
+    # all touch every payload block — framing headers are tiny
+    # structs and the flush must write the SAME block objects it
+    # buffered, never a joined copy
+    "ceph_tpu/store/bluestore.py",
 ]
 
 # constructs that materialise a full payload copy

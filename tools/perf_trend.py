@@ -118,6 +118,7 @@ _REBUILD_ATTRIB_PREFIX = "rebuild decode attribution"
 _MESH_ATTRIB_PREFIX = "multichip mesh attribution"
 _LOAD_PREFIX = "open-loop load attribution"
 _SELFTUNE_PREFIX = "closed-loop selftune attribution"
+_STORE_LADDER_PREFIX = "store ladder write MB/s"
 _K8M4_MARK = "k=8 m=4"
 
 # defaults, overridable from the CLI
@@ -130,6 +131,11 @@ HOP_P99_SLACK_S = 1e-3     # ...and must also grow by this much abs.
 SCALING_TOL = 0.8          # 16-client MB/s >= tol * best history
 OVERLAP_TOL = 0.5          # fresh overlap frac >= tol * best history
 SELFTUNE_FLOOR = 1.0       # tuned MB/s >= floor * static, every rung
+STORE_LADDER_FLOOR = 0.85  # bluestore MB/s >= floor * blockstore at
+#                            EVERY (queue depth, txn size) rung; the
+#                            slack absorbs single-process IO noise
+#                            (same spirit as RATIO_TOL), the mean
+#                            ratio in the record stays the headline
 
 
 def _records_from_text(text: str) -> List[Dict]:
@@ -222,6 +228,7 @@ def check(attribution: Optional[Dict], history: List[Dict],
           fresh_rebuild: Optional[Dict] = None,
           fresh_mesh: Optional[Dict] = None,
           fresh_selftune: Optional[Dict] = None,
+          fresh_store_ladder: Optional[Dict] = None,
           stage_tol: float = STAGE_TOL,
           ratio_tol: float = RATIO_TOL,
           min_device_fraction: float = MIN_DEVICE_FRACTION,
@@ -249,8 +256,32 @@ def check(attribution: Optional[Dict], history: List[Dict],
     routing-collapse check; ``fresh_selftune`` the selftune config's
     static-vs-tuned ladder + tuner audit block, feeding the
     tuned>=static every-rung floor and the zero-guard-trip
-    re-assert."""
+    re-assert; ``fresh_store_ladder`` the store_ladder config's
+    single-store microbench record, feeding the bluestore>=blockstore
+    every-rung floor (ISSUE 17)."""
     findings: List[Dict] = []
+
+    # -- async-store top-hop gate (ISSUE 17) --------------------------
+    # With osd_objectstore=bluestore the commit ack rides WAL group
+    # commit and apply runs off the PG-lock path: a fresh waterfall
+    # still naming store_apply the top hop means the deferred
+    # pipeline is not deferring (applier starved, deferred queue
+    # saturated at depth, or readers serializing on the apply
+    # barrier).
+    if attribution is not None \
+            and attribution.get("osd_objectstore") == "bluestore":
+        wf = attribution.get("waterfall")
+        if isinstance(wf, dict) and wf.get("top_hop") == "store_apply":
+            findings.append({
+                "check": "store-top-hop", "severity": "fail",
+                "message":
+                    "osd_objectstore=bluestore yet the fresh "
+                    "waterfall still names store_apply as top_hop — "
+                    "the WAL/deferred-apply pipeline is not taking "
+                    "the store off the critical path (check the "
+                    "store_waterfall block: deferred_queue share, "
+                    "wal group_syncs vs txns, and "
+                    "bluestore_deferred_queue_depth backpressure)"})
 
     # -- routing collapse (the r05 signature) -------------------------
     if attribution is not None:
@@ -752,6 +783,36 @@ def check(attribution: Optional[Dict], history: List[Dict],
                     f"collapse before the rollback caught it; the "
                     f"controller must stay inside the guard envelope "
                     f"on a fault-free bench run"})
+
+    # -- store-ladder bluestore>=blockstore floor (ISSUE 17) ----------
+    # ``fresh_store_ladder`` carries the single-store microbench
+    # (memstore / blockstore / bluestore at qd 1/8/32, 64 KiB and
+    # 1 MiB txns) measured in ONE process, so no machine-speed
+    # tolerance is owed: the async rewrite's worst case is the
+    # synchronous discipline it replaced — any rung where bluestore
+    # loses to blockstore is a regression outright.
+    if fresh_store_ladder is not None:
+        ladder = fresh_store_ladder.get("ladder") or {}
+        blue = ladder.get("bluestore") or {}
+        block = ladder.get("blockstore") or {}
+        for rung in sorted(set(blue) & set(block)):
+            old = block.get(rung)
+            new = blue.get(rung)
+            if not isinstance(old, (int, float)) \
+                    or not isinstance(new, (int, float)):
+                continue
+            if new < STORE_LADDER_FLOOR * old:
+                findings.append({
+                    "check": "store-ladder-regression",
+                    "severity": "fail",
+                    "message":
+                        f"bluestore {new:.1f} MB/s < blockstore "
+                        f"{old:.1f} MB/s at the {rung} rung — the "
+                        f"async store lost to the synchronous "
+                        f"discipline it replaced (check wal "
+                        f"group_syncs amortization and the apply "
+                        f"batch occupancy in the record's "
+                        f"store_waterfall)"})
     return findings
 
 
@@ -768,6 +829,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
     mesh = _pick(fresh_records, _MESH_ATTRIB_PREFIX)
     load = _pick(fresh_records, _LOAD_PREFIX)
     selftune = _pick(fresh_records, _SELFTUNE_PREFIX)
+    store_ladder = _pick(fresh_records, _STORE_LADDER_PREFIX)
     ladder = None
     if scaling:
         cl_side = (scaling.get("classic") or {}).get("clients")
@@ -792,6 +854,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
         fresh_ladder=ladder, fresh_load=load,
         fresh_rebuild=rebuild, fresh_mesh=mesh,
         fresh_selftune=selftune,
+        fresh_store_ladder=store_ladder,
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
         hop_p99_factor=hop_p99_factor, overlap_tol=overlap_tol)
