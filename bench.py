@@ -1887,6 +1887,181 @@ def bench_load(n_clients=200, n_gateways=2, ops_per_client=6,
             gw.shutdown()
 
 
+def bench_load_rmw(n_clients=64, ops_per_client=6, hot_objs=16,
+                   obj_bytes=4 << 20):
+    """Overwrite-heavy open-loop profile (ISSUE 20): the load
+    harness's Poisson/absolute-deadline client discipline pointed at
+    rados-level sub-stripe overwrites on an EC overwrite pool —
+    4/16/64 KiB patches at random chunk-aligned offsets into large
+    pre-written objects, with Zipf(1.1) skew on the OBJECT choice (a
+    handful of hot images soak most writes, the RBD/CephFS shape).
+    Mid-run one OSD dies with data loss and is revived, so the
+    parity-delta path rides recovery contention and a shrunken acting
+    set.  Acceptance, from exported counters alone: ZERO
+    client-visible errors, per-size-class p99s reported, and the
+    delta path demonstrably carried traffic (a chaos profile that
+    quietly full-pathed everything would prove nothing)."""
+    import bisect
+    import random
+    import threading
+
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.cluster import Cluster, test_config
+
+    f = machine_factor()
+    conf = test_config(osd_backend="crimson",
+                       osd_heartbeat_interval=2.0,
+                       osd_heartbeat_grace=max(20.0, 12.0 * f),
+                       mon_osd_down_out_interval=120.0)
+    # open-loop honesty (see bench_load): offered rate must stay
+    # under the box's RMW service rate or the p99 measures backlog
+    mean_gap = 8.0 * f
+    total_ops = n_clients * ops_per_client
+    sizes = (("4k", 4 << 10), ("16k", 16 << 10), ("64k", 64 << 10))
+    # Zipf(1.1) CDF over the pre-written object set
+    w = [1.0 / (i + 1) ** 1.1 for i in range(hot_objs)]
+    tot_w = sum(w)
+    cdf, acc = [], 0.0
+    for wi in w:
+        acc += wi / tot_w
+        cdf.append(acc)
+    n_osds = 7
+    with Cluster(n_osds=n_osds, conf=conf) as c:
+        for i in range(n_osds):
+            c.wait_for_osd_up(i, 30)
+        c.create_ec_profile("lrmw", plugin="tpu", k="4", m="2")
+        c.create_pool("lrmwp", "erasure",
+                      erasure_code_profile="lrmw")
+        ret, rs, _ = c.mon_command({"prefix": "osd pool set",
+                                    "pool": "lrmwp",
+                                    "var": "allow_ec_overwrites",
+                                    "val": "true"})
+        assert ret == 0, rs
+        # a few shared handles, round-robined: the objecter is
+        # thread-safe and per-client handles would mean 64 mon
+        # sessions for no extra fidelity
+        rads = [c.rados(timeout=120 * f) for _ in range(4)]
+        ios = [r.open_ioctx("lrmwp") for r in rads]
+        blob = os.urandom(obj_bytes)
+        comps = [ios[0].aio_write_full(f"img{i}", blob)
+                 for i in range(hot_objs)]
+        assert all(cp.wait(120 * f) == 0 for cp in comps)
+        deadline = time.monotonic() + 30 * f
+        while True:                  # flag propagation to the OSDs
+            try:
+                ios[0].write("img0", blob[:4096], 0)
+                break
+            except RadosError as e:
+                if e.errno != 95 or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+
+        errors: list = []
+        lats: dict = {lbl: [] for lbl, _ in sizes}
+        lat_lock = threading.Lock()
+        progress = [0]
+        late = [0]
+        t0 = time.monotonic() + 0.5   # shared epoch: fleet starts hot
+
+        def worker(ci):
+            rng = random.Random(0xC0FFEE ^ ci)
+            io = ios[ci % len(ios)]
+            next_t = t0 + rng.expovariate(1.0 / mean_gap)
+            for j in range(ops_per_client):
+                delay = next_t - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                elif delay < -0.25:
+                    late[0] += 1
+                oi = bisect.bisect_left(cdf, rng.random())
+                lbl, size = sizes[rng.randrange(len(sizes))]
+                off = rng.randrange(0, (obj_bytes - size) // 4096) \
+                    * 4096
+                patch = blob[off % 7919:off % 7919 + size] \
+                    if off % 7919 + size <= obj_bytes else blob[:size]
+                t_s = time.monotonic()
+                try:
+                    io.write(f"img{oi}", patch, off)
+                    with lat_lock:
+                        lats[lbl].append(time.monotonic() - t_s)
+                        progress[0] += 1
+                except Exception as e:  # noqa: BLE001
+                    errors.append((ci, j, repr(e)))
+                next_t += rng.expovariate(1.0 / mean_gap)
+
+        ts = [threading.Thread(target=worker, args=(ci,),
+                               name=f"lrmw-c{ci}")
+              for ci in range(n_clients)]
+        for t in ts:
+            t.start()
+        # chaos lands once the fleet is demonstrably flowing
+        # (progress-driven, not wall-clock)
+        victim = n_osds // 2
+        deadline = time.monotonic() + 120 * f
+        while progress[0] < max(1, total_ops // 8) and \
+                time.monotonic() < deadline:
+            time.sleep(0.05)
+        c.kill_osd(victim, lose_data=True)
+        c.wait_for_osd_down(victim, 30)
+        c.revive_osd(victim)
+        c.wait_for_osd_up(victim, 30)
+        for t in ts:
+            t.join()
+        wall = time.monotonic() - t0
+        assert not errors, \
+            f"overwrite chaos leaked client errors: {errors[:5]}"
+        c.wait_for_clean(max(120.0, 90.0 * f))
+        latency = {}
+        for lbl, _sz in sizes:
+            vals = sorted(lats[lbl])
+            latency[lbl] = {
+                "ops": len(vals),
+                "p50_ms": round(_pctl(vals, 0.50) * 1e3, 2),
+                "p95_ms": round(_pctl(vals, 0.95) * 1e3, 2),
+                "p99_ms": round(_pctl(vals, 0.99) * 1e3, 2)}
+        delta_ops = full_ops = fallbacks = 0
+        for osd in c.osds.values():
+            if osd is None:
+                continue
+            for pg in osd.pgs.values():
+                be = getattr(pg, "backend", None)
+                delta_ops += getattr(be, "delta_rmw_ops", 0)
+                full_ops += getattr(be, "rmw_full_ops", 0)
+                fallbacks += getattr(be, "delta_rmw_fallbacks", 0)
+        assert delta_ops > 0, \
+            "overwrite chaos profile never exercised the delta path"
+        rec = {
+            "metric": "overwrite-heavy load attribution "
+                      f"({n_clients} rados clients, 4-64 KiB "
+                      "zipf-object overwrites on an EC k=4 m=2 "
+                      "overwrite pool, poisson open-loop arrivals "
+                      "against absolute deadlines, one OSD "
+                      "lost+revived mid-run; value = 16k p99 ms)",
+            "value": latency["16k"]["p99_ms"], "unit": "ms",
+            "vs_baseline": 1.0,
+            "clients": n_clients,
+            "ops": progress[0], "errors": len(errors),
+            "latency_ms": latency,
+            "arrival": {
+                "mean_gap_s": round(mean_gap, 3),
+                "offered_hz": round(n_clients / mean_gap, 2),
+                "achieved_hz": round(progress[0] / wall, 2)
+                if wall > 0 else 0.0,
+                "late_frac": round(late[0] / max(1, total_ops), 4)},
+            "rmw": {"delta_ops": delta_ops, "full_ops": full_ops,
+                    "fallbacks": fallbacks,
+                    "victim_osd": victim},
+        }
+        print(json.dumps(rec), flush=True)
+        emit(f"overwrite chaos 16 KiB p99 ms ({n_clients} open-loop "
+             f"rados clients, zipf objects, one OSD lost+revived "
+             f"mid-run; 0 client errors, delta path took "
+             f"{delta_ops}/{delta_ops + full_ops} RMWs, "
+             f"{fallbacks} fallbacks; baseline=itself)",
+             latency["16k"]["p99_ms"], "ms", 1.0)
+        _FLOOR_STATS["load_rmw_attribution"] = rec
+
+
 def bench_rebuild(n_objs=26, obj_bytes=8 << 20):
     """Rebuild as a first-class scenario (ISSUE 11): the cluster_k8m4
     OSD-loss recovery, but the attribution record is DECODE-side.
@@ -2474,6 +2649,204 @@ def bench_store_ladder():
     _FLOOR_STATS["store_ladder_attribution"] = rec
 
 
+def _rmw_cluster_run(plugin, n_objs, obj_bytes, sizes, n_ow,
+                     extra_conf=None):
+    """One RMW run (ISSUE 20): pre-write ``n_objs`` objects on a k=8
+    m=4 overwrite-enabled EC pool, then per size class drive ``n_ow``
+    random chunk-aligned sub-stripe overwrites (all aio, one wave) and
+    return {label: MB/s} plus the delta-path counters summed over
+    every PG backend and batcher."""
+    import random
+
+    from ceph_tpu.client.rados import RadosError
+    from ceph_tpu.cluster import Cluster, test_config
+    from ceph_tpu.osd.batcher import EncodeBatcher
+    from ceph_tpu.utils import faults as faultlib
+
+    faultlib.registry().reset()
+    EncodeBatcher.reset_breaker()
+    f = machine_factor()
+    k, m, n_osds, su = "8", "4", 13, 16384
+    overrides = {
+        "osd_objectstore": "bluestore",
+        # same many-daemons-few-cores guards as the k8m4 write bench:
+        # slow heartbeat chatter, machine-scaled grace, slow down->out
+        "osd_heartbeat_interval": 2.0,
+        "osd_heartbeat_grace": max(20.0, 12.0 * f),
+        "mon_osd_down_out_interval": 60.0,
+        "osd_pool_default_pg_num": 32,
+        "ec_tpu_queue_window_us": 3000,
+    }
+    if extra_conf:
+        overrides.update(extra_conf)
+    if plugin == "tpu":
+        # pay geometry compiles outside the cluster: the full-encode
+        # kernel serves the pre-write, the delta kernels serve every
+        # dirty-column count a chunk-aligned 4-16 KiB overwrite can
+        # produce (a jit inside 13 single-core daemons starves
+        # heartbeats — the r4 k8m4 failure mode)
+        from ceph_tpu.ec import registry as ecreg
+        codec = ecreg.instance().factory(
+            "tpu", {"k": k, "m": m, "technique": "reed_sol_van"})
+        try:
+            codec.encode_batch_async(
+                np.zeros((64, int(k), su), dtype=np.uint8)).wait()
+            if hasattr(codec, "delta_encode_batch_async"):
+                for d in (1, 2, 4):
+                    codec.delta_encode_batch_async(
+                        np.zeros((4, d, su), dtype=np.uint8),
+                        tuple(range(d))).wait()
+        except Exception:
+            pass                     # device trouble: CPU twin serves
+    with Cluster(n_osds=n_osds, conf=test_config(**overrides)) as c:
+        for i in range(n_osds):
+            c.wait_for_osd_up(i, 30)
+        # 16 KiB chunks (stripe_width 128 KiB): the production shape
+        # for a device-batched codec — at the 4 KiB default the fixed
+        # per-sub-op cost dominates both sides and the head-to-head
+        # measures messaging, not the RMW data path
+        c.create_ec_profile("rmw", plugin=plugin, k=k, m=m,
+                            stripe_unit=str(su))
+        c.create_pool("rmwp", "erasure", erasure_code_profile="rmw")
+        ret, rs, _ = c.mon_command({"prefix": "osd pool set",
+                                    "pool": "rmwp",
+                                    "var": "allow_ec_overwrites",
+                                    "val": "true"})
+        assert ret == 0, rs
+        rad = c.rados(timeout=60 * f)
+        io = rad.open_ioctx("rmwp")
+        blob = os.urandom(obj_bytes)
+        comps = [io.aio_write_full(f"o{i}", blob)
+                 for i in range(n_objs)]
+        assert all(cp.wait(120 * f) == 0 for cp in comps)
+        deadline = time.monotonic() + 30 * f
+        while True:                  # flag propagation to the OSDs
+            try:
+                io.write("o0", blob[:4096], 0)
+                break
+            except RadosError as e:
+                if e.errno != 95 or time.monotonic() > deadline:
+                    raise
+                time.sleep(0.2)
+        rng = random.Random(0xD317A)
+        per_size = {}
+        for label, size in sizes:
+            patch = os.urandom(size)
+            # chunk-aligned offsets: the natural block-workload shape,
+            # and it keeps the dirty-column count the SIZE's property
+            # (a straddling write dirties one extra column, crossing
+            # the k/2 eligibility cut by accident of offset).  Untimed
+            # warmup wave first: the class's first ops pay per-shape
+            # compiles and the routing learner's probes, which are
+            # one-time costs, not steady-state RMW throughput
+            warm = [io.aio_write(
+                f"o{rng.randrange(n_objs)}", patch,
+                rng.randrange(0, (obj_bytes - size) // su) * su)
+                for _ in range(8)]
+            assert all(cp.wait(120 * f) == 0 for cp in warm)
+            t0 = time.perf_counter()
+            comps = [io.aio_write(
+                f"o{rng.randrange(n_objs)}", patch,
+                rng.randrange(0, (obj_bytes - size) // su) * su)
+                for _ in range(n_ow)]
+            assert all(cp.wait(120 * f) == 0 for cp in comps)
+            per_size[label] = (n_ow * size / 2**20
+                               / (time.perf_counter() - t0))
+        st = {"rmw_ops": 0, "full_ops": 0, "fallbacks": 0,
+              "census": {}, "delta_reqs": 0, "delta_calls": 0,
+              "delta_coalesced": 0, "delta_cpu_reqs": 0}
+        for osd in c.osds.values():
+            if osd is None:
+                continue
+            for pg in osd.pgs.values():
+                be = getattr(pg, "backend", None)
+                st["rmw_ops"] += getattr(be, "delta_rmw_ops", 0)
+                st["full_ops"] += getattr(be, "rmw_full_ops", 0)
+                st["fallbacks"] += getattr(be, "delta_rmw_fallbacks",
+                                           0)
+                for d, n in getattr(be, "delta_dirty_census",
+                                    {}).items():
+                    key = str(d)
+                    st["census"][key] = st["census"].get(key, 0) + n
+            b = getattr(osd, "encode_batcher", None)
+            if b is not None:
+                for ctr in ("delta_reqs", "delta_calls",
+                            "delta_coalesced", "delta_cpu_reqs"):
+                    st[ctr] += getattr(b, ctr, 0)
+        return per_size, st
+
+
+def bench_rmw(n_objs=16, obj_bytes=8 << 20, n_ow=96):
+    """Sub-stripe RMW head to head (ISSUE 20): random chunk-aligned
+    4/16/64 KiB overwrites over committed 8 MiB objects on a 13-OSD
+    k=8 m=4 overwrite pool (16 KiB chunks, 128 KiB stripes) — the
+    parity-delta path (read only dirty columns, one batched GF
+    delta-matmul, store-XOR on parity shards) vs the SAME plugin
+    forced full-stripe (osd_ec_delta_rmw=false) vs plugin=jerasure
+    inline.  4/16 KiB dirty ONE column, 64 KiB dirties four (the
+    eligibility boundary at the default max_dirty=0.5); the win
+    shrinks as the dirty fraction grows toward the full stripe.
+    Emits the rmw attribution record perf_trend gates on."""
+    sizes = (("4k", 4 << 10), ("16k", 16 << 10), ("64k", 64 << 10))
+    d_mbs, d_st = _rmw_cluster_run("tpu", n_objs, obj_bytes, sizes,
+                                   n_ow)
+    f_mbs, f_st = _rmw_cluster_run(
+        "tpu", n_objs, obj_bytes, sizes, n_ow,
+        extra_conf={"osd_ec_delta_rmw": False})
+    j_mbs, _ = _rmw_cluster_run("jerasure", n_objs, obj_bytes, sizes,
+                                n_ow)
+    per = {}
+    for label, _sz in sizes:
+        per[label] = {
+            "delta": round(d_mbs[label], 3),
+            "full": round(f_mbs[label], 3),
+            "jerasure": round(j_mbs[label], 3),
+            "vs_full": round(d_mbs[label] / f_mbs[label], 3),
+            "vs_jerasure": round(d_mbs[label] / j_mbs[label], 3),
+        }
+    total_rmw = d_st["rmw_ops"] + d_st["full_ops"]
+    rec = {
+        "metric": "rmw overwrite MB/s (13-OSD k=8 m=4 overwrite pool,"
+                  f" {n_ow} aio random chunk-aligned sub-stripe "
+                  f"overwrites per size class over "
+                  f"{n_objs}x{obj_bytes >> 20} MiB committed objects;"
+                  " value = delta-path 4 KiB class, vs_baseline = "
+                  "delta over forced-full at 4 KiB)",
+        "value": per["4k"]["delta"], "unit": "MB/s",
+        "vs_baseline": per["4k"]["vs_full"],
+        "sizes": per,
+        "delta": {
+            "rmw_ops": d_st["rmw_ops"],
+            "full_ops": d_st["full_ops"],
+            "fallbacks": d_st["fallbacks"],
+            "delta_fraction": round(
+                d_st["rmw_ops"] / max(1, total_rmw), 4),
+            "dirty_census": d_st["census"],
+            "routing": {
+                "delta_reqs": d_st["delta_reqs"],
+                "delta_calls": d_st["delta_calls"],
+                "delta_coalesced": d_st["delta_coalesced"],
+                "delta_cpu_reqs": d_st["delta_cpu_reqs"]},
+        },
+        # the forced-full control must show ZERO delta ops or the
+        # comparison measured nothing
+        "full_run": {"rmw_ops": f_st["rmw_ops"],
+                     "full_ops": f_st["full_ops"]},
+    }
+    print(json.dumps(rec), flush=True)
+    emit(f"rmw 4 KiB overwrite MB/s (delta-path k=8 m=4; "
+         f"delta {per['4k']['delta']:.2f} / full "
+         f"{per['4k']['full']:.2f} / jerasure "
+         f"{per['4k']['jerasure']:.2f}; 16 KiB "
+         f"{per['16k']['vs_full']:.2f}x full; delta took "
+         f"{d_st['rmw_ops']}/{total_rmw} RMWs, "
+         f"{d_st['fallbacks']} fallbacks; "
+         f"baseline=same plugin osd_ec_delta_rmw=false "
+         f"{per['4k']['full']:.2f} MB/s)",
+         per["4k"]["delta"], "MB/s", per["4k"]["vs_full"])
+    _FLOOR_STATS["rmw_attribution"] = rec
+
+
 CONFIGS = {
     "roofline": bench_roofline,
     "rs_k2m1": lambda: bench_encode_rs(2, 1, 4 << 10, 1024),
@@ -2515,6 +2888,15 @@ EXTRA_CONFIGS = {
     # microbench (ISSUE 17) — memstore vs blockstore vs bluestore at
     # qd 1/8/32, 64 KiB and 1 MiB txns, bluestore >= blockstore gated
     "store_ladder": bench_store_ladder,
+    # opt-in (--only rmw): sub-stripe overwrite head-to-head
+    # (ISSUE 20) — parity-delta RMW vs forced full-stripe vs jerasure
+    # at 4/16/64 KiB over committed 8 MiB objects, delta >= full
+    # gated at every size by perf_trend
+    "rmw": bench_rmw,
+    # opt-in (--only load_rmw): the overwrite-heavy open-loop chaos
+    # profile (ISSUE 20) — zipf-object 4-64 KiB rados overwrites with
+    # a mid-run OSD loss, zero client errors + delta path exercised
+    "load_rmw": bench_load_rmw,
 }
 CONFIGS_ALL = dict(CONFIGS, **EXTRA_CONFIGS)
 
@@ -2613,7 +2995,8 @@ def main():
                 fresh_selftune=_FLOOR_STATS.get(
                     "selftune_attribution"),
                 fresh_store_ladder=_FLOOR_STATS.get(
-                    "store_ladder_attribution"))
+                    "store_ladder_attribution"),
+                fresh_rmw=_FLOOR_STATS.get("rmw_attribution"))
             for fnd in findings:
                 print(f"# --assert-floor perf-trend "
                       f"{fnd['severity'].upper()} [{fnd['check']}]: "

@@ -147,14 +147,30 @@ class Objecter(Dispatcher):
         with self.lock:
             if newmap.epoch <= self.osdmap.epoch:
                 return
-            self.osdmap = newmap
+            oldmap, self.osdmap = self.osdmap, newmap
             resend = list(self.inflight.values())
         self.map_ready.set()
-        # resend ops whose target moved (reference _scan_requests /
-        # need_resend on every new map)
+        # resend ops whose target moved OR whose PG interval changed
+        # (reference _scan_requests / need_resend on every new map).
+        # The primary-only check is not enough: when a NON-primary
+        # acting shard dies, the PG discards its in-flight ops on the
+        # interval change and relies on the client to resend (pg.py
+        # documents that contract next to the reqid dedup that makes
+        # the resend exactly-once) — without this, a write caught
+        # mid-flight by a replica/shard death hangs until
+        # rados_osd_op_timeout
         for op in resend:
             target = self._target_of(op)
             if target != op.target_osd:
+                self._send_op(op)
+                continue
+            try:
+                pgid = self._pgid_of(newmap, op)
+                if op.pool in oldmap.pools and \
+                        oldmap.pg_to_up_acting_osds(pgid) != \
+                        newmap.pg_to_up_acting_osds(pgid):
+                    self._send_op(op)
+            except Exception:
                 self._send_op(op)
         # lingers re-register on EVERY new map, even when the target
         # primary is unchanged: any interval change (a replica dying)
@@ -713,6 +729,13 @@ class IoCtx:
     def aio_write_full(self, oid: str, data: bytes) -> Completion:
         return self.rados.objecter.submit(
             self.pool_id, oid, [OSDOp("writefull", data=data)],
+            snapc=self._write_snapc())
+
+    def aio_write(self, oid: str, data: bytes,
+                  offset: int = 0) -> Completion:
+        return self.rados.objecter.submit(
+            self.pool_id, oid,
+            [OSDOp("write", offset=offset, data=data)],
             snapc=self._write_snapc())
 
     def aio_read(self, oid: str, length: int = 0,

@@ -108,6 +108,21 @@ class ReactorBatcher:
             ("dec", (ec_impl, sinfo, have, want,
                      self._marshal(cb, shard))))
 
+    def submit_delta(self, ec_impl, sinfo, delta, dirty_cols, cb,
+                     tracked=None) -> None:
+        # parity-delta RMW lane: same shard-buffered front as encode —
+        # the Δparity continuation re-enters ECBackend, so it must
+        # land back on the PG's owning reactor
+        shard = self._current_shard()
+        if shard < 0:
+            self._inner.submit_delta(ec_impl, sinfo, delta, dirty_cols,
+                                     self._marshal(cb, 0),
+                                     tracked=tracked)
+            return
+        self._pending[shard].append(
+            ("delta", (ec_impl, sinfo, delta, dirty_cols,
+                       self._marshal(cb, shard), tracked)))
+
     def shard_tick(self, shard: int) -> None:
         """Tick hook for ``shard``'s reactor: flush its buffered
         submissions, then cut the coalescing window iff every shard
@@ -122,6 +137,9 @@ class ReactorBatcher:
                     break               # shutdown flush raced us
                 if kind == "enc":
                     inner.submit(a[0], a[1], a[2], a[3], tracked=a[4])
+                elif kind == "delta":
+                    inner.submit_delta(a[0], a[1], a[2], a[3], a[4],
+                                       tracked=a[5])
                 else:
                     inner.submit_decode(*a)
         for other in self._pending:
@@ -141,6 +159,9 @@ class ReactorBatcher:
                 if kind == "enc":
                     self._inner.submit(a[0], a[1], a[2], a[3],
                                        tracked=a[4])
+                elif kind == "delta":
+                    self._inner.submit_delta(a[0], a[1], a[2], a[3],
+                                             a[4], tracked=a[5])
                 else:
                     self._inner.submit_decode(*a)
 

@@ -165,6 +165,78 @@ class TpuCodecMixin:
         return _DecodeHandle(
             core.backend.apply_gf8_rows_async(rows_gf, stack), erased)
 
+    def delta_async_supported(self) -> bool:
+        """True when this geometry can ride the async device
+        parity-delta pipeline (same gate as device decode: byte-domain
+        w=8 with a GF coding matrix in hand)."""
+        return self.decode_async_supported()
+
+    def delta_encode_batch_async(self, delta: np.ndarray, dirty_cols):
+        """Non-blocking parity delta: Δdata uint8 [B, D, L] for the
+        D dirty data columns -> AsyncBatch whose wait() yields
+        Δparity uint8 [B, m, L] (new_parity = old_parity XOR Δparity,
+        applied shard-side via the store's xor_write op).
+
+        The dirty columns are scattered into a zero [B, k, L] block
+        and dispatched through the SAME per-pool compiled encode
+        kernel as encode_batch_async — GF linearity makes the zero
+        columns inert, so M·pad(Δ) == M[:, dirty]·Δ bit for bit.  A
+        per-dirty-signature kernel (M[:, dirty] baked into its own
+        jit) would be cheaper per byte moved, but every fresh
+        (signature, shape-bucket) pair pays a multi-second XLA
+        compile, and overwrite traffic sprays signatures: measured
+        on the rmw bench, first-touch compile stalls inverted the
+        whole win (delta 0.1x full at 4 KiB).  One shared kernel
+        means a delta dispatch NEVER compiles — the staging rings,
+        mesh sharding, h2d EWMA and DeviceLedger are encode's own,
+        already hot."""
+        if not self.delta_async_supported():
+            raise ValueError("async device delta needs a byte-domain "
+                             "w=8 GF coding matrix")
+        core = self.core
+        cols = [int(c) for c in dirty_cols]
+        delta = np.asarray(delta, dtype=np.uint8)
+        if delta.ndim != 3 or delta.shape[1] != len(cols):
+            raise ValueError(
+                f"expected [batch, D={len(cols)}, L] delta input")
+        block = np.zeros((delta.shape[0], self.k, delta.shape[2]),
+                         dtype=np.uint8)
+        block[:, cols, :] = delta
+        return core.backend.apply_gf8_matrix_async(
+            core.coding_matrix, block)
+
+    def delta_encode_batch(self, delta: np.ndarray,
+                           dirty_cols) -> np.ndarray:
+        """Synchronous parity delta (the CPU-twin / oracle route):
+        Δdata [B, D, L] -> Δparity [B, m, L] via CodecCore."""
+        return self.core.delta_parity(
+            np.asarray(delta, dtype=np.uint8), dirty_cols)
+
+    def prewarm_delta(self, chunk_size: int, dirty_cols=None,
+                      batches=(1,)) -> None:
+        """Make the delta lane hot before the first sub-stripe
+        overwrite.  Delta dispatches ride the per-pool encode kernel
+        (dirty columns zero-padded to [B, k, L]), so there is no
+        per-signature executable to warm — just the staging ring and
+        the pool matrix at the encode shape.  Idempotent per
+        (geometry, chunk_size); ``dirty_cols`` is accepted for API
+        compatibility but no longer selects an executable."""
+        if not self.delta_async_supported():
+            return
+        pre = getattr(self.core.backend, "prewarm_geometry", None)
+        if pre is not None:
+            pre(self.k, chunk_size, batches=batches, w=self.w)
+        key = ("delta", type(self).__name__, self.k, self.m, self.w,
+               int(chunk_size))
+        if key in _PREWARMED_SHAPES:
+            return
+        _PREWARMED_SHAPES.add(key)
+        z = np.zeros((1, 1, int(chunk_size)), dtype=np.uint8)
+        try:
+            self.delta_encode_batch_async(z, (0,)).wait()
+        except Exception:
+            _PREWARMED_SHAPES.discard(key)  # best-effort
+
     def prewarm_decode(self, chunk_size: int, batches=(1,)) -> None:
         """Make the common recovery signatures hot before the first
         rebuild window: host-side combined recovery rows for every

@@ -199,7 +199,14 @@ class MOSDOpReply(Message):
 @register
 class MOSDECSubOpWrite(Message):
     """Primary -> shard: apply this shard's transaction (reference
-    ECSubWrite carried by messages/MOSDECSubOpWrite.h)."""
+    ECSubWrite carried by messages/MOSDECSubOpWrite.h).
+
+    Parity-delta RMW sub-writes (ecbackend._try_delta_rmw) use this
+    SAME message: the transaction simply carries ``xor_write`` store
+    ops for parity shards (identical wire shape to ``write``; the
+    store XORs the payload into the committed chunk) and plain writes
+    for dirty data shards — no schema or TYPE change, so mixed-version
+    acting sets keep interoperating."""
     TYPE = 108
 
     def __init__(self, pgid: str = "", shard: int = -1,

@@ -241,6 +241,39 @@ class CodecCore:
     def encode(self, data: np.ndarray) -> np.ndarray:
         return self.encode_batch(data)
 
+    def delta_parity(self, delta: np.ndarray,
+                     dirty_cols) -> np.ndarray:
+        """Parity delta for a partial-stripe overwrite: GF(2^w)
+        linearity gives ``new_parity = old_parity XOR M[:,dirty]·Δdata``
+        (Δdata = old XOR new), so only the dirty data columns ride the
+        matmul.  delta uint8 [..., D, L] for D = len(dirty_cols) ->
+        Δparity uint8 [..., m, L].  Byte-domain GF-matrix geometries
+        only — the same eligibility gate as device decode."""
+        if self.layout != "byte" or self.coding_matrix is None:
+            raise ValueError("delta parity needs a byte-domain GF "
+                             "coding matrix")
+        cols = list(dirty_cols)
+        if delta.shape[-2] != len(cols):
+            raise ValueError(f"expected {len(cols)} dirty columns")
+        if delta.shape[-1] == 0:
+            return np.zeros(delta.shape[:-2] + (self.m, 0),
+                            dtype=np.uint8)
+        if self.gf8_encode_fast():
+            # compiled backends: scatter Δ into a zero [..., k, L]
+            # block and reuse the per-pool encode kernel (zero
+            # columns are GF-inert) — a per-dirty-signature kernel
+            # would pay a fresh XLA compile for every (signature,
+            # shape) pair the overwrite mix sprays at it
+            block = np.zeros(
+                delta.shape[:-2] + (self.k, delta.shape[-1]),
+                dtype=np.uint8)
+            block[..., cols, :] = delta
+            return self.backend.apply_gf8_matrix(self.coding_matrix,
+                                                 block)
+        sub = np.ascontiguousarray(self.coding_matrix[:, cols])
+        return self._apply(matrix_to_bitmatrix(sub, self.w), sub,
+                           delta)
+
     def _apply(self, B: np.ndarray, M: Optional[np.ndarray],
                data: np.ndarray) -> np.ndarray:
         if self.layout == "byte":

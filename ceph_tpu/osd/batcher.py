@@ -89,6 +89,38 @@ class _DecReq:
         self.t_enq = time.monotonic()
 
 
+class _DeltaReq:
+    """One queued parity-delta encode (sub-stripe overwrite RMW):
+    ``delta`` holds old XOR new chunk bytes for the DIRTY data
+    columns only, laid out ``[nstripes, D, chunk]`` for
+    D = len(dirty_cols).  GF(2^8) linearity makes the parity update
+    ``new_parity = old_parity XOR M[:,dirty]·Δdata``, so only the
+    dirty columns ride the device — the rider's ``cb`` receives
+    {parity_shard_index: Δparity chunk bytes} to XOR into the
+    stored parity chunks (store-level ``xor_write``)."""
+
+    def __init__(self, ec_impl, sinfo: ecutil.StripeInfo, delta,
+                 dirty_cols,
+                 cb: Callable[[Optional[Dict[int, bytes]]], None],
+                 tracked=None):
+        self.ec_impl = ec_impl
+        self.sinfo = sinfo
+        self.delta = delta
+        self.dirty_cols = tuple(dirty_cols)
+        self.cb = cb
+        self.tracked = tracked
+        self.nbytes = ecutil.nbytes_of(delta)
+        self.nstripes = self.nbytes // (
+            len(self.dirty_cols) * sinfo.chunk_size)
+        self.t_enq = time.monotonic()
+        self.done = False
+
+    def as_array(self, ncols: int) -> np.ndarray:
+        """[nstripes, D, chunk] view of the delta buffer — no copy."""
+        return ecutil.as_stripe_array(self.delta, self.nstripes,
+                                      ncols, self.sinfo.chunk_size)
+
+
 class _BatchTwin:
     """Device-free execution twin with the BATCHED codec API: encode
     and decode run as ONE kernel call over a whole [N, k, chunk]
@@ -158,6 +190,10 @@ class EncodeBatcher:
                                              # 0 = not yet learned ->
                                              # seeded from the encode
                                              # EWMA (_dec_min_bytes)
+    _delta_min_device_bytes: float = 0.0     # parity-delta crossover;
+                                             # 0 = not yet learned ->
+                                             # seeded like the decode
+                                             # side (_delta_min_bytes)
     _probe_tick: int = 0                     # shared probe cadence
     _warmed: set = set()                     # geometries prewarmed
     _h2d_bps: float = 0.0                    # warm link rate EWMA, shared
@@ -444,6 +480,32 @@ class EncodeBatcher:
                     dp.add(f"dec_route_{reason}",
                            description="decode routing verdicts: "
                                        + desc)
+            if "delta_route_device" not in dp._types:
+                # parity-delta RMW routing verdicts (own guard: dperf
+                # instances created by older sessions predate these).
+                # Same reason ladder as encode/decode — the delta
+                # matmul rides the same device and crossover machinery
+                for reason, desc in (
+                        ("device", "delta batches over the "
+                                   "crossover -> device"),
+                        ("pin", "delta batches under the operator/"
+                                "calibration pin -> twin "
+                                "(deterministic)"),
+                        ("learned", "delta batches under the LEARNED "
+                                    "crossover -> twin"),
+                        ("idle_probe", "idle-device delta re-probes "
+                                       "forced to the device"),
+                        ("tick_probe", "1-in-N periodic delta probes "
+                                       "forced to the device"),
+                        ("breaker_open", "delta batches the open "
+                                         "breaker routed to the "
+                                         "twin"),
+                        ("breaker_probe", "delta re-admission probes "
+                                          "through the open "
+                                          "breaker")):
+                    dp.add(f"delta_route_{reason}",
+                           description="parity-delta routing "
+                                       "verdicts: " + desc)
             if "staging_host_bytes_now" not in dp._types:
                 # memory-accounting + overlap gauges (ISSUE 10),
                 # registered under their own guard: dperf instances
@@ -519,6 +581,10 @@ class EncodeBatcher:
         self.dec_reqs = 0            # decode requests served
         self.dec_coalesced = 0       # decode requests that shared a call
         self.dec_cpu_reqs = 0        # decode requests on the CPU twin
+        self.delta_calls = 0         # batched parity-delta calls issued
+        self.delta_reqs = 0          # delta requests served
+        self.delta_coalesced = 0     # delta requests that shared a call
+        self.delta_cpu_reqs = 0      # delta requests on the CPU twin
         self.encode_errors = 0       # encode/continuation failures
         self.device_errors = 0       # classified device failures
         self._cpu_twins: Dict[Tuple, object] = {}  # device-failure path
@@ -616,6 +682,67 @@ class EncodeBatcher:
             except Exception:
                 dec = None
             cb(dec)
+
+    def submit_delta(self, ec_impl, sinfo: ecutil.StripeInfo, delta,
+                     dirty_cols,
+                     cb: Callable[[Optional[Dict[int, bytes]]], None],
+                     tracked=None) -> None:
+        """Queue a parity-delta encode for a partial-stripe
+        overwrite: ``delta`` is old XOR new chunk bytes for the DIRTY
+        data columns only ([nstripes, D, chunk] layout); ``cb`` later
+        receives {parity_shard_index: Δparity bytes} (or None on
+        failure) from the collector thread — the caller XORs each
+        Δparity into the stored parity chunk (``xor_write``).
+
+        Delta requests coalesce per (geometry, dirty-column
+        signature): a sub-stripe overwrite workload re-hits few
+        signatures (a 4 KiB write always dirties one column), so hot
+        small-write traffic lands on a handful of prewarmed compiled
+        shapes — the same coalescing economics as recovery."""
+        cols = tuple(sorted(dirty_cols))
+        stopped = self._stop or \
+            not hasattr(ec_impl, "delta_encode_batch_async")
+        req = None
+        if not stopped:
+            req = _DeltaReq(ec_impl, sinfo, delta, cols, cb, tracked)
+            if req.nstripes == 0:
+                k = ec_impl.get_data_chunk_count()
+                m = ec_impl.get_coding_chunk_count()
+                cb({k + j: b"" for j in range(m)})
+                return
+            key = ("delta", _geometry_key(ec_impl, sinfo), cols)
+            with self._cond:
+                if self._stop:
+                    stopped = True   # raced shutdown: compute inline
+                else:
+                    if not self._queues:
+                        self._first_enqueue = time.monotonic()
+                    self._queues.setdefault(key, []).append(req)
+                    self._pending_stripes += req.nstripes
+                    self._cond.notify()
+        if stopped:
+            try:
+                out = self._delta_inline(ec_impl, sinfo, delta, cols)
+            except Exception:
+                out = None
+            cb(out)
+
+    def _delta_inline(self, ec_impl, sinfo: ecutil.StripeInfo,
+                      delta, cols) -> Dict[int, memoryview]:
+        """Synchronous device-free Δparity (shutdown/no-async-API
+        fallback for submit_delta)."""
+        cs = sinfo.chunk_size
+        nstripes = ecutil.nbytes_of(delta) // (len(cols) * cs)
+        arr = np.asarray(ecutil.as_stripe_array(
+            delta, nstripes, len(cols), cs), dtype=np.uint8)
+        if hasattr(ec_impl, "delta_encode_batch"):
+            parity = ec_impl.delta_encode_batch(arr, cols)
+        else:
+            parity = ec_impl.core.delta_parity(arr, cols)
+        k = ec_impl.get_data_chunk_count()
+        return {k + j: memoryview(
+                    np.ascontiguousarray(parity[:, j])).cast("B")
+                for j in range(parity.shape[1])}
 
     def tick_flush(self) -> None:
         """Cut the coalescing window NOW: everything queued dispatches
@@ -910,6 +1037,15 @@ class EncodeBatcher:
                     groups.append((key, reqs,
                                    self._route_dec_group(key, reqs)))
                     continue
+                if key[0] == "delta":
+                    # parity-delta groups route + dispatch like
+                    # decode groups: async handle on the bounded
+                    # completion queue, h2d pipelined under the
+                    # previous group's compute
+                    groups.append((key, reqs,
+                                   self._route_delta_group(key,
+                                                           reqs)))
+                    continue
                 to_cpu = self._route_to_cpu(key, reqs)
                 if not to_cpu and self._breaker_blocks():
                     to_cpu = True
@@ -950,6 +1086,13 @@ class EncodeBatcher:
                 elif isinstance(handle, tuple) and handle \
                         and handle[0] == "decdev":
                     self._complete_group_dec_dev(
+                        key, reqs, handle,
+                        trust_win=(ngroups == 1))
+                elif handle == "delta_cpu":
+                    self._complete_group_delta_twin(key, reqs)
+                elif isinstance(handle, tuple) and handle \
+                        and handle[0] == "deltadev":
+                    self._complete_group_delta_dev(
                         key, reqs, handle,
                         trust_win=(ngroups == 1))
                 elif handle == "cpu":
@@ -1116,6 +1259,7 @@ class EncodeBatcher:
             # unlearned) and the device gets re-tried on its merits
             cls._min_device_bytes = cls._pinned_min_device_bytes
             cls._dec_min_device_bytes = 0.0   # re-seed from encode
+            cls._delta_min_device_bytes = 0.0
             cls._dev_bps = {}
             if self.bperf is not None:
                 self.bperf.inc("breaker_close")
@@ -1166,6 +1310,7 @@ class EncodeBatcher:
         cls._min_device_bytes = 0.0
         cls._pinned_min_device_bytes = 0.0
         cls._dec_min_device_bytes = 0.0
+        cls._delta_min_device_bytes = 0.0
         cls._probe_tick = 0
         cls._cpu_bps = {}
         cls._dev_bps = {}
@@ -1204,6 +1349,7 @@ class EncodeBatcher:
             "min_device_bytes": cls._min_device_bytes,
             "pinned_min_device_bytes": cls._pinned_min_device_bytes,
             "dec_min_device_bytes": cls._dec_min_device_bytes,
+            "delta_min_device_bytes": cls._delta_min_device_bytes,
             "dev_bps": dict(cls._dev_bps),
         }
         st = cls._mesh_state.get(key)
@@ -1212,6 +1358,8 @@ class EncodeBatcher:
             cls._min_device_bytes = st["min_device_bytes"]
             cls._pinned_min_device_bytes = st["pinned_min_device_bytes"]
             cls._dec_min_device_bytes = st["dec_min_device_bytes"]
+            cls._delta_min_device_bytes = st.get(
+                "delta_min_device_bytes", 0.0)
             cls._dev_bps = dict(st["dev_bps"])
         # first time on this shape: keep the current scalars as the
         # seed (a mesh is at worst as fast as one of its chips)
@@ -1808,6 +1956,390 @@ class EncodeBatcher:
                     cur, dev_pipe * cpu_rate / 2, self.crossover_min)
             elif trust_win and dev_pipe < cpu_pred / 2 and cur > 0:
                 cls._dec_min_device_bytes = min(cur, in_bytes / 2)
+        except Exception:
+            pass                     # learning is best-effort
+
+    # -- parity-delta device pipeline (sub-stripe overwrite RMW) -------
+    def _delta_min_bytes(self) -> float:
+        """The parity-delta crossover threshold.  Delta keeps its own
+        learned value (a delta call moves D dirty columns IN per m
+        parity columns OUT — different transfer economics from both
+        encode and decode), seeded from the encode EWMA until delta
+        groups have taught it anything, same as the decode side."""
+        cls = EncodeBatcher
+        if cls._delta_min_device_bytes > 0:
+            return cls._delta_min_device_bytes
+        return cls._min_device_bytes
+
+    def _route_delta_group(self, key: Tuple,
+                           reqs: List["_DeltaReq"]):
+        """Collect-time routing + dispatch for one parity-delta
+        group.  Returns the completion-queue handle:
+
+        * ``("deltadev", handles, t_disp, in_bytes)`` — async device
+          dispatch in flight (joined by _complete_group_delta_dev);
+        * ``"delta_cpu"`` — routed to (or falling back on) the CPU
+          twin's delta_parity."""
+        impl = reqs[0].ec_impl
+        sup = getattr(impl, "delta_async_supported", None)
+        if sup is None or \
+                not hasattr(impl, "delta_encode_batch_async"):
+            return "delta_cpu"
+        try:
+            if not sup():
+                return "delta_cpu"
+        except Exception:
+            return "delta_cpu"
+        to_cpu = self._route_to_cpu_delta(key, reqs)
+        if not to_cpu and self._breaker_blocks():
+            to_cpu = True
+        self._note_route_delta(key, reqs, to_cpu)
+        if to_cpu:
+            return "delta_cpu"
+        handle = self._dispatch_group_delta(key, reqs)
+        if handle is None:
+            return "delta_cpu"       # dispatch failed: twin serves
+        return ("deltadev",) + handle
+
+    def _route_to_cpu_delta(self, key: Tuple,
+                            reqs: List["_DeltaReq"]) -> bool:
+        """_route_to_cpu with the delta-side crossover: same
+        pin/idle-probe/tick-probe ladder (shared probe cadence and
+        idle clocks), judged against _delta_min_bytes() over the
+        group's dirty-column input bytes."""
+        if not self.adaptive_cpu:
+            self._route_reason = "device"
+            return False
+        thr = self._delta_min_bytes()
+        if thr <= 0:
+            self._route_reason = "device"
+            return False
+        total = sum(r.nbytes for r in reqs)
+        if total >= thr:
+            self._route_reason = "device"
+            return False
+        cls = EncodeBatcher
+        if 0 < cls._pinned_min_device_bytes and \
+                thr <= cls._pinned_min_device_bytes:
+            self._route_reason = "pin"
+            return True
+        now = time.monotonic()
+        if self.idle_reprobe_s > 0 and \
+                now - cls._last_device_ts > self.idle_reprobe_s and \
+                now - cls._last_idle_probe_ts > self.idle_reprobe_s:
+            cls._last_idle_probe_ts = now
+            self._route_reason = "idle_probe"
+            return False
+        cls._probe_tick += 1
+        blocked = cls._probe_tick % self.probe_interval != 0
+        self._route_reason = "learned" if blocked else "tick_probe"
+        return blocked
+
+    def _note_route_delta(self, key: Tuple, reqs: List["_DeltaReq"],
+                          to_cpu: bool) -> None:
+        """Publish one delta routing verdict (delta_route_* counter
+        + flight-recorder event).  Collector thread only."""
+        reason = self._route_reason or \
+            ("learned" if to_cpu else "device")
+        self._route_reason = None
+        if self.dperf is not None and \
+                f"delta_route_{reason}" in self.dperf._types:
+            self.dperf.inc(f"delta_route_{reason}")
+        rec = self.recorder
+        if rec is not None:
+            rec.note("delta_route", reason=reason,
+                     to="cpu" if to_cpu else "device",
+                     bytes=sum(r.nbytes for r in reqs),
+                     reqs=len(reqs),
+                     dirty_cols=len(key[2]),
+                     crossover=int(self._delta_min_bytes()))
+
+    def _dispatch_group_delta(self, key: Tuple,
+                              reqs: List["_DeltaReq"]):
+        """Issue the async device delta-matmul for one (geometry,
+        dirty-column signature) group: concat every request's
+        [nstripes, D, chunk] delta stack and dispatch tile-by-tile
+        through delta_encode_batch_async (prewarmed compiled shape,
+        StagingPool staging, full seven-phase ledger).  Returns
+        (handles, t_disp, in_bytes) or None on dispatch failure."""
+        t_form = time.monotonic()
+        self._account_queue_wait(reqs, t_form)
+        cols = key[2]
+        try:
+            arrs = [r.as_array(len(cols)) for r in reqs]
+            if len(arrs) > 1:
+                batch = np.concatenate(arrs, axis=0)
+                self._note_copy(batch.nbytes,
+                                "batcher.delta_batch_concat")
+            else:
+                batch = np.asarray(arrs[0])
+        except Exception:
+            # malformed request payload: NOT a device fault (must not
+            # trip the breaker) — the twin path fails the bad rider
+            # per-request and still serves its group-mates
+            return None
+        in_bytes = batch.nbytes
+        tile = max(1, self.max_stripes)
+        handles = None
+        delay = self.device_retry_s
+        for attempt in range(3):
+            try:
+                faultlib.registry().hit(faultlib.DEVICE_DISPATCH)
+                handles = [
+                    reqs[0].ec_impl.delta_encode_batch_async(
+                        batch[i:i + tile], cols)
+                    for i in range(0, batch.shape[0], tile)]
+                break
+            except Exception:
+                handles = None
+                if attempt < 2 and delay > 0:
+                    time.sleep(min(delay, 0.1))
+                    delay *= 2
+        if handles is None:
+            self._device_failure("dispatch")
+            return None
+        t_disp = time.monotonic()
+        EncodeBatcher._last_device_ts = t_disp
+        self.stage_seconds["batch_form"] += t_disp - t_form
+        if self.bperf is not None:
+            self.bperf.hinc("batch_stripes", batch.shape[0])
+            self.bperf.inc("h2d_bytes", in_bytes)
+        for r in reqs:
+            if r.tracked is not None:
+                r.tracked.mark_event("ec:delta_dispatched")
+        return (handles, t_disp, in_bytes)
+
+    def _complete_group_delta_twin(self, key: Tuple,
+                                   reqs: List["_DeltaReq"]) -> None:
+        """Coalesced device-free Δparity: the whole group's delta
+        stripes go through ONE CodecCore.delta_parity call on the
+        CPU twin (native GF kernels when available) — the coalescing
+        win survives CPU routing, like _complete_group_cpu."""
+        t_form = time.monotonic()
+        t_wall = time.time()
+        self._account_queue_wait(reqs, t_form)
+        cols = key[2]
+        k = reqs[0].ec_impl.get_data_chunk_count()
+        parity = None
+        arrs = None
+        try:
+            twin = self.cpu_twin(reqs[0].ec_impl, reqs[0].sinfo)
+            arrs = [r.as_array(len(cols)) for r in reqs]
+            if len(arrs) > 1:
+                batch = np.concatenate(arrs, axis=0)
+                self._note_copy(batch.nbytes,
+                                "batcher.delta_batch_concat")
+            else:
+                batch = np.asarray(arrs[0])
+            parity = twin.core.delta_parity(
+                np.asarray(batch, dtype=np.uint8), cols)
+        except Exception:
+            parity = None
+        if parity is None:
+            # twin trouble: per-request fallback (still device-free)
+            for r in reqs:
+                try:
+                    out = self._delta_inline(r.ec_impl, r.sinfo,
+                                             r.delta, cols)
+                except Exception:
+                    self._cb_error()
+                    out = None
+                self.delta_reqs += 1
+                self.delta_cpu_reqs += 1
+                try:
+                    r.done = True
+                    r.cb(out)
+                except Exception:
+                    self._cb_error()
+            return
+        self.delta_calls += 1
+        self.cpu_calls += 1
+        self.delta_cpu_reqs += len(reqs)
+        self.stage_seconds["device"] += time.monotonic() - t_form
+        # twin groups still fold into the device waterfall: coarse
+        # two-stamp host-lane ledger, same idiom as the encode twin
+        t_done = time.time()
+        self._observe_device_ledger(
+            {"stage_acquire": t_wall, "compute_start": t_wall,
+             "compute_done": t_done, "deliver": t_done,
+             "device": -1, "bytes": int(sum(r.nbytes for r in reqs)),
+             "stripes": int(sum(r.nstripes for r in reqs)),
+             "group": "delta"})
+        if self.bperf is not None:
+            self.bperf.hinc("batch_stripes",
+                            sum(r.nstripes for r in reqs))
+            self.bperf.inc("cpu_reqs", len(reqs))
+            if len(reqs) > 1:
+                self.bperf.inc("coalesced_reqs", len(reqs))
+        if len(reqs) > 1:
+            self.delta_coalesced += len(reqs)
+        self._deliver_delta(reqs, parity, k)
+
+    def _complete_group_delta_dev(self, key: Tuple,
+                                  reqs: List["_DeltaReq"], handle,
+                                  trust_win: bool = True) -> None:
+        """Join one in-flight device delta group: harvest the
+        seven-phase ledgers, fold h2d samples into the link EWMA,
+        teach the delta crossover, and split the [B, m, chunk]
+        Δparity stack back to each rider.  Device trouble falls the
+        WHOLE group back to the batched CPU twin — zero client
+        errors."""
+        _tag, handles, t_disp, in_bytes = handle
+        k = reqs[0].ec_impl.get_data_chunk_count()
+        parity = None
+        dev_time = None
+        out_bytes = 0
+        try:
+            faultlib.registry().hit(faultlib.DEVICE_COMPLETION)
+            parts = [np.asarray(h.wait()) for h in handles]
+            parity = parts[0] if len(parts) == 1 \
+                else np.concatenate(parts, axis=0)
+            out_bytes = parity.nbytes
+            dev_time = time.monotonic() - t_disp
+            self._device_success()
+            for h in handles:
+                hb = getattr(h, "h2d_bytes", 0)
+                hs = getattr(h, "h2d_seconds", 0.0)
+                if hb and hs > 0:
+                    bps = hb / hs
+                    EncodeBatcher._h2d_bps = bps \
+                        if EncodeBatcher._h2d_bps <= 0 else (
+                            0.7 * EncodeBatcher._h2d_bps + 0.3 * bps)
+        except Exception:
+            parity = None
+            self._device_failure("completion")
+        if parity is None:
+            self._complete_group_delta_twin(key, reqs)
+            return
+        if self.adaptive_cpu:
+            self._learn_crossover_delta(key, reqs, dev_time,
+                                        in_bytes, out_bytes,
+                                        trust_win=trust_win)
+        self.delta_calls += 1
+        if len(reqs) > 1:
+            self.delta_coalesced += len(reqs)
+        if self.perf is not None:
+            self.perf.inc("ec_delta_batch_calls")
+            if len(reqs) > 1:
+                self.perf.inc("ec_delta_batch_coalesced", len(reqs))
+        # fenced-window stage split, same link-rate model as decode
+        h2d_s = d2h_s = 0.0
+        if self._h2d_bps > 0:
+            h2d_s = min(dev_time, in_bytes / self._h2d_bps)
+            d2h_s = min(dev_time - h2d_s, out_bytes / self._h2d_bps)
+        self.stage_seconds["h2d"] += h2d_s
+        self.stage_seconds["d2h"] += d2h_s
+        self.stage_seconds["device"] += max(
+            0.0, dev_time - h2d_s - d2h_s)
+        if self.bperf is not None:
+            self.bperf.hinc("dispatch_ms", dev_time * 1e3)
+            self.bperf.inc("d2h_bytes", out_bytes)
+            self.bperf.inc("device_reqs", len(reqs))
+            if len(reqs) > 1:
+                self.bperf.inc("coalesced_reqs", len(reqs))
+        for h in handles:
+            leds = getattr(h, "ledgers", None) or \
+                [getattr(h, "ledger", None)]
+            for led in leds:
+                if led is not None:
+                    led["group"] = "delta"
+                self._observe_device_ledger(led)
+        self._publish_device_telemetry(reqs[0].ec_impl)
+        self._deliver_delta(reqs, parity, k)
+
+    def _deliver_delta(self, reqs: List["_DeltaReq"],
+                       parity: np.ndarray, k: int) -> None:
+        """Split a [B, m, chunk] Δparity stack back per rider and
+        fire callbacks with {parity_shard_index: Δparity bytes}.
+        The per-parity column gathers are the one unavoidable copy
+        (the stack interleaves shards) — the memoryviews then ride
+        by reference into the xor_write sub-transactions."""
+        m = parity.shape[1]
+        off = 0
+        copied = 0
+        for r in reqs:
+            p = parity[off:off + r.nstripes]
+            off += r.nstripes
+            out = {}
+            for j in range(m):
+                src = p[:, j]
+                col = np.ascontiguousarray(src)
+                if col is not src:
+                    copied += col.nbytes
+                out[k + j] = memoryview(col).cast("B")
+            self.delta_reqs += 1
+            try:
+                r.done = True
+                r.cb(out)
+            except Exception:
+                self._cb_error()
+        if copied:
+            self._note_copy(copied, "batcher.delta_shard_gather")
+
+    def _cpu_rate_delta(self, key: Tuple,
+                        reqs: List["_DeltaReq"]) -> float:
+        """CPU twin Δparity throughput for this geometry (bytes of
+        dirty-column input per second), measured once on real data;
+        shared process-wide like _cpu_rate.  One bucket per geometry
+        (not per dirty signature): the GF matmul's bytes/s is nearly
+        independent of D — compute and input both scale with D."""
+        rk = ("delta", key[1])
+        rate = EncodeBatcher._cpu_bps.get(rk)
+        if rate is None:
+            r = reqs[0]
+            cols = key[2]
+            try:
+                twin = self.cpu_twin(r.ec_impl, r.sinfo)
+                arr = np.asarray(r.as_array(len(cols)),
+                                 dtype=np.uint8)
+                t0 = time.monotonic()
+                twin.core.delta_parity(arr, cols)
+                dt = max(time.monotonic() - t0, 1e-6)
+                rate = r.nbytes / dt
+            except Exception:
+                # no twin: fall back to the encode-side measurement
+                # (same matmul cost model) rather than guessing
+                rate = EncodeBatcher._cpu_bps.get(key[1], 0.0)
+            EncodeBatcher._cpu_bps[rk] = rate
+        return rate
+
+    def _learn_crossover_delta(self, key: Tuple,
+                               reqs: List["_DeltaReq"],
+                               dev_time: float, in_bytes: int,
+                               out_bytes: int,
+                               trust_win: bool = True) -> None:
+        """_learn_crossover for delta groups: same pipelined cost
+        model (max of the h2d/compute/d2h legs vs the CPU twin's
+        prediction) and compile/outlier rejection, moving the
+        DELTA-side threshold and its own per-geometry device-rate
+        EWMA bucket."""
+        try:
+            cls = EncodeBatcher
+            rk = ("delta", key[1])
+            cpu_rate = max(self._cpu_rate_delta(key, reqs), 1.0)
+            cpu_pred = in_bytes / cpu_rate
+            h2d_s = d2h_s = 0.0
+            if cls._h2d_bps > 0:
+                h2d_s = min(dev_time, in_bytes / cls._h2d_bps)
+                d2h_s = min(max(0.0, dev_time - h2d_s),
+                            out_bytes / cls._h2d_bps)
+            compute_s = max(0.0, dev_time - h2d_s - d2h_s)
+            rate = cls._dev_bps.get(rk, 0.0)
+            if rate > 0 and compute_s > 5.0 * (in_bytes / rate) \
+                    and compute_s > 1e-3:
+                return               # compile/stall outlier
+            if compute_s > 0:
+                bps = in_bytes / compute_s
+                cls._dev_bps[rk] = bps if rate <= 0 else (
+                    0.7 * rate + 0.3 * bps)
+            dev_pipe = max(h2d_s, compute_s, d2h_s) \
+                if (h2d_s or d2h_s) else dev_time
+            cur = self._delta_min_bytes()
+            if dev_pipe > cpu_pred:
+                cls._delta_min_device_bytes = max(
+                    cur, dev_pipe * cpu_rate / 2, self.crossover_min)
+            elif trust_win and dev_pipe < cpu_pred / 2 and cur > 0:
+                cls._delta_min_device_bytes = min(cur, in_bytes / 2)
         except Exception:
             pass                     # learning is best-effort
 

@@ -127,6 +127,11 @@ class _WriteOp:
         self.acked_segs: Dict[int, Set[int]] = {}
         self.sent_subwrites: Dict[Tuple[int, int], Tuple] = {}
         self.deadline_timer = None
+        # parity-delta RMW (sub-stripe overwrite): read plan while the
+        # dirty columns' old chunks are in flight, then the lowered
+        # txn plan (cols, new dirty-column bytes, chunk_off, Δparity)
+        self.delta_pending: Optional[Tuple] = None
+        self.delta_txn: Optional[Tuple] = None
 
 
 class _ReadOp:
@@ -192,6 +197,34 @@ class ECBackend(PGBackend):
             # stripes
             self.seg_bytes = max(stripe_width,
                                  seg - seg % stripe_width)
+        # parity-delta RMW (sub-stripe overwrites): GF(2^8) linearity
+        # gives new_parity = old_parity ^ M[:, dirty]·(new ^ old), so
+        # a small overwrite of committed stripes reads back ONLY the
+        # dirty data columns, device-computes the Δparity once on the
+        # primary (osd/batcher.py submit_delta), and ships parity
+        # shards an xor_write the store applies against the committed
+        # parity.  Clean data shards carry metadata only.
+        # (no allows_overwrites gate here: the PG rejects partial
+        # overwrites on non-overwrite pools long before submit, and
+        # the flag may flip after this backend was built)
+        try:
+            dn = host.conf["osd_ec_delta_rmw"]
+        except (AttributeError, KeyError, TypeError):
+            dn = True
+        self.delta_rmw = bool(dn)
+        # dirty-column fraction above which the full re-encode wins
+        # (most of the stripe comes back anyway, and one plain encode
+        # beats read+delta at that point)
+        try:
+            frac = host.conf["osd_ec_delta_rmw_max_dirty"]
+        except (AttributeError, KeyError, TypeError):
+            frac = 0.5
+        self.delta_max_dirty = float(frac)
+        self.delta_rmw_ops = 0           # ops lowered to Δparity
+        self.delta_rmw_fallbacks = 0     # eligible, but a dirty-shard
+                                         # read failed -> full path
+        self.rmw_full_ops = 0            # read-back ops on full path
+        self.delta_dirty_census: Dict[int, int] = {}   # D -> op count
         # write pipeline queues (reference ECBackend.cc:2151)
         self.waiting_commit: Dict[int, _WriteOp] = {}
         self.in_flight_reads: Dict[int, _ReadOp] = {}
@@ -470,7 +503,11 @@ class ECBackend(PGBackend):
         # accompanying truncate will discard don't count (writefull)
         existing_end = min(info.size, astart + alen)
         if mut.truncate is not None:
-            existing_end = min(existing_end, max(lo, mut.truncate))
+            # the truncate applies BEFORE the writes (pg.py projects
+            # sizes the same way): bytes at/above it are discarded and
+            # must not be read back — including bytes BELOW the write
+            # start, which become zeros, not resurrected stale data
+            existing_end = min(existing_end, mut.truncate)
         if existing_end <= astart or \
                 self._fully_covers(mut.writes, astart, existing_end) \
                 or self._overlay_covers(op.oid, astart, existing_end,
@@ -481,6 +518,9 @@ class ECBackend(PGBackend):
             # 1891-1920: in-flight extents served from cache)
             self._reads_to_commit(op)
             return
+        if self._try_delta_rmw(op, lo, hi, astart, alen):
+            return
+        self.rmw_full_ops += 1
         op.to_read = (astart, existing_end - astart)
         if mut.tracked_op is not None:
             mut.tracked_op.mark_event("ec:rmw_read")
@@ -505,6 +545,183 @@ class ECBackend(PGBackend):
             if pos >= hi:
                 return True
         return pos >= hi
+
+    # -- parity-delta RMW (sub-stripe overwrite) -----------------------
+    def _try_delta_rmw(self, op: _WriteOp, lo: int, hi: int,
+                       astart: int, alen: int) -> bool:
+        """Sub-stripe overwrite fast path.  Eligible when the mutation
+        is a plain tracked write entirely inside committed stripes, no
+        earlier in-flight write overlaps the extent (those bytes are
+        not on shards yet — the overlay algebra stays on the full
+        path), the dirty-column fraction is small enough, and every
+        dirty column's shard is up (the old bytes are read verbatim,
+        never reconstructed — reconstruction is the full path's job).
+        Returns True when the delta read plan was started."""
+        mut = op.mutation
+        if not self.delta_rmw or not op.tracked:
+            return False                 # barriers keep the full path
+        if hi > op.committed_size:
+            return False                 # extends the object: stripes
+                                         # beyond committed aren't on
+                                         # shards yet
+        batcher = getattr(self.host, "encode_batcher", None)
+        if batcher is None or \
+                not hasattr(self.ec_impl, "delta_encode_batch_async"):
+            return False
+        st = self._pending_objs.get(op.oid)
+        if st is not None:
+            for seq, off, data in st["writes"]:
+                if seq < op.seq and off < astart + alen \
+                        and off + len(data) > astart:
+                    return False
+        cols = self._dirty_columns(mut.writes, astart, alen)
+        if not cols or len(cols) > self.k * self.delta_max_dirty:
+            return False                 # dirty majority: re-encode
+        acting = {s: o for s, o in self.host.acting_shards()
+                  if o is not None}
+        if any(c not in acting for c in cols):
+            return False
+        chunk_off = \
+            self.sinfo.aligned_logical_offset_to_chunk_offset(astart)
+        chunk_len = self.sinfo \
+            .aligned_logical_offset_to_chunk_offset(astart + alen) \
+            - chunk_off
+        op.delta_pending = (astart, alen, hi, cols, chunk_off,
+                            chunk_len)
+        self.delta_rmw_ops += 1
+        self.delta_dirty_census[len(cols)] = \
+            self.delta_dirty_census.get(len(cols), 0) + 1
+        if mut.tracked_op is not None:
+            mut.tracked_op.mark_event("ec:rmw_delta_read")
+        self._start_read(
+            op.oid, chunk_off, chunk_len,
+            {c: acting[c] for c in cols},
+            lambda received, errors:
+                self._delta_read_done(op, received, errors),
+            trace=(mut.trace_id, mut.parent_span_id))
+        return True
+
+    def _dirty_columns(self, writes: List[Tuple[int, bytes]],
+                       astart: int, alen: int) -> Tuple[int, ...]:
+        """Data columns (chunk indices) any write byte lands in,
+        across every stripe row of the aligned extent."""
+        W = self.sinfo.stripe_width
+        cs = self.sinfo.chunk_size
+        cols: Set[int] = set()
+        for off, data in writes:
+            w_lo = max(off, astart)
+            w_hi = min(off + len(data), astart + alen)
+            if w_lo >= w_hi:
+                continue
+            for r in range((w_lo - astart) // W,
+                           (w_hi - astart + W - 1) // W):
+                s0 = astart + r * W
+                l = max(w_lo, s0)
+                h = min(w_hi, s0 + W)
+                cols.update(range((l - s0) // cs,
+                                  (h - s0 + cs - 1) // cs))
+                if len(cols) >= self.k:
+                    return tuple(range(self.k))
+        return tuple(sorted(cols))
+
+    def _delta_read_done(self, op: _WriteOp,
+                         received: Dict[int, bytes],
+                         errors: Dict[int, int]) -> None:
+        """Old dirty-column chunks arrived: build the XOR delta in
+        column space and hand it to the batcher's delta lane (ONE
+        GF delta-matmul per coalesced batch on the device)."""
+        if not op.alive:
+            return
+        astart, alen, hi, cols, chunk_off, chunk_len = op.delta_pending
+        batcher = getattr(self.host, "encode_batcher", None)
+        if batcher is None or errors or \
+                any(len(received.get(c, b"")) != chunk_len
+                    for c in cols):
+            # a dirty shard couldn't serve its old chunk verbatim:
+            # reconstruct through the ordinary full-stripe read-back
+            # instead — correctness never rides the fast path
+            self._delta_fallback(op)
+            return
+        import numpy as np
+        cs = self.sinfo.chunk_size
+        W = self.sinfo.stripe_width
+        nrows = alen // W
+        old = np.stack(
+            [np.frombuffer(received[c], dtype=np.uint8)
+             .reshape(nrows, cs) for c in cols], axis=1)
+        new = old.copy()
+        copytrack.note_copy(old.nbytes, "ecbackend.delta_stage")
+        colidx = {c: i for i, c in enumerate(cols)}
+        for off, data in op.mutation.writes:
+            w_lo = max(off, astart)
+            w_hi = min(off + len(data), astart + alen)
+            if w_lo >= w_hi:
+                continue
+            src = np.frombuffer(data, dtype=np.uint8)
+            for r in range((w_lo - astart) // W,
+                           (w_hi - astart + W - 1) // W):
+                s0 = astart + r * W
+                for c in cols:
+                    c0 = s0 + c * cs
+                    l = max(w_lo, c0)
+                    h = min(w_hi, c0 + cs)
+                    if l < h:
+                        new[r, colidx[c], l - c0:h - c0] = \
+                            src[l - off:h - off]
+        delta = old
+        delta ^= new                     # in place: old is dead after
+        new_cols = {
+            c: memoryview(np.ascontiguousarray(new[:, i])).cast("B")
+            for i, c in enumerate(cols)}
+        op.delta_pending = (astart, hi, cols, new_cols, chunk_off)
+        if op.mutation.tracked_op is not None:
+            op.mutation.tracked_op.mark_event("ec:encode_queued")
+        batcher.submit_delta(
+            self.ec_impl, self.sinfo, delta, cols,
+            lambda dp: self._delta_encode_done(op, dp),
+            tracked=op.mutation.tracked_op)
+
+    def _delta_fallback(self, op: _WriteOp) -> None:
+        """Delta read failed (dirty shard down/short mid-flight): take
+        the ordinary reconstructing read-back, which decodes the
+        extent from any k shards."""
+        astart, alen = op.delta_pending[0], op.delta_pending[1]
+        op.delta_pending = None
+        self.delta_rmw_fallbacks += 1
+        self.rmw_full_ops += 1
+        mut = op.mutation
+        info = op.obj_info or ObjectInfo()
+        existing_end = min(info.size, astart + alen)
+        op.to_read = (astart, existing_end - astart)
+        if mut.tracked_op is not None:
+            mut.tracked_op.mark_event("ec:rmw_read")
+        self.objects_read(
+            op.oid, astart,
+            min(existing_end, op.committed_size) - astart,
+            lambda res, data: self._rmw_read_done(op, res, data),
+            trace=(mut.trace_id, mut.parent_span_id))
+
+    def _delta_encode_done(self, op: _WriteOp,
+                           dparity: Optional[Dict[int, bytes]]) -> None:
+        """Continuation from the batcher's collector thread with the
+        Δparity chunk map {k+j: bytes}: re-enter under the PG lock and
+        queue for the ORDERED send (same contract as _encode_done)."""
+        lock = getattr(self.host, "lock", None)
+        if lock is None:
+            import contextlib
+            lock = contextlib.nullcontext()
+        with lock:
+            if not op.alive:
+                return
+            if op.mutation.tracked_op is not None:
+                op.mutation.tracked_op.mark_event("ec:encoded")
+            if dparity is None:          # delta failed even inline: EIO
+                self._fail_op(op, -5)
+                return
+            op.delta_txn = op.delta_pending + (dparity,)
+            op.delta_pending = None
+            op.state = op.ENCODED
+            self._flush_ready()
 
     def _rmw_read_done(self, op: _WriteOp, res: int,
                        data: bytes) -> None:
@@ -636,7 +853,10 @@ class ECBackend(PGBackend):
                     break            # mid-op: later ops must wait
                 continue
             op.state = op.SENT
-            if op.encoded is not None:
+            if op.delta_txn is not None:
+                txns = self._generate_transactions(
+                    op, delta_plan=op.delta_txn)
+            elif op.encoded is not None:
                 astart, hi, chunks = op.encoded
                 txns = self._generate_transactions(
                     op, write_plan=(astart, hi, chunks))
@@ -852,7 +1072,8 @@ class ECBackend(PGBackend):
     def _generate_transactions(self, op: _WriteOp,
                                write_plan: Optional[Tuple] = None,
                                hinfo: Optional[ecutil.HashInfo] = None,
-                               chunk_off: Optional[int] = None
+                               chunk_off: Optional[int] = None,
+                               delta_plan: Optional[Tuple] = None
                                ) -> Dict[int, Transaction]:
         """Lower the logical mutation to per-shard store transactions
         (reference ECTransaction::generate_transactions ->
@@ -862,7 +1083,15 @@ class ECBackend(PGBackend):
         segment of a pipelined op, ``hinfo`` is the caller-maintained
         running HashInfo (already folded through every segment) and
         ``chunk_off`` the final segment's shard offset, while
-        write_plan keeps the whole-op bounds so sizes stay right."""
+        write_plan keeps the whole-op bounds so sizes stay right.
+        ``delta_plan`` is (astart, hi, cols, new_cols, chunk_off,
+        dparity) for a parity-delta RMW: dirty data shards get their
+        new column bytes as a plain write, parity shards get an
+        ``xor_write`` the store XORs into the committed parity chunk
+        (WAL-backed stores replay it crash-safe), clean data shards
+        carry metadata only.  The wire format does not change — the
+        sub-write is a normal MOSDECSubOpWrite whose transaction
+        happens to hold xor_write ops."""
         mut, oid = op.mutation, op.oid
         txns: Dict[int, Transaction] = {
             shard: Transaction()
@@ -918,14 +1147,57 @@ class ECBackend(PGBackend):
             for_all(lambda s, t, o, c:
                     t.setattr(c, o, SS_ATTR, mut.snapset))
 
-        if mut.writes:
+        if mut.truncate is not None:
+            # logical truncate: shards trim to the per-shard size; any
+            # stale bytes inside the final partial stripe stay hidden
+            # behind ObjectInfo.size (reads trim, RMW re-encodes whole
+            # stripes from the logical content).  The truncate op is
+            # emitted BEFORE any accompanying write — the store
+            # applies ops in order, and the truncate logically
+            # precedes the writes (pg.py projects sizes the same
+            # way), so it must never chop bytes the write just put
+            # past it.  The writes branch below folds the write end
+            # into new_size.
+            new_size = mut.truncate
+            shard_sz = self.sinfo.object_size_to_shard_size(new_size)
+            for_all(lambda s, t, o, c: t.truncate(c, o, shard_sz))
+            if not mut.writes:
+                # pure truncate invalidates cumulative CRCs (the
+                # write path below refreshes/clears them otherwise)
+                cleared = ecutil.HashInfo(self.k + self.m).encode()
+                for_all(lambda s, t, o, c:
+                        t.setattr(c, o, ecutil.HINFO_KEY, cleared))
+
+        if mut.writes and delta_plan is not None:
+            # ★ parity-delta RMW: the device computed only
+            # M[:, dirty]·Δdata — parity shards apply it with a store
+            # XOR, clean data shards move no data at all
+            astart, hi, cols, new_cols, dchunk_off, dparity = \
+                delta_plan
+            new_size = max(new_size, hi)
+            dhinfo = self._update_hinfo(oid, {}, dchunk_off, False)
+            henc = dhinfo.encode()       # overwrite: CRCs unknowable
+            for shard, txn in txns.items():
+                obj = GHObject(oid, shard)
+                coll = self.host.coll_of(shard)
+                if shard in new_cols:
+                    txn.write(coll, obj, dchunk_off, new_cols[shard])
+                elif shard in dparity:
+                    txn.xor_write(coll, obj, dchunk_off,
+                                  dparity[shard])
+                txn.setattr(coll, obj, ecutil.HINFO_KEY, henc)
+        elif mut.writes:
             assert write_plan is not None, \
                 "writes with data must arrive pre-encoded"
             # ★ the batched encode already happened: one [nstripes, k,
             # chunk] device call in the OSD batcher, shared with
             # concurrent ops from other PGs
             astart, hi, chunks = write_plan
-            new_size = max(info.size, hi)
+            # when a truncate rides along it applied first: the final
+            # size is the write end over the truncated base, never the
+            # pre-truncate size
+            new_size = max(new_size if mut.truncate is not None
+                           else info.size, hi)
             if chunk_off is None:
                 chunk_off = self.sinfo \
                     .aligned_logical_offset_to_chunk_offset(astart)
@@ -941,21 +1213,6 @@ class ECBackend(PGBackend):
                 coll = self.host.coll_of(shard)
                 txn.write(coll, obj, chunk_off, chunks[shard])
                 txn.setattr(coll, obj, ecutil.HINFO_KEY, henc)
-
-        if mut.truncate is not None:
-            # logical truncate: shards trim to the per-shard size; any
-            # stale bytes inside the final partial stripe stay hidden
-            # behind ObjectInfo.size (reads trim, RMW re-encodes whole
-            # stripes from the logical content)
-            new_size = mut.truncate
-            shard_sz = self.sinfo.object_size_to_shard_size(new_size)
-            for_all(lambda s, t, o, c: t.truncate(c, o, shard_sz))
-            if not mut.writes:
-                # pure truncate invalidates cumulative CRCs (the
-                # write path above already refreshed/cleared them)
-                cleared = ecutil.HashInfo(self.k + self.m).encode()
-                for_all(lambda s, t, o, c:
-                        t.setattr(c, o, ecutil.HINFO_KEY, cleared))
 
         oi = ObjectInfo(size=new_size, version=op.at_version).encode()
         for_all(lambda s, t, o, c: t.setattr(c, o, OI_ATTR, oi))

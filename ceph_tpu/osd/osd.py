@@ -233,6 +233,11 @@ class OSD(Dispatcher):
                       description="batched EC decode calls")
         self.perf.add("ec_dec_batch_coalesced",
                       description="decode requests that shared a call")
+        self.perf.add("ec_delta_batch_calls",
+                      description="batched parity-delta (RMW) device "
+                      "calls")
+        self.perf.add("ec_delta_batch_coalesced",
+                      description="delta requests that shared a call")
         self.perf.add("ec_subwrite_timeouts",
                       description="EC sub-write deadlines expired")
         self.perf.add("ec_subwrite_retries",

@@ -56,7 +56,7 @@ from ..utils.finisher import Finisher
 from .filestore import _BatchView, _objkey, _unobjkey
 from .kv import LogDB, WriteBatch
 from .objectstore import (GHObject, ObjectStat, ObjectStore,
-                          Transaction, check_ops)
+                          Transaction, check_ops, xor_into)
 
 BLOCK = 4096
 # compress only runs of at least this many full blocks (reference
@@ -535,6 +535,32 @@ class BlockStore(ObjectStore):
             ext.size = max(ext.size, end)
             put_ext(coll, obj, ext)
 
+        def xor_extent(coll, obj, offset, data) -> None:
+            """Parity-delta fold: read ONLY the covered blocks
+            (zero-fill holes/EOF, compressed members re-home first),
+            XOR the delta in, then store through the normal COW write
+            path so CRC discipline and crash atomicity are inherited
+            rather than re-implemented."""
+            ensure_obj(coll, obj)
+            ext = get_ext(coll, obj)
+            end = offset + len(data)
+            lb0, lb1 = offset // BLOCK, (end + BLOCK - 1) // BLOCK
+            flatten_range(ext, lb0, lb1)
+            base = bytearray(len(data))
+            pos = offset
+            while pos < end:
+                lb = pos // BLOCK
+                boff = pos % BLOCK
+                run = min(BLOCK - boff, end - pos)
+                if lb < len(ext.blocks) and ext.blocks[lb] >= 0:
+                    blk = read_base_block(ext, lb)
+                    base[pos - offset:pos - offset + run] = \
+                        blk[boff:boff + run]
+                pos += run
+            put_ext(coll, obj, ext)
+            xor_into(base, 0, data)
+            write_extent(coll, obj, offset, base)
+
         for op in ops:
             name = op[0]
             try:
@@ -545,6 +571,9 @@ class BlockStore(ObjectStore):
                 elif name == "write":
                     _, coll, obj, offset, data = op
                     write_extent(coll, obj, offset, data)
+                elif name == "xor_write":
+                    _, coll, obj, offset, data = op
+                    xor_extent(coll, obj, offset, data)
                 elif name == "zero":
                     _, coll, obj, offset, length = op
                     ensure_obj(coll, obj)

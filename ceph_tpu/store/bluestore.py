@@ -390,9 +390,10 @@ class BlueStore(BlockStore):
             return False
         return self._db.get(self._exists_key(coll, obj)) is not None
 
-    _CREATES = frozenset(("touch", "write", "zero", "truncate",
-                          "setattr", "omap_setkeys", "omap_setheader",
-                          "omap_rmkeys", "omap_clear", "rmattr"))
+    _CREATES = frozenset(("touch", "write", "xor_write", "zero",
+                          "truncate", "setattr", "omap_setkeys",
+                          "omap_setheader", "omap_rmkeys", "omap_clear",
+                          "rmattr"))
 
     def _admit_overlay(self, ops, seq: int) -> None:
         """Record the existence outcome of admitted (not yet applied)
@@ -503,7 +504,7 @@ class BlueStore(BlockStore):
                 record = None
             nbytes = len(record) if record is not None else sum(
                 len(op[4]) for op in merged_ops
-                if op[0] == "write")
+                if op[0] in ("write", "xor_write"))
             self._txn_meta("journal_bytes", nbytes)
             self._wal_write(seq, record, nbytes)
             self._stamp_txn("journal_append")

@@ -32,7 +32,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from ..utils.finisher import Finisher
 from .kv import LogDB, WriteBatch
 from .objectstore import (GHObject, ObjectStat, ObjectStore, Transaction,
-                          check_ops)
+                          check_ops, xor_into)
 
 
 def _objkey(obj: GHObject) -> str:
@@ -274,6 +274,21 @@ class FileStore(ObjectStore):
                     fh.write(b"\x00" * (offset - size))
                 fh.seek(offset)
                 fh.write(data)
+        elif name == "xor_write":
+            _, coll, obj, offset, data = op
+            path = self._ensure_obj(coll, obj, ctx)
+            if not os.path.exists(path):
+                open(path, "wb").close()
+            with open(path, "r+b") as fh:
+                size = fh.seek(0, 2)
+                end = offset + len(data)
+                if size < end:
+                    fh.write(b"\x00" * (end - size))
+                fh.seek(offset)
+                cur = bytearray(fh.read(len(data)))
+                xor_into(cur, 0, data)
+                fh.seek(offset)
+                fh.write(cur)
         elif name == "zero":
             _, coll, obj, offset, length = op
             self._apply_op_inner(

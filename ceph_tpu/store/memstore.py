@@ -16,7 +16,7 @@ from typing import Callable, Dict, List, Optional
 
 from ..utils.finisher import Finisher
 from .objectstore import (GHObject, ObjectStat, ObjectStore, Transaction,
-                          check_ops)
+                          check_ops, xor_into)
 
 
 class _Object:
@@ -139,6 +139,14 @@ class MemStore(ObjectStore):
                 self._grow(end - len(o.data))
                 o.data.extend(b"\x00" * (end - len(o.data)))
             o.data[offset:end] = data
+        elif name == "xor_write":
+            _, coll, obj, offset, data = op
+            o = self._obj(coll, obj, create=True)
+            end = offset + len(data)
+            if len(o.data) < end:
+                self._grow(end - len(o.data))
+                o.data.extend(b"\x00" * (end - len(o.data)))
+            xor_into(o.data, offset, data)
         elif name == "zero":
             _, coll, obj, offset, length = op
             o = self._obj(coll, obj, create=True)

@@ -100,6 +100,19 @@ def _opts() -> List[Option]:
                            "encode of segment N+1 overlaps the "
                            "sub-write fanout of segment N (0 disables "
                            "segmentation)"),
+        Option("osd_ec_delta_rmw", bool, True,
+               description="parity-delta RMW for sub-stripe EC "
+                           "overwrites: read back only the dirty data "
+                           "columns, device-compute Δparity = "
+                           "M[:,dirty]·Δdata once on the primary, and "
+                           "apply it on parity shards with a store "
+                           "XOR (false = always full-stripe "
+                           "re-encode)"),
+        Option("osd_ec_delta_rmw_max_dirty", float, 0.5, min=0.0,
+               max=1.0, tunable=True,
+               description="dirty-column fraction above which the "
+                           "delta path yields to the full re-encode "
+                           "(reading most of the stripe back anyway)"),
         Option("ec_tpu_fallback_cpu", bool, True,
                description="CPU bit-plane path when no TPU is present "
                            "(monitors validate profiles without devices)"),

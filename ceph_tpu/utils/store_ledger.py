@@ -85,7 +85,7 @@ PHASE_BOUNDS: List[float] = [
 OP_FAMILIES = ("write", "truncate", "setattr", "omap", "clone",
                "touch", "remove", "other")
 _OP_FAMILY = {
-    "write": "write", "zero": "write",
+    "write": "write", "zero": "write", "xor_write": "write",
     "truncate": "truncate",
     "setattr": "setattr", "setattrs": "setattr", "rmattr": "setattr",
     "omap_setkeys": "omap", "omap_rmkeys": "omap",

@@ -253,6 +253,36 @@ def test_client_resend_on_primary_death(cl):
     assert io.read("pre0") == b"b" * 1000
 
 
+def test_client_resend_on_shard_death_interval_change(cl):
+    """A write caught in flight when a NON-primary acting shard dies
+    must complete via resend-on-interval-change, not hang to the op
+    timeout: the PG discards its in-flight ops on the interval change
+    and relies on the client to resend (reqid dedup makes that
+    exactly-once), but a primary-move-only resend rule never fires —
+    the op wedged until rados_osd_op_timeout (surfaced by the
+    overwrite-heavy chaos profile, ISSUE 20)."""
+    cl.create_ec_profile("eird", plugin="jerasure", k="2", m="1")
+    cl.create_pool("ecird", "erasure", erasure_code_profile="eird")
+    r = cl.rados()
+    io = r.open_ioctx("ecird")
+    io.write_full("tgt", b"a" * 9000)
+    cl.wait_for_clean(20)
+    with r.objecter.lock:
+        osdmap = r.objecter.osdmap
+    pgid = osdmap.object_locator_to_pg("tgt", io.pool_id)
+    _, _, acting, primary = osdmap.pg_to_up_acting_osds(pgid)
+    shard = next(o for o in acting if o is not None and o != primary)
+    # kill the shard and write BEFORE the mon marks it down: the op
+    # wedges on the dead shard's sub-write ack with the primary still
+    # up, so only the interval change can unstick it
+    cl.kill_osd(shard)
+    comp = io.aio_write_full("tgt", b"b" * 9000)
+    cl.wait_for_osd_down(shard)
+    assert comp.wait(30) == 0, \
+        "in-flight write hung across the interval change"
+    assert io.read("tgt") == b"b" * 9000
+
+
 def test_central_config_propagates_to_daemons():
     """`config set` must reach every daemon (reference ConfigMonitor
     -> MConfig): overrides ride map publication and fire the local

@@ -621,3 +621,100 @@ def test_store_gates_run_from_cli(tmp_path, history):
         _store_ladder_rec())))
     r = _run_cli(ok, history)
     assert r.returncode == 0, (r.stdout, r.stderr)
+
+
+def _rmw_rec(sizes=None, delta=None, full_run=None, vs=2.4):
+    return {"metric": "rmw overwrite MB/s (13-OSD k=8 m=4 overwrite "
+                      "pool, 64 aio random chunk-aligned sub-stripe "
+                      "overwrites per size class; value = delta-path "
+                      "4 KiB class, vs_baseline = delta over "
+                      "forced-full at 4 KiB)",
+            "value": 0.5, "unit": "MB/s", "vs_baseline": vs,
+            "sizes": sizes or {
+                "4k": {"delta": 0.48, "full": 0.20, "vs_full": 2.4},
+                "16k": {"delta": 1.9, "full": 0.8, "vs_full": 2.38},
+                "64k": {"delta": 3.1, "full": 3.0, "vs_full": 1.03}},
+            "delta": delta or {
+                "rmw_ops": 130, "full_ops": 70, "fallbacks": 0,
+                "delta_fraction": 0.65,
+                "dirty_census": {"1": 64, "4": 66}},
+            "full_run": full_run or {"rmw_ops": 0, "full_ops": 200}}
+
+
+def test_rmw_floor_per_size(history):
+    """The delta path must hold >= RMW_FLOOR x the forced full-stripe
+    run at EVERY overwrite size of the fresh head-to-head."""
+    rounds = perf_trend.load_history(history)
+    assert not [f for f in
+                perf_trend.check(None, rounds, fresh_rmw=_rmw_rec())
+                if f["check"].startswith("rmw-")]
+    # a size class losing to the full path fails and is named
+    losing = _rmw_rec(sizes={
+        "4k": {"delta": 0.1, "full": 0.2, "vs_full": 0.5},
+        "16k": {"delta": 1.9, "full": 0.8, "vs_full": 2.38}})
+    hits = [f for f in perf_trend.check(None, rounds, fresh_rmw=losing)
+            if f["check"] == "rmw-floor"]
+    assert len(hits) == 1 and "4k" in hits[0]["message"]
+    # exact convergence passes (equality is NOT a regression: the
+    # crossover's worst case is "took the full path")...
+    even = _rmw_rec(sizes={
+        "4k": {"delta": 0.48, "full": 0.20, "vs_full": 2.4},
+        "64k": {"delta": 3.0, "full": 3.0, "vs_full": 1.0}})
+    assert not [f for f in perf_trend.check(None, rounds,
+                                            fresh_rmw=even)
+                if f["check"] == "rmw-floor"]
+    # ...but ANY size class strictly under 1.0 is one
+    under = _rmw_rec(sizes={
+        "64k": {"delta": 2.9, "full": 3.0, "vs_full": 0.967}})
+    assert [f for f in perf_trend.check(None, rounds,
+                                        fresh_rmw=under)
+            if f["check"] == "rmw-floor"]
+    # no rmw record at all: gate self-skips
+    assert not [f for f in perf_trend.check(None, rounds)
+                if f["check"].startswith("rmw-")]
+
+
+def test_rmw_delta_collapse_and_control_leak(history):
+    """A delta run where almost nothing took the delta path compared
+    full vs full (collapse); delta ops in the forced-off control mean
+    the knob leaked — both fail regardless of throughput."""
+    rounds = perf_trend.load_history(history)
+    collapsed = _rmw_rec(delta={
+        "rmw_ops": 3, "full_ops": 197, "fallbacks": 41,
+        "delta_fraction": 0.015, "dirty_census": {"1": 3}})
+    hits = [f for f in perf_trend.check(None, rounds,
+                                        fresh_rmw=collapsed)
+            if f["check"] == "rmw-delta-collapse"]
+    assert len(hits) == 1 and "41" in hits[0]["message"]
+    leaky = _rmw_rec(full_run={"rmw_ops": 55, "full_ops": 145})
+    hits = [f for f in perf_trend.check(None, rounds, fresh_rmw=leaky)
+            if f["check"] == "rmw-control-leak"]
+    assert len(hits) == 1 and "55" in hits[0]["message"]
+
+
+def test_rmw_history_floor_and_cli(tmp_path, history):
+    """vs_baseline is held to ratio_tol x the best rmw-carrying
+    history round (older rounds without one silently skip), and the
+    whole gate runs end to end from the CLI."""
+    with_rmw = history + [_hist_round(tmp_path, 3,
+                                      [_cluster(1.0), _rmw_rec(vs=2.5)])]
+    rounds = perf_trend.load_history(with_rmw)
+    hits = [f for f in
+            perf_trend.check(None, rounds, fresh_rmw=_rmw_rec(vs=1.2))
+            if f["check"] == "rmw-throughput-regression"]
+    assert len(hits) == 1 and "2.500" in hits[0]["message"]
+    assert not [f for f in
+                perf_trend.check(None, rounds, fresh_rmw=_rmw_rec(vs=2.4))
+                if f["check"] == "rmw-throughput-regression"]
+    fresh = tmp_path / "fresh_rmw.json"
+    fresh.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(1.05),
+        _rmw_rec(sizes={"4k": {"vs_full": 0.4}}))))
+    r = _run_cli(fresh, with_rmw)
+    assert r.returncode == 1, (r.stdout, r.stderr)
+    assert "rmw-floor" in r.stdout
+    ok = tmp_path / "fresh_rmw_ok.json"
+    ok.write_text("\n".join(json.dumps(r) for r in (
+        _headline(17.5), _cluster(1.05), _rmw_rec())))
+    r = _run_cli(ok, with_rmw)
+    assert r.returncode == 0, (r.stdout, r.stderr)

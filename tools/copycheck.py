@@ -76,6 +76,13 @@ HOT_MODULES = [
     # structs and the flush must write the SAME block objects it
     # buffered, never a joined copy
     "ceph_tpu/store/bluestore.py",
+    # the parity-delta RMW path (ISSUE 20): Δdata staging in the tpu
+    # plugin (delta_encode_batch_async) and the Δparity hand-back must
+    # stay memoryview discipline end to end — one audited np.stack
+    # builds the old/new column block in ecbackend (copytracked as
+    # ecbackend.delta_stage), and everything after it is views: a
+    # stray bytes() here would double-copy every sub-stripe overwrite
+    "ceph_tpu/ec/plugins/tpu.py",
 ]
 
 # constructs that materialise a full payload copy

@@ -119,6 +119,7 @@ _MESH_ATTRIB_PREFIX = "multichip mesh attribution"
 _LOAD_PREFIX = "open-loop load attribution"
 _SELFTUNE_PREFIX = "closed-loop selftune attribution"
 _STORE_LADDER_PREFIX = "store ladder write MB/s"
+_RMW_PREFIX = "rmw overwrite MB/s"
 _K8M4_MARK = "k=8 m=4"
 
 # defaults, overridable from the CLI
@@ -136,6 +137,20 @@ STORE_LADDER_FLOOR = 0.85  # bluestore MB/s >= floor * blockstore at
 #                            slack absorbs single-process IO noise
 #                            (same spirit as RATIO_TOL), the mean
 #                            ratio in the record stays the headline
+RMW_FLOOR = 1.0            # delta-path MB/s >= floor * forced-full at
+#                            EVERY overwrite size (equality passes:
+#                            the crossover learner's worst case is
+#                            "route to the full path", so losing a
+#                            size outright means the delta path fired
+#                            where it should not have; the >=2x
+#                            small-write win is the record's
+#                            vs_baseline headline)
+RMW_MIN_DELTA_FRACTION = 0.25  # share of RMWs that must actually take
+#                            the delta path in the delta run: 2 of the
+#                            3 size classes are delta-eligible, so a
+#                            fraction under this means eligibility or
+#                            routing collapsed and the bench compared
+#                            full vs full
 
 
 def _records_from_text(text: str) -> List[Dict]:
@@ -229,6 +244,7 @@ def check(attribution: Optional[Dict], history: List[Dict],
           fresh_mesh: Optional[Dict] = None,
           fresh_selftune: Optional[Dict] = None,
           fresh_store_ladder: Optional[Dict] = None,
+          fresh_rmw: Optional[Dict] = None,
           stage_tol: float = STAGE_TOL,
           ratio_tol: float = RATIO_TOL,
           min_device_fraction: float = MIN_DEVICE_FRACTION,
@@ -258,7 +274,10 @@ def check(attribution: Optional[Dict], history: List[Dict],
     tuned>=static every-rung floor and the zero-guard-trip
     re-assert; ``fresh_store_ladder`` the store_ladder config's
     single-store microbench record, feeding the bluestore>=blockstore
-    every-rung floor (ISSUE 17)."""
+    every-rung floor (ISSUE 17); ``fresh_rmw`` the rmw config's
+    delta-vs-forced-full overwrite record, feeding the every-size
+    delta>=full floor, the delta-path routing-collapse check, and the
+    forced-off control-leak assert (ISSUE 20)."""
     findings: List[Dict] = []
 
     # -- async-store top-hop gate (ISSUE 17) --------------------------
@@ -813,6 +832,72 @@ def check(attribution: Optional[Dict], history: List[Dict],
                         f"group_syncs amortization and the apply "
                         f"batch occupancy in the record's "
                         f"store_waterfall)"})
+
+    # -- parity-delta RMW floor + routing collapse (ISSUE 20) ---------
+    # ``fresh_rmw`` carries the rmw config's head-to-head (delta path
+    # vs the SAME plugin forced full-stripe, per overwrite size,
+    # measured in one process).  Three independent failure modes:
+    # the delta path LOSING a size class to the full path it exists
+    # to beat; the delta run silently riding the full path (an
+    # eligibility/routing collapse makes the bench compare full vs
+    # full and the floor check meaningless); and the forced-off
+    # control still taking delta ops (the knob leaked, nothing was
+    # controlled).  A fresh record beating history's best vs_baseline
+    # is additionally held to ratio_tol like every throughput line.
+    if fresh_rmw is not None:
+        for label, row in sorted((fresh_rmw.get("sizes")
+                                  or {}).items()):
+            vf = row.get("vs_full") if isinstance(row, dict) else None
+            if isinstance(vf, (int, float)) and vf < RMW_FLOOR:
+                findings.append({
+                    "check": "rmw-floor", "severity": "fail",
+                    "message":
+                        f"delta-path {label} overwrites at {vf:.3f}x "
+                        f"the forced full-stripe run < {RMW_FLOOR:.2f}"
+                        f" — the parity-delta path lost to the full "
+                        f"re-encode it replaces (check the dirty "
+                        f"census and delta_route_* verdicts in the "
+                        f"record's delta block)"})
+        dblock = fresh_rmw.get("delta") or {}
+        dfrac = dblock.get("delta_fraction")
+        if isinstance(dfrac, (int, float)) \
+                and dfrac < RMW_MIN_DELTA_FRACTION:
+            findings.append({
+                "check": "rmw-delta-collapse", "severity": "fail",
+                "message":
+                    f"only {dfrac:.3f} of RMWs took the delta path "
+                    f"(< {RMW_MIN_DELTA_FRACTION:.2f}) in the "
+                    f"delta-enabled run — eligibility or routing "
+                    f"collapsed ({dblock.get('fallbacks', 0)} "
+                    f"fallbacks, census "
+                    f"{dblock.get('dirty_census')}) and the bench "
+                    f"compared full vs full"})
+        ctrl = (fresh_rmw.get("full_run") or {}).get("rmw_ops")
+        if isinstance(ctrl, (int, float)) and ctrl > 0:
+            findings.append({
+                "check": "rmw-control-leak", "severity": "fail",
+                "message":
+                    f"{int(ctrl)} delta op(s) fired in the "
+                    f"osd_ec_delta_rmw=false control run — the knob "
+                    f"does not gate the path and the comparison "
+                    f"measured nothing"})
+        rr = fresh_rmw.get("vs_baseline")
+        best = None
+        for rnd in history:
+            rec = _pick(rnd["records"], _RMW_PREFIX)
+            if rec and isinstance(rec.get("vs_baseline"),
+                                  (int, float)):
+                v = float(rec["vs_baseline"])
+                best = v if best is None else max(best, v)
+        if isinstance(rr, (int, float)) and best is not None \
+                and rr < ratio_tol * best:
+            findings.append({
+                "check": "rmw-throughput-regression",
+                "severity": "fail",
+                "message":
+                    f"delta-path 4 KiB overwrites at {rr:.3f}x the "
+                    f"forced-full baseline < {ratio_tol:.2f} x best "
+                    f"history {best:.3f}x"})
     return findings
 
 
@@ -830,6 +915,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
     load = _pick(fresh_records, _LOAD_PREFIX)
     selftune = _pick(fresh_records, _SELFTUNE_PREFIX)
     store_ladder = _pick(fresh_records, _STORE_LADDER_PREFIX)
+    rmw = _pick(fresh_records, _RMW_PREFIX)
     ladder = None
     if scaling:
         cl_side = (scaling.get("classic") or {}).get("clients")
@@ -855,6 +941,7 @@ def run(fresh_records: List[Dict], history: List[Dict],
         fresh_rebuild=rebuild, fresh_mesh=mesh,
         fresh_selftune=selftune,
         fresh_store_ladder=store_ladder,
+        fresh_rmw=rmw,
         stage_tol=stage_tol, ratio_tol=ratio_tol,
         min_device_fraction=min_device_fraction,
         hop_p99_factor=hop_p99_factor, overlap_tol=overlap_tol)
